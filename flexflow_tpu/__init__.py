@@ -1,0 +1,37 @@
+"""flexflow_tpu — a TPU-native distributed DNN training framework with the
+capability surface of FlexFlow (reference: dycz0fx/FlexFlow), re-designed
+for JAX/XLA/Pallas/pjit.
+
+The reference's architecture (Legion task runtime + CUDA kernels + a
+custom mapper enforcing per-op MCMC-searched placements) is replaced by:
+graph of ops -> per-op sharding strategies over a jax.sharding.Mesh ->
+one jitted SPMD step with XLA-inserted ICI/DCN collectives -> MCMC search
+over sharding assignments driven by a calibrated cost model.
+"""
+
+from .config import CompMode, FFConfig, FFIterationConfig, ParameterSyncType
+from .model import FFModel
+from .tensor import Parameter, Tensor
+from .core.optimizers import AdamOptimizer, SGDOptimizer
+from .parallel.mesh import MachineSpec, default_mesh, make_mesh
+from .parallel.pconfig import OpStrategy, ParallelConfig, Strategy
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "FFConfig",
+    "FFIterationConfig",
+    "FFModel",
+    "CompMode",
+    "ParameterSyncType",
+    "Tensor",
+    "Parameter",
+    "SGDOptimizer",
+    "AdamOptimizer",
+    "MachineSpec",
+    "default_mesh",
+    "make_mesh",
+    "Strategy",
+    "OpStrategy",
+    "ParallelConfig",
+]

@@ -1,0 +1,198 @@
+"""Runtime configuration for the TPU-native FlexFlow rebuild.
+
+Mirrors the knob surface of the reference `FFConfig` (reference:
+include/config.h:98-154, parse_args src/runtime/model.cc:2258-2379) but
+re-targeted at TPU execution: instead of Legion `-ll:*` resource flags the
+machine is described by a `jax.sharding.Mesh` (see
+:mod:`flexflow_tpu.parallel.mesh`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class CompMode:
+    """Computation mode (reference: ffconst.h COMP_MODE_TRAINING/INFERENCE)."""
+
+    TRAINING = "training"
+    INFERENCE = "inference"
+
+
+class ParameterSyncType:
+    """Kept for API compatibility with the reference (ffconst.h:44-48).
+
+    On TPU both modes lower to XLA collectives chosen by GSPMD; `PS` and
+    `NCCL` differ only in how the reference moved gradients, which has no
+    TPU analog (SURVEY.md section 7, hard part (e)).
+    """
+
+    NONE = "none"
+    PS = "ps"
+    NCCL = "nccl"
+
+
+@dataclasses.dataclass
+class FFIterationConfig:
+    """Per-iteration runtime config (reference: include/config.h:156-161).
+
+    ``seq_length`` truncates sequence-bearing shapes (BatchMatmul /
+    attention) for variable-length batches.
+    """
+
+    seq_length: int = -1
+
+    def reset(self) -> None:
+        self.seq_length = -1
+
+
+@dataclasses.dataclass
+class FFConfig:
+    """All runtime knobs.
+
+    Reference parity (include/config.h:98-154):
+      batchSize -> batch_size, epochs -> epochs, iterations -> iterations,
+      numNodes/workersPerNode -> described by the mesh,
+      learningRate/weightDecay -> lr/weight_decay (consumed by optimizers),
+      search_budget/search_alpha/search_overlap_backward_update ->
+        search_* (consumed by flexflow_tpu.search.mcmc),
+      import_strategy_file/export_strategy_file -> strategy I/O,
+      enable_sample_parallel/parameter_parallel/attribute_parallel ->
+        search-space gates, plus the new TPU-first axes (sequence/expert/
+        pipeline parallel) which the reference lacked (SURVEY.md 2.4).
+    """
+
+    batch_size: int = 64
+    epochs: int = 1
+    iterations: int = 1
+    learning_rate: float = 0.01
+    weight_decay: float = 0.0001
+    seed: int = 0
+
+    # numerics
+    compute_dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    # profiling / debugging
+    profiling: bool = False
+    log_instance_creation: bool = False
+
+    # auto-parallelization (reference: config.h:116-141)
+    search_budget: int = 0
+    search_alpha: float = 0.05
+    search_overlap_backward_update: bool = False
+    import_strategy_file: Optional[str] = None
+    export_strategy_file: Optional[str] = None
+    enable_sample_parallel: bool = True
+    enable_parameter_parallel: bool = False
+    enable_attribute_parallel: bool = False
+    # TPU-first additions: new parallel axes (SURVEY.md section 2.4 calls
+    # these out as absent from the reference and required here).
+    enable_sequence_parallel: bool = False
+    enable_expert_parallel: bool = False
+    enable_pipeline_parallel: bool = False
+    enable_propagation: bool = False
+    machine_model_file: Optional[str] = None
+
+    # fusion (reference: --fusion flag, model.cc:1472)
+    perform_fusion: bool = False
+
+    # remat: trade FLOPs for HBM (no reference analog; TPU-first)
+    remat: bool = False
+
+    # synthetic input when no dataset is provided (reference: config.h:131)
+    synthetic_input: bool = False
+
+    # mesh description: axis names/sizes. None = single device.
+    mesh_shape: Optional[Sequence[int]] = None
+    mesh_axes: Optional[Sequence[str]] = None
+
+    iter_config: FFIterationConfig = dataclasses.field(
+        default_factory=FFIterationConfig
+    )
+
+    # argv to parse at construction; None = don't touch the process argv
+    # (a library must not hijack the host application's flags). Use
+    # FFConfig.from_args() in driver scripts for reference CLI parity.
+    argv: Optional[Sequence[str]] = None
+
+    def __post_init__(self):
+        if self.argv is not None:
+            self.parse_args(self.argv)
+
+    @classmethod
+    def from_args(cls, argv: Optional[Sequence[str]] = None) -> "FFConfig":
+        """Reference-style construction: parse CLI flags
+        (FFConfig::parse_args, model.cc:2258-2379)."""
+        return cls(argv=list(sys.argv[1:]) if argv is None else list(argv))
+
+    # -- CLI parity (reference: FFConfig::parse_args model.cc:2258-2379) --
+    _FLAG_MAP = {
+        "-b": ("batch_size", int),
+        "--batch-size": ("batch_size", int),
+        "-e": ("epochs", int),
+        "--epochs": ("epochs", int),
+        "--iterations": ("iterations", int),
+        "-lr": ("learning_rate", float),
+        "--learning-rate": ("learning_rate", float),
+        "-wd": ("weight_decay", float),
+        "--weight-decay": ("weight_decay", float),
+        "--search-budget": ("search_budget", int),
+        "--budget": ("search_budget", int),
+        "--search-alpha": ("search_alpha", float),
+        "--alpha": ("search_alpha", float),
+        "--import": ("import_strategy_file", str),
+        "--import-strategy": ("import_strategy_file", str),
+        "--export": ("export_strategy_file", str),
+        "--export-strategy": ("export_strategy_file", str),
+        "--machine-model-file": ("machine_model_file", str),
+        "--seed": ("seed", int),
+    }
+    _BOOL_FLAGS = {
+        "--profiling": "profiling",
+        "--fusion": "perform_fusion",
+        "--remat": "remat",
+        "--overlap": "search_overlap_backward_update",
+        "--enable-parameter-parallel": "enable_parameter_parallel",
+        "--enable-attribute-parallel": "enable_attribute_parallel",
+        "--enable-sample-parallel": "enable_sample_parallel",
+        "--enable-sequence-parallel": "enable_sequence_parallel",
+        "--enable-expert-parallel": "enable_expert_parallel",
+        "--enable-pipeline-parallel": "enable_pipeline_parallel",
+        "--enable-propagation": "enable_propagation",
+        "--synthetic-input": "synthetic_input",
+    }
+
+    def parse_args(self, argv: Sequence[str]) -> None:
+        i = 0
+        argv = list(argv)
+        while i < len(argv):
+            a = argv[i]
+            if a in self._FLAG_MAP and i + 1 < len(argv):
+                field, typ = self._FLAG_MAP[a]
+                setattr(self, field, typ(argv[i + 1]))
+                i += 2
+                continue
+            if a in self._BOOL_FLAGS:
+                setattr(self, self._BOOL_FLAGS[a], True)
+                i += 1
+                continue
+            i += 1
+
+    # -- device/mesh introspection --
+    @property
+    def workers_per_node(self) -> int:
+        return jax.local_device_count()
+
+    @property
+    def num_nodes(self) -> int:
+        return jax.process_count()
+
+    @property
+    def num_devices(self) -> int:
+        return jax.device_count()

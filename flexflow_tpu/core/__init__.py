@@ -1,0 +1,4 @@
+"""Training services: initializers, optimizers, losses, metrics, executor,
+dataloader — TPU-native equivalents of reference src/runtime/{initializer,
+optimizer}.cc, src/loss_functions/, src/metrics_functions/,
+python/flexflow_dataloader.cc."""

@@ -1,0 +1,241 @@
+"""Executor: compiles the op graph into jitted train/eval steps.
+
+Replaces the reference's per-iteration Legion machinery (SURVEY.md 3.3):
+forward/zero_gradients/backward/update index launches + begin/end_trace
+become ONE jitted function per step — XLA tracing plays the role Legion
+tracing played (record once, replay thereafter), `jax.grad` replaces the
+hand-written backward tasks, and GSPMD inserts every collective the
+mapper/NCCL layer used to orchestrate.
+
+State layout (all pytrees, shardable):
+  params     {op_name: {weight_name: array}}
+  states     {op_name: {state_name: array}}     (e.g. BN running stats)
+  opt_state  optimizer-specific mirror of params
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..op import Op, OpContext
+from ..tensor import Tensor
+from . import initializers as I
+from . import losses as L
+from . import metrics as M
+from .optimizers import Optimizer
+from ..parallel.pconfig import Strategy
+from ..parallel.sharding import (
+    batch_sharding,
+    op_output_sharding,
+    spec_for_axes,
+    weight_sharding,
+)
+
+
+class TrainState:
+    """Flat container; registered as a pytree for jit/donation."""
+
+    def __init__(self, params, states, opt_state, step):
+        self.params = params
+        self.states = states
+        self.opt_state = opt_state
+        self.step = step
+
+    def tree_flatten(self):
+        return (self.params, self.states, self.opt_state, self.step), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
+
+
+class Executor:
+    def __init__(self, model, optimizer: Optimizer, loss_fn, metric_names,
+                 mesh: Optional[Mesh] = None,
+                 strategy: Optional[Strategy] = None):
+        self.model = model
+        self.config = model.config
+        self.optimizer = optimizer
+        self.loss_fn = L.resolve(loss_fn) if loss_fn is not None else None
+        self.loss_name = loss_fn if isinstance(loss_fn, str) else "custom"
+        self.metric_names = list(metric_names or [])
+        self.mesh = mesh
+        self.strategy = strategy or Strategy()
+        self._train_step = None
+        self._eval_step = None
+
+    # ---------------- initialization ----------------
+    def init_state(self, rng) -> TrainState:
+        """Create params/states with per-parameter folded keys, sharded
+        per strategy. Replaces reference initializer index launches
+        (initializer.cc) + optimizer->init replicas (optimizer.cc:22-41)."""
+        params: Dict[str, Dict[str, jax.Array]] = {}
+        states: Dict[str, Dict[str, jax.Array]] = {}
+        for op in self.model.ops:
+            wspecs = op.weight_specs()
+            if wspecs:
+                op_params = {}
+                for wname, spec in wspecs.items():
+                    key = jax.random.fold_in(
+                        jax.random.fold_in(rng, _stable_hash(op.name)),
+                        _stable_hash(wname))
+                    init_fn = spec.custom_init or I.resolve(spec.initializer)
+                    arr = init_fn(key, spec.shape, spec.dtype)
+                    if self.mesh is not None:
+                        sh = weight_sharding(
+                            spec, self.strategy.for_op(op.name), self.mesh)
+                        arr = jax.device_put(arr, sh)
+                    op_params[wname] = arr
+                params[op.name] = op_params
+            sspecs = op.state_specs()
+            if sspecs:
+                op_states = {}
+                for sname, sspec in sspecs.items():
+                    arr = jnp.full(sspec.shape, sspec.init_value, sspec.dtype)
+                    if self.mesh is not None:
+                        arr = jax.device_put(
+                            arr, NamedSharding(self.mesh, P()))
+                    op_states[sname] = arr
+                states[op.name] = op_states
+        opt_state = self.optimizer.init_state(params) if self.optimizer else {}
+        step = jnp.zeros((), jnp.int32)
+        return TrainState(params, states, opt_state, step)
+
+    # ---------------- forward ----------------
+    def forward_values(self, params, states, inputs: Dict[str, jax.Array],
+                      training: bool, rng, seq_length: int = -1):
+        """Topological walk of the graph; returns (tensor-values map,
+        new_states)."""
+        values: Dict[int, jax.Array] = {}
+        for t in self.model.input_tensors:
+            if t.name not in inputs:
+                raise KeyError(f"missing input {t.name!r}; have {list(inputs)}")
+            values[t.uid] = inputs[t.name]
+        new_states: Dict[str, Dict[str, jax.Array]] = {}
+        for op in self.model.ops:
+            ctx = OpContext(
+                training=training,
+                rng=(jax.random.fold_in(rng, _stable_hash(op.name))
+                     if rng is not None else None),
+                seq_length=seq_length,
+                state_in=states.get(op.name, {}),
+            )
+            xs = [values[t.uid] for t in op.inputs]
+            op_params = params.get(op.name, {})
+            # remat: recompute this op's activations in backward instead of
+            # saving them (HBM-for-FLOPs trade, SURVEY.md env notes). Ops
+            # with functional state (BN) are excluded — their state updates
+            # must not be re-traced.
+            if self.config.remat and op.weight_specs() and not op.state_specs():
+                ys = jax.checkpoint(
+                    lambda p, x, _op=op, _ctx=ctx: _op.forward(p, x, _ctx)
+                )(op_params, xs)
+            else:
+                ys = op.forward(op_params, xs, ctx)
+            if self.mesh is not None:
+                shardings = op_output_sharding(
+                    op, self.strategy.for_op(op.name), self.mesh)
+                ys = [jax.lax.with_sharding_constraint(y, s)
+                      for y, s in zip(ys, shardings)]
+            for t, y in zip(op.outputs, ys):
+                values[t.uid] = y
+            if ctx.state_out:
+                new_states[op.name] = ctx.state_out
+        # carry through untouched states (eval path of ops w/o forward call)
+        for name, s in states.items():
+            new_states.setdefault(name, s)
+        return values, new_states
+
+    def _outputs_and_loss(self, params, states, batch, training, rng,
+                          seq_length):
+        values, new_states = self.forward_values(
+            params, states, batch, training, rng, seq_length)
+        logits = values[self.model.final_tensor.uid]
+        loss = jnp.asarray(0.0, jnp.float32)
+        if self.loss_fn is not None and "label" in batch:
+            loss = self.loss_fn(logits, batch["label"])
+        return loss, (logits, new_states)
+
+    # ---------------- step builders ----------------
+    def build_train_step(self):
+        cfg = self.config
+
+        def train_step(state: TrainState, batch: Dict[str, jax.Array], rng):
+            seq_length = cfg.iter_config.seq_length
+            grad_fn = jax.value_and_grad(
+                self._outputs_and_loss, argnums=0, has_aux=True)
+            (loss, (logits, new_states)), grads = grad_fn(
+                state.params, state.states, batch, True, rng, seq_length)
+            new_params, new_opt = self.optimizer.update(
+                state.params, grads, state.opt_state, state.step)
+            metrics = {"loss": loss}
+            if "label" in batch and self.metric_names:
+                sparse = self.loss_name.startswith("sparse")
+                metrics.update(M.compute_metrics(
+                    self.metric_names, logits, batch["label"], sparse))
+            return TrainState(new_params, new_states, new_opt,
+                              state.step + 1), metrics
+
+        jitted = jax.jit(train_step, donate_argnums=(0,))
+        return jitted
+
+    def build_eval_step(self):
+        cfg = self.config
+
+        def eval_step(state: TrainState, batch: Dict[str, jax.Array]):
+            loss, (logits, _) = self._outputs_and_loss(
+                state.params, state.states, batch, False, None,
+                cfg.iter_config.seq_length)
+            metrics = {"loss": loss}
+            if "label" in batch and self.metric_names:
+                sparse = self.loss_name.startswith("sparse")
+                metrics.update(M.compute_metrics(
+                    self.metric_names, logits, batch["label"], sparse))
+            return logits, metrics
+
+        return jax.jit(eval_step)
+
+    @property
+    def train_step(self):
+        if self._train_step is None:
+            self._train_step = self.build_train_step()
+        return self._train_step
+
+    @property
+    def eval_step(self):
+        if self._eval_step is None:
+            self._eval_step = self.build_eval_step()
+        return self._eval_step
+
+    # ---------------- data placement ----------------
+    def shard_batch(self, batch: Dict[str, np.ndarray]):
+        """Place a host batch on device(s), sharded over the data axis —
+        the TPU analog of SingleDataLoader::next_batch's per-part copies
+        (flexflow_dataloader.cc:649-740)."""
+        out = {}
+        for k, v in batch.items():
+            arr = jnp.asarray(v)
+            if self.mesh is not None:
+                out[k] = jax.device_put(
+                    arr, batch_sharding(self.mesh, arr.ndim))
+            else:
+                out[k] = arr
+        return out
+
+
+def _stable_hash(s: str) -> int:
+    """Deterministic string hash (Python's hash() is salted per-process)."""
+    h = 2166136261
+    for c in s.encode():
+        h = ((h ^ c) * 16777619) & 0x7FFFFFFF
+    return h

@@ -1,0 +1,90 @@
+"""Parameter initializers.
+
+Reference: src/runtime/initializer.cc + initializer_kernel.cu (curand-based
+Glorot/Zero/Constant/Uniform/Norm tasks launched per parameter,
+initializer.cc:16-330). Here each is a pure function of a PRNG key; the
+executor folds a per-parameter key out of the model seed, so results are
+reproducible and device-count independent.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape: Tuple[int, ...]) -> Tuple[int, int]:
+    """fan_in/fan_out matching the reference's GlorotUniform task
+    (initializer.cc): dense (in,out); conv (out,in,kh,kw) uses
+    receptive-field scaling."""
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    if len(shape) == 4:  # conv OIHW
+        rf = shape[2] * shape[3]
+        return shape[1] * rf, shape[0] * rf
+    # attention (in, heads, d) etc.: fold trailing dims
+    fan_in = shape[0]
+    fan_out = 1
+    for s in shape[1:]:
+        fan_out *= s
+    return fan_in, fan_out
+
+
+def glorot_uniform(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    scale = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -scale, scale)
+
+
+def zeros(key, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def make_constant(value: float):
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+    return init
+
+
+def make_uniform(minv: float, maxv: float, seed: int = 0):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, minv, maxv)
+    return init
+
+
+def make_normal(mean: float = 0.0, stddev: float = 1.0, seed: int = 0):
+    def init(key, shape, dtype=jnp.float32):
+        return mean + stddev * jax.random.normal(key, shape, dtype)
+    return init
+
+
+def he_normal(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / fan_in)
+
+
+INITIALIZERS: Dict[str, Callable] = {
+    "glorot": glorot_uniform,
+    "glorot_uniform": glorot_uniform,
+    "zeros": zeros,
+    "zero": zeros,
+    "ones": ones,
+    "he_normal": he_normal,
+    "norm": make_normal(),
+    "normal": make_normal(),
+}
+
+
+def resolve(name_or_fn) -> Callable:
+    if callable(name_or_fn):
+        return name_or_fn
+    return INITIALIZERS[name_or_fn]

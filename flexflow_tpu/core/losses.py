@@ -1,0 +1,78 @@
+"""Loss functions.
+
+Reference: src/loss_functions/loss_functions.cu — sparse-CCE via
+subtract-one-hot kernel, CCE, MSE, with the gradient scaled by 1/num_parts
+when the logit tensor is partitioned (loss_functions.cu:127-160). On TPU
+that scale factor is unnecessary: we define losses as *means over the
+global batch* and differentiate the whole step, so sharding never changes
+the math.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+LOSS_SPARSE_CCE = "sparse_categorical_crossentropy"
+LOSS_CCE = "categorical_crossentropy"
+LOSS_MSE = "mean_squared_error"
+LOSS_BCE = "binary_crossentropy"
+LOSS_IDENTITY = "identity"
+
+
+def sparse_categorical_crossentropy(logits_or_probs, labels,
+                                    from_logits: bool = False):
+    """labels: int (batch,) or (batch, 1). The reference applies this to
+    *softmax outputs* (the graph ends in Softmax, loss takes probs)."""
+    labels = labels.reshape(labels.shape[0]).astype(jnp.int32)
+    if from_logits:
+        logp = jax.nn.log_softmax(logits_or_probs, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(logits_or_probs, 1e-12, 1.0))
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)
+    return jnp.mean(nll)
+
+
+def categorical_crossentropy(probs, labels, from_logits: bool = False):
+    if from_logits:
+        logp = jax.nn.log_softmax(probs, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(probs, 1e-12, 1.0))
+    return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+
+def mean_squared_error(preds, targets, from_logits: bool = False):
+    return jnp.mean(jnp.square(preds.astype(jnp.float32)
+                               - targets.astype(jnp.float32)))
+
+
+def binary_crossentropy(preds, targets, from_logits: bool = False):
+    if from_logits:
+        return jnp.mean(jnp.maximum(preds, 0) - preds * targets
+                        + jnp.log1p(jnp.exp(-jnp.abs(preds))))
+    p = jnp.clip(preds, 1e-7, 1 - 1e-7)
+    return -jnp.mean(targets * jnp.log(p) + (1 - targets) * jnp.log(1 - p))
+
+
+def identity(preds, targets, from_logits: bool = False):
+    """Mean of predictions — used when the graph computes its own loss."""
+    return jnp.mean(preds)
+
+
+LOSSES: Dict[str, Callable] = {
+    LOSS_SPARSE_CCE: sparse_categorical_crossentropy,
+    "sparse_crossentropy": sparse_categorical_crossentropy,
+    LOSS_CCE: categorical_crossentropy,
+    LOSS_MSE: mean_squared_error,
+    "mse": mean_squared_error,
+    LOSS_BCE: binary_crossentropy,
+    LOSS_IDENTITY: identity,
+}
+
+
+def resolve(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    return LOSSES[name_or_fn]

@@ -1,0 +1,89 @@
+"""Training metrics.
+
+Reference: src/metrics_functions/ — a `PerfMetrics` struct accumulated
+per-partition on device and folded through an UPDATE_METRICS task on CPU0
+(metrics_functions.cu:177-320, model.cc:2084-2108). On TPU the per-part
+accumulation + future-fold is a single jnp reduction inside the jitted
+step; the host only sees final scalars.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+METRICS_ACCURACY = "accuracy"
+METRICS_CCE = "categorical_crossentropy"
+METRICS_SPARSE_CCE = "sparse_categorical_crossentropy"
+METRICS_MSE = "mean_squared_error"
+METRICS_RMSE = "root_mean_squared_error"
+METRICS_MAE = "mean_absolute_error"
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Host-side accumulator, mirroring the reference struct
+    (include/metrics_functions.h:26-58)."""
+
+    train_all: int = 0
+    train_correct: int = 0
+    cce_loss: float = 0.0
+    sparse_cce_loss: float = 0.0
+    mse_loss: float = 0.0
+    rmse_loss: float = 0.0
+    mae_loss: float = 0.0
+
+    def update(self, other: "PerfMetrics"):
+        self.train_all += other.train_all
+        self.train_correct += other.train_correct
+        self.cce_loss += other.cce_loss
+        self.sparse_cce_loss += other.sparse_cce_loss
+        self.mse_loss += other.mse_loss
+        self.rmse_loss += other.rmse_loss
+        self.mae_loss += other.mae_loss
+
+    def accuracy(self) -> float:
+        return self.train_correct / max(1, self.train_all)
+
+
+def compute_metrics(metric_names: Sequence[str], preds: jax.Array,
+                    labels: jax.Array, sparse: bool) -> Dict[str, jax.Array]:
+    """Pure-JAX metric computation; returns scalar sums/counts so results
+    are exact under any sharding (mean taken on host)."""
+    out: Dict[str, jax.Array] = {}
+    n = preds.shape[0]
+    out["count"] = jnp.asarray(n, jnp.int32)
+    if sparse:
+        lbl = labels.reshape(labels.shape[0]).astype(jnp.int32)
+    else:
+        lbl = None
+    for m in metric_names:
+        if m == METRICS_ACCURACY:
+            pred_cls = jnp.argmax(preds, axis=-1).astype(jnp.int32)
+            if sparse:
+                correct = jnp.sum(pred_cls == lbl)
+            else:
+                correct = jnp.sum(pred_cls == jnp.argmax(labels, axis=-1))
+            out["correct"] = correct
+        elif m in (METRICS_CCE, METRICS_SPARSE_CCE):
+            logp = jnp.log(jnp.clip(preds, 1e-12, 1.0))
+            if sparse:
+                nll = -jnp.take_along_axis(logp, lbl[:, None], axis=-1)
+            else:
+                nll = -jnp.sum(labels * logp, axis=-1)
+            out["cce_sum"] = jnp.sum(nll)
+        elif m == METRICS_MSE:
+            out["mse_sum"] = jnp.sum(
+                jnp.mean(jnp.square(preds - labels), axis=-1))
+        elif m == METRICS_RMSE:
+            # per-sample root-mean-square error, summed (host divides by
+            # count — matches the reference's per-part rmse accumulation)
+            out["rmse_sum"] = jnp.sum(
+                jnp.sqrt(jnp.mean(jnp.square(preds - labels), axis=-1)))
+        elif m == METRICS_MAE:
+            out["mae_sum"] = jnp.sum(
+                jnp.mean(jnp.abs(preds - labels), axis=-1))
+    return out

@@ -1,0 +1,2 @@
+"""Pallas TPU kernels — the in-tree native-kernel equivalents of the
+reference's src/ops/*.cu (SURVEY.md section 7 step 9)."""

@@ -1,0 +1,172 @@
+"""Flash attention (Pallas, TPU).
+
+Replaces the reference's single cuDNN fused-MHA call
+(src/ops/attention.cu:245 cudnnMultiHeadAttnForward) with an online-softmax
+blocked kernel that never materializes the (Lq, Lk) score matrix in HBM.
+
+Forward is a Pallas kernel (grid over (batch*heads, q-blocks), inner
+fori_loop over k-blocks with online max/sum rescaling). Backward is a
+custom VJP that recomputes probabilities from the saved logsumexp — exact
+gradients with no saved probability tensor.
+
+Layout contract: (batch, seq, heads, head_dim) in/out, matching
+ops/attention.py. head_dim is zero-padded to a multiple of 128 lanes
+(padding is exact: zero d-columns contribute nothing to q.k^T, and padded
+v columns are sliced off the output).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on pure-CPU builds
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    _HAS_PLTPU = False
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      block_q, block_k, seq_k, scale, causal):
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32)  # (block_q, d)
+    d = q.shape[-1]
+    m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kb = seq_k // block_k
+    if causal:
+        # blocks strictly above the diagonal contribute nothing
+        num_kb = jnp.minimum(num_kb,
+                             ((qi + 1) * block_q + block_k - 1) // block_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l))[:, None]
+
+
+def _fwd_pallas(q, k, v, *, causal, scale, block_q, block_k):
+    """q,k,v: (bh, s, d_padded) -> o (bh, sq, d_padded), lse (bh, sq, 1)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    kern = functools.partial(
+        _flash_fwd_kernel, block_q=block_q, block_k=block_k, seq_k=sk,
+        scale=scale, causal=causal)
+    grid = (bh, sq // block_q)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    o, _ = _fwd_pallas(q, k, v, causal=causal, scale=scale,
+                       block_q=block_q, block_k=block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    o, lse = _fwd_pallas(q, k, v, causal=causal, scale=scale,
+                         block_q=block_q, block_k=block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    if causal:
+        # top-left alignment (j <= i), matching the forward kernel's
+        # qpos >= kpos mask exactly — required for correct gradients
+        # when seq_q != seq_k.
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jnp.exp(s - lse)  # (bh, sq, sk); lse broadcasts over last dim
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_bshd(q, k, v, *, causal=False,
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """softmax(QK^T/sqrt(d))V for (b, s, h, d) tensors via Pallas.
+
+    Raises on unsupported shapes/platform; callers fall back to XLA.
+    """
+    if not _HAS_PLTPU or jax.default_backend() != "tpu":
+        raise NotImplementedError("pallas flash attention requires TPU")
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if sq % block_q != 0 or sk % block_k != 0:
+        raise NotImplementedError(f"seq ({sq},{sk}) not divisible by block")
+    if d > 256:
+        raise NotImplementedError("head_dim > 256 unsupported")
+
+    # scale uses the unpadded head_dim
+    scale = 1.0 / math.sqrt(d)
+    d_pad = max(128, ((d + 127) // 128) * 128)
+
+    def to_bhd(x, s):
+        x = jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+        if d_pad != d:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, d_pad - d)))
+        return x
+
+    o = _flash(to_bhd(q, sq), to_bhd(k, sk), to_bhd(v, sk),
+               causal, scale, block_q, block_k)
+    o = o[..., :d].reshape(b, h, sq, d)
+    return jnp.swapaxes(o, 1, 2)

@@ -1,0 +1,419 @@
+"""FFModel: the graph-builder + training driver.
+
+Mirrors the reference `FFModel` public surface (include/model.h:266-536 —
+one builder method per layer type, then compile/fit/forward/backward/
+update/zero_gradients) so reference examples translate 1:1, while the
+implementation is TPU-native: compile() produces jitted JAX steps instead
+of Legion partitions/launchers (SURVEY.md section 7).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from .config import CompMode, FFConfig
+from .core.executor import Executor, TrainState
+from .core.optimizers import AdamOptimizer, Optimizer, SGDOptimizer
+from .op import Op
+from .ops import (
+    LSTM,
+    Aggregate,
+    BatchMatmul,
+    BatchNorm,
+    Concat,
+    Conv2D,
+    Dropout,
+    ElementBinary,
+    ElementUnary,
+    Embedding,
+    Flat,
+    GroupBy,
+    Linear,
+    MultiHeadAttention,
+    Pool2D,
+    Reshape,
+    Reverse,
+    Softmax,
+    Split,
+    TopK,
+    Transpose,
+)
+from .parallel.mesh import default_mesh, make_mesh
+from .parallel.pconfig import OpStrategy, Strategy
+from .tensor import Tensor
+
+
+class FFModel:
+    def __init__(self, config: Optional[FFConfig] = None,
+                 mesh: Optional[Mesh] = None,
+                 strategy: Optional[Strategy] = None):
+        self.config = config or FFConfig()
+        self.ops: List[Op] = []
+        self.input_tensors: List[Tensor] = []
+        self._name_counts: Dict[str, int] = {}
+        self.mesh = mesh
+        self.strategy = strategy
+        self.executor: Optional[Executor] = None
+        self.state: Optional[TrainState] = None
+        self.label_tensor: Optional[Tensor] = None
+        self._rng = jax.random.PRNGKey(self.config.seed)
+
+    # ---------------- tensors ----------------
+    def create_tensor(self, shape: Sequence[int], dtype=jnp.float32,
+                      name: Optional[str] = None) -> Tensor:
+        t = Tensor(tuple(shape), dtype,
+                   name=name or self._fresh_name("input"), is_input=True)
+        self.input_tensors.append(t)
+        return t
+
+    def _fresh_name(self, base: str) -> str:
+        n = self._name_counts.get(base, 0)
+        self._name_counts[base] = n + 1
+        return base if n == 0 else f"{base}_{n}"
+
+    def add_op(self, op: Op) -> Op:
+        op.finalize()
+        self.ops.append(op)
+        return op
+
+    # ---------------- layer builders (include/model.h:276-410) ----------
+    def conv2d(self, input: Tensor, out_channels: int, kernel_h: int,
+               kernel_w: int, stride_h: int, stride_w: int, padding_h: int,
+               padding_w: int, activation=None, groups: int = 1,
+               use_bias: bool = True, name: Optional[str] = None,
+               kernel_initializer="glorot", bias_initializer="zeros") -> Tensor:
+        op = Conv2D(self, name or self._fresh_name("conv2d"), [input],
+                    out_channels, kernel_h, kernel_w, stride_h, stride_w,
+                    padding_h, padding_w, activation or "none", groups,
+                    use_bias, kernel_initializer, bias_initializer)
+        return self.add_op(op).output
+
+    def dense(self, input: Tensor, out_channels: int, activation=None,
+              use_bias: bool = True, name: Optional[str] = None,
+              kernel_initializer="glorot", bias_initializer="zeros") -> Tensor:
+        op = Linear(self, name or self._fresh_name("dense"), [input],
+                    out_channels, activation or "none", use_bias,
+                    kernel_initializer, bias_initializer)
+        return self.add_op(op).output
+
+    def embedding(self, input: Tensor, num_entries: int, out_dim: int,
+                  aggr: str = "sum", name: Optional[str] = None,
+                  kernel_initializer="glorot") -> Tensor:
+        op = Embedding(self, name or self._fresh_name("embedding"), [input],
+                       num_entries, out_dim, aggr, kernel_initializer)
+        return self.add_op(op).output
+
+    def pool2d(self, input: Tensor, kernel_h: int, kernel_w: int,
+               stride_h: int, stride_w: int, padding_h: int, padding_w: int,
+               pool_type: str = "max", activation=None,
+               name: Optional[str] = None) -> Tensor:
+        op = Pool2D(self, name or self._fresh_name("pool2d"), [input],
+                    kernel_h, kernel_w, stride_h, stride_w, padding_h,
+                    padding_w, pool_type, activation or "none")
+        return self.add_op(op).output
+
+    def batch_norm(self, input: Tensor, relu: bool = True,
+                   name: Optional[str] = None) -> Tensor:
+        op = BatchNorm(self, name or self._fresh_name("batch_norm"),
+                       [input], relu)
+        return self.add_op(op).output
+
+    def batch_matmul(self, a: Tensor, b: Tensor,
+                     a_seq_length_dim: int = -1, b_seq_length_dim: int = -1,
+                     name: Optional[str] = None) -> Tensor:
+        op = BatchMatmul(self, name or self._fresh_name("batch_matmul"),
+                         [a, b], a_seq_length_dim, b_seq_length_dim)
+        return self.add_op(op).output
+
+    def dropout(self, input: Tensor, rate: float, seed: int = 0,
+                name: Optional[str] = None) -> Tensor:
+        op = Dropout(self, name or self._fresh_name("dropout"), [input],
+                     rate, seed)
+        return self.add_op(op).output
+
+    def multihead_attention(self, query: Tensor, key: Tensor, value: Tensor,
+                            embed_dim: int, num_heads: int, kdim: int = 0,
+                            vdim: int = 0, dropout: float = 0.0,
+                            bias: bool = True, add_bias_kv: bool = False,
+                            add_zero_attn: bool = False,
+                            causal: bool = False,
+                            name: Optional[str] = None,
+                            kernel_initializer="glorot") -> Tensor:
+        op = MultiHeadAttention(
+            self, name or self._fresh_name("attention"), [query, key, value],
+            embed_dim, num_heads, kdim, vdim, dropout, bias, add_bias_kv,
+            add_zero_attn, causal, kernel_initializer)
+        return self.add_op(op).output
+
+    # elementwise unary (model.h exp/relu/sigmoid/tanh/elu/scalar ops)
+    def _unary(self, mode, input, name=None, scalar=None) -> Tensor:
+        op = ElementUnary(self, name or self._fresh_name(mode), [input],
+                          mode, scalar)
+        return self.add_op(op).output
+
+    def exp(self, input, name=None):
+        return self._unary("exp", input, name)
+
+    def relu(self, input, name=None):
+        return self._unary("relu", input, name)
+
+    def sigmoid(self, input, name=None):
+        return self._unary("sigmoid", input, name)
+
+    def tanh(self, input, name=None):
+        return self._unary("tanh", input, name)
+
+    def elu(self, input, name=None):
+        return self._unary("elu", input, name)
+
+    def gelu(self, input, name=None):
+        return self._unary("gelu", input, name)
+
+    def identity(self, input, name=None):
+        return self._unary("identity", input, name)
+
+    def scalar_multiply(self, input, scalar, name=None):
+        return self._unary("scalar_multiply", input, name, scalar=scalar)
+
+    # elementwise binary
+    def _binary(self, mode, a, b, name=None) -> Tensor:
+        op = ElementBinary(self, name or self._fresh_name(mode), [a, b], mode)
+        return self.add_op(op).output
+
+    def add(self, a, b, name=None):
+        return self._binary("add", a, b, name)
+
+    def subtract(self, a, b, name=None):
+        return self._binary("subtract", a, b, name)
+
+    def multiply(self, a, b, name=None):
+        return self._binary("multiply", a, b, name)
+
+    def divide(self, a, b, name=None):
+        return self._binary("divide", a, b, name)
+
+    def max(self, a, b, name=None):
+        return self._binary("max", a, b, name)
+
+    def min(self, a, b, name=None):
+        return self._binary("min", a, b, name)
+
+    # shape ops
+    def concat(self, tensors: Sequence[Tensor], axis: int,
+               name: Optional[str] = None) -> Tensor:
+        op = Concat(self, name or self._fresh_name("concat"), list(tensors),
+                    axis)
+        return self.add_op(op).output
+
+    def split(self, input: Tensor, sizes: Union[int, Sequence[int]],
+              axis: int, name: Optional[str] = None) -> List[Tensor]:
+        if isinstance(sizes, int):
+            total = input.shape[axis % len(input.shape)]
+            assert total % sizes == 0
+            sizes = [total // sizes] * sizes
+        op = Split(self, name or self._fresh_name("split"), [input],
+                   list(sizes), axis)
+        return list(self.add_op(op).outputs)
+
+    def flat(self, input: Tensor, name: Optional[str] = None) -> Tensor:
+        op = Flat(self, name or self._fresh_name("flat"), [input])
+        return self.add_op(op).output
+
+    def reshape(self, input: Tensor, shape: Sequence[int],
+                name: Optional[str] = None) -> Tensor:
+        op = Reshape(self, name or self._fresh_name("reshape"), [input],
+                     tuple(shape))
+        return self.add_op(op).output
+
+    def transpose(self, input: Tensor, perm: Sequence[int],
+                  name: Optional[str] = None) -> Tensor:
+        op = Transpose(self, name or self._fresh_name("transpose"), [input],
+                       list(perm))
+        return self.add_op(op).output
+
+    def reverse(self, input: Tensor, axis: int,
+                name: Optional[str] = None) -> Tensor:
+        op = Reverse(self, name or self._fresh_name("reverse"), [input], axis)
+        return self.add_op(op).output
+
+    def top_k(self, input: Tensor, k: int, sorted: bool = True,
+              name: Optional[str] = None) -> Tuple[Tensor, Tensor]:
+        op = TopK(self, name or self._fresh_name("topk"), [input], k, sorted)
+        self.add_op(op)
+        return op.outputs[0], op.outputs[1]
+
+    def softmax(self, input: Tensor, axis: int = -1,
+                name: Optional[str] = None) -> Tensor:
+        op = Softmax(self, name or self._fresh_name("softmax"), [input], axis)
+        return self.add_op(op).output
+
+    def group_by(self, data: Tensor, assign: Tensor, n: int, alpha: float,
+                 name: Optional[str] = None) -> List[Tensor]:
+        op = GroupBy(self, name or self._fresh_name("group_by"),
+                     [data, assign], n, alpha)
+        return list(self.add_op(op).outputs)
+
+    def aggregate(self, gate_preds: Tensor, gate_assign: Tensor,
+                  exp_preds: Sequence[Tensor], n: int,
+                  name: Optional[str] = None) -> Tensor:
+        op = Aggregate(self, name or self._fresh_name("aggregate"),
+                       [gate_preds, gate_assign] + list(exp_preds), n)
+        return self.add_op(op).output
+
+    def lstm(self, input: Tensor, hidden_size: int,
+             return_sequences: bool = True,
+             name: Optional[str] = None) -> Tensor:
+        op = LSTM(self, name or self._fresh_name("lstm"), [input],
+                  hidden_size, return_sequences)
+        return self.add_op(op).output
+
+    # ---------------- compile / train ----------------
+    @property
+    def final_tensor(self) -> Tensor:
+        return self.ops[-1].outputs[0]
+
+    def compile(self, optimizer: Optional[Optimizer] = None,
+                loss_type: Optional[str] = "sparse_categorical_crossentropy",
+                metrics: Optional[Sequence[str]] = None,
+                comp_mode: str = CompMode.TRAINING,
+                mesh: Optional[Mesh] = None,
+                strategy: Optional[Strategy] = None) -> None:
+        """Reference: FFModel::compile (model.cc:1551-1796). Runs strategy
+        search when config.search_budget > 0, builds the executor, and
+        initializes parameters (sharded per strategy)."""
+        if mesh is not None:
+            self.mesh = mesh
+        if strategy is not None:
+            self.strategy = strategy
+        if optimizer is None:
+            optimizer = SGDOptimizer(lr=self.config.learning_rate)
+        self.optimizer = optimizer
+
+        if self.strategy is None and self.config.import_strategy_file:
+            self.strategy = Strategy.load(self.config.import_strategy_file)
+
+        if self.config.search_budget > 0:
+            from .search.mcmc import optimize
+            self.strategy = optimize(self, budget=self.config.search_budget,
+                                     alpha=self.config.search_alpha)
+            if self.config.export_strategy_file:
+                self.strategy.save(self.config.export_strategy_file)
+
+        self.executor = Executor(self, optimizer, loss_type, metrics,
+                                 mesh=self.mesh, strategy=self.strategy)
+        self.state = self.executor.init_state(self._next_rng())
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # reference-parity train-loop primitives (model.cc:1414-1461). On TPU
+    # forward/backward/update are one fused jitted step; these methods keep
+    # the imperative API by staging a batch and running the step on update.
+    def init_layers(self):
+        if self.state is None:
+            self.compile()
+
+    def forward(self, batch: Dict[str, np.ndarray]):
+        batch = self.executor.shard_batch(batch)
+        logits, metrics = self.executor.eval_step(self.state, batch)
+        return logits
+
+    def zero_gradients(self):
+        pass  # gradients are pure values on TPU; nothing to zero
+
+    def train_batch(self, batch: Dict[str, np.ndarray]):
+        """One optimizer step; returns metrics dict of scalars."""
+        batch = self.executor.shard_batch(batch)
+        self.state, metrics = self.executor.train_step(
+            self.state, batch, self._next_rng())
+        return metrics
+
+    def fit(self, x: Dict[str, np.ndarray], y: np.ndarray,
+            batch_size: Optional[int] = None, epochs: Optional[int] = None,
+            shuffle: bool = True, verbose: bool = True):
+        """Keras-style fit over host numpy arrays (reference:
+        base_model.py:195-255 + _train loop :347-424)."""
+        bs = batch_size or self.config.batch_size
+        ep = epochs or self.config.epochs
+        names = list(x.keys())
+        n = len(y)
+        steps = n // bs
+        rng = np.random.RandomState(self.config.seed)
+        history = []
+        for epoch in range(ep):
+            idx = rng.permutation(n) if shuffle else np.arange(n)
+            epoch_metrics = []
+            t0 = time.time()
+            for s in range(steps):
+                sel = idx[s * bs:(s + 1) * bs]
+                batch = {k: x[k][sel] for k in names}
+                batch["label"] = y[sel]
+                m = self.train_batch(batch)
+                epoch_metrics.append(m)
+            # fold metrics on host (reference: UPDATE_METRICS future fold)
+            agg = {}
+            for m in epoch_metrics:
+                for k, v in m.items():
+                    agg[k] = agg.get(k, 0.0) + float(v)
+            dt = time.time() - t0
+            out = {"epoch": epoch, "loss": agg.get("loss", 0.0) / max(1, steps),
+                   "throughput": steps * bs / dt}
+            if "correct" in agg:
+                out["accuracy"] = agg["correct"] / agg["count"]
+            history.append(out)
+            if verbose:
+                acc = f" accuracy={out.get('accuracy', float('nan')):.4f}"
+                print(f"epoch {epoch}: loss={out['loss']:.4f}{acc} "
+                      f"({out['throughput']:.1f} samples/s)")
+        return history
+
+    def evaluate(self, x: Dict[str, np.ndarray], y: np.ndarray,
+                 batch_size: Optional[int] = None):
+        bs = batch_size or self.config.batch_size
+        names = list(x.keys())
+        n = len(y)
+        steps = max(1, n // bs)
+        step_metrics = []
+        for s in range(steps):
+            sel = slice(s * bs, (s + 1) * bs)
+            batch = {k: x[k][sel] for k in names}
+            batch["label"] = y[sel]
+            sharded = self.executor.shard_batch(batch)
+            _, m = self.executor.eval_step(self.state, sharded)
+            step_metrics.append(m)  # device scalars; convert once at end
+        agg: Dict[str, float] = {}
+        for m in step_metrics:
+            for k, v in m.items():
+                agg[k] = agg.get(k, 0.0) + float(v)
+        out = {"loss": agg.get("loss", 0.0) / steps}
+        if "correct" in agg:
+            out["accuracy"] = agg["correct"] / agg["count"]
+        return out
+
+    # ---------------- weight access (reference Parameter::get/set) ------
+    def get_weights(self, op_name: str) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in self.state.params[op_name].items()}
+
+    def set_weights(self, op_name: str, weights: Dict[str, np.ndarray]):
+        cur = self.state.params[op_name]
+        for k, v in weights.items():
+            assert cur[k].shape == v.shape, (op_name, k, cur[k].shape, v.shape)
+            cur[k] = jnp.asarray(v, cur[k].dtype)
+
+    def summary(self) -> str:
+        lines = [f"{'op':30s} {'type':20s} {'output':24s} {'params':>12s}"]
+        total = 0
+        for op in self.ops:
+            n = sum(int(np.prod(s.shape)) for s in op.weight_specs().values())
+            total += n
+            lines.append(f"{op.name:30s} {op.op_type:20s} "
+                         f"{str(op.outputs[0].shape):24s} {n:>12,d}")
+        lines.append(f"total params: {total:,d}")
+        return "\n".join(lines)

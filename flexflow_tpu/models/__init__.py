@@ -1,0 +1,7 @@
+"""Model zoo — the reference's examples/cpp + examples/python workloads
+(SURVEY.md 2.7), built on the framework's builder API."""
+
+from .alexnet import build_alexnet
+from .transformer import build_transformer
+
+__all__ = ["build_alexnet", "build_transformer"]

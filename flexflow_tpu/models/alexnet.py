@@ -1,0 +1,45 @@
+"""AlexNet.
+
+Reference: examples/cpp/AlexNet/alexnet.cc:34-137 (top_level_task graph) and
+bootcamp_demo/ff_alexnet_cifar10.py — conv/pool/flat/dense/softmax stack.
+CIFAR-10 variant uses 32x32 inputs; ImageNet variant 224x224.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import FFConfig
+from ..model import FFModel
+
+
+def build_alexnet(config: Optional[FFConfig] = None, batch_size: int = None,
+                  num_classes: int = 10, image_size: int = 32,
+                  mesh=None, strategy=None) -> FFModel:
+    cfg = config or FFConfig()
+    bs = batch_size or cfg.batch_size
+    ff = FFModel(cfg, mesh=mesh, strategy=strategy)
+    x = ff.create_tensor((bs, 3, image_size, image_size), name="input")
+
+    if image_size >= 64:
+        # ImageNet-scale geometry (alexnet.cc:60-80)
+        t = ff.conv2d(x, 64, 11, 11, 4, 4, 2, 2, activation="relu")
+        t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+        t = ff.conv2d(t, 192, 5, 5, 1, 1, 2, 2, activation="relu")
+        t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    else:
+        # CIFAR-10 geometry (bootcamp_demo/ff_alexnet_cifar10.py)
+        t = ff.conv2d(x, 64, 5, 5, 1, 1, 2, 2, activation="relu")
+        t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+        t = ff.conv2d(t, 192, 5, 5, 1, 1, 2, 2, activation="relu")
+        t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = ff.conv2d(t, 384, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = ff.conv2d(t, 256, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = ff.pool2d(t, 3, 3, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 4096, activation="relu")
+    t = ff.dense(t, 4096, activation="relu")
+    t = ff.dense(t, num_classes)
+    t = ff.softmax(t)
+    return ff

@@ -1,0 +1,42 @@
+"""Transformer encoder — the flagship model.
+
+Reference: examples/cpp/Transformer/transformer.cc:28-56,110-135 — an
+encoder of MultiHeadAttention + dense blocks (512 hidden / 8 layers,
+synthetic data). We keep the same op mix (MHA + dense + elementwise add);
+the attention core runs through the Pallas flash kernel on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..config import FFConfig
+from ..model import FFModel
+
+
+def build_transformer(config: Optional[FFConfig] = None,
+                      batch_size: int = None, seq_len: int = 128,
+                      hidden: int = 512, num_heads: int = 8,
+                      num_layers: int = 6, ff_dim: int = 2048,
+                      num_classes: int = 10, dtype=jnp.float32,
+                      mesh=None, strategy=None) -> FFModel:
+    cfg = config or FFConfig()
+    bs = batch_size or cfg.batch_size
+    ff = FFModel(cfg, mesh=mesh, strategy=strategy)
+    t = ff.create_tensor((bs, seq_len, hidden), dtype=dtype, name="input")
+    for i in range(num_layers):
+        a = ff.multihead_attention(t, t, t, hidden, num_heads,
+                                   name=f"layer{i}_attn")
+        t = ff.add(a, t, name=f"layer{i}_res1")
+        h = ff.dense(t, ff_dim, activation="relu", name=f"layer{i}_ff1")
+        h = ff.dense(h, hidden, name=f"layer{i}_ff2")
+        t = ff.add(h, t, name=f"layer{i}_res2")
+    # classification head over the first position (avoids a giant
+    # flat->dense): slice via split, then dense+softmax.
+    head, _rest = ff.split(t, [1, t.shape[1] - 1], axis=1, name="cls_split")
+    head = ff.reshape(head, (bs, hidden), name="cls_reshape")
+    logits = ff.dense(head, num_classes, name="cls_head")
+    out = ff.softmax(logits, name="cls_softmax")
+    return ff

@@ -1,0 +1,47 @@
+"""Operator library — TPU-native equivalents of reference src/ops/*.cu.
+
+Each op is a pure-functional JAX computation (forward only; backward comes
+from autodiff of the whole step). The hot ops additionally have Pallas
+kernels under flexflow_tpu/kernels/.
+"""
+
+from .linear import Linear
+from .conv import Conv2D, Pool2D, BatchNorm, Flat
+from .elementwise import ElementUnary, ElementBinary, Dropout, Softmax
+from .tensor_ops import (
+    Concat,
+    Split,
+    Reshape,
+    Transpose,
+    Reverse,
+    TopK,
+    BatchMatmul,
+)
+from .embedding import Embedding
+from .attention import MultiHeadAttention
+from .moe import GroupBy, Aggregate
+from .rnn import LSTM
+
+__all__ = [
+    "Linear",
+    "Conv2D",
+    "Pool2D",
+    "BatchNorm",
+    "Flat",
+    "ElementUnary",
+    "ElementBinary",
+    "Dropout",
+    "Softmax",
+    "Concat",
+    "Split",
+    "Reshape",
+    "Transpose",
+    "Reverse",
+    "TopK",
+    "BatchMatmul",
+    "Embedding",
+    "MultiHeadAttention",
+    "GroupBy",
+    "Aggregate",
+    "LSTM",
+]

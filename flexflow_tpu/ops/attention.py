@@ -1,0 +1,165 @@
+"""Multi-head attention.
+
+Reference: src/ops/attention.cu — a single cuDNN fused-MHA call
+(cudnnMultiHeadAttnForward, attention.cu:245) with one packed 3-D weight
+tensor holding {Wq,Wk,Wv,Wo} per head (attention.cu:88-104).
+
+TPU-native design: separate (E, H, D) projection weights whose `head`
+logical axis maps to a mesh axis for TP (Megatron-style), and a Pallas
+flash-attention kernel (flexflow_tpu/kernels/flash_attention.py) for the
+core softmax(QK^T)V — the op the north star explicitly calls out for
+replacement. Long-sequence SP/CP shards the `seq` axis; see
+flexflow_tpu/parallel/ring_attention.py.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..op import (
+    CHANNEL_IN,
+    CHANNEL_OUT,
+    HEAD,
+    SAMPLE,
+    SEQ,
+    Op,
+    OpContext,
+    WeightSpec,
+    register_op,
+)
+
+
+@register_op
+class MultiHeadAttention(Op):
+    op_type = "multihead_attention"
+
+    def __init__(self, model, name, inputs, embed_dim: int, num_heads: int,
+                 kdim: int = 0, vdim: int = 0, dropout: float = 0.0,
+                 use_bias: bool = False, add_bias_kv: bool = False,
+                 add_zero_attn: bool = False, causal: bool = False,
+                 kernel_initializer: str = "glorot",
+                 use_flash: bool = True):
+        super().__init__(model, name, inputs)
+        q, k, v = inputs
+        self.embed_dim = int(embed_dim)
+        self.num_heads = int(num_heads)
+        self.kdim = int(kdim) if kdim > 0 else self.embed_dim
+        self.vdim = int(vdim) if vdim > 0 else self.embed_dim
+        assert self.embed_dim % self.num_heads == 0
+        self.head_dim = self.embed_dim // self.num_heads
+        self.dropout = dropout
+        self.use_bias = use_bias
+        self.add_bias_kv = add_bias_kv
+        self.add_zero_attn = add_zero_attn
+        self.causal = causal
+        self.use_flash = use_flash
+        self.q_in = q.shape[-1]
+        self.k_in = k.shape[-1]
+        self.v_in = v.shape[-1]
+        self.kernel_initializer = kernel_initializer
+        self.attrs = {"embed_dim": embed_dim, "num_heads": num_heads,
+                      "dropout": dropout, "use_bias": use_bias,
+                      "causal": causal}
+
+    def output_shapes(self):
+        q = self.inputs[0]
+        return [(q.shape[0], q.shape[1], self.embed_dim)]
+
+    def weight_specs(self):
+        h, d = self.num_heads, self.head_dim
+        specs = {
+            "wq": WeightSpec((self.q_in, h, d), initializer=self.kernel_initializer,
+                             axes=(CHANNEL_IN, HEAD, None)),
+            "wk": WeightSpec((self.k_in, h, d), initializer=self.kernel_initializer,
+                             axes=(CHANNEL_IN, HEAD, None)),
+            "wv": WeightSpec((self.v_in, h, d), initializer=self.kernel_initializer,
+                             axes=(CHANNEL_IN, HEAD, None)),
+            "wo": WeightSpec((h, d, self.embed_dim),
+                             initializer=self.kernel_initializer,
+                             axes=(HEAD, None, CHANNEL_OUT)),
+        }
+        if self.use_bias:
+            specs["bo"] = WeightSpec((self.embed_dim,), initializer="zeros",
+                                     axes=(CHANNEL_OUT,))
+        if self.add_bias_kv:
+            # one learned extra kv position (torch MultiheadAttention
+            # bias_k/bias_v semantics)
+            specs["bias_k"] = WeightSpec((1, h, d), initializer="zeros",
+                                         axes=(None, HEAD, None))
+            specs["bias_v"] = WeightSpec((1, h, d), initializer="zeros",
+                                         axes=(None, HEAD, None))
+        return specs
+
+    def forward(self, params, xs, ctx: OpContext):
+        q_in, k_in, v_in = xs
+        q = jnp.einsum("bse,ehd->bshd", q_in, params["wq"].astype(q_in.dtype))
+        k = jnp.einsum("bse,ehd->bshd", k_in, params["wk"].astype(k_in.dtype))
+        v = jnp.einsum("bse,ehd->bshd", v_in, params["wv"].astype(v_in.dtype))
+        if self.add_bias_kv:
+            b = k.shape[0]
+            bk = jnp.broadcast_to(params["bias_k"].astype(k.dtype),
+                                  (b,) + params["bias_k"].shape)
+            bv = jnp.broadcast_to(params["bias_v"].astype(v.dtype),
+                                  (b,) + params["bias_v"].shape)
+            k = jnp.concatenate([k, bk], axis=1)
+            v = jnp.concatenate([v, bv], axis=1)
+
+        o = self._attend(q, k, v, ctx)
+
+        y = jnp.einsum("bshd,hde->bse", o, params["wo"].astype(o.dtype))
+        if self.use_bias:
+            y = y + params["bo"]
+        if self.dropout > 0.0 and ctx.training and ctx.rng is not None:
+            keep = 1.0 - self.dropout
+            mask = jax.random.bernoulli(ctx.rng, keep, y.shape)
+            y = jnp.where(mask, y / keep, 0.0).astype(y.dtype)
+        return [y]
+
+    def _attend(self, q, k, v, ctx: OpContext):
+        """softmax(QK^T/sqrt(d))V, (b, s, h, d) layout."""
+        has_seq_trunc = ctx.seq_length is not None and ctx.seq_length >= 0
+        if self.add_zero_attn:
+            zero = jnp.zeros(k.shape[:1] + (1,) + k.shape[2:], k.dtype)
+            k = jnp.concatenate([k, zero], axis=1)
+            v = jnp.concatenate([v, zero], axis=1)
+        # flash path handles neither seq_length truncation nor the
+        # (now off-block-size) zero-attn row; use XLA for those.
+        if self.use_flash and not has_seq_trunc and not self.add_zero_attn:
+            from ..kernels.flash_attention import flash_attention_bshd
+            try:
+                return flash_attention_bshd(q, k, v, causal=self.causal)
+            except Exception:
+                pass  # fall back to the XLA path (e.g. tiny shapes on CPU)
+        scale = 1.0 / math.sqrt(self.head_dim)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        if self.causal:
+            # top-left alignment (query i attends keys j <= i), matching
+            # the Pallas forward kernel's qpos >= kpos mask.
+            lq, lk = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((lq, lk), dtype=bool))
+            logits = jnp.where(mask, logits, -jnp.inf)
+        if ctx.seq_length is not None and ctx.seq_length >= 0:
+            kidx = jnp.arange(logits.shape[-1])
+            logits = jnp.where(kidx[None, None, None, :] < ctx.seq_length,
+                               logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    def output_axes(self):
+        return [(SAMPLE, SEQ, CHANNEL_OUT)]
+
+    def input_axes(self):
+        return [(SAMPLE, SEQ, CHANNEL_IN)] * 3
+
+    def flops(self) -> float:
+        b, lq = self.inputs[0].shape[:2]
+        lk = self.inputs[1].shape[1]
+        e, h, d = self.embed_dim, self.num_heads, self.head_dim
+        proj = 2.0 * b * (lq * self.q_in + lk * self.k_in + lk * self.v_in) * e
+        attn = 2.0 * b * h * lq * lk * d * 2
+        out = 2.0 * b * lq * e * e
+        return proj + attn + out

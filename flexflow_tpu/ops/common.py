@@ -1,0 +1,35 @@
+"""Shared helpers for ops (activation modes, padding math)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Activation modes, matching reference ActiMode (ffconst.h).
+AC_MODE_NONE = "none"
+AC_MODE_RELU = "relu"
+AC_MODE_SIGMOID = "sigmoid"
+AC_MODE_TANH = "tanh"
+AC_MODE_GELU = "gelu"
+
+_ACTIVATIONS = {
+    AC_MODE_NONE: lambda x: x,
+    AC_MODE_RELU: jax.nn.relu,
+    AC_MODE_SIGMOID: jax.nn.sigmoid,
+    AC_MODE_TANH: jnp.tanh,
+    AC_MODE_GELU: jax.nn.gelu,
+}
+
+
+def apply_activation(x: jax.Array, mode) -> jax.Array:
+    if mode is None or mode is False:
+        return x
+    if callable(mode):
+        return mode(x)
+    return _ACTIVATIONS[mode](x)
+
+
+def conv_out_dim(in_size: int, kernel: int, stride: int, pad: int) -> int:
+    """Output spatial size, matching the reference's conv shape math
+    (src/runtime/model.cc:134-212 sub-tensor computation)."""
+    return (in_size + 2 * pad - kernel) // stride + 1

@@ -1,0 +1,277 @@
+"""Convolution / pooling / batch-norm / flatten.
+
+Reference: src/ops/conv_2d.cu (cuDNN conv with per-shape algorithm
+auto-selection — on TPU, XLA picks the conv strategy during compilation, so
+the whole algorithm-selection machinery at conv_2d.cu:173-260 disappears),
+src/ops/pool_2d.cu, src/ops/batch_norm.cu, src/ops/flat.cu.
+
+Layout: the graph-level API is NCHW to match reference examples 1:1;
+XLA's layout assignment re-tiles for the MXU internally, so we do not
+hand-transpose to NHWC.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..op import (
+    CHANNEL,
+    CHANNEL_IN,
+    CHANNEL_OUT,
+    HEIGHT,
+    SAMPLE,
+    WIDTH,
+    Op,
+    OpContext,
+    StateSpec,
+    WeightSpec,
+    register_op,
+)
+from .common import AC_MODE_NONE, apply_activation, conv_out_dim
+
+
+@register_op
+class Conv2D(Op):
+    op_type = "conv2d"
+
+    def __init__(self, model, name, inputs, out_channels: int,
+                 kernel_h: int, kernel_w: int, stride_h: int, stride_w: int,
+                 padding_h: int, padding_w: int, activation=AC_MODE_NONE,
+                 groups: int = 1, use_bias: bool = True,
+                 kernel_initializer: str = "glorot",
+                 bias_initializer: str = "zeros"):
+        super().__init__(model, name, inputs)
+        n, c, h, w = inputs[0].shape
+        self.in_channels = c
+        self.out_channels = int(out_channels)
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.padding = (padding_h, padding_w)
+        self.groups = groups
+        self.activation = activation
+        self.use_bias = use_bias
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer
+        self.out_h = conv_out_dim(h, kernel_h, stride_h, padding_h)
+        self.out_w = conv_out_dim(w, kernel_w, stride_w, padding_w)
+        self.attrs = {
+            "out_channels": self.out_channels,
+            "kernel": self.kernel,
+            "stride": self.stride,
+            "padding": self.padding,
+            "groups": groups,
+            "activation": activation,
+            "use_bias": use_bias,
+        }
+
+    def output_shapes(self):
+        n = self.inputs[0].shape[0]
+        return [(n, self.out_channels, self.out_h, self.out_w)]
+
+    def weight_specs(self) -> Dict[str, WeightSpec]:
+        kh, kw = self.kernel
+        specs = {
+            "kernel": WeightSpec(
+                shape=(self.out_channels, self.in_channels // self.groups, kh, kw),
+                initializer=self.kernel_initializer,
+                axes=(CHANNEL_OUT, CHANNEL_IN, None, None),
+            )
+        }
+        if self.use_bias:
+            specs["bias"] = WeightSpec(
+                shape=(self.out_channels,),
+                initializer=self.bias_initializer,
+                axes=(CHANNEL_OUT,),
+            )
+        return specs
+
+    def forward(self, params, xs, ctx: OpContext):
+        (x,) = xs
+        ph, pw = self.padding
+        y = lax.conv_general_dilated(
+            x,
+            params["kernel"].astype(x.dtype),
+            window_strides=self.stride,
+            padding=[(ph, ph), (pw, pw)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.groups,
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+        if self.use_bias:
+            y = y + params["bias"].reshape(1, -1, 1, 1)
+        return [apply_activation(y, self.activation)]
+
+    def output_axes(self):
+        return [(SAMPLE, CHANNEL_OUT, HEIGHT, WIDTH)]
+
+    def input_axes(self):
+        return [(SAMPLE, CHANNEL_IN, HEIGHT, WIDTH)]
+
+    def flops(self) -> float:
+        n = self.inputs[0].shape[0]
+        kh, kw = self.kernel
+        return (2.0 * n * self.out_channels * self.out_h * self.out_w
+                * (self.in_channels // self.groups) * kh * kw)
+
+
+@register_op
+class Pool2D(Op):
+    op_type = "pool2d"
+
+    POOL_MAX = "max"
+    POOL_AVG = "avg"
+
+    def __init__(self, model, name, inputs, kernel_h, kernel_w, stride_h,
+                 stride_w, padding_h, padding_w, pool_type="max",
+                 activation=AC_MODE_NONE):
+        super().__init__(model, name, inputs)
+        n, c, h, w = inputs[0].shape
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.padding = (padding_h, padding_w)
+        self.pool_type = pool_type
+        self.activation = activation
+        self.out_h = conv_out_dim(h, kernel_h, stride_h, padding_h)
+        self.out_w = conv_out_dim(w, kernel_w, stride_w, padding_w)
+        self.attrs = {"kernel": self.kernel, "stride": self.stride,
+                      "padding": self.padding, "pool_type": pool_type}
+
+    def output_shapes(self):
+        n, c = self.inputs[0].shape[:2]
+        return [(n, c, self.out_h, self.out_w)]
+
+    def forward(self, params, xs, ctx: OpContext):
+        (x,) = xs
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.padding
+        window = (1, 1, kh, kw)
+        strides = (1, 1, sh, sw)
+        pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        if self.pool_type == self.POOL_MAX:
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            y = lax.reduce_window(x, init, lax.max, window, strides, pads)
+        else:
+            summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+            # cuDNN CUDNN_POOLING_AVERAGE_COUNT_INCLUDE_PADDING semantics
+            y = summed / float(kh * kw)
+        return [apply_activation(y, self.activation)]
+
+    def output_axes(self):
+        return [(SAMPLE, CHANNEL, HEIGHT, WIDTH)]
+
+    def input_axes(self):
+        return [(SAMPLE, CHANNEL, HEIGHT, WIDTH)]
+
+    def flops(self) -> float:
+        n, c = self.inputs[0].shape[:2]
+        kh, kw = self.kernel
+        return float(n * c * self.out_h * self.out_w * kh * kw)
+
+
+@register_op
+class BatchNorm(Op):
+    """Training-mode batch norm with running stats.
+
+    Reference: src/ops/batch_norm.cu (cuDNN BN, running stats in a Realm
+    instance, model.h:883-899). Running stats here are functional state in
+    the executor's `state` pytree, updated each training step.
+    """
+
+    op_type = "batch_norm"
+    MOMENTUM = 0.9
+    EPS = 1e-5
+
+    def __init__(self, model, name, inputs, relu: bool = True):
+        super().__init__(model, name, inputs)
+        self.relu = relu
+        self.num_channels = inputs[0].shape[1]
+        self.attrs = {"relu": relu}
+
+    def output_shapes(self):
+        return [tuple(self.inputs[0].shape)]
+
+    def weight_specs(self):
+        c = self.num_channels
+        return {
+            "scale": WeightSpec((c,), initializer="ones", axes=(CHANNEL,)),
+            "bias": WeightSpec((c,), initializer="zeros", axes=(CHANNEL,)),
+        }
+
+    def state_specs(self):
+        c = self.num_channels
+        return {
+            "running_mean": StateSpec((c,), init_value=0.0),
+            "running_var": StateSpec((c,), init_value=1.0),
+        }
+
+    def forward(self, params, xs, ctx: OpContext):
+        (x,) = xs
+        reduce_axes = (0, 2, 3) if x.ndim == 4 else tuple(
+            i for i in range(x.ndim) if i != 1)
+        if ctx.training:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            var = jnp.var(xf, axis=reduce_axes)
+            ctx.state_out["running_mean"] = (
+                self.MOMENTUM * ctx.state_in["running_mean"]
+                + (1 - self.MOMENTUM) * mean)
+            ctx.state_out["running_var"] = (
+                self.MOMENTUM * ctx.state_in["running_var"]
+                + (1 - self.MOMENTUM) * var)
+        else:
+            mean = ctx.state_in["running_mean"]
+            var = ctx.state_in["running_var"]
+            ctx.state_out["running_mean"] = mean
+            ctx.state_out["running_var"] = var
+        shape = [1] * x.ndim
+        shape[1] = -1
+        inv = lax.rsqrt(var + self.EPS).reshape(shape).astype(x.dtype)
+        mean = mean.reshape(shape).astype(x.dtype)
+        y = (x - mean) * inv * params["scale"].reshape(shape) + params[
+            "bias"].reshape(shape)
+        if self.relu:
+            y = jax.nn.relu(y)
+        return [y]
+
+    def output_axes(self):
+        n = len(self.outputs[0].shape)
+        axes = [None] * n
+        axes[0] = SAMPLE
+        axes[1] = CHANNEL
+        return [tuple(axes)]
+
+    input_axes = output_axes
+
+    def flops(self) -> float:
+        return 8.0 * self.inputs[0].num_elements
+
+
+@register_op
+class Flat(Op):
+    """4D (N,C,H,W) -> 2D (N, C*H*W). Reference: src/ops/flat.cu."""
+
+    op_type = "flat"
+
+    def output_shapes(self):
+        n = self.inputs[0].shape[0]
+        rest = 1
+        for s in self.inputs[0].shape[1:]:
+            rest *= s
+        return [(n, rest)]
+
+    def forward(self, params, xs, ctx: OpContext):
+        (x,) = xs
+        return [x.reshape(x.shape[0], -1)]
+
+    def output_axes(self):
+        return [(SAMPLE, CHANNEL)]
+
+    def input_axes(self):
+        axes = [None] * len(self.inputs[0].shape)
+        axes[0] = SAMPLE
+        return [tuple(axes)]

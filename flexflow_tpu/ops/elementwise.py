@@ -1,0 +1,133 @@
+"""Elementwise unary/binary ops, dropout, softmax.
+
+Reference: src/ops/element_unary.cu, element_binary.cu, dropout.cu,
+softmax.cu. The reference's in-place output machinery
+(can_inplace_output + compile-time in-place pass, model.cc:1580-1609) has
+no TPU analog: XLA does buffer reuse itself.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..op import SAMPLE, CHANNEL, Op, OpContext, register_op
+
+
+_UNARY = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "elu": jax.nn.elu,
+    "exp": jnp.exp,
+    "gelu": jax.nn.gelu,
+    "identity": lambda x: x,
+    "scalar_multiply": None,  # uses attrs["scalar"]
+}
+
+_BINARY = {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "divide": jnp.divide,
+    "max": jnp.maximum,
+    "min": jnp.minimum,
+}
+
+
+@register_op
+class ElementUnary(Op):
+    op_type = "element_unary"
+
+    def __init__(self, model, name, inputs, mode: str, scalar: float = None):
+        super().__init__(model, name, inputs)
+        assert mode in _UNARY, f"unknown unary mode {mode}"
+        self.mode = mode
+        self.scalar = scalar
+        self.attrs = {"mode": mode, "scalar": scalar}
+
+    def output_shapes(self):
+        return [tuple(self.inputs[0].shape)]
+
+    def forward(self, params, xs, ctx: OpContext):
+        (x,) = xs
+        if self.mode == "scalar_multiply":
+            return [x * self.scalar]
+        return [_UNARY[self.mode](x)]
+
+    def flops(self) -> float:
+        return float(self.inputs[0].num_elements)
+
+
+@register_op
+class ElementBinary(Op):
+    op_type = "element_binary"
+
+    def __init__(self, model, name, inputs, mode: str):
+        super().__init__(model, name, inputs)
+        assert mode in _BINARY, f"unknown binary mode {mode}"
+        # Reference requires same-shape (element_binary.cu: broadcasting NOT
+        # general); we allow numpy broadcasting as a superset.
+        self.mode = mode
+        self.attrs = {"mode": mode}
+
+    def output_shapes(self):
+        a, b = self.inputs[0].shape, self.inputs[1].shape
+        return [tuple(jnp.broadcast_shapes(a, b))]
+
+    def forward(self, params, xs, ctx: OpContext):
+        a, b = xs
+        return [_BINARY[self.mode](a, b)]
+
+    def flops(self) -> float:
+        return float(self.outputs[0].num_elements)
+
+
+@register_op
+class Dropout(Op):
+    """Reference: src/ops/dropout.cu (cuDNN dropout with reserve space —
+    here: stateless jax.random.bernoulli keyed off the per-step rng)."""
+
+    op_type = "dropout"
+
+    def __init__(self, model, name, inputs, rate: float, seed: int = 0):
+        super().__init__(model, name, inputs)
+        self.rate = float(rate)
+        self.seed = seed
+        self.attrs = {"rate": rate, "seed": seed}
+
+    def output_shapes(self):
+        return [tuple(self.inputs[0].shape)]
+
+    def forward(self, params, xs, ctx: OpContext):
+        (x,) = xs
+        if not ctx.training or self.rate <= 0.0:
+            return [x]
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(ctx.rng, keep, x.shape)
+        return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)]
+
+
+@register_op
+class Softmax(Op):
+    """Reference: src/ops/softmax.cu (cuDNN accurate-mode softmax =
+    max-subtracted, which is exactly jax.nn.softmax)."""
+
+    op_type = "softmax"
+
+    def __init__(self, model, name, inputs, axis: int = -1):
+        super().__init__(model, name, inputs)
+        self.axis = axis
+        self.attrs = {"axis": axis}
+
+    def output_shapes(self):
+        return [tuple(self.inputs[0].shape)]
+
+    def forward(self, params, xs, ctx: OpContext):
+        (x,) = xs
+        return [jax.nn.softmax(x, axis=self.axis)]
+
+    def flops(self) -> float:
+        return 5.0 * self.inputs[0].num_elements

@@ -1,0 +1,85 @@
+"""Embedding lookup with SUM/AVG aggregation.
+
+Reference: src/ops/embedding.cu (custom gather/scatter-add kernels) plus a
+hand-vectorized AVX2 CPU embedding-bag (embedding_avx2.cc:15-296). The op
+takes int indices of shape (batch, bag) and produces (batch, out_dim),
+aggregating over the bag dimension — DLRM-style embedding bag.
+
+TPU-native design: a plain `take` gather; XLA lowers it to an efficient
+one-hot-matmul or dynamic-gather depending on table size. The table's
+`vocab` logical axis can be mapped to a mesh axis for DLRM parameter
+parallelism (the reference placed whole tables on specific GPUs via
+strategies, SURVEY.md 2.3; sharding the vocab dim over ICI is the TPU
+generalization).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..op import CHANNEL_OUT, SAMPLE, VOCAB, Op, OpContext, WeightSpec, register_op
+
+AGGR_MODE_NONE = "none"
+AGGR_MODE_SUM = "sum"
+AGGR_MODE_AVG = "avg"
+
+
+@register_op
+class Embedding(Op):
+    op_type = "embedding"
+
+    def __init__(self, model, name, inputs, num_entries: int, out_dim: int,
+                 aggr: str = AGGR_MODE_SUM, kernel_initializer: str = "glorot"):
+        super().__init__(model, name, inputs)
+        self.num_entries = int(num_entries)
+        self.out_dim = int(out_dim)
+        self.aggr = aggr
+        self.kernel_initializer = kernel_initializer
+        self.attrs = {"num_entries": num_entries, "out_dim": out_dim,
+                      "aggr": aggr}
+
+    def output_shapes(self):
+        in_shape = self.inputs[0].shape
+        if self.aggr == AGGR_MODE_NONE:
+            return [tuple(in_shape) + (self.out_dim,)]
+        # (batch, bag) -> (batch, out_dim): aggregate over the bag dim.
+        return [(in_shape[0], self.out_dim)]
+
+    def output_dtypes(self):
+        return [jnp.dtype(jnp.float32)]
+
+    def weight_specs(self):
+        return {
+            "kernel": WeightSpec(
+                shape=(self.num_entries, self.out_dim),
+                initializer=self.kernel_initializer,
+                axes=(VOCAB, CHANNEL_OUT),
+            )
+        }
+
+    def forward(self, params, xs, ctx: OpContext):
+        (idx,) = xs
+        table = params["kernel"]
+        emb = jnp.take(table, idx.astype(jnp.int32), axis=0)
+        if self.aggr == AGGR_MODE_SUM:
+            emb = jnp.sum(emb, axis=-2)
+        elif self.aggr == AGGR_MODE_AVG:
+            emb = jnp.mean(emb, axis=-2)
+        return [emb]
+
+    def output_axes(self):
+        n = len(self.outputs[0].shape)
+        axes = [None] * n
+        axes[0] = SAMPLE
+        axes[-1] = CHANNEL_OUT
+        return [tuple(axes)]
+
+    def input_axes(self):
+        axes = [None] * len(self.inputs[0].shape)
+        axes[0] = SAMPLE
+        return [tuple(axes)]
+
+    def flops(self) -> float:
+        bag = self.inputs[0].shape[-1] if len(self.inputs[0].shape) > 1 else 1
+        return float(self.inputs[0].shape[0] * bag * self.out_dim)

@@ -1,0 +1,99 @@
+"""Dense / Linear layer.
+
+Reference: src/ops/linear.cu (1120 LoC) — cuBLAS SGEMM forward, two GEMMs +
+GEMV backward, and hand-built parameter parallelism: when out_channels is
+split the reference replicates the input tensor and adds a `backward2`
+replica-reduction task (linear.cu:144-270, 766-820). On TPU all of that
+collapses to a single jnp.dot with the kernel's `channel_out` logical axis
+mapped to a mesh axis: GSPMD inserts the all-gather/reduce-scatter.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..op import (
+    CHANNEL_IN,
+    CHANNEL_OUT,
+    SAMPLE,
+    Op,
+    OpContext,
+    WeightSpec,
+    register_op,
+)
+from .common import AC_MODE_NONE, apply_activation
+
+
+@register_op
+class Linear(Op):
+    op_type = "linear"
+
+    def __init__(self, model, name, inputs, out_channels: int,
+                 activation=AC_MODE_NONE, use_bias: bool = True,
+                 kernel_initializer: str = "glorot",
+                 bias_initializer: str = "zeros"):
+        super().__init__(model, name, inputs)
+        self.out_channels = int(out_channels)
+        self.in_channels = int(inputs[0].shape[-1])
+        self.activation = activation
+        self.use_bias = use_bias
+        self.kernel_initializer = kernel_initializer
+        self.bias_initializer = bias_initializer
+        self.attrs = {
+            "out_channels": self.out_channels,
+            "activation": activation,
+            "use_bias": use_bias,
+        }
+
+    def output_shapes(self) -> List[Tuple[int, ...]]:
+        return [tuple(self.inputs[0].shape[:-1]) + (self.out_channels,)]
+
+    def weight_specs(self) -> Dict[str, WeightSpec]:
+        # Kernel stored (in, out): the natural layout for x @ W on the MXU.
+        # (The reference stores (out, in) for cuBLAS^T, linear.cu:488-546.)
+        specs = {
+            "kernel": WeightSpec(
+                shape=(self.in_channels, self.out_channels),
+                initializer=self.kernel_initializer,
+                axes=(CHANNEL_IN, CHANNEL_OUT),
+            )
+        }
+        if self.use_bias:
+            specs["bias"] = WeightSpec(
+                shape=(self.out_channels,),
+                initializer=self.bias_initializer,
+                axes=(CHANNEL_OUT,),
+            )
+        return specs
+
+    def forward(self, params, xs, ctx: OpContext):
+        (x,) = xs
+        y = jnp.dot(x, params["kernel"].astype(x.dtype),
+                    preferred_element_type=jnp.float32)
+        y = y.astype(x.dtype)
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return [apply_activation(y, self.activation)]
+
+    def output_axes(self):
+        n = len(self.outputs[0].shape)
+        axes = [None] * n
+        axes[0] = SAMPLE
+        axes[-1] = CHANNEL_OUT
+        return [tuple(axes)]
+
+    def input_axes(self):
+        n = len(self.inputs[0].shape)
+        axes = [None] * n
+        axes[0] = SAMPLE
+        axes[-1] = CHANNEL_IN
+        return [tuple(axes)]
+
+    def flops(self) -> float:
+        batch = 1
+        for s in self.inputs[0].shape[:-1]:
+            batch *= s
+        return 2.0 * batch * self.in_channels * self.out_channels

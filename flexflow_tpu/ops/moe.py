@@ -1,0 +1,120 @@
+"""Mixture-of-Experts routing ops: GroupBy (dispatch) and Aggregate
+(combine).
+
+Reference: src/ops/group_by.cc (CPU-only scatter of samples to per-expert
+tensors with capacity factor `alpha`) and src/ops/aggregate.cc (CPU-only
+weighted combine). The reference registers these LOC_PROC (CPU) because
+irregular scatter is hostile to GPUs (model.cc:2525-2568).
+
+TPU-native design: GShard-style *dense dispatch*. Routing becomes one-hot
+dispatch masks contracted with the data on the MXU — no scatter at all,
+fully differentiable, and the expert dimension is a real array axis that
+can be sharded over a mesh `expert` axis so GSPMD inserts the all-to-all
+(expert parallelism, which the reference lacked — SURVEY.md section 2.4).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..op import EXPERT, SAMPLE, Op, OpContext, register_op
+
+
+def dispatch_mask(assign: jax.Array, n_experts: int, capacity: int):
+    """Build a dense dispatch mask from top-k expert assignments.
+
+    assign: (batch, k) int — expert id per (sample, slot).
+    Returns (batch*k, n_experts, capacity) float mask. Slot s of sample b
+    routes to position `rank` within its expert's capacity buffer, where
+    rank counts earlier (sample, slot) pairs assigned to the same expert;
+    overflow beyond capacity is dropped (the reference drops too:
+    group_by.cc capacity factor alpha).
+    """
+    flat = assign.reshape(-1).astype(jnp.int32)  # (B*k,)
+    onehot = jax.nn.one_hot(flat, n_experts, dtype=jnp.float32)  # (S, n)
+    ranks = jnp.cumsum(onehot, axis=0) * onehot - onehot  # rank within expert
+    rank = jnp.sum(ranks, axis=1).astype(jnp.int32)  # (S,)
+    keep = (rank < capacity).astype(jnp.float32)
+    pos = jax.nn.one_hot(rank, capacity, dtype=jnp.float32)  # (S, cap)
+    return onehot[:, :, None] * pos[:, None, :] * keep[:, None, None]
+
+
+@register_op
+class GroupBy(Op):
+    """inputs: (data (B, D), assign (B, k)); outputs: n tensors (cap, D)."""
+
+    op_type = "group_by"
+
+    def __init__(self, model, name, inputs, n: int, alpha: float):
+        super().__init__(model, name, inputs)
+        self.n = int(n)
+        self.alpha = float(alpha)
+        data, assign = inputs
+        batch = data.shape[0]
+        k = assign.shape[1]
+        self.k = k
+        # capacity per expert, matching group_by.cc's alpha*k*B/n
+        self.capacity = max(1, int(self.alpha * k * batch / self.n))
+        self.attrs = {"n": n, "alpha": alpha, "capacity": self.capacity}
+
+    def output_shapes(self):
+        d = self.inputs[0].shape[-1]
+        return [(self.capacity, d)] * self.n
+
+    def output_dtypes(self):
+        return [self.inputs[0].dtype] * self.n
+
+    def forward(self, params, xs, ctx: OpContext):
+        data, assign = xs
+        mask = dispatch_mask(assign, self.n, self.capacity)  # (S, n, cap)
+        xrep = jnp.repeat(data, self.k, axis=0)  # (S, D), slot-major like mask
+        expert_in = jnp.einsum("snc,sd->ncd", mask,
+                               xrep.astype(jnp.float32))
+        expert_in = expert_in.astype(data.dtype)
+        return [expert_in[i] for i in range(self.n)]
+
+    def output_axes(self):
+        return [(SAMPLE, None)] * self.n
+
+
+@register_op
+class Aggregate(Op):
+    """inputs: (gate_preds (B,k), assign (B,k), exp_pred_0..n-1 (cap, D));
+    output: (B, D) weighted combine. Reference: aggregate.cc."""
+
+    op_type = "aggregate"
+
+    def __init__(self, model, name, inputs, n: int, capacity: int = None,
+                 alpha: float = None):
+        super().__init__(model, name, inputs)
+        self.n = int(n)
+        gate, assign = inputs[0], inputs[1]
+        self.k = assign.shape[1]
+        batch = gate.shape[0]
+        if capacity is None:
+            capacity = inputs[2].shape[0]
+        self.capacity = int(capacity)
+        self.attrs = {"n": n, "capacity": self.capacity}
+
+    def output_shapes(self):
+        b = self.inputs[0].shape[0]
+        d = self.inputs[2].shape[-1]
+        return [(b, d)]
+
+    def output_dtypes(self):
+        return [self.inputs[2].dtype]
+
+    def forward(self, params, xs, ctx: OpContext):
+        gate, assign = xs[0], xs[1]
+        experts = jnp.stack(xs[2:], axis=0)  # (n, cap, D)
+        mask = dispatch_mask(assign, self.n, self.capacity)  # (S, n, cap)
+        gathered = jnp.einsum("snc,ncd->sd", mask,
+                              experts.astype(jnp.float32))  # (B*k, D)
+        b, k = assign.shape
+        gathered = gathered.reshape(b, k, -1)
+        out = jnp.sum(gathered * gate[:, :, None].astype(jnp.float32), axis=1)
+        return [out.astype(experts.dtype)]
+
+    def output_axes(self):
+        return [(SAMPLE, None)]

@@ -1,0 +1,198 @@
+"""Shape/data-movement ops: concat, split, reshape, transpose, reverse,
+top-k, batch matmul.
+
+Reference: src/ops/{concat,split,reshape,transpose,reverse,topk,
+batch_matmul}.cu. All the reference's hand-written strided-copy kernels
+become single jnp calls; XLA emits the copies (usually fused away).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..op import SAMPLE, SEQ, Op, OpContext, register_op
+
+
+@register_op
+class Concat(Op):
+    op_type = "concat"
+
+    def __init__(self, model, name, inputs, axis: int):
+        super().__init__(model, name, inputs)
+        self.axis = axis % len(inputs[0].shape)
+        self.attrs = {"axis": self.axis}
+
+    def output_shapes(self):
+        shape = list(self.inputs[0].shape)
+        shape[self.axis] = sum(t.shape[self.axis] for t in self.inputs)
+        return [tuple(shape)]
+
+    def forward(self, params, xs, ctx: OpContext):
+        return [jnp.concatenate(xs, axis=self.axis)]
+
+
+@register_op
+class Split(Op):
+    op_type = "split"
+
+    def __init__(self, model, name, inputs, sizes: List[int], axis: int):
+        super().__init__(model, name, inputs)
+        self.axis = axis % len(inputs[0].shape)
+        self.sizes = list(sizes)
+        assert sum(self.sizes) == inputs[0].shape[self.axis]
+        self.attrs = {"axis": self.axis, "sizes": self.sizes}
+
+    def output_shapes(self):
+        out = []
+        for s in self.sizes:
+            shape = list(self.inputs[0].shape)
+            shape[self.axis] = s
+            out.append(tuple(shape))
+        return out
+
+    def forward(self, params, xs, ctx: OpContext):
+        (x,) = xs
+        indices = []
+        acc = 0
+        for s in self.sizes[:-1]:
+            acc += s
+            indices.append(acc)
+        return list(jnp.split(x, indices, axis=self.axis))
+
+
+@register_op
+class Reshape(Op):
+    op_type = "reshape"
+
+    def __init__(self, model, name, inputs, shape: Tuple[int, ...]):
+        super().__init__(model, name, inputs)
+        shape = tuple(int(s) for s in shape)
+        n_in = inputs[0].num_elements
+        if -1 in shape:
+            known = 1
+            for s in shape:
+                if s != -1:
+                    known *= s
+            shape = tuple(n_in // known if s == -1 else s for s in shape)
+        self.new_shape = shape
+        self.attrs = {"shape": shape}
+
+    def output_shapes(self):
+        return [self.new_shape]
+
+    def forward(self, params, xs, ctx: OpContext):
+        return [xs[0].reshape(self.new_shape)]
+
+
+@register_op
+class Transpose(Op):
+    op_type = "transpose"
+
+    def __init__(self, model, name, inputs, perm: List[int]):
+        super().__init__(model, name, inputs)
+        self.perm = list(perm)
+        self.attrs = {"perm": self.perm}
+
+    def output_shapes(self):
+        s = self.inputs[0].shape
+        return [tuple(s[p] for p in self.perm)]
+
+    def forward(self, params, xs, ctx: OpContext):
+        return [jnp.transpose(xs[0], self.perm)]
+
+
+@register_op
+class Reverse(Op):
+    op_type = "reverse"
+
+    def __init__(self, model, name, inputs, axis: int):
+        super().__init__(model, name, inputs)
+        self.axis = axis % len(inputs[0].shape)
+        self.attrs = {"axis": self.axis}
+
+    def output_shapes(self):
+        return [tuple(self.inputs[0].shape)]
+
+    def forward(self, params, xs, ctx: OpContext):
+        return [jnp.flip(xs[0], axis=self.axis)]
+
+
+@register_op
+class TopK(Op):
+    """Two outputs (values, indices). Reference: src/ops/topk.cu's bitonic
+    per-thread-heap kernel -> lax.top_k (XLA's native TPU sort)."""
+
+    op_type = "topk"
+
+    def __init__(self, model, name, inputs, k: int, sorted: bool = True):
+        super().__init__(model, name, inputs)
+        self.k = int(k)
+        self.sorted = sorted
+        self.attrs = {"k": k, "sorted": sorted}
+
+    def output_shapes(self):
+        shape = list(self.inputs[0].shape)
+        shape[-1] = self.k
+        return [tuple(shape), tuple(shape)]
+
+    def output_dtypes(self):
+        return [self.inputs[0].dtype, jnp.dtype(jnp.int32)]
+
+    def forward(self, params, xs, ctx: OpContext):
+        values, indices = jax.lax.top_k(xs[0], self.k)
+        return [values, indices.astype(jnp.int32)]
+
+
+@register_op
+class BatchMatmul(Op):
+    """Batched matmul A @ B over leading batch dims.
+
+    Reference: src/ops/batch_matmul.cu — cuBLAS strided-batched GEMM with
+    seq_length-aware shape truncation (`a_seq_length_dim`, runtime
+    iter_config.seq_length masks, model.h:1029-1047). We reproduce the
+    truncation semantics with a mask (dynamic shapes would defeat XLA
+    caching; masking keeps the compiled program static).
+    """
+
+    op_type = "batch_matmul"
+
+    def __init__(self, model, name, inputs, a_seq_length_dim: int = -1,
+                 b_seq_length_dim: int = -1):
+        super().__init__(model, name, inputs)
+        a, b = inputs
+        assert a.shape[:-2] == b.shape[:-2], "batch dims must match"
+        assert a.shape[-1] == b.shape[-2], (a.shape, b.shape)
+        self.a_seq_length_dim = a_seq_length_dim
+        self.b_seq_length_dim = b_seq_length_dim
+        self.attrs = {"a_seq_length_dim": a_seq_length_dim,
+                      "b_seq_length_dim": b_seq_length_dim}
+
+    def output_shapes(self):
+        a, b = self.inputs
+        return [tuple(a.shape[:-1]) + (b.shape[-1],)]
+
+    @staticmethod
+    def _seq_mask(x, dim, seq_length):
+        if dim < 0 or seq_length is None or seq_length < 0:
+            return x
+        idx = jnp.arange(x.shape[dim])
+        shape = [1] * x.ndim
+        shape[dim] = -1
+        return jnp.where(idx.reshape(shape) < seq_length, x, 0)
+
+    def forward(self, params, xs, ctx: OpContext):
+        a, b = xs
+        a = self._seq_mask(a, self.a_seq_length_dim, ctx.seq_length)
+        b = self._seq_mask(b, self.b_seq_length_dim, ctx.seq_length)
+        y = jnp.matmul(a, b, preferred_element_type=jnp.float32)
+        return [y.astype(a.dtype)]
+
+    def flops(self) -> float:
+        a, b = self.inputs
+        batch = 1
+        for s in a.shape[:-2]:
+            batch *= s
+        return 2.0 * batch * a.shape[-2] * a.shape[-1] * b.shape[-1]

@@ -1,0 +1,12 @@
+"""Parallelism: mesh construction, per-op strategies, sharding resolution,
+ring attention (SP), pipeline parallelism.
+
+This layer replaces the reference's FFMapper + ParallelConfig machinery
+(src/mapper/mapper.cc, include/config.h:47-73): instead of routing Legion
+index-task points to explicit device ids, a strategy maps each op's
+*logical axes* to mesh axes and GSPMD materializes the placement.
+"""
+
+from .mesh import MachineSpec, make_mesh, default_mesh
+from .pconfig import OpStrategy, Strategy, ParallelConfig
+from .sharding import spec_for_axes, op_output_sharding, weight_sharding

@@ -1,0 +1,86 @@
+"""Device mesh construction.
+
+The reference discovers GPUs/CPUs and their memories inside the mapper
+(mapper.cc:55-145) and encodes machines analytically in `MachineModel`
+(machine_model.cc). On TPU the machine is a `jax.sharding.Mesh`: an N-D
+array of devices with named axes. Canonical axis names:
+
+  data      — batch (DP; reference "sample parallel")
+  model     — tensor parallel (reference "parameter/attribute parallel")
+  seq       — sequence/context parallel (new, no reference analog)
+  expert    — expert parallel for MoE (new)
+  pipe      — pipeline stages (new)
+
+Meshes should be laid out so the fastest-varying axes ride ICI; multi-host
+meshes put `data` on DCN (jax device order already enumerates
+process-local devices contiguously, which achieves this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA = "data"
+MODEL = "model"
+SEQ_AX = "seq"
+EXPERT_AX = "expert"
+PIPE = "pipe"
+
+ALL_AXES = (DATA, MODEL, SEQ_AX, EXPERT_AX, PIPE)
+
+
+@dataclasses.dataclass
+class MachineSpec:
+    """Analytic description of the target machine for the cost model
+    (replaces reference EnhancedMachineModel, simulator.h:99-236).
+
+    Defaults approximate a TPU v5p pod slice.
+    """
+
+    num_chips: int = 1
+    # per-chip
+    peak_flops: float = 459e12  # bf16 FLOP/s per v5p chip
+    hbm_bandwidth: float = 2.765e12  # bytes/s
+    hbm_capacity: float = 95e9  # bytes
+    vmem_capacity: float = 128e6
+    # interconnect
+    ici_bandwidth: float = 9e10 * 2  # bytes/s per link, 3D torus, bidir
+    ici_latency: float = 1e-6
+    dcn_bandwidth: float = 25e9
+    dcn_latency: float = 10e-6
+
+    @staticmethod
+    def v5e(num_chips: int = 1) -> "MachineSpec":
+        return MachineSpec(
+            num_chips=num_chips, peak_flops=197e12, hbm_bandwidth=8.1e11,
+            hbm_capacity=16e9, ici_bandwidth=4.5e10, dcn_bandwidth=25e9)
+
+
+def make_mesh(shape: Sequence[int], axes: Sequence[str],
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from axis sizes/names over the available devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod(shape))
+    assert n <= len(devices), (
+        f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(tuple(shape))
+    return Mesh(arr, tuple(axes))
+
+
+def default_mesh(num_devices: Optional[int] = None) -> Mesh:
+    """Pure data-parallel mesh over all devices (the reference's default
+    strategy is pure DP too — mapper.cc:118-145 seeds 1D-5D DP)."""
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return make_mesh((len(devices),), (DATA,), devices)
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh((1,), (DATA,), jax.devices()[:1])

@@ -1,0 +1,124 @@
+"""Per-op parallelization strategies.
+
+Reference `ParallelConfig` (include/config.h:47-73): device_type, nDims,
+per-dim split counts, explicit device_ids. The TPU-native strategy is a
+mapping {logical axis -> mesh axis}; split counts follow from the mesh
+axis sizes and explicit device ids follow from the mesh layout, so both
+reference fields are derived, not stored.
+
+`ParallelConfig` is retained as a compatibility view (strategy file I/O,
+tests that check reference semantics like num_parts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+from ..op import Op
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    """Compatibility view of one op's placement (reference config.h:47-73)."""
+
+    device_type: str = "tpu"
+    dims: List[int] = dataclasses.field(default_factory=lambda: [1])
+    device_ids: List[int] = dataclasses.field(default_factory=lambda: [0])
+
+    @property
+    def num_parts(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def is_data_parallel(self) -> bool:
+        # reference simulator.cc:28-40: DP = only the sample (outermost
+        # logical, innermost stored) dim is split. We store NumPy order, so
+        # DP = only dims[0] split.
+        return self.num_parts == self.dims[0]
+
+
+@dataclasses.dataclass
+class OpStrategy:
+    """Maps an op's logical axes to mesh axes. axis_map values may be a
+    mesh axis name, a tuple of axis names (multi-axis sharding), or None."""
+
+    axis_map: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    def mesh_axis_for(self, logical_axis: Optional[str]):
+        if logical_axis is None:
+            return None
+        return self.axis_map.get(logical_axis)
+
+    def copy(self) -> "OpStrategy":
+        return OpStrategy(dict(self.axis_map))
+
+
+class Strategy:
+    """Global strategy: op name -> OpStrategy, plus a default.
+
+    The default maps `sample` to the mesh's `data` axis — exactly the
+    reference's seeded data-parallel default (mapper.cc:118-145).
+    """
+
+    def __init__(self, op_strategies: Optional[Dict[str, OpStrategy]] = None,
+                 default: Optional[OpStrategy] = None):
+        self.op_strategies: Dict[str, OpStrategy] = op_strategies or {}
+        self.default = default or OpStrategy({"sample": "data"})
+
+    def for_op(self, op_name: str) -> OpStrategy:
+        return self.op_strategies.get(op_name, self.default)
+
+    def set(self, op_name: str, strategy: OpStrategy) -> None:
+        self.op_strategies[op_name] = strategy
+
+    def copy(self) -> "Strategy":
+        return Strategy(
+            {k: v.copy() for k, v in self.op_strategies.items()},
+            self.default.copy(),
+        )
+
+    # ---- file I/O ----
+    # Native format is JSON ({"default": {...}, "ops": {name: axis_map}}).
+    # The reference's plain-text format (strategy.cc:95-189) is also
+    # readable/writable for tooling familiarity via to_text/from_text.
+
+    def save(self, path: str) -> None:
+        data = {
+            "format": "flexflow_tpu_strategy_v1",
+            "default": self.default.axis_map,
+            "ops": {k: v.axis_map for k, v in self.op_strategies.items()},
+        }
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2)
+
+    @staticmethod
+    def load(path: str) -> "Strategy":
+        with open(path) as f:
+            data = json.load(f)
+        return Strategy(
+            {k: OpStrategy(v) for k, v in data.get("ops", {}).items()},
+            OpStrategy(data.get("default", {"sample": "data"})),
+        )
+
+    def __repr__(self):
+        return (f"Strategy(default={self.default.axis_map}, "
+                f"{len(self.op_strategies)} op overrides)")
+
+
+DATA_PARALLEL = Strategy()
+
+
+def megatron_strategy(model_axis: str = "model") -> Strategy:
+    """TP default: split channel_out/head/vocab over the model axis (the
+    reference reached the same placement through MCMC discovering
+    out-channel splits for Linear, linear.cu:1074-1107)."""
+    return Strategy(default=OpStrategy({
+        "sample": "data",
+        "channel_out": model_axis,
+        "head": model_axis,
+        "vocab": model_axis,
+    }))

@@ -1,0 +1,69 @@
+"""Resolve (op, strategy, mesh) -> jax shardings.
+
+This is the whole of the reference's mapper layer (mapper.cc slice_task /
+map_task, 1531 LoC) reduced to PartitionSpec construction: GSPMD does the
+actual placement and collective insertion.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..op import Op, WeightSpec
+from .pconfig import OpStrategy
+
+
+def spec_for_axes(axes: Sequence[Optional[str]], strategy: OpStrategy,
+                  mesh: Mesh, shape: Optional[Sequence[int]] = None) -> P:
+    """Build a PartitionSpec mapping each logical axis through the
+    strategy; axes that resolve to mesh axes not present in `mesh` (or
+    that don't divide the dim size) are left unsharded."""
+    entries = []
+    used = set()
+    for i, ax in enumerate(axes):
+        m = strategy.mesh_axis_for(ax)
+        if m is None:
+            entries.append(None)
+            continue
+        names = (m,) if isinstance(m, str) else tuple(m)
+        names = tuple(n for n in names
+                      if n in mesh.shape and n not in used)
+        if not names:
+            entries.append(None)
+            continue
+        if shape is not None:
+            size = 1
+            for n in names:
+                size *= mesh.shape[n]
+            if shape[i] % size != 0:
+                entries.append(None)
+                continue
+        used.update(names)
+        entries.append(names[0] if len(names) == 1 else names)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def op_output_sharding(op: Op, strategy: OpStrategy, mesh: Mesh):
+    """NamedSharding per output of `op`."""
+    out = []
+    for i, axes in enumerate(op.output_axes()):
+        spec = spec_for_axes(axes, strategy, mesh, op.outputs[i].shape)
+        out.append(NamedSharding(mesh, spec))
+    return out
+
+
+def weight_sharding(spec: WeightSpec, strategy: OpStrategy, mesh: Mesh):
+    pspec = spec_for_axes(spec.axes, strategy, mesh, spec.shape)
+    return NamedSharding(mesh, pspec)
+
+
+def batch_sharding(mesh: Mesh, ndim: int, data_axis: str = "data"):
+    """Input batch: shard dim 0 over the data axis."""
+    if data_axis not in mesh.shape:
+        return NamedSharding(mesh, P())
+    return NamedSharding(mesh, P(data_axis, *([None] * (ndim - 1))))

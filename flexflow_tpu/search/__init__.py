@@ -1,0 +1,2 @@
+"""Auto-parallelization search — the heart of the reference
+(SURVEY.md 2.4): cost model + simulator + MCMC over per-op strategies."""

@@ -1,0 +1,18 @@
+"""MCMC strategy search (reference: FFModel::optimize, model.cc:1905-1968).
+
+Round-1 placeholder: returns the data-parallel default so
+compile(search_budget>0) is functional; the annealing loop over the
+simulator lands with the cost-model milestone.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from ..parallel.pconfig import Strategy
+
+
+def optimize(model, budget: int = 0, alpha: float = 0.05) -> Strategy:
+    warnings.warn("MCMC strategy search not yet implemented; "
+                  "returning data-parallel default strategy")
+    return model.strategy or Strategy()

@@ -1,0 +1,95 @@
+"""Symbolic tensor handles for the graph builder.
+
+The reference `Tensor` (include/tensor.h:27-73) wraps a Legion
+`LogicalRegion` plus gradient region and partitions. On TPU there are no
+regions: a `Tensor` here is a *symbolic* handle produced while the user
+builds the graph; concrete values are JAX arrays materialized by the
+executor, and gradients come from `jax.grad` instead of paired grad
+regions. Partitions become sharding specs attached at compile time
+(flexflow_tpu/parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import TYPE_CHECKING, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+if TYPE_CHECKING:
+    from .op import Op
+
+_uid = itertools.count()
+
+
+class Tensor:
+    """Symbolic N-D tensor handle.
+
+    shape is stored outer-to-inner, NumPy order. (The reference stores
+    Legion/Fortran order `adim[]` innermost-first, tensor.h:44; we keep
+    NumPy order throughout and translate only in frontends that care.)
+    """
+
+    __slots__ = (
+        "shape",
+        "dtype",
+        "owner_op",
+        "owner_idx",
+        "name",
+        "uid",
+        "is_input",
+        "initial_value",
+    )
+
+    def __init__(
+        self,
+        shape: Tuple[int, ...],
+        dtype=jnp.float32,
+        owner_op: Optional["Op"] = None,
+        owner_idx: int = 0,
+        name: Optional[str] = None,
+        is_input: bool = False,
+    ):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = jnp.dtype(dtype)
+        self.owner_op = owner_op
+        self.owner_idx = owner_idx
+        self.uid = next(_uid)
+        self.name = name or f"tensor_{self.uid}"
+        self.is_input = is_input
+        self.initial_value: Optional[np.ndarray] = None
+
+    @property
+    def num_dims(self) -> int:
+        return len(self.shape)
+
+    @property
+    def num_elements(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def size_bytes(self) -> int:
+        return self.num_elements * self.dtype.itemsize
+
+    def __repr__(self):
+        prod = self.owner_op.name if self.owner_op is not None else "input"
+        return f"Tensor({self.name}, shape={self.shape}, dtype={self.dtype.name}, by={prod})"
+
+
+class Parameter(Tensor):
+    """A trainable weight handle (reference: include/tensor.h `Parameter`).
+
+    ``sync_type`` is kept for API compatibility (ParameterSyncType); on TPU
+    gradient synchronization is always XLA collectives inserted by GSPMD.
+    """
+
+    __slots__ = ("sync_type", "initializer_name")
+
+    def __init__(self, shape, dtype=jnp.float32, owner_op=None, name=None,
+                 sync_type: str = "none", initializer_name: str = "glorot"):
+        super().__init__(shape, dtype, owner_op=owner_op, name=name)
+        self.sync_type = sync_type
+        self.initializer_name = initializer_name

@@ -1,0 +1,38 @@
+"""Test harness: force an 8-device CPU platform so every parallelism axis
+(DP/TP/SP/EP/PP) is exercised without TPU hardware — the capability the
+reference never had (its "distributed" CI needed 4 real GPUs,
+SURVEY.md section 4)."""
+
+import os
+
+# Unconditional: the image pre-sets JAX_PLATFORMS (sitecustomize) to the
+# TPU tunnel, but tests must run on a virtual 8-device CPU platform.
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                           + os.environ.get("XLA_FLAGS", ""))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# env var alone is overridden by the image's sitecustomize; force it.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "float32")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+@pytest.fixture
+def mesh8():
+    from flexflow_tpu.parallel.mesh import make_mesh
+    return make_mesh((8,), ("data",))
+
+
+@pytest.fixture
+def mesh_2d():
+    from flexflow_tpu.parallel.mesh import make_mesh
+    return make_mesh((4, 2), ("data", "model"))

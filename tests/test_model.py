@@ -1,0 +1,113 @@
+"""End-to-end training tests: graph building, compile, fit.
+
+Pattern follows reference tests/accuracy_tests.sh — train few epochs on a
+small problem and assert the model actually learns."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, SGDOptimizer
+
+
+def make_mlp(config=None):
+    ff = FFModel(config or FFConfig())
+    x = ff.create_tensor((config.batch_size if config else 64, 16),
+                         name="input")
+    t = ff.dense(x, 32, activation="relu")
+    t = ff.dense(t, 4)
+    t = ff.softmax(t)
+    return ff
+
+
+def synthetic_classification(n=512, d=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d, classes).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rng.randn(n, classes), axis=1).astype(np.int32)
+    return x, y
+
+
+def test_mlp_learns():
+    cfg = FFConfig()
+    cfg.batch_size = 64
+    ff = make_mlp(cfg)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    x, y = synthetic_classification()
+    hist = ff.fit({"input": x}, y, epochs=12, verbose=False)
+    assert hist[-1]["accuracy"] > 0.8, hist[-1]
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+
+def test_mlp_adam_learns():
+    cfg = FFConfig()
+    cfg.batch_size = 64
+    ff = make_mlp(cfg)
+    ff.compile(optimizer=AdamOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    x, y = synthetic_classification()
+    hist = ff.fit({"input": x}, y, epochs=8, verbose=False)
+    assert hist[-1]["accuracy"] > 0.8, hist[-1]
+
+
+def test_cnn_trains_and_bn_state_updates():
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    ff = FFModel(cfg)
+    x = ff.create_tensor((16, 3, 8, 8), name="input")
+    t = ff.conv2d(x, 8, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = ff.batch_norm(t, relu=True)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 4)
+    t = ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 3, 8, 8).astype(np.float32)
+    ys = (xs.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    rm_before = np.asarray(
+        ff.state.states["batch_norm"]["running_mean"]).copy()
+    hist = ff.fit({"input": xs}, ys, epochs=3, verbose=False)
+    rm_after = np.asarray(ff.state.states["batch_norm"]["running_mean"])
+    assert not np.allclose(rm_before, rm_after), "BN stats must update"
+    assert np.isfinite(hist[-1]["loss"])
+
+
+def test_weight_get_set_roundtrip():
+    cfg = FFConfig()
+    ff = make_mlp(cfg)
+    ff.compile()
+    w = ff.get_weights("dense")
+    assert w["kernel"].shape == (16, 32)
+    neww = {k: np.zeros_like(v) for k, v in w.items()}
+    ff.set_weights("dense", neww)
+    w2 = ff.get_weights("dense")
+    np.testing.assert_allclose(w2["kernel"], 0.0)
+
+
+def test_mse_regression_learns():
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    ff = FFModel(cfg)
+    x = ff.create_tensor((32, 8), name="input")
+    t = ff.dense(x, 16, activation="tanh")
+    t = ff.dense(t, 1)
+    ff.compile(optimizer=AdamOptimizer(lr=0.01),
+               loss_type="mean_squared_error", metrics=[])
+    rng = np.random.RandomState(0)
+    xs = rng.randn(256, 8).astype(np.float32)
+    ys = (xs.sum(axis=1, keepdims=True) * 0.1).astype(np.float32)
+    hist = ff.fit({"input": xs}, ys, epochs=10, verbose=False)
+    assert hist[-1]["loss"] < hist[0]["loss"] * 0.5
+
+
+def test_summary():
+    cfg = FFConfig()
+    ff = make_mlp(cfg)
+    s = ff.summary()
+    assert "dense" in s and "total params" in s
