@@ -73,6 +73,7 @@ class Executor:
         self.strategy = strategy or Strategy()
         self._train_step = None
         self._eval_step = None
+        self._last_aux_losses = []
 
     # ---------------- initialization ----------------
     def init_state(self, rng) -> TrainState:
@@ -90,7 +91,12 @@ class Executor:
                         jax.random.fold_in(rng, _stable_hash(op.name)),
                         _stable_hash(wname))
                     init_fn = spec.custom_init or I.resolve(spec.initializer)
-                    arr = init_fn(key, spec.shape, spec.dtype)
+                    if spec.fan_in is not None or spec.fan_out is not None:
+                        arr = init_fn(key, spec.shape, spec.dtype,
+                                      fan_in=spec.fan_in,
+                                      fan_out=spec.fan_out)
+                    else:
+                        arr = init_fn(key, spec.shape, spec.dtype)
                     if self.mesh is not None:
                         sh = weight_sharding(
                             spec, self.strategy.for_op(op.name), self.mesh)
@@ -122,6 +128,7 @@ class Executor:
                 raise KeyError(f"missing input {t.name!r}; have {list(inputs)}")
             values[t.uid] = inputs[t.name]
         new_states: Dict[str, Dict[str, jax.Array]] = {}
+        aux_losses = []
         for op in self.model.ops:
             ctx = OpContext(
                 training=training,
@@ -129,14 +136,19 @@ class Executor:
                      if rng is not None else None),
                 seq_length=seq_length,
                 state_in=states.get(op.name, {}),
+                mesh=self.mesh,
+                op_strategy=self.strategy.for_op(op.name),
             )
             xs = [values[t.uid] for t in op.inputs]
             op_params = params.get(op.name, {})
             # remat: recompute this op's activations in backward instead of
             # saving them (HBM-for-FLOPs trade, SURVEY.md env notes). Ops
-            # with functional state (BN) are excluded — their state updates
-            # must not be re-traced.
-            if self.config.remat and op.weight_specs() and not op.state_specs():
+            # with functional state (BN) or aux losses (MoE) are excluded —
+            # their ctx side-channel values must not escape the
+            # checkpointed trace (tracer leak otherwise).
+            if (self.config.remat and op.weight_specs()
+                    and not op.state_specs()
+                    and not getattr(op, "has_aux_loss", False)):
                 ys = jax.checkpoint(
                     lambda p, x, _op=op, _ctx=ctx: _op.forward(p, x, _ctx)
                 )(op_params, xs)
@@ -151,9 +163,12 @@ class Executor:
                 values[t.uid] = y
             if ctx.state_out:
                 new_states[op.name] = ctx.state_out
+            if ctx.aux_loss is not None:
+                aux_losses.append(ctx.aux_loss)
         # carry through untouched states (eval path of ops w/o forward call)
         for name, s in states.items():
             new_states.setdefault(name, s)
+        self._last_aux_losses = aux_losses
         return values, new_states
 
     def _outputs_and_loss(self, params, states, batch, training, rng,
@@ -164,6 +179,8 @@ class Executor:
         loss = jnp.asarray(0.0, jnp.float32)
         if self.loss_fn is not None and "label" in batch:
             loss = self.loss_fn(logits, batch["label"])
+        for aux in self._last_aux_losses:
+            loss = loss + aux
         return loss, (logits, new_states)
 
     # ---------------- step builders ----------------

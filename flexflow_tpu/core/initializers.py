@@ -35,8 +35,9 @@ def _fans(shape: Tuple[int, ...]) -> Tuple[int, int]:
     return fan_in, fan_out
 
 
-def glorot_uniform(key, shape, dtype=jnp.float32):
-    fan_in, fan_out = _fans(shape)
+def glorot_uniform(key, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+    if fan_in is None or fan_out is None:
+        fan_in, fan_out = _fans(shape)
     scale = math.sqrt(6.0 / (fan_in + fan_out))
     return jax.random.uniform(key, shape, dtype, -scale, scale)
 
@@ -67,8 +68,9 @@ def make_normal(mean: float = 0.0, stddev: float = 1.0, seed: int = 0):
     return init
 
 
-def he_normal(key, shape, dtype=jnp.float32):
-    fan_in, _ = _fans(shape)
+def he_normal(key, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+    if fan_in is None:
+        fan_in, _ = _fans(shape)
     return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / fan_in)
 
 

@@ -24,6 +24,8 @@ from .op import Op
 from .ops import (
     LSTM,
     Aggregate,
+    MoEFFN,
+    PipelineBlocks,
     BatchMatmul,
     BatchNorm,
     Concat,
@@ -264,6 +266,32 @@ class FFModel:
                   name: Optional[str] = None) -> Tensor:
         op = Aggregate(self, name or self._fresh_name("aggregate"),
                        [gate_preds, gate_assign] + list(exp_preds), n)
+        return self.add_op(op).output
+
+
+    def moe_ffn(self, input: Tensor, num_experts: int, k: int,
+                hidden_dim: int, out_dim: int = None,
+                capacity_factor: float = 1.25, activation="relu",
+                aux_loss_weight: float = 1e-2,
+                name: Optional[str] = None) -> Tensor:
+        """Fused expert-parallel MoE FFN (TPU-first EP; the composable
+        reference path softmax+topk+group_by+aggregate also exists)."""
+        op = MoEFFN(self, name or self._fresh_name("moe_ffn"), [input],
+                    num_experts, k, hidden_dim, out_dim, capacity_factor,
+                    activation, aux_loss_weight)
+        return self.add_op(op).output
+
+
+    def pipeline_blocks(self, input: Tensor, block_builder, num_layers: int,
+                        num_microbatches: int = 4,
+                        name: Optional[str] = None) -> Tensor:
+        """Stack of identical shape-preserving blocks with first-class
+        pipeline parallelism (GPipe schedule when the strategy maps the
+        `layer` axis to a mesh `pipe` axis). block_builder(sub_model, t)
+        builds one block with the normal layer API."""
+        op = PipelineBlocks(self, name or self._fresh_name("pipeline"),
+                            [input], block_builder, num_layers,
+                            num_microbatches)
         return self.add_op(op).output
 
     def lstm(self, input: Tensor, hidden_size: int,

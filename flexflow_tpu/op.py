@@ -47,18 +47,26 @@ HEIGHT = "height"
 WIDTH = "width"
 EXPERT = "expert"
 VOCAB = "vocab"
+LAYER = "layer"
 REPLICA = None  # dimension never split
 
 
 @dataclasses.dataclass
 class WeightSpec:
-    """Declaration of one trainable parameter of an op."""
+    """Declaration of one trainable parameter of an op.
+
+    ``fan_in``/``fan_out`` override shape-derived fans for fan-scaled
+    initializers — needed for stacked weights (MoE experts (E, D, H),
+    attention (E, H, Dh)) where the generic shape heuristic is wrong.
+    """
 
     shape: Tuple[int, ...]
     dtype: jnp.dtype = jnp.float32
     initializer: str = "glorot"  # name into core.initializers registry
     axes: Tuple[Optional[str], ...] = None  # logical axis per dim
     custom_init: Optional[Callable] = None  # overrides `initializer`
+    fan_in: Optional[int] = None
+    fan_out: Optional[int] = None
 
     def __post_init__(self):
         if self.axes is None:
@@ -80,17 +88,44 @@ class StateSpec:
 
 
 class OpContext:
-    """Per-invocation context handed to ``Op.forward``."""
+    """Per-invocation context handed to ``Op.forward``.
 
-    __slots__ = ("training", "rng", "seq_length", "state_in", "state_out")
+    ``mesh``/``op_strategy`` let collective-aware ops (ring attention for
+    SP, fused MoE for EP) pick explicit shard_map implementations when
+    their strategy maps an axis to a >1-sized mesh axis.
+    """
+
+    __slots__ = ("training", "rng", "seq_length", "state_in", "state_out",
+                 "mesh", "op_strategy", "aux_loss")
 
     def __init__(self, training: bool, rng=None, seq_length: int = -1,
-                 state_in: Optional[dict] = None):
+                 state_in: Optional[dict] = None, mesh=None,
+                 op_strategy=None):
         self.training = training
         self.rng = rng
         self.seq_length = seq_length
         self.state_in = state_in or {}
         self.state_out: dict = {}
+        self.mesh = mesh
+        self.op_strategy = op_strategy
+        # ops may set a scalar auxiliary loss (e.g. MoE load-balancing);
+        # the executor adds it to the training objective.
+        self.aux_loss = None
+
+    def mesh_axis_size(self, logical_axis: str) -> int:
+        """Size of the mesh axis a logical axis maps to (1 if unmapped)."""
+        if self.mesh is None or self.op_strategy is None:
+            return 1
+        ax = self.op_strategy.mesh_axis_for(logical_axis)
+        if ax is None or not isinstance(ax, str):
+            return 1
+        return self.mesh.shape.get(ax, 1)
+
+    def mesh_axis_name(self, logical_axis: str):
+        if self.op_strategy is None:
+            return None
+        ax = self.op_strategy.mesh_axis_for(logical_axis)
+        return ax if isinstance(ax, str) else None
 
 
 class Op:

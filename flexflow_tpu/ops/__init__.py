@@ -20,6 +20,8 @@ from .tensor_ops import (
 from .embedding import Embedding
 from .attention import MultiHeadAttention
 from .moe import GroupBy, Aggregate
+from .moe_ffn import MoEFFN
+from .pipeline import PipelineBlocks
 from .rnn import LSTM
 
 __all__ = [
@@ -43,5 +45,7 @@ __all__ = [
     "MultiHeadAttention",
     "GroupBy",
     "Aggregate",
+    "MoEFFN",
+    "PipelineBlocks",
     "LSTM",
 ]

@@ -70,16 +70,21 @@ class MultiHeadAttention(Op):
 
     def weight_specs(self):
         h, d = self.num_heads, self.head_dim
+        e = self.embed_dim
         specs = {
             "wq": WeightSpec((self.q_in, h, d), initializer=self.kernel_initializer,
-                             axes=(CHANNEL_IN, HEAD, None)),
+                             axes=(CHANNEL_IN, HEAD, None),
+                             fan_in=self.q_in, fan_out=e),
             "wk": WeightSpec((self.k_in, h, d), initializer=self.kernel_initializer,
-                             axes=(CHANNEL_IN, HEAD, None)),
+                             axes=(CHANNEL_IN, HEAD, None),
+                             fan_in=self.k_in, fan_out=e),
             "wv": WeightSpec((self.v_in, h, d), initializer=self.kernel_initializer,
-                             axes=(CHANNEL_IN, HEAD, None)),
-            "wo": WeightSpec((h, d, self.embed_dim),
+                             axes=(CHANNEL_IN, HEAD, None),
+                             fan_in=self.v_in, fan_out=e),
+            "wo": WeightSpec((h, d, e),
                              initializer=self.kernel_initializer,
-                             axes=(HEAD, None, CHANNEL_OUT)),
+                             axes=(HEAD, None, CHANNEL_OUT),
+                             fan_in=e, fan_out=e),
         }
         if self.use_bias:
             specs["bo"] = WeightSpec((self.embed_dim,), initializer="zeros",
@@ -121,6 +126,25 @@ class MultiHeadAttention(Op):
     def _attend(self, q, k, v, ctx: OpContext):
         """softmax(QK^T/sqrt(d))V, (b, s, h, d) layout."""
         has_seq_trunc = ctx.seq_length is not None and ctx.seq_length >= 0
+        # Sequence parallelism: when the strategy maps `seq` to a mesh
+        # axis, run ring attention over that axis (K/V rotate over ICI).
+        # Guards mirror spec_for_axes' graceful degradation: fall back to
+        # the XLA path when shapes don't divide the mesh axes or when kv
+        # carries extra rows (bias_kv/zero_attn).
+        seq_size = ctx.mesh_axis_size("seq")
+        if (seq_size > 1 and not has_seq_trunc
+                and not self.add_zero_attn and not self.add_bias_kv
+                and q.shape[1] % seq_size == 0
+                and k.shape[1] % seq_size == 0):
+            from ..parallel.ring_attention import ring_attention
+            data_ax = ctx.mesh_axis_name("sample") or "data"
+            data_size = (ctx.mesh.shape.get(data_ax, 1)
+                         if ctx.mesh is not None else 1)
+            if q.shape[0] % max(1, data_size) == 0:
+                return ring_attention(
+                    q, k, v, ctx.mesh, seq_axis=ctx.mesh_axis_name("seq"),
+                    batch_axis=data_ax, causal=self.causal,
+                    scale=1.0 / math.sqrt(self.head_dim))
         if self.add_zero_attn:
             zero = jnp.zeros(k.shape[:1] + (1,) + k.shape[2:], k.dtype)
             k = jnp.concatenate([k, zero], axis=1)

@@ -13,7 +13,26 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-from ..op import SAMPLE, CHANNEL, Op, OpContext, register_op
+from ..op import SAMPLE, CHANNEL, SEQ, Op, OpContext, register_op
+
+
+def _passthrough_axes(shape):
+    """Logical axes for rank-preserving ops: (sample, seq, channel) for
+    rank-3 sequence tensors, sample-only otherwise (conv NCHW tensors are
+    handled by the conv ops' own overrides)."""
+    n = len(shape)
+    axes = [None] * n
+    if n >= 1:
+        axes[0] = SAMPLE
+    if n == 3:
+        axes[1] = SEQ
+        axes[2] = CHANNEL
+    return [tuple(axes)]
+
+
+class PassthroughAxesMixin:
+    """Shared logical-axis labeling for rank-preserving ops."""
+
 
 
 _UNARY = {
@@ -38,7 +57,7 @@ _BINARY = {
 
 
 @register_op
-class ElementUnary(Op):
+class ElementUnary(PassthroughAxesMixin, Op):
     op_type = "element_unary"
 
     def __init__(self, model, name, inputs, mode: str, scalar: float = None):
@@ -61,8 +80,10 @@ class ElementUnary(Op):
         return float(self.inputs[0].num_elements)
 
 
+
+
 @register_op
-class ElementBinary(Op):
+class ElementBinary(PassthroughAxesMixin, Op):
     op_type = "element_binary"
 
     def __init__(self, model, name, inputs, mode: str):
@@ -85,8 +106,10 @@ class ElementBinary(Op):
         return float(self.outputs[0].num_elements)
 
 
+
+
 @register_op
-class Dropout(Op):
+class Dropout(PassthroughAxesMixin, Op):
     """Reference: src/ops/dropout.cu (cuDNN dropout with reserve space —
     here: stateless jax.random.bernoulli keyed off the per-step rng)."""
 
@@ -110,8 +133,10 @@ class Dropout(Op):
         return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)]
 
 
+
+
 @register_op
-class Softmax(Op):
+class Softmax(PassthroughAxesMixin, Op):
     """Reference: src/ops/softmax.cu (cuDNN accurate-mode softmax =
     max-subtracted, which is exactly jax.nn.softmax)."""
 
@@ -128,6 +153,13 @@ class Softmax(Op):
     def forward(self, params, xs, ctx: OpContext):
         (x,) = xs
         return [jax.nn.softmax(x, axis=self.axis)]
+
+
+    def output_axes(self):
+        return _passthrough_axes(self.outputs[0].shape)
+
+    def input_axes(self):
+        return [_passthrough_axes(t.shape)[0] for t in self.inputs]
 
     def flops(self) -> float:
         return 5.0 * self.inputs[0].num_elements

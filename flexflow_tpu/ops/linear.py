@@ -19,6 +19,7 @@ from ..op import (
     CHANNEL_IN,
     CHANNEL_OUT,
     SAMPLE,
+    SEQ,
     Op,
     OpContext,
     WeightSpec,
@@ -82,6 +83,8 @@ class Linear(Op):
         n = len(self.outputs[0].shape)
         axes = [None] * n
         axes[0] = SAMPLE
+        if n == 3:
+            axes[1] = SEQ  # (batch, seq, features) layout
         axes[-1] = CHANNEL_OUT
         return [tuple(axes)]
 
@@ -89,6 +92,8 @@ class Linear(Op):
         n = len(self.inputs[0].shape)
         axes = [None] * n
         axes[0] = SAMPLE
+        if n == 3:
+            axes[1] = SEQ
         axes[-1] = CHANNEL_IN
         return [tuple(axes)]
 

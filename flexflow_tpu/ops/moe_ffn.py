@@ -1,0 +1,142 @@
+"""Fused Mixture-of-Experts FFN with expert parallelism.
+
+The reference composes MoE from softmax + TopK + GroupBy + per-expert
+dense ops + Aggregate, all placed by the strategy machinery but with NO
+expert-parallel dispatch (SURVEY.md 2.4: "no all-to-all EP dispatch").
+This op provides the TPU-first EP path: expert weights are stacked with a
+leading `expert` axis; when the strategy maps that axis to a mesh axis,
+GSPMD turns the dispatch/combine einsums into all-to-alls over ICI.
+
+GShard-style: top-k gating, capacity-bounded dense dispatch masks, and a
+load-balancing auxiliary loss added to the objective.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..op import CHANNEL, EXPERT, SAMPLE, SEQ, Op, OpContext, WeightSpec, register_op
+from .common import AC_MODE_RELU, apply_activation
+from .moe import dispatch_mask
+
+
+@register_op
+class MoEFFN(Op):
+    """input (..., D) -> output (..., out_dim) through num_experts
+    two-layer FFNs with top-k routing."""
+
+    op_type = "moe_ffn"
+    has_aux_loss = True  # excluded from remat (ctx side-channel)
+
+    def __init__(self, model, name, inputs, num_experts: int, k: int,
+                 hidden_dim: int, out_dim: int = None,
+                 capacity_factor: float = 1.25,
+                 activation=AC_MODE_RELU, aux_loss_weight: float = 1e-2,
+                 kernel_initializer: str = "glorot"):
+        super().__init__(model, name, inputs)
+        self.num_experts = int(num_experts)
+        self.k = int(k)
+        self.hidden_dim = int(hidden_dim)
+        self.in_dim = inputs[0].shape[-1]
+        self.out_dim = int(out_dim) if out_dim else self.in_dim
+        self.capacity_factor = float(capacity_factor)
+        self.activation = activation
+        self.aux_loss_weight = aux_loss_weight
+        self.kernel_initializer = kernel_initializer
+        n_tokens = 1
+        for s in inputs[0].shape[:-1]:
+            n_tokens *= s
+        self.n_tokens = n_tokens
+        self.capacity = max(
+            1, int(self.capacity_factor * self.k * n_tokens
+                   / self.num_experts))
+        self.attrs = {"num_experts": num_experts, "k": k,
+                      "hidden_dim": hidden_dim, "out_dim": self.out_dim,
+                      "capacity": self.capacity}
+
+    def output_shapes(self):
+        return [tuple(self.inputs[0].shape[:-1]) + (self.out_dim,)]
+
+    def weight_specs(self):
+        e, d, h, o = self.num_experts, self.in_dim, self.hidden_dim, self.out_dim
+        return {
+            "gate": WeightSpec((d, e), initializer=self.kernel_initializer,
+                               axes=(CHANNEL, None)),
+            "w1": WeightSpec((e, d, h), initializer=self.kernel_initializer,
+                             axes=(EXPERT, None, None), fan_in=d, fan_out=h),
+            "b1": WeightSpec((e, h), initializer="zeros",
+                             axes=(EXPERT, None)),
+            "w2": WeightSpec((e, h, o), initializer=self.kernel_initializer,
+                             axes=(EXPERT, None, None), fan_in=h, fan_out=o),
+            "b2": WeightSpec((e, o), initializer="zeros",
+                             axes=(EXPERT, None)),
+        }
+
+    def forward(self, params, xs, ctx: OpContext):
+        (x,) = xs
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        tokens = x.reshape(-1, d)  # (N, D)
+        n = tokens.shape[0]
+        e, cap, k = self.num_experts, self.capacity, self.k
+
+        logits = jnp.dot(tokens, params["gate"].astype(tokens.dtype),
+                         preferred_element_type=jnp.float32)  # (N, E)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        gate_vals, assign = jax.lax.top_k(probs, k)  # (N, k)
+        # renormalize the selected gates
+        gate_vals = gate_vals / jnp.clip(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+        mask = dispatch_mask(assign.astype(jnp.int32), e, cap)  # (N*k, E, C)
+        xrep = jnp.repeat(tokens, k, axis=0)  # (N*k, D) slot-major
+        expert_in = jnp.einsum("snc,sd->ncd", mask,
+                               xrep.astype(jnp.float32)).astype(x.dtype)
+
+        # per-expert FFN — batched over the (shardable) expert axis
+        h = jnp.einsum("ecd,edh->ech", expert_in,
+                       params["w1"].astype(x.dtype),
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        h = apply_activation(h + params["b1"][:, None, :].astype(x.dtype),
+                             self.activation)
+        out_e = jnp.einsum("ech,eho->eco", h, params["w2"].astype(x.dtype),
+                           preferred_element_type=jnp.float32).astype(x.dtype)
+        out_e = out_e + params["b2"][:, None, :].astype(x.dtype)
+
+        # combine: weight each slot by its (renormalized) gate value
+        combined = jnp.einsum("snc,nco->so", mask,
+                              out_e.astype(jnp.float32))  # (N*k, O)
+        combined = combined.reshape(n, k, self.out_dim)
+        out = jnp.sum(combined * gate_vals[..., None], axis=1)
+
+        if ctx.training:
+            # GShard load-balancing loss: E * sum_e f_e * p_e where f_e is
+            # the fraction of tokens whose top-1 goes to e and p_e the mean
+            # gate probability of e.
+            top1 = jax.nn.one_hot(assign[:, 0], e, dtype=jnp.float32)
+            f = jnp.mean(top1, axis=0)
+            p = jnp.mean(probs, axis=0)
+            ctx.aux_loss = (self.aux_loss_weight * e
+                            * jnp.sum(f * p)).astype(jnp.float32)
+
+        return [out.astype(x.dtype).reshape(orig_shape[:-1] + (self.out_dim,))]
+
+    def output_axes(self):
+        n = len(self.outputs[0].shape)
+        axes = [None] * n
+        axes[0] = SAMPLE
+        if n == 3:
+            axes[1] = SEQ
+        return [tuple(axes)]
+
+    input_axes = output_axes
+
+    def flops(self) -> float:
+        # gate + 2 FFN GEMMs over dispatched capacity
+        gate = 2.0 * self.n_tokens * self.in_dim * self.num_experts
+        ffn = (2.0 * self.num_experts * self.capacity
+               * (self.in_dim * self.hidden_dim
+                  + self.hidden_dim * self.out_dim))
+        dispatch = 2.0 * self.n_tokens * self.k * self.num_experts * self.capacity
+        return gate + ffn + dispatch

@@ -1,0 +1,160 @@
+"""PipelineBlocks: a stack of identical sub-graphs with first-class
+pipeline parallelism.
+
+Builder: ``ff.pipeline_blocks(x, block_builder, num_layers)`` where
+``block_builder(sub_model, t) -> t_out`` constructs one shape-preserving
+block using the normal layer API on a sub-FFModel. Weights of every block
+op are stacked with a leading `layer` dim; when the strategy maps `layer`
+to a mesh `pipe` axis, forward runs the GPipe collective-permute schedule
+(parallel/pipeline.py); otherwise it is a plain lax.scan over layers
+(which XLA compiles to a single fused loop — also the idiomatic TPU way
+to build deep repeated models).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..op import LAYER, SAMPLE, SEQ, Op, OpContext, WeightSpec, register_op
+
+
+@register_op
+class PipelineBlocks(Op):
+    op_type = "pipeline_blocks"
+    has_aux_loss = True  # may carry sub-op aux losses; excluded from remat
+
+    def __init__(self, model, name, inputs, block_builder: Callable,
+                 num_layers: int, num_microbatches: int = 4):
+        super().__init__(model, name, inputs)
+        self.num_layers = int(num_layers)
+        self.num_microbatches = int(num_microbatches)
+        # build the symbolic block sub-graph once
+        from ..model import FFModel
+        from ..config import FFConfig
+        sub = FFModel(FFConfig())
+        x_sym = sub.create_tensor(inputs[0].shape, dtype=inputs[0].dtype,
+                                  name="block_input")
+        out_sym = block_builder(sub, x_sym)
+        assert tuple(out_sym.shape) == tuple(inputs[0].shape), (
+            f"pipeline block must preserve shape: {inputs[0].shape} -> "
+            f"{out_sym.shape}")
+        for op in sub.ops:
+            assert not op.state_specs(), (
+                f"stateful op {op.name} not supported inside pipeline "
+                f"blocks (functional scan)")
+        self.sub = sub
+        self.sub_input = x_sym
+        self.sub_output = out_sym
+        self.attrs = {"num_layers": num_layers,
+                      "num_microbatches": num_microbatches}
+
+    def output_shapes(self):
+        return [tuple(self.inputs[0].shape)]
+
+    def weight_specs(self) -> Dict[str, WeightSpec]:
+        specs = {}
+        for op in self.sub.ops:
+            for wname, s in op.weight_specs().items():
+                specs[f"{op.name}.{wname}"] = WeightSpec(
+                    shape=(self.num_layers,) + tuple(s.shape),
+                    dtype=s.dtype,
+                    initializer=s.initializer,
+                    axes=(LAYER,) + tuple(s.axes),
+                    custom_init=self._stacked_init(s) if (
+                        s.custom_init or s.fan_in or s.fan_out
+                        or s.initializer not in ("zeros", "ones")) else None,
+                    fan_in=s.fan_in, fan_out=s.fan_out,
+                )
+        return specs
+
+    @staticmethod
+    def _stacked_init(spec: WeightSpec):
+        """Initialize each layer slice independently (vmapped keys)."""
+        from ..core import initializers as I
+
+        base = spec.custom_init or I.resolve(spec.initializer)
+
+        def init(key, shape, dtype, fan_in=None, fan_out=None):
+            L = shape[0]
+            keys = jax.random.split(key, L)
+            def one(k):
+                try:
+                    return base(k, shape[1:], dtype, fan_in=spec.fan_in,
+                                fan_out=spec.fan_out)
+                except TypeError:
+                    return base(k, shape[1:], dtype)
+            return jax.vmap(one)(keys)
+
+        return init
+
+    def _block_fn(self, ctx: OpContext):
+        sub = self.sub
+
+        def block_fn(layer_params: Dict[str, jax.Array], h, layer_idx):
+            values = {self.sub_input.uid: h}
+            aux = jnp.float32(0.0)
+            layer_rng = (jax.random.fold_in(ctx.rng, layer_idx)
+                         if ctx.rng is not None else None)
+            for i, op in enumerate(sub.ops):
+                sub_ctx = OpContext(
+                    training=ctx.training,
+                    rng=(jax.random.fold_in(layer_rng, i)
+                         if layer_rng is not None else None),
+                    seq_length=ctx.seq_length,
+                    mesh=ctx.mesh, op_strategy=ctx.op_strategy)
+                op_params = {w: layer_params[f"{op.name}.{w}"]
+                             for w in op.weight_specs()}
+                xs = [values[t.uid] for t in op.inputs]
+                ys = op.forward(op_params, xs, sub_ctx)
+                for t, y in zip(op.outputs, ys):
+                    values[t.uid] = y
+                if sub_ctx.aux_loss is not None:
+                    aux = aux + sub_ctx.aux_loss
+            return values[self.sub_output.uid], aux
+
+        return block_fn
+
+    def forward(self, params, xs, ctx: OpContext):
+        (x,) = xs
+        from ..parallel.pipeline import pipeline_apply
+        block_fn = self._block_fn(ctx)
+        pipe_size = ctx.mesh_axis_size("layer")
+        mesh = ctx.mesh if pipe_size > 1 else None
+        if mesh is not None:
+            data_ax = ctx.mesh_axis_name("sample") or "data"
+            out, aux = pipeline_apply(
+                block_fn, params, x, mesh,
+                pipe_axis=ctx.mesh_axis_name("layer"),
+                num_microbatches=self.num_microbatches,
+                num_layers=self.num_layers,
+                data_axis=data_ax)
+        else:
+            def body(carry, inp):
+                h, a = carry
+                lp, li = inp
+                y, la = block_fn(lp, h, li)
+                return (y, a + la), None
+            (out, aux), _ = lax.scan(
+                body, (x, jnp.float32(0.0)),
+                (params, jnp.arange(self.num_layers)),
+                length=self.num_layers)
+        if ctx.training:
+            ctx.aux_loss = aux
+        return [out]
+
+    def output_axes(self):
+        n = len(self.outputs[0].shape)
+        axes = [None] * n
+        axes[0] = SAMPLE
+        if n == 3:
+            axes[1] = SEQ
+        return [tuple(axes)]
+
+    input_axes = output_axes
+
+    def flops(self) -> float:
+        return self.num_layers * sum(op.flops() for op in self.sub.ops)

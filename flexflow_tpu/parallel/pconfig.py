@@ -112,6 +112,14 @@ class Strategy:
 DATA_PARALLEL = Strategy()
 
 
+def sequence_parallel_strategy(seq_axis: str = "seq") -> Strategy:
+    """SP/CP: activations sharded over the sequence dim; attention runs
+    as ring attention over `seq_axis` (new capability vs the reference,
+    SURVEY.md 2.4)."""
+    return Strategy(default=OpStrategy({"sample": "data",
+                                        "seq": seq_axis}))
+
+
 def megatron_strategy(model_axis: str = "model") -> Strategy:
     """TP default: split channel_out/head/vocab over the model axis (the
     reference reached the same placement through MCMC discovering

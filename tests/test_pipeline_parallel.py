@@ -1,0 +1,148 @@
+"""Pipeline parallelism tests: PipelineBlocks with and without a pipe
+mesh axis must produce identical results (GPipe reorders compute but not
+math)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, Strategy, make_mesh
+from flexflow_tpu.parallel.pconfig import OpStrategy
+
+
+def pp_strategy():
+    return Strategy(default=OpStrategy({"sample": "data",
+                                        "layer": "pipe"}))
+
+
+def mlp_block(sub, t):
+    h = sub.dense(t, 32, activation="relu", name="blk_ff1")
+    h = sub.dense(h, 16, name="blk_ff2")
+    return sub.add(h, t, name="blk_res")
+
+
+def build(cfg, mesh=None, strategy=None, num_layers=4, num_microbatches=4):
+    ff = FFModel(cfg, mesh=mesh, strategy=strategy)
+    x = ff.create_tensor((cfg.batch_size, 16), name="input")
+    t = ff.pipeline_blocks(x, mlp_block, num_layers,
+                           num_microbatches=num_microbatches)
+    t = ff.dense(t, 4)
+    t = ff.softmax(t)
+    ff.compile(optimizer=AdamOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"], mesh=mesh, strategy=strategy)
+    return ff
+
+
+def data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 16).astype(np.float32)
+    w = rng.randn(16, 4).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+def test_stacked_blocks_train_single_device():
+    cfg = FFConfig()
+    cfg.batch_size = 64
+    ff = build(cfg)
+    # stacked weights have leading layer dim
+    w = ff.state.params["pipeline"]["blk_ff1.kernel"]
+    assert w.shape == (4, 16, 32), w.shape
+    # per-layer slices must be independently initialized
+    assert not np.allclose(np.asarray(w[0]), np.asarray(w[1]))
+    x, y = data()
+    hist = ff.fit({"input": x}, y, epochs=8, verbose=False)
+    assert hist[-1]["accuracy"] > 0.8, hist[-1]
+
+
+def test_pp_matches_unsharded():
+    cfg = FFConfig()
+    cfg.batch_size = 64
+    x, y = data()
+
+    ff1 = build(cfg)
+    h1 = ff1.fit({"input": x}, y, epochs=2, shuffle=False, verbose=False)
+
+    mesh = make_mesh((2, 4), ("data", "pipe"))
+    ff2 = build(cfg, mesh=mesh, strategy=pp_strategy())
+    w = ff2.state.params["pipeline"]["blk_ff1.kernel"]
+    assert w.sharding.spec == P("pipe",), w.sharding.spec
+    h2 = ff2.fit({"input": x}, y, epochs=2, shuffle=False, verbose=False)
+
+    assert abs(h1[-1]["loss"] - h2[-1]["loss"]) < 1e-3, (h1[-1], h2[-1])
+    w1 = ff1.get_weights("pipeline")["blk_ff1.kernel"]
+    w2 = ff2.get_weights("pipeline")["blk_ff1.kernel"]
+    np.testing.assert_allclose(w1, w2, atol=2e-4)
+
+
+def test_pp_microbatch_counts():
+    """Different microbatch counts give the same result (pure schedule)."""
+    cfg = FFConfig()
+    cfg.batch_size = 64
+    x, y = data(64)
+    mesh = make_mesh((1, 4), ("data", "pipe"))
+    outs = []
+    for m in (2, 8):
+        ff = build(cfg, mesh=mesh, strategy=pp_strategy(),
+                   num_microbatches=m)
+        logits = ff.forward({"input": x})
+        outs.append(np.asarray(logits))
+    np.testing.assert_allclose(outs[0], outs[1], atol=1e-5)
+
+
+def test_moe_inside_pipeline_keeps_aux_loss():
+    """Review regression: MoE aux loss must survive inside PipelineBlocks."""
+    cfg = FFConfig()
+    cfg.batch_size = 32
+
+    def moe_block(sub, t):
+        h = sub.moe_ffn(t, num_experts=2, k=1, hidden_dim=32,
+                        capacity_factor=2.0, name="blk_moe")
+        return sub.add(h, t, name="blk_res")
+
+    ff = FFModel(cfg)
+    x = ff.create_tensor((32, 16), name="input")
+    t = ff.pipeline_blocks(x, moe_block, 2)
+    t = ff.softmax(ff.dense(t, 4))
+    ff.compile(optimizer=AdamOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=[])
+    xd, yd = data(32)
+    ff.train_batch({"input": xd, "label": yd})
+    assert len(ff.executor._last_aux_losses) == 1
+
+
+def test_weightless_pipeline_block():
+    """Review regression: blocks without weights must not crash scan."""
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    ff = FFModel(cfg)
+    x = ff.create_tensor((16, 8), name="input")
+    t = ff.pipeline_blocks(x, lambda sub, h: sub.relu(h, name="blk_relu"), 3)
+    t = ff.softmax(ff.dense(t, 4))
+    ff.compile(optimizer=AdamOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    xd = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+    yd = np.zeros(16, np.int32)
+    m = ff.train_batch({"input": xd, "label": yd})
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_remat_with_moe_no_tracer_leak():
+    """Review regression: remat must skip aux-loss ops (tracer leak)."""
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.remat = True
+    ff = FFModel(cfg)
+    x = ff.create_tensor((32, 16), name="input")
+    t = ff.dense(x, 32, activation="relu")
+    t = ff.moe_ffn(t, num_experts=2, k=1, hidden_dim=32)
+    t = ff.softmax(ff.dense(t, 4))
+    ff.compile(optimizer=AdamOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    xd, yd = data(32)
+    m = ff.train_batch({"input": xd, "label": yd})
+    assert np.isfinite(float(m["loss"]))
