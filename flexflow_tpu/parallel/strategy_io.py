@@ -1,0 +1,91 @@
+"""Reference-format strategy file I/O.
+
+The reference persists strategies as plain text (strategy.cc:95-189):
+
+    <num_ops>
+    <op_name> <device_type> <nDims> <dim_0> ... <dim_n-1> <id_0> ... <id_k-1>
+
+keyed at runtime by hash(op name) -> MappingTagID. We keep the same
+format for tooling familiarity: export derives per-dim split counts and
+device ids from (strategy, mesh); import reconstructs an axis map by
+matching split counts back onto the op's logical axes.
+
+The native format remains JSON (Strategy.save/load) — it round-trips the
+axis maps exactly; this module is the compatibility layer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..op import Op
+from .pconfig import OpStrategy, ParallelConfig, Strategy
+
+
+def op_parallel_config(op: Op, strategy: OpStrategy, mesh) -> ParallelConfig:
+    """Derive the reference-style view: per-output-dim split counts +
+    explicit device ids (row-major over the mesh submesh used)."""
+    out_axes = op.output_axes()[0] if op.outputs else ()
+    out_shape = op.outputs[0].shape if op.outputs else ()
+    dims = []
+    used_axes = []
+    for i, ax in enumerate(out_axes):
+        m = strategy.mesh_axis_for(ax)
+        if isinstance(m, str) and m in mesh.shape \
+                and out_shape[i] % mesh.shape[m] == 0 \
+                and m not in used_axes:
+            dims.append(mesh.shape[m])
+            used_axes.append(m)
+        else:
+            dims.append(1)
+    n_parts = int(np.prod(dims)) if dims else 1
+    device_ids = list(range(n_parts))
+    return ParallelConfig(device_type="tpu", dims=dims,
+                          device_ids=device_ids)
+
+
+def save_strategies_to_file(model, strategy: Strategy, mesh,
+                            path: str) -> None:
+    """Reference text format (strategy.cc:126-189)."""
+    lines = [str(len(model.ops))]
+    for op in model.ops:
+        pc = op_parallel_config(op, strategy.for_op(op.name), mesh)
+        parts = [op.name, pc.device_type, str(len(pc.dims))]
+        parts += [str(d) for d in pc.dims]
+        parts += [str(i) for i in pc.device_ids]
+        lines.append(" ".join(parts))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def load_strategies_from_file(model, mesh, path: str) -> Strategy:
+    """Rebuild an axis map from the text format: a >1 split on dim i maps
+    that dim's logical axis to the smallest matching mesh axis."""
+    with open(path) as f:
+        tokens = f.read().split("\n")
+    n = int(tokens[0].strip())
+    ops_by_name = {op.name: op for op in model.ops}
+    strat = Strategy()
+    for line in tokens[1:n + 1]:
+        parts = line.split()
+        name, _dev = parts[0], parts[1]
+        ndims = int(parts[2])
+        dims = [int(x) for x in parts[3:3 + ndims]]
+        op = ops_by_name.get(name)
+        if op is None:
+            continue
+        out_axes = op.output_axes()[0]
+        axis_map: Dict[str, str] = {}
+        used = set()
+        for i, split in enumerate(dims):
+            if split <= 1 or i >= len(out_axes) or out_axes[i] is None:
+                continue
+            for mesh_ax, size in mesh.shape.items():
+                if size == split and mesh_ax not in used:
+                    axis_map[out_axes[i]] = mesh_ax
+                    used.add(mesh_ax)
+                    break
+        strat.set(name, OpStrategy(axis_map))
+    return strat

@@ -1,0 +1,152 @@
+"""Per-op cost estimation under a candidate strategy.
+
+The analog of the reference's `Op::measure_operator_cost` (real CUDA
+kernels timed on GPU0, e.g. linear.cu:1000-1073) — but on TPU a candidate
+strategy implies a recompile, so costs come from the roofline + collective
+formulas in machine_model.py instead of per-candidate measurement
+(SURVEY.md section 7 hard part (d)); measure.py calibrates the formulas'
+efficiency factors against real jitted ops once per machine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from ..op import Op
+from ..parallel.pconfig import OpStrategy
+from .machine_model import TPUMachineModel
+
+BWD_FLOP_FACTOR = 2.0  # dX and dW GEMMs ≈ 2x fwd (reference bwd = 2 GEMMs)
+# per-op-type overrides: attention bwd recomputes probabilities from the
+# saved logsumexp (flash custom-VJP) + 4 grad einsums ≈ 4x fwd
+BWD_FACTOR_BY_TYPE = {"multihead_attention": 4.0}
+MATMUL_OPS = {"linear", "conv2d", "batch_matmul", "multihead_attention",
+              "embedding", "lstm", "moe_ffn", "pipeline_blocks"}
+
+
+@dataclasses.dataclass
+class OpCost:
+    fwd: float          # compute seconds, sharded
+    bwd: float
+    fwd_comm: float     # collective seconds attributable to fwd
+    bwd_comm: float
+    sync: float         # gradient sync (DP all-reduce) seconds
+    mem: float          # bytes resident per device (weights+opt+acts)
+
+
+def _axis_size(strategy: OpStrategy, mesh, logical_axis) -> int:
+    ax = strategy.mesh_axis_for(logical_axis)
+    if not isinstance(ax, str):
+        return 1
+    return mesh.shape.get(ax, 1)
+
+
+def _axis_name(strategy: OpStrategy, logical_axis) -> Optional[str]:
+    ax = strategy.mesh_axis_for(logical_axis)
+    return ax if isinstance(ax, str) else None
+
+
+def compute_shards(op: Op, strategy: OpStrategy, mesh) -> int:
+    """Product of mesh-axis sizes over which this op's compute divides,
+    honoring divisibility like sharding.spec_for_axes."""
+    used = set()
+    total = 1
+    out_shape = op.outputs[0].shape if op.outputs else ()
+    for i, ax in enumerate(op.output_axes()[0] if op.outputs else ()):
+        name = _axis_name(strategy, ax)
+        if name is None or name in used or name not in mesh.shape:
+            continue
+        size = mesh.shape[name]
+        if i < len(out_shape) and out_shape[i] % size != 0:
+            continue
+        used.add(name)
+        total *= size
+    return max(1, total)
+
+
+def op_cost(op: Op, strategy: OpStrategy, mesh,
+            mm: TPUMachineModel, optimizer_state_mult: float = 3.0
+            ) -> OpCost:
+    shards = compute_shards(op, strategy, mesh)
+    flops = op.flops()
+    act_bytes = sum(t.size_bytes() for t in op.outputs)
+    in_bytes = sum(t.size_bytes() for t in op.inputs)
+    w_bytes = op.weight_bytes()
+    is_mm = op.op_type in MATMUL_OPS
+
+    dp = _axis_size(strategy, mesh, "sample")
+    tp_axis = _axis_name(strategy, "channel_out")
+    tp = _axis_size(strategy, mesh, "channel_out")
+    head_tp = _axis_size(strategy, mesh, "head")
+    seq_ax = _axis_name(strategy, "seq")
+    sp = _axis_size(strategy, mesh, "seq")
+    ep_ax = _axis_name(strategy, "expert")
+    ep = _axis_size(strategy, mesh, "expert")
+    pp_ax = _axis_name(strategy, "layer")
+    pp = _axis_size(strategy, mesh, "layer")
+
+    fwd_comm = 0.0
+    bwd_comm = 0.0
+    sync = 0.0
+
+    fwd = mm.compute_time(flops / shards,
+                          (act_bytes + in_bytes + w_bytes) / shards, is_mm)
+    bwd = BWD_FACTOR_BY_TYPE.get(op.op_type, BWD_FLOP_FACTOR) * fwd
+
+    # --- TP (Megatron pattern): fwd all-reduce of the (data-sharded)
+    # output when the contraction dim is sharded; bwd all-reduce of the
+    # input grad. (The reference hand-built this as replica tensors +
+    # backward2 reduction, linear.cu:144-270.)
+    eff_tp = max(tp, head_tp)
+    if eff_tp > 1 and op.op_type in ("linear", "multihead_attention",
+                                     "conv2d", "lstm"):
+        fwd_comm += mm.all_reduce(act_bytes / dp, eff_tp, tp_axis)
+        bwd_comm += mm.all_reduce(in_bytes / dp, eff_tp, tp_axis)
+
+    # --- embedding vocab sharding: output psum over vocab axis
+    vocab = _axis_size(strategy, mesh, "vocab")
+    if vocab > 1 and op.op_type == "embedding":
+        fwd_comm += mm.all_reduce(act_bytes / dp, vocab,
+                                  _axis_name(strategy, "vocab"))
+        bwd_comm += mm.all_reduce(act_bytes / dp, vocab,
+                                  _axis_name(strategy, "vocab"))
+
+    # --- SP ring attention: (S-1) kv-shard hops each way
+    if sp > 1 and op.op_type == "multihead_attention":
+        kv_bytes = 2 * in_bytes / 3 / max(1, dp)  # k+v of the three inputs
+        fwd_comm += (sp - 1) * mm.ppermute(kv_bytes / sp, seq_ax)
+        bwd_comm += 2 * (sp - 1) * mm.ppermute(kv_bytes / sp, seq_ax)
+
+    # --- EP: dispatch + combine all-to-alls of the capacity buffers
+    if ep > 1 and op.op_type == "moe_ffn":
+        disp_bytes = (op.num_experts * op.capacity * op.in_dim * 4) / dp
+        fwd_comm += 2 * mm.all_to_all(disp_bytes / ep, ep, ep_ax)
+        bwd_comm += 2 * mm.all_to_all(disp_bytes / ep, ep, ep_ax)
+
+    # --- PP: GPipe bubble inflates compute; per-tick activation hop
+    if pp > 1 and op.op_type == "pipeline_blocks":
+        M = op.num_microbatches
+        bubble = (M + pp - 1) / M
+        fwd *= bubble
+        bwd *= bubble
+        mb_bytes = in_bytes / max(1, dp) / M
+        fwd_comm += (M + pp - 1) * mm.ppermute(mb_bytes, pp_ax)
+        bwd_comm += (M + pp - 1) * mm.ppermute(mb_bytes, pp_ax)
+
+    # --- DP gradient sync: all-reduce of each weight's grad over the
+    # data axis (the reference's NCCL all-reduce / PS update+prefetch,
+    # optimizer_kernel.cu:113-180)
+    if dp > 1 and w_bytes > 0:
+        # weights sharded over model/expert/pipe/vocab axes reduce
+        # per-device grad bytes proportionally
+        sync = mm.all_reduce(w_bytes / max(1, eff_tp * ep * pp * vocab),
+                             dp, _axis_name(strategy, "sample"))
+
+    # --- memory: weights (+ optimizer state) + activations per device
+    w_per_dev = w_bytes / max(1, eff_tp * ep * pp * vocab)
+    act_per_dev = act_bytes / shards
+    mem = w_per_dev * (1.0 + optimizer_state_mult) + act_per_dev * 2
+
+    return OpCost(fwd=fwd, bwd=bwd, fwd_comm=fwd_comm, bwd_comm=bwd_comm,
+                  sync=sync, mem=mem)

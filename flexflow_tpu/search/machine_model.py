@@ -1,0 +1,134 @@
+"""TPU machine model: analytic costs for compute, HBM, and collectives.
+
+Replaces the reference `MachineModel` hierarchy (include/simulator.h:99-236,
+machine_model.cc — membus/UPI/NIC/PCIe/NVLink paths with per-segment
+pipelining). On TPU the comm fabric collapses to two tiers: ICI (intra-pod
+torus) and DCN (cross-slice); GSPMD's collectives have closed-form cost on
+a ring/torus, so `get_comm_path` becomes per-collective formulas.
+
+Calibration: `efficiency` factors default to typical XLA/TPU achieved
+fractions and can be overwritten from real microbenchmarks
+(search/measure.py) — the analog of the reference timing real kernels in
+`measure_operator_cost`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Optional
+
+from ..parallel.mesh import MachineSpec
+
+
+@dataclasses.dataclass
+class TPUMachineModel:
+    spec: MachineSpec
+    # achieved-fraction calibration knobs (overridable via measure.py)
+    efficiency: Dict[str, float] = dataclasses.field(default_factory=lambda: {
+        "matmul": 0.55,      # MXU-bound ops (dense/conv/attention GEMMs)
+        "elementwise": 0.8,  # HBM-bound ops (fraction of peak HBM bw)
+        "collective": 0.75,  # fraction of peak ICI bw
+    })
+    # mesh axes that ride DCN instead of ICI (multi-host `data` axis)
+    dcn_axes: tuple = ()
+
+    # ---- compute ----
+    def compute_time(self, flops: float, bytes_moved: float,
+                     is_matmul: bool = True) -> float:
+        """Roofline: max of MXU time and HBM time."""
+        t_flops = flops / (self.spec.peak_flops
+                           * self.efficiency["matmul"])
+        t_mem = bytes_moved / (self.spec.hbm_bandwidth
+                               * self.efficiency["elementwise"])
+        return max(t_flops, t_mem)
+
+    # ---- collectives (ring formulas over the relevant axis) ----
+    def _bw_lat(self, axis: Optional[str]):
+        if axis is not None and axis in self.dcn_axes:
+            return (self.spec.dcn_bandwidth, self.spec.dcn_latency)
+        return (self.spec.ici_bandwidth * self.efficiency["collective"],
+                self.spec.ici_latency)
+
+    def all_reduce(self, nbytes: float, axis_size: int,
+                   axis: Optional[str] = None) -> float:
+        if axis_size <= 1:
+            return 0.0
+        bw, lat = self._bw_lat(axis)
+        return 2.0 * (axis_size - 1) / axis_size * nbytes / bw \
+            + 2 * (axis_size - 1) * lat
+
+    def all_gather(self, nbytes_out: float, axis_size: int,
+                   axis: Optional[str] = None) -> float:
+        if axis_size <= 1:
+            return 0.0
+        bw, lat = self._bw_lat(axis)
+        return (axis_size - 1) / axis_size * nbytes_out / bw \
+            + (axis_size - 1) * lat
+
+    reduce_scatter = all_gather  # same ring cost
+
+    def all_to_all(self, nbytes_local: float, axis_size: int,
+                   axis: Optional[str] = None) -> float:
+        if axis_size <= 1:
+            return 0.0
+        bw, lat = self._bw_lat(axis)
+        # each device exchanges (n-1)/n of its local bytes
+        return (axis_size - 1) / axis_size * nbytes_local / bw \
+            + (axis_size - 1) * lat
+
+    def ppermute(self, nbytes: float, axis: Optional[str] = None) -> float:
+        bw, lat = self._bw_lat(axis)
+        return nbytes / bw + lat
+
+    # ---- memory penalty (reference simulator.cc:603-628: 1ms per MB
+    # over framebuffer capacity) ----
+    def memory_penalty(self, bytes_per_device: float) -> float:
+        over = bytes_per_device - self.spec.hbm_capacity
+        if over <= 0:
+            return 0.0
+        return over * 1e-9  # 1 ms per MB, same constant as the reference
+
+    # ---- calibration I/O ----
+    def save_calibration(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.efficiency, f)
+
+    def load_calibration(self, path: str) -> None:
+        with open(path) as f:
+            self.efficiency.update(json.load(f))
+
+
+def default_machine_model(mesh=None, spec: Optional[MachineSpec] = None,
+                          machine_file: Optional[str] = None
+                          ) -> TPUMachineModel:
+    """Build a model for the current device (v5e single chip by default).
+    `machine_file` (FFConfig.machine_model_file) may override MachineSpec
+    fields via JSON — the analog of the reference's machine config file
+    (machine_config_example). A multi-host run marks the mesh's `data`
+    axis as DCN-resident (cross-slice collectives priced at DCN rates)."""
+    if spec is None:
+        spec = MachineSpec.v5e()
+        try:
+            import jax
+            kind = jax.devices()[0].device_kind.lower()
+            if "v5p" in kind or "v4" in kind:
+                spec = MachineSpec()
+        except Exception:
+            pass
+    if machine_file:
+        with open(machine_file) as f:
+            data = json.load(f)
+        for k, v in data.items():
+            if hasattr(spec, k):
+                setattr(spec, k, v)
+    dcn_axes = ()
+    if mesh is not None:
+        spec.num_chips = int(mesh.size)
+        try:
+            import jax
+            if jax.process_count() > 1 and "data" in mesh.shape:
+                dcn_axes = ("data",)
+        except Exception:
+            pass
+    return TPUMachineModel(spec=spec, dcn_axes=dcn_axes)

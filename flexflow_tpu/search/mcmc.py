@@ -1,18 +1,155 @@
-"""MCMC strategy search (reference: FFModel::optimize, model.cc:1905-1968).
+"""MCMC strategy search.
 
-Round-1 placeholder: returns the data-parallel default so
-compile(search_budget>0) is functional; the annealing loop over the
-simulator lands with the cost-model milestone.
+Direct analog of the reference `FFModel::optimize` (model.cc:1905-1968):
+simulated annealing over per-op strategies, starting from pure data
+parallelism, with two move types — `rewrite` (re-strategize one random op)
+and, with probability 0.25, `propagate` (copy an op's strategy to a graph
+neighbor; reference model.cc:1807-1903) — accepting uphill moves with
+probability exp(-alpha * delta), and resetting to the best strategy every
+budget/100 iterations.
+
+The candidate set per op is the TPU-native strategy space: which logical
+axes map to which mesh axes, gated by the same CLI flags the reference
+used (--enable-parameter-parallel etc., config.h:139-141) plus the new
+SP/EP/PP axes.
 """
 
 from __future__ import annotations
 
-import warnings
+import math
+import random
+from typing import Dict, List, Optional
 
-from ..parallel.pconfig import Strategy
+from ..parallel.pconfig import OpStrategy, Strategy
+from .machine_model import default_machine_model
+from .simulator import Simulator
 
 
-def optimize(model, budget: int = 0, alpha: float = 0.05) -> Strategy:
-    warnings.warn("MCMC strategy search not yet implemented; "
-                  "returning data-parallel default strategy")
-    return model.strategy or Strategy()
+def candidate_maps(op, mesh, cfg) -> List[Dict[str, str]]:
+    """Enumerate legal axis maps for one op on this mesh."""
+    axes = mesh.shape
+    cands: List[Dict[str, str]] = []
+    base: Dict[str, str] = {}
+    if "data" in axes and cfg.enable_sample_parallel:
+        base = {"sample": "data"}
+    cands.append(dict(base))          # pure DP (or replicated)
+    if not base:
+        cands.append({})
+
+    model_ax = "model" if "model" in axes else None
+    if model_ax:
+        tp_ok = cfg.enable_parameter_parallel or cfg.enable_attribute_parallel
+        if tp_ok and op.op_type in ("linear", "lstm"):
+            cands.append({**base, "channel_out": model_ax})
+        if cfg.enable_attribute_parallel and op.op_type == "conv2d":
+            cands.append({**base, "channel_out": model_ax})
+        if tp_ok and op.op_type == "multihead_attention":
+            cands.append({**base, "head": model_ax})
+        if cfg.enable_parameter_parallel and op.op_type == "embedding":
+            cands.append({**base, "vocab": model_ax})
+
+    if cfg.enable_sequence_parallel and "seq" in axes:
+        if op.op_type in ("multihead_attention", "linear", "lstm",
+                          "element_unary", "element_binary", "dropout",
+                          "softmax", "moe_ffn"):
+            cands.append({**base, "seq": "seq"})
+            if model_ax and op.op_type == "multihead_attention":
+                cands.append({**base, "seq": "seq", "head": model_ax})
+
+    if cfg.enable_expert_parallel and op.op_type == "moe_ffn":
+        ep_ax = "expert" if "expert" in axes else model_ax
+        if ep_ax:
+            cands.append({**base, "expert": ep_ax})
+
+    if cfg.enable_pipeline_parallel and op.op_type == "pipeline_blocks":
+        if "pipe" in axes:
+            cands.append({**base, "layer": "pipe"})
+
+    # dedupe
+    seen = set()
+    out = []
+    for c in cands:
+        key = tuple(sorted(c.items()))
+        if key not in seen:
+            seen.add(key)
+            out.append(c)
+    return out
+
+
+def optimize(model, budget: int = 1000, alpha: float = 0.05,
+             mesh=None, seed: int = 0, verbose: bool = False,
+             simulator: Optional[Simulator] = None) -> Strategy:
+    """Anneal over strategies; returns the best found.
+
+    Reference contract: called from compile() when search_budget > 0
+    (model.cc:1561-1570); unlike the reference we do NOT exit the process
+    after search — the found strategy is used directly (and exported when
+    --export is set).
+    """
+    mesh = mesh or model.mesh
+    if mesh is None:
+        return model.strategy or Strategy()
+    cfg = model.config
+    sim = simulator or Simulator(
+        model, mesh,
+        default_machine_model(mesh, machine_file=cfg.machine_model_file),
+        overlap_backward_sync=cfg.search_overlap_backward_update)
+    rng = random.Random(seed)
+
+    cands = {op.name: candidate_maps(op, mesh, cfg) for op in model.ops}
+    edges = []
+    producer = {}
+    for op in model.ops:
+        for t in op.outputs:
+            producer[t.uid] = op
+    for op in model.ops:
+        for t in op.inputs:
+            if t.uid in producer:
+                edges.append((producer[t.uid], op))
+
+    current = (model.strategy or Strategy()).copy()
+    # materialize every op's map so moves are local
+    for op in model.ops:
+        current.set(op.name, current.for_op(op.name).copy())
+    cur_cost = sim.simulate(current)
+    best, best_cost = current.copy(), cur_cost
+
+    searchable = [op for op in model.ops if len(cands[op.name]) > 1]
+    if not searchable:
+        return best
+
+    reset_every = max(1, budget // 100)
+    for it in range(budget):
+        if it > 0 and it % reset_every == 0 and cur_cost > best_cost:
+            current, cur_cost = best.copy(), best_cost
+
+        nxt = current.copy()
+        # propagation move is opt-in (reference --enable-propagation,
+        # model.cc:2374), fired with prob 0.25 like model.cc:1807-1903
+        if cfg.enable_propagation and rng.random() < 0.25 and edges:
+            # propagate along a random edge (reference propagation move)
+            src, dst = rng.choice(edges)
+            m = current.for_op(src.name).axis_map
+            if m in cands.get(dst.name, []):
+                nxt.set(dst.name, OpStrategy(dict(m)))
+            else:
+                op = rng.choice(searchable)
+                nxt.set(op.name, OpStrategy(
+                    dict(rng.choice(cands[op.name]))))
+        else:
+            op = rng.choice(searchable)
+            nxt.set(op.name, OpStrategy(dict(rng.choice(cands[op.name]))))
+
+        nxt_cost = sim.simulate(nxt)
+        delta = nxt_cost - cur_cost
+        if delta <= 0 or rng.random() < math.exp(
+                -delta / max(1e-12, alpha * cur_cost)):
+            current, cur_cost = nxt, nxt_cost
+            if cur_cost < best_cost:
+                best, best_cost = current.copy(), cur_cost
+                if verbose:
+                    print(f"[search] iter {it}: {best_cost*1e3:.3f} ms/step")
+
+    if verbose:
+        print(f"[search] best estimated step time: {best_cost*1e3:.3f} ms")
+    return best

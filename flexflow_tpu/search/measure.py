@@ -1,0 +1,86 @@
+"""On-device microbenchmarks to calibrate the cost model.
+
+The analog of the reference's `inner_measure_operator_cost`
+(src/runtime/model.cu:20-62): run real kernels (warmup + repeats) and
+record achieved efficiency. On TPU we calibrate the machine model's
+efficiency factors once (matmul MXU fraction, elementwise HBM fraction)
+instead of timing every (op, config) pair — candidate strategies can't be
+individually timed without a recompile each (SURVEY.md 7 hard part (d)).
+
+NOTE on timing: through remote-tunnel platforms block_until_ready may not
+synchronize; a device->host scalar fetch is used to delimit timing.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from .machine_model import TPUMachineModel
+
+
+def _sync(x) -> float:
+    import jax.numpy as jnp
+    return float(jnp.ravel(x)[0])
+
+
+def measure_matmul_efficiency(mm: TPUMachineModel, n: int = 8192,
+                              repeats: int = 30) -> float:
+    # repeats must be large enough that total device time >> one
+    # host<->device round trip (remote tunnels add ~100ms per sync)
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((n, n), jnp.bfloat16)
+
+    @jax.jit
+    def f(a):
+        return jnp.dot(a, a, preferred_element_type=jnp.float32).astype(
+            jnp.bfloat16)
+
+    y = f(x)
+    _sync(y)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        y = f(y)
+    _sync(y)
+    dt = (time.perf_counter() - t0) / repeats
+    achieved = 2.0 * n ** 3 / dt
+    return min(1.0, achieved / mm.spec.peak_flops)
+
+
+def measure_elementwise_efficiency(mm: TPUMachineModel, n: int = 16384,
+                                   repeats: int = 100) -> float:
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((n, n), jnp.float32)
+
+    @jax.jit
+    def f(a):
+        return a * 1.0001 + 0.5
+
+    y = f(x)
+    _sync(y)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        y = f(y)
+    _sync(y)
+    dt = (time.perf_counter() - t0) / repeats
+    achieved_bytes = 2.0 * x.size * 4 / dt  # read + write
+    return min(1.0, achieved_bytes / mm.spec.hbm_bandwidth)
+
+
+def calibrate(mm: TPUMachineModel, save_path: Optional[str] = None
+              ) -> TPUMachineModel:
+    """Update mm.efficiency from real kernel timings on this device."""
+    try:
+        mm.efficiency["matmul"] = max(0.05, measure_matmul_efficiency(mm))
+        mm.efficiency["elementwise"] = max(
+            0.05, measure_elementwise_efficiency(mm))
+    except Exception as e:  # CPU or restricted platform: keep defaults
+        import warnings
+        warnings.warn(f"calibration failed, using defaults: {e}")
+    if save_path:
+        mm.save_calibration(save_path)
+    return mm

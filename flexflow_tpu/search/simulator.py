@@ -1,0 +1,204 @@
+"""Event-driven execution simulator.
+
+Direct analog of the reference `Simulator::simulate_runtime`
+(simulator.cc:330-629): build a task graph (fwd, bwd, comm, update nodes)
+for a candidate global strategy and run a priority-queue event loop over
+contended resources. On TPU the resources are the (single, SPMD) compute
+stream and the ICI fabric; comm tasks overlap compute exactly as XLA's
+async collectives do, and the DP gradient all-reduce can overlap the
+remaining backward pass (the reference models the same overlap for PS
+update, simulator.cc:393-497, gated by `search_overlap_backward_update`).
+
+Memory over HBM capacity adds the reference's 1ms/MB penalty
+(simulator.cc:603-628, machine_model.memory_penalty).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Dict, List, Optional
+
+from ..parallel.pconfig import Strategy
+from .cost_model import OpCost, op_cost
+from .machine_model import TPUMachineModel, default_machine_model
+
+
+@dataclasses.dataclass
+class SimTask:
+    name: str
+    duration: float
+    resource: str               # "compute" or "comm"
+    deps: List["SimTask"] = dataclasses.field(default_factory=list)
+    # runtime state
+    unresolved: int = 0
+    ready_time: float = 0.0
+    finish_time: float = 0.0
+
+
+class TaskGraph:
+    def __init__(self):
+        self.tasks: List[SimTask] = []
+
+    def add(self, name, duration, resource, deps=()):
+        t = SimTask(name=name, duration=duration, resource=resource,
+                    deps=list(deps))
+        self.tasks.append(t)
+        return t
+
+    def simulate(self) -> float:
+        """Priority-queue event loop (reference simulator.cc:499-554)."""
+        children: Dict[int, List[SimTask]] = {}
+        for t in self.tasks:
+            t.unresolved = len(t.deps)
+            for d in t.deps:
+                children.setdefault(id(d), []).append(t)
+        free: Dict[str, float] = {}
+        counter = 0
+        q = []
+        for t in self.tasks:
+            if t.unresolved == 0:
+                heapq.heappush(q, (t.ready_time, counter, t))
+                counter += 1
+        makespan = 0.0
+        done = 0
+        while q:
+            ready, _, t = heapq.heappop(q)
+            start = max(ready, free.get(t.resource, 0.0))
+            t.finish_time = start + t.duration
+            free[t.resource] = t.finish_time
+            makespan = max(makespan, t.finish_time)
+            done += 1
+            for c in children.get(id(t), []):
+                c.ready_time = max(c.ready_time, t.finish_time)
+                c.unresolved -= 1
+                if c.unresolved == 0:
+                    heapq.heappush(q, (c.ready_time, counter, c))
+                    counter += 1
+        assert done == len(self.tasks), "cycle in task graph"
+        return makespan
+
+    def export_dot(self, path: str) -> None:
+        """Taskgraph DOT export (reference --taskgraph, simulator.h DotFile)."""
+        with open(path, "w") as f:
+            f.write("digraph taskgraph {\n")
+            ids = {id(t): i for i, t in enumerate(self.tasks)}
+            for t in self.tasks:
+                f.write(f'  t{ids[id(t)]} [label="{t.name}\\n'
+                        f'{t.duration*1e6:.1f}us ({t.resource})"];\n')
+            for t in self.tasks:
+                for d in t.deps:
+                    f.write(f"  t{ids[id(d)]} -> t{ids[id(t)]};\n")
+            f.write("}\n")
+
+
+class Simulator:
+    def __init__(self, model, mesh, mm: Optional[TPUMachineModel] = None,
+                 overlap_backward_sync: bool = True):
+        self.model = model
+        self.mesh = mesh
+        self.mm = mm or default_machine_model(mesh)
+        self.overlap = overlap_backward_sync
+        self._cache: Dict[tuple, OpCost] = {}
+        # global multiplier calibrated from one real measured step
+        # (calibrate_end_to_end); scales predictions without changing the
+        # relative ordering the search depends on.
+        self.time_scale = 1.0
+        # strategy-independent graph maps, built once (the annealing loop
+        # calls simulate() thousands of times)
+        self._producer = {}
+        for op in model.ops:
+            for t in op.outputs:
+                self._producer[t.uid] = op
+        self._consumers: Dict[str, list] = {}
+        for op in model.ops:
+            for t in op.inputs:
+                if t.uid in self._producer:
+                    self._consumers.setdefault(
+                        self._producer[t.uid].name, []).append(op)
+
+    def calibrate_end_to_end(self, strategy: Strategy,
+                             measured_step_seconds: float) -> float:
+        """Set time_scale so the *step-time* part of simulate(strategy)
+        equals the measured step time (the memory penalty is excluded
+        from scaling) — the TPU analog of the reference grounding its
+        model in real kernel measurements. Returns the scale applied."""
+        raw, _penalty = self._simulate_raw(strategy)
+        if raw > 0:
+            self.time_scale = measured_step_seconds / raw
+        return self.time_scale
+
+    def _op_cost(self, op, strategy: Strategy) -> OpCost:
+        """Per-(op, op-strategy) cost with caching (the analog of the
+        reference's hash-keyed measurement cache, simulator.cc:301-321)."""
+        s = strategy.for_op(op.name)
+        key = (op.name, tuple(sorted(
+            (k, str(v)) for k, v in s.axis_map.items())))
+        if key not in self._cache:
+            self._cache[key] = op_cost(op, s, self.mesh, self.mm)
+        return self._cache[key]
+
+    def simulate(self, strategy: Strategy,
+                 dot_path: Optional[str] = None) -> float:
+        """Estimated seconds per training step under `strategy`."""
+        step_time, penalty = self._simulate_raw(strategy, dot_path)
+        return step_time * self.time_scale + penalty
+
+    def _simulate_raw(self, strategy: Strategy,
+                      dot_path: Optional[str] = None):
+        """Returns (unscaled step seconds, memory penalty seconds)."""
+        g = TaskGraph()
+        fwd_tasks: Dict[str, SimTask] = {}
+        producer = self._producer
+
+        total_mem = 0.0
+        costs = {op.name: self._op_cost(op, strategy)
+                 for op in self.model.ops}
+
+        # forward chain
+        for op in self.model.ops:
+            c = costs[op.name]
+            deps = [fwd_tasks[producer[t.uid].name]
+                    for t in op.inputs if t.uid in producer]
+            if c.fwd_comm > 0:
+                comm = g.add(f"{op.name}:fwd_comm", c.fwd_comm, "comm", deps)
+                deps = deps + [comm]
+            fwd_tasks[op.name] = g.add(f"{op.name}:fwd", c.fwd, "compute",
+                                       deps)
+            total_mem += c.mem
+
+        # backward chain (reverse graph)
+        consumers = self._consumers
+        bwd_tasks: Dict[str, SimTask] = {}
+        sync_tasks: List[SimTask] = []
+        for op in reversed(self.model.ops):
+            c = costs[op.name]
+            deps = [bwd_tasks[cons.name] for cons in consumers.get(op.name, [])
+                    if cons.name in bwd_tasks]
+            if not deps:
+                deps = [fwd_tasks[self.model.ops[-1].name]]
+            if c.bwd_comm > 0:
+                comm = g.add(f"{op.name}:bwd_comm", c.bwd_comm, "comm", deps)
+                deps = deps + [comm]
+            bwd_tasks[op.name] = g.add(f"{op.name}:bwd", c.bwd, "compute",
+                                       deps)
+            if c.sync > 0:
+                # grad all-reduce may overlap the rest of backward
+                # (reference overlap flag, simulator.cc:393-497)
+                sync_deps = [bwd_tasks[op.name]]
+                st = g.add(f"{op.name}:grad_sync", c.sync, "comm", sync_deps)
+                sync_tasks.append(st)
+
+        if not self.overlap and sync_tasks:
+            # serialize syncs after all backward work: model by chaining
+            last_bwd = bwd_tasks[self.model.ops[0].name]
+            for st in sync_tasks:
+                st.deps.append(last_bwd)
+
+        step_time = g.simulate()
+        if dot_path:
+            g.export_dot(dot_path)
+        return step_time, self.mm.memory_penalty(total_mem)
+
+    def memory_per_device(self, strategy: Strategy) -> float:
+        return sum(self._op_cost(op, strategy).mem for op in self.model.ops)

@@ -1,0 +1,141 @@
+"""Auto-parallelization tests: cost model, simulator, MCMC search,
+strategy file I/O (reference text format)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer, Strategy, make_mesh
+from flexflow_tpu.parallel.pconfig import OpStrategy, megatron_strategy
+from flexflow_tpu.parallel.strategy_io import (
+    load_strategies_from_file,
+    op_parallel_config,
+    save_strategies_to_file,
+)
+from flexflow_tpu.search.machine_model import default_machine_model
+from flexflow_tpu.search.mcmc import candidate_maps, optimize
+from flexflow_tpu.search.simulator import Simulator
+
+
+def build_big_mlp(batch=32, hidden=4096):
+    """TP-friendly: huge dense layers, small batch -> model parallelism
+    should beat pure DP on a (1, 8) data x model mesh."""
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    cfg.enable_parameter_parallel = True
+    ff = FFModel(cfg)
+    x = ff.create_tensor((batch, hidden), name="input")
+    t = ff.dense(x, hidden, activation="relu", name="big1")
+    t = ff.dense(t, hidden, activation="relu", name="big2")
+    t = ff.dense(t, 10, name="head")
+    t = ff.softmax(t)
+    return ff
+
+
+def test_simulator_monotonic_in_dp():
+    """For a compute-bound model (batch large enough that per-step compute
+    dominates the fixed gradient all-reduce), DP must beat replication.
+    (At small batch the simulator correctly prefers replication — the
+    all-reduce is a fixed cost while compute scales with batch.)"""
+    ff = build_big_mlp(batch=32768, hidden=512)
+    mesh = make_mesh((8,), ("data",))
+    sim = Simulator(ff, mesh)
+    t_dp = sim.simulate(Strategy())  # sample -> data
+    t_repl = sim.simulate(Strategy(default=OpStrategy({})))  # replicated
+    assert t_dp < t_repl, (t_dp, t_repl)
+
+
+def test_simulator_tp_beats_dp_for_big_layers():
+    ff = build_big_mlp(batch=8, hidden=8192)
+    mesh = make_mesh((1, 8), ("data", "model"))
+    sim = Simulator(ff, mesh)
+    t_dp = sim.simulate(Strategy())
+    t_tp = sim.simulate(megatron_strategy())
+    assert t_tp < t_dp, (t_tp, t_dp)
+
+
+def test_memory_penalty_applies():
+    ff = build_big_mlp(batch=8, hidden=8192)
+    mesh = make_mesh((1, 8), ("data", "model"))
+    mm = default_machine_model(mesh)
+    mm.spec.hbm_capacity = 1e6  # absurdly small: everything over budget
+    sim_small = Simulator(ff, mesh, mm)
+    sim_big = Simulator(ff, mesh)
+    assert sim_small.simulate(Strategy()) > sim_big.simulate(Strategy())
+
+
+def test_candidate_maps_respect_gates():
+    ff = build_big_mlp()
+    mesh = make_mesh((1, 8), ("data", "model"))
+    op = ff.ops[0]  # big dense
+    cfg = ff.config
+    cfg.enable_parameter_parallel = False
+    cands = candidate_maps(op, mesh, cfg)
+    assert all("channel_out" not in c for c in cands)
+    cfg.enable_parameter_parallel = True
+    cands = candidate_maps(op, mesh, cfg)
+    assert any(c.get("channel_out") == "model" for c in cands)
+
+
+def test_mcmc_finds_tp_for_big_layers():
+    ff = build_big_mlp(batch=8, hidden=8192)
+    mesh = make_mesh((1, 8), ("data", "model"))
+    ff.mesh = mesh
+    best = optimize(ff, budget=300, alpha=0.05, mesh=mesh, seed=0)
+    sim = Simulator(ff, mesh)
+    t_best = sim.simulate(best)
+    t_dp = sim.simulate(Strategy())
+    assert t_best <= t_dp
+    # the big layers should end up model-parallel
+    big_maps = [best.for_op(n).axis_map for n in ("big1", "big2")]
+    assert any(m.get("channel_out") == "model" for m in big_maps), big_maps
+
+
+def test_search_wired_into_compile_and_trains():
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.search_budget = 50
+    cfg.enable_parameter_parallel = True
+    mesh = make_mesh((2, 4), ("data", "model"))
+    ff = FFModel(cfg, mesh=mesh)
+    x = ff.create_tensor((16, 64), name="input")
+    t = ff.dense(x, 256, activation="relu")
+    t = ff.dense(t, 4)
+    t = ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 64).astype(np.float32)
+    ys = rng.randint(0, 4, 64).astype(np.int32)
+    hist = ff.fit({"input": xs}, ys, epochs=2, verbose=False)
+    assert np.isfinite(hist[-1]["loss"])
+
+
+def test_reference_strategy_file_roundtrip(tmp_path):
+    ff = build_big_mlp(batch=8, hidden=512)
+    mesh = make_mesh((2, 4), ("data", "model"))
+    strat = megatron_strategy()
+    path = str(tmp_path / "strategy.txt")
+    save_strategies_to_file(ff, strat, mesh, path)
+    text = open(path).read().splitlines()
+    assert text[0] == str(len(ff.ops))
+    # big1 line: name tpu ndims dims... -> (batch split 2, channel 4)
+    big1 = next(l for l in text if l.startswith("big1"))
+    parts = big1.split()
+    assert parts[1] == "tpu" and parts[2] == "2"
+    assert parts[3:5] == ["2", "4"], parts
+
+    loaded = load_strategies_from_file(ff, mesh, path)
+    m = loaded.for_op("big1").axis_map
+    assert m.get("sample") == "data" and m.get("channel_out") == "model", m
+
+
+def test_simulator_dot_export(tmp_path):
+    ff = build_big_mlp(batch=8, hidden=256)
+    mesh = make_mesh((8,), ("data",))
+    sim = Simulator(ff, mesh)
+    dot = str(tmp_path / "graph.dot")
+    sim.simulate(Strategy(), dot_path=dot)
+    content = open(dot).read()
+    assert "digraph taskgraph" in content
+    assert "big1:fwd" in content and "grad_sync" in content
