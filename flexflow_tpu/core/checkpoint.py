@@ -1,0 +1,75 @@
+"""Checkpoint / resume via orbax.
+
+The reference has NO training-state serialization (SURVEY.md section 5:
+"no model-state serialization to disk"); the closest artifacts are host
+get/set of weights and strategy files. This is the planned-in recovery
+story: full TrainState (params, states, opt_state, step) saved with
+orbax, with optional async saves so the step loop never blocks.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .executor import TrainState
+
+
+def _checkpointer(use_async: bool = False):
+    import orbax.checkpoint as ocp
+    if use_async:
+        return ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return ocp.Checkpointer(ocp.StandardCheckpointHandler())
+
+
+def save_checkpoint(path: str, state: TrainState,
+                    use_async: bool = False, force: bool = True):
+    """Save a TrainState to `path` (a directory).
+
+    With use_async=True the write happens in a background thread and the
+    AsyncCheckpointer is RETURNED — the caller must keep it and call
+    wait_until_finished() (or close()) before relying on the checkpoint
+    or exiting; the checkpoint is uncommitted until then."""
+    ckptr = _checkpointer(use_async)
+    payload = {
+        "params": state.params,
+        "states": state.states,
+        "opt_state": state.opt_state,
+        "step": state.step,
+    }
+    ckptr.save(os.path.abspath(path), payload, force=force)
+    if use_async:
+        return ckptr
+    ckptr.close()
+    return None
+
+
+def restore_checkpoint(path: str, state: TrainState) -> TrainState:
+    """Restore into the structure (and shardings) of `state`."""
+    import orbax.checkpoint as ocp
+    ckptr = _checkpointer(False)
+    target = {
+        "params": state.params,
+        "states": state.states,
+        "opt_state": state.opt_state,
+        "step": state.step,
+    }
+    restored = ckptr.restore(
+        os.path.abspath(path),
+        args=ocp.args.StandardRestore(target))
+    ckptr.close()
+    return TrainState(restored["params"], restored["states"],
+                      restored["opt_state"], restored["step"])
+
+
+def save_model(model, path: str, use_async: bool = False):
+    """Returns the AsyncCheckpointer when use_async=True (see
+    save_checkpoint), else None."""
+    return save_checkpoint(path, model.state, use_async=use_async)
+
+
+def restore_model(model, path: str) -> None:
+    model.state = restore_checkpoint(path, model.state)

@@ -1,0 +1,123 @@
+"""Data loading.
+
+Reference: `SingleDataLoader` (python/flexflow_dataloader.cc:576-740) —
+the full dataset lives in zero-copy host memory (attached numpy),
+`next_batch` index-launches per-part GPU copies with per-part sample
+offsets, `reset` rewinds. TPU-native equivalent: the dataset stays in
+host numpy; `next_batch` device_puts the next slice sharded over the
+mesh `data` axis (and, multi-host, assembles a global array from
+process-local shards via jax.make_array_from_process_local_data).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.sharding import batch_sharding
+
+
+class SingleDataLoader:
+    """One loader per (input tensor, full dataset array) pair, mirroring
+    the reference's per-tensor loaders; `DataLoaderSet` batches them."""
+
+    def __init__(self, name: str, data: np.ndarray, batch_size: int,
+                 mesh=None, shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = True):
+        self.name = name
+        self.data = np.asarray(data)
+        self.batch_size = int(batch_size)
+        self.mesh = mesh
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.RandomState(seed)
+        self._order = np.arange(len(self.data))
+        self._pos = 0
+        if shuffle:
+            self._rng.shuffle(self._order)
+
+    @property
+    def num_samples(self) -> int:
+        return len(self.data)
+
+    @property
+    def num_batches(self) -> int:
+        n = self.num_samples // self.batch_size
+        if not self.drop_last and self.num_samples % self.batch_size:
+            n += 1
+        return n
+
+    def reset(self) -> None:
+        self._pos = 0
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+
+    def next_batch(self):
+        """Host slice -> device array sharded over the data axis."""
+        if self._pos + self.batch_size > self.num_samples:
+            if self.drop_last or self._pos >= self.num_samples:
+                raise StopIteration
+        sel = self._order[self._pos:self._pos + self.batch_size]
+        self._pos += self.batch_size
+        host = self.data[sel]
+        arr = jnp.asarray(host)
+        if self.mesh is not None:
+            arr = jax.device_put(arr, batch_sharding(self.mesh, arr.ndim))
+        return arr
+
+
+class DataLoaderSet:
+    """Batches several SingleDataLoaders in lockstep (inputs + label),
+    the shape FFModel.fit consumes."""
+
+    def __init__(self, arrays: Dict[str, np.ndarray], batch_size: int,
+                 mesh=None, shuffle: bool = True, seed: int = 0):
+        n = {len(v) for v in arrays.values()}
+        assert len(n) == 1, "all arrays must have equal sample counts"
+        # one shared shuffled order: shuffle once here, not per-loader
+        self._order_rng = np.random.RandomState(seed)
+        self.loaders = {
+            k: SingleDataLoader(k, v, batch_size, mesh=mesh, shuffle=False)
+            for k, v in arrays.items()
+        }
+        self.shuffle = shuffle
+        self.batch_size = batch_size
+
+    @property
+    def num_batches(self) -> int:
+        return next(iter(self.loaders.values())).num_batches
+
+    def reset(self) -> None:
+        if self.shuffle:
+            order = np.arange(
+                next(iter(self.loaders.values())).num_samples)
+            self._order_rng.shuffle(order)
+            for l in self.loaders.values():
+                l._order = order
+        for l in self.loaders.values():
+            l._pos = 0
+
+    def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
+        self.reset()
+        for _ in range(self.num_batches):
+            yield {k: l.next_batch() for k, l in self.loaders.items()}
+
+
+def synthetic_batch(model, label_classes: int = 10, seed: int = 0
+                    ) -> Dict[str, np.ndarray]:
+    """Synthetic inputs matching the model's declared input tensors
+    (reference: syntheticInput when no --dataset, alexnet.cc:100-104)."""
+    rng = np.random.RandomState(seed)
+    batch = {}
+    for t in model.input_tensors:
+        if jnp.issubdtype(t.dtype, jnp.integer):
+            batch[t.name] = rng.randint(0, 10, t.shape).astype(np.int32)
+        else:
+            batch[t.name] = rng.randn(*t.shape).astype(
+                np.dtype(t.dtype).name)
+    bs = model.input_tensors[0].shape[0]
+    batch["label"] = rng.randint(0, label_classes, bs).astype(np.int32)
+    return batch

@@ -1,0 +1,3 @@
+"""Frontends: Keras-compatible API, ONNX importer, PyTorch fx importer —
+the TPU-native equivalents of reference python/flexflow/{keras,onnx,torch}
+(SURVEY.md 2.6)."""

@@ -1,0 +1,26 @@
+"""Keras-compatible frontend.
+
+Reference: python/flexflow/keras/ — Sequential/Model over a shared base
+(keras/models/base_model.py), layer classes translating 1:1 onto FFModel
+builder calls, optimizer/loss/metric name shims, callbacks. Same usage:
+
+    from flexflow_tpu.frontends import keras
+    model = keras.Sequential([
+        keras.layers.Conv2D(32, (3, 3), activation="relu",
+                            input_shape=(3, 32, 32)),
+        keras.layers.Flatten(),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer="sgd",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, epochs=5)
+"""
+
+from . import layers
+from .callbacks import Callback, EarlyStopping, VerifyMetrics
+from .models import Model, Sequential
+from .optimizers import SGD, Adam
+
+__all__ = ["layers", "Model", "Sequential", "SGD", "Adam", "Callback",
+           "EarlyStopping", "VerifyMetrics"]

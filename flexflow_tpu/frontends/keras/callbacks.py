@@ -1,0 +1,70 @@
+"""Keras callbacks (reference: python/flexflow/keras/callbacks.py and the
+accuracy-assert callback used by tests/accuracy_tests.sh)."""
+
+from __future__ import annotations
+
+
+class Callback:
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", min_delta=0.0, patience=0,
+                 mode="min"):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+        self.stopped_epoch = None
+
+    def on_train_begin(self, logs=None):
+        self.best = None
+        self.wait = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        better = (self.best is None
+                  or (self.mode == "min" and cur < self.best - self.min_delta)
+                  or (self.mode == "max" and cur > self.best + self.min_delta))
+        if better:
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stopped_epoch = epoch
+                self.model.stop_training = True
+
+
+class VerifyMetrics(Callback):
+    """Assert a final metric threshold (the accuracy_tests.sh pattern:
+    examples/python/keras/accuracy.py)."""
+
+    def __init__(self, metric="accuracy", threshold=0.9):
+        self.metric = metric
+        self.threshold = threshold
+        self.last = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.last = (logs or {}).get(self.metric)
+
+    def on_train_end(self, logs=None):
+        assert self.last is not None and self.last >= self.threshold, (
+            f"{self.metric}={self.last} below threshold {self.threshold}")

@@ -1,0 +1,199 @@
+"""Keras Model/Sequential.
+
+Reference: python/flexflow/keras/models/base_model.py — compile() builds
+the FFModel graph + optimizer (:127-193), fit() wires dataloaders and
+runs the per-iteration train loop (:347-424). Here compile() emits the
+recorded layer DAG onto an FFModel and fit() delegates to FFModel.fit
+with callback hooks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...config import FFConfig
+from ...model import FFModel
+from .layers import Input, KTensor, Layer
+from .optimizers import resolve as resolve_optimizer
+
+_LOSS_ALIASES = {
+    "sparse_categorical_crossentropy": "sparse_categorical_crossentropy",
+    "categorical_crossentropy": "categorical_crossentropy",
+    "mean_squared_error": "mean_squared_error",
+    "mse": "mean_squared_error",
+    "binary_crossentropy": "binary_crossentropy",
+}
+
+
+class Model:
+    def __init__(self, inputs=None, outputs=None, name: str = "model",
+                 config: Optional[FFConfig] = None, mesh=None,
+                 strategy=None):
+        self.name = name
+        self.inputs: List[KTensor] = (
+            inputs if isinstance(inputs, (list, tuple))
+            else [inputs] if inputs is not None else [])
+        self.outputs: List[KTensor] = (
+            outputs if isinstance(outputs, (list, tuple))
+            else [outputs] if outputs is not None else [])
+        self.config = config
+        self.mesh = mesh
+        self.strategy = strategy
+        self.ffmodel: Optional[FFModel] = None
+        self.stop_training = False
+
+    # ---- graph emission ----
+    def _emit(self, batch_size: int) -> FFModel:
+        cfg = self.config or FFConfig()
+        cfg.batch_size = batch_size
+        ff = FFModel(cfg, mesh=self.mesh, strategy=self.strategy)
+        mapping: Dict[int, object] = {}
+        for kt in self.inputs:
+            mapping[kt.uid] = ff.create_tensor(
+                (batch_size,) + kt.shape, dtype=kt.dtype, name=kt.ff_name)
+
+        def emit(kt: KTensor):
+            if kt.uid in mapping:
+                return mapping[kt.uid]
+            ins = [emit(i) for i in kt.inputs]
+            out = kt.layer.emit(ff, ins)
+            mapping[kt.uid] = out
+            return out
+
+        for out in self.outputs:
+            emit(out)
+        return ff
+
+    # ---- keras API ----
+    def compile(self, optimizer="sgd", loss="sparse_categorical_crossentropy",
+                metrics=None, batch_size: Optional[int] = None, **kw):
+        self._optimizer = resolve_optimizer(optimizer)
+        self._loss = _LOSS_ALIASES.get(loss, loss)
+        self._metrics = list(metrics or [])
+        self._batch_size = batch_size
+        self._compiled = False
+
+    def _ensure_ff(self, batch_size: int):
+        if self.ffmodel is None or not self._compiled:
+            self.ffmodel = self._emit(batch_size)
+            self.ffmodel.compile(optimizer=self._optimizer,
+                                 loss_type=self._loss,
+                                 metrics=self._metrics)
+            self._compiled = True
+
+    def fit(self, x, y, batch_size: int = 64, epochs: int = 1,
+            callbacks: Sequence = (), shuffle: bool = True,
+            verbose: bool = True):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        bs = self._batch_size or batch_size
+        self._ensure_ff(bs)  # builds Sequential graphs lazily
+        assert len(xs) == len(self.inputs), (
+            f"model has {len(self.inputs)} inputs, got {len(xs)} arrays")
+        inputs = {}
+        for kt, arr in zip(self.inputs, xs):
+            name = self.ffmodel.input_tensors[
+                self.inputs.index(kt)].name
+            inputs[name] = np.asarray(arr)
+
+        for cb in callbacks:
+            cb.set_model(self)
+        self.stop_training = False
+        history = []
+        for cb in callbacks:
+            cb.on_train_begin()
+        for epoch in range(epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            h = self.ffmodel.fit(inputs, np.asarray(y), batch_size=bs,
+                                 epochs=1, shuffle=shuffle,
+                                 verbose=False)
+            logs = h[-1]
+            logs["epoch"] = epoch
+            history.append(logs)
+            if verbose:
+                acc = (f" accuracy={logs['accuracy']:.4f}"
+                       if "accuracy" in logs else "")
+                print(f"epoch {epoch}: loss={logs['loss']:.4f}{acc} "
+                      f"({logs['throughput']:.1f} samples/s)")
+            for cb in callbacks:
+                cb.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        for cb in callbacks:
+            cb.on_train_end(history[-1] if history else None)
+        return history
+
+    def evaluate(self, x, y, batch_size: int = 64):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        bs = self._batch_size or batch_size
+        self._ensure_ff(bs)
+        inputs = {}
+        for i, arr in enumerate(xs):
+            inputs[self.ffmodel.input_tensors[i].name] = np.asarray(arr)
+        return self.ffmodel.evaluate(inputs, np.asarray(y), batch_size=bs)
+
+    def predict(self, x, batch_size: int = 64):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        bs = self._batch_size or batch_size
+        self._ensure_ff(bs)
+        outs = []
+        n = len(xs[0])
+        n_batches = (n + bs - 1) // bs
+        for s in range(n_batches):
+            batch = {}
+            valid = min(bs, n - s * bs)
+            for i, arr in enumerate(xs):
+                part = np.asarray(arr[s * bs:s * bs + valid])
+                if valid < bs:  # pad the tail to keep shapes static
+                    pad = np.repeat(part[:1], bs - valid, axis=0)
+                    part = np.concatenate([part, pad], axis=0)
+                batch[self.ffmodel.input_tensors[i].name] = part
+            out = np.asarray(self.ffmodel.forward(batch))
+            outs.append(out[:valid])
+        return np.concatenate(outs, axis=0)
+
+    def summary(self):
+        self._ensure_ff(self._batch_size or 64)
+        print(self.ffmodel.summary())
+
+
+class Sequential(Model):
+    def __init__(self, layers: Sequence = (), name: str = "sequential",
+                 config: Optional[FFConfig] = None, mesh=None,
+                 strategy=None):
+        super().__init__(name=name, config=config, mesh=mesh,
+                         strategy=strategy)
+        self._layers: List[Layer] = []
+        self._input_shape = None
+        for l in layers:
+            self.add(l)
+
+    def add(self, layer: Layer):
+        self._layers.append(layer)
+        return self
+
+    def _build_graph(self):
+        assert self._layers, "empty Sequential"
+        first = self._layers[0]
+        in_shape = getattr(first, "_input_shape", None) or self._input_shape
+        assert in_shape is not None, (
+            "first layer needs input_shape= or call build(input_shape)")
+        import jax.numpy as jnp
+        dtype = jnp.int32 if type(first).__name__ == "Embedding" else jnp.float32
+        t = Input(in_shape, dtype=dtype)
+        self.inputs = [t]
+        for l in self._layers:
+            t = l(t)
+        self.outputs = [t]
+
+    def build(self, input_shape):
+        self._input_shape = tuple(input_shape)
+        return self
+
+    def _ensure_ff(self, batch_size: int):
+        if not self.inputs:
+            self._build_graph()
+        super()._ensure_ff(batch_size)

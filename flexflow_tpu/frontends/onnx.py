@@ -1,0 +1,171 @@
+"""ONNX importer.
+
+Reference: python/flexflow/onnx/model.py — `ONNXModel.apply(ffmodel,
+input_dict)` with per-node handlers (Conv, Gemm->dense, MaxPool/
+AveragePool, BatchNormalization, Concat, Split, Flatten, Relu, Softmax,
+Reshape, Add/Sub/Mul, Dropout; onnx/model.py:74-340).
+
+Gated on the `onnx` package (not in this image's environment); the
+handler table is complete so it activates wherever onnx is installed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+try:
+    import onnx
+    from onnx import numpy_helper
+    HAS_ONNX = True
+except ImportError:  # pragma: no cover - onnx absent in CI image
+    HAS_ONNX = False
+
+
+def _sym_pads(attrs, node):
+    """ONNX pads are [h_begin, w_begin, h_end, w_end]; the framework's
+    conv/pool take symmetric padding only — reject asymmetric pads loudly
+    rather than silently dropping the end pads."""
+    pads = attrs.get("pads", [0, 0, 0, 0])
+    if len(pads) == 4 and (pads[0] != pads[2] or pads[1] != pads[3]):
+        raise NotImplementedError(
+            f"asymmetric ONNX padding {pads} on node "
+            f"{node.name or node.output[0]} is unsupported")
+    return pads
+
+
+class ONNXModel:
+    def __init__(self, path_or_model):
+        if not HAS_ONNX:
+            raise ImportError(
+                "the `onnx` package is required for the ONNX importer; "
+                "pip install onnx")
+        self.model = (onnx.load(path_or_model)
+                      if isinstance(path_or_model, str) else path_or_model)
+        self.inits = {t.name: numpy_helper.to_array(t)
+                      for t in self.model.graph.initializer}
+
+    @staticmethod
+    def _attrs(node) -> Dict:
+        out = {}
+        for a in node.attribute:
+            if a.type == onnx.AttributeProto.INT:
+                out[a.name] = a.i
+            elif a.type == onnx.AttributeProto.INTS:
+                out[a.name] = list(a.ints)
+            elif a.type == onnx.AttributeProto.FLOAT:
+                out[a.name] = a.f
+            elif a.type == onnx.AttributeProto.STRING:
+                out[a.name] = a.s.decode()
+        return out
+
+    def apply(self, ffmodel, input_dict: Dict[str, "Tensor"]):
+        """Emit the graph onto ffmodel; input_dict maps ONNX graph input
+        names to framework tensors. Returns the output tensor."""
+        values = dict(input_dict)
+        pending_weights: Dict[str, Dict[str, np.ndarray]] = {}
+        out = None
+        for node in self.model.graph.node:
+            a = self._attrs(node)
+            ins = node.input
+            name = node.name or node.output[0]
+            if node.op_type == "Conv":
+                w = self.inits[ins[1]]
+                bias = self.inits[ins[2]] if len(ins) > 2 else None
+                kh, kw = a.get("kernel_shape", w.shape[2:])
+                sh, sw = a.get("strides", [1, 1])
+                pads = _sym_pads(a, node)
+                t = ffmodel.conv2d(values[ins[0]], w.shape[0], kh, kw, sh,
+                                   sw, pads[0], pads[1],
+                                   groups=a.get("group", 1),
+                                   use_bias=bias is not None, name=name)
+                pending_weights[name] = {"kernel": w} | (
+                    {"bias": bias} if bias is not None else {})
+            elif node.op_type == "Gemm":
+                w = self.inits[ins[1]]
+                bias = self.inits[ins[2]] if len(ins) > 2 else None
+                out_dim = w.shape[0] if a.get("transB", 0) else w.shape[1]
+                t = ffmodel.dense(values[ins[0]], out_dim,
+                                  use_bias=bias is not None, name=name)
+                kernel = w.T if a.get("transB", 0) else w
+                pending_weights[name] = {"kernel": kernel} | (
+                    {"bias": bias} if bias is not None else {})
+            elif node.op_type == "MatMul":
+                w = self.inits.get(ins[1])
+                if w is not None:
+                    t = ffmodel.dense(values[ins[0]], w.shape[1],
+                                      use_bias=False, name=name)
+                    pending_weights[name] = {"kernel": w}
+                else:
+                    t = ffmodel.batch_matmul(values[ins[0]], values[ins[1]],
+                                             name=name)
+            elif node.op_type in ("MaxPool", "AveragePool"):
+                kh, kw = a["kernel_shape"]
+                sh, sw = a.get("strides", [kh, kw])
+                pads = _sym_pads(a, node)
+                t = ffmodel.pool2d(values[ins[0]], kh, kw, sh, sw,
+                                   pads[0], pads[1],
+                                   pool_type=("max" if node.op_type ==
+                                              "MaxPool" else "avg"),
+                                   name=name)
+            elif node.op_type == "GlobalAveragePool":
+                shp = values[ins[0]].shape
+                t = ffmodel.pool2d(values[ins[0]], shp[2], shp[3], 1, 1,
+                                   0, 0, pool_type="avg", name=name)
+            elif node.op_type == "BatchNormalization":
+                t = ffmodel.batch_norm(values[ins[0]], relu=False,
+                                       name=name)
+                pending_weights[name] = {"scale": self.inits[ins[1]],
+                                         "bias": self.inits[ins[2]]}
+            elif node.op_type == "Concat":
+                t = ffmodel.concat([values[i] for i in ins],
+                                   axis=a.get("axis", 1), name=name)
+            elif node.op_type == "Split":
+                sizes = a.get("split")
+                if sizes is None and len(ins) > 1:  # opset>=13: input 1
+                    sizes = self.inits[ins[1]].tolist()
+                if sizes is None:  # equal split into len(outputs)
+                    sizes = len(node.output)
+                outs = ffmodel.split(values[ins[0]], sizes,
+                                     axis=a.get("axis", 0), name=name)
+                for o_name, o_t in zip(node.output, outs):
+                    values[o_name] = o_t
+                continue
+            elif node.op_type == "Flatten":
+                t = ffmodel.flat(values[ins[0]], name=name)
+            elif node.op_type == "Relu":
+                t = ffmodel.relu(values[ins[0]], name=name)
+            elif node.op_type == "Sigmoid":
+                t = ffmodel.sigmoid(values[ins[0]], name=name)
+            elif node.op_type == "Tanh":
+                t = ffmodel.tanh(values[ins[0]], name=name)
+            elif node.op_type == "Softmax":
+                t = ffmodel.softmax(values[ins[0]], name=name)
+            elif node.op_type == "Dropout":
+                t = ffmodel.dropout(values[ins[0]], a.get("ratio", 0.5),
+                                    name=name)
+            elif node.op_type in ("Add", "Sub", "Mul", "Div"):
+                mode = {"Add": "add", "Sub": "subtract", "Mul": "multiply",
+                        "Div": "divide"}[node.op_type]
+                t = getattr(ffmodel, mode)(values[ins[0]], values[ins[1]],
+                                           name=name)
+            elif node.op_type == "Reshape":
+                shape = self.inits[ins[1]].tolist()
+                t = ffmodel.reshape(values[ins[0]], shape, name=name)
+            elif node.op_type == "Transpose":
+                t = ffmodel.transpose(values[ins[0]], a["perm"], name=name)
+            elif node.op_type == "Identity":
+                t = values[ins[0]]
+            else:
+                raise NotImplementedError(
+                    f"unsupported ONNX op {node.op_type}")
+            values[node.output[0]] = t
+            out = t
+        self.pending_weights = pending_weights
+        return out
+
+    def import_weights(self, ffmodel) -> None:
+        for name, w in self.pending_weights.items():
+            ffmodel.set_weights(name, {k: np.asarray(v)
+                                       for k, v in w.items()})

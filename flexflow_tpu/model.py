@@ -373,7 +373,11 @@ class FFModel:
         names = list(x.keys())
         n = len(y)
         steps = n // bs
-        rng = np.random.RandomState(self.config.seed)
+        # persistent across fit() calls so per-epoch shuffles differ even
+        # when a wrapper drives one epoch at a time (keras frontend)
+        if not hasattr(self, "_fit_rng"):
+            self._fit_rng = np.random.RandomState(self.config.seed)
+        rng = self._fit_rng
         history = []
         for epoch in range(ep):
             idx = rng.permutation(n) if shuffle else np.arange(n)
@@ -424,6 +428,15 @@ class FFModel:
         if "correct" in agg:
             out["accuracy"] = agg["correct"] / agg["count"]
         return out
+
+    def create_data_loader(self, tensor_or_name, data) -> "SingleDataLoader":
+        """Reference parity: FFModel.create_data_loader (cbinding :1618)
+        — one loader per (tensor, full numpy dataset)."""
+        from .core.dataloader import SingleDataLoader
+        name = (tensor_or_name if isinstance(tensor_or_name, str)
+                else tensor_or_name.name)
+        return SingleDataLoader(name, data, self.config.batch_size,
+                                mesh=self.mesh)
 
     # ---------------- weight access (reference Parameter::get/set) ------
     def get_weights(self, op_name: str) -> Dict[str, np.ndarray]:

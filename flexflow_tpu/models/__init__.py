@@ -3,5 +3,21 @@
 
 from .alexnet import build_alexnet
 from .transformer import build_transformer
+from .resnet import build_resnet
+from .inception import build_inception_v3
+from .dlrm import build_dlrm
+from .moe import build_moe_fused, build_moe_reference
+from .candle_uno import build_candle_uno
+from .nmt_lstm import build_nmt_lstm
 
-__all__ = ["build_alexnet", "build_transformer"]
+__all__ = [
+    "build_alexnet",
+    "build_transformer",
+    "build_resnet",
+    "build_inception_v3",
+    "build_dlrm",
+    "build_moe_reference",
+    "build_moe_fused",
+    "build_candle_uno",
+    "build_nmt_lstm",
+]

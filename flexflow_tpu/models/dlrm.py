@@ -1,0 +1,73 @@
+"""DLRM (deep learning recommendation model).
+
+Reference: examples/cpp/DLRM/dlrm.cc:26-124 — bottom MLP over dense
+features, one embedding bag per sparse feature, pairwise dot-product
+feature interaction, top MLP, sigmoid CTR head. The reference's headline
+trick is *parameter-parallel* embedding placement (per-GPU tables via
+strategy files, dlrm_strategy.cc); the TPU equivalent shards each table's
+vocab over the mesh `model` axis (strategy {vocab: model}).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..config import FFConfig
+from ..model import FFModel
+
+
+def build_dlrm(config: Optional[FFConfig] = None, batch_size: int = None,
+               dense_dim: int = 13,
+               embedding_vocab_sizes: Sequence[int] = (1000,) * 8,
+               embedding_bag_size: int = 1, embedding_dim: int = 64,
+               bot_mlp: Sequence[int] = (512, 256, 64),
+               top_mlp: Sequence[int] = (512, 256, 1),
+               mesh=None, strategy=None) -> FFModel:
+    cfg = config or FFConfig()
+    bs = batch_size or cfg.batch_size
+    ff = FFModel(cfg, mesh=mesh, strategy=strategy)
+
+    dense_in = ff.create_tensor((bs, dense_dim), name="dense_features")
+    sparse_ins = [
+        ff.create_tensor((bs, embedding_bag_size), dtype=jnp.int32,
+                         name=f"sparse_{i}")
+        for i in range(len(embedding_vocab_sizes))
+    ]
+
+    # bottom MLP (dlrm.cc create_mlp)
+    t = dense_in
+    for i, width in enumerate(bot_mlp):
+        t = ff.dense(t, width, activation="relu", name=f"bot_mlp_{i}")
+    dense_emb = t  # (bs, embedding_dim)
+    assert dense_emb.shape[-1] == embedding_dim, (
+        "last bot_mlp width must equal embedding_dim")
+
+    # embedding bags (dlrm.cc create_emb; vocab-shardable for ICI
+    # parameter parallelism)
+    embs = [
+        ff.embedding(s, vocab, embedding_dim, aggr="sum", name=f"emb_{i}")
+        for i, (s, vocab) in enumerate(zip(sparse_ins,
+                                           embedding_vocab_sizes))
+    ]
+
+    # pairwise dot-product interaction (dlrm.cc interact_features):
+    # stack features (bs, F, D), compute (bs, F, F) gram via batch_matmul
+    feats = [dense_emb] + embs
+    F = len(feats)
+    stacked = ff.concat(feats, axis=1, name="interact_cat")  # (bs, F*D)
+    stacked = ff.reshape(stacked, (bs, F, embedding_dim),
+                         name="interact_reshape")
+    trans = ff.transpose(stacked, [0, 2, 1], name="interact_T")
+    gram = ff.batch_matmul(stacked, trans, name="interact_bmm")  # (bs,F,F)
+    gram_flat = ff.reshape(gram, (bs, F * F), name="interact_flat")
+    top_in = ff.concat([dense_emb, gram_flat], axis=1, name="top_cat")
+
+    # top MLP + sigmoid CTR
+    t = top_in
+    for i, width in enumerate(top_mlp[:-1]):
+        t = ff.dense(t, width, activation="relu", name=f"top_mlp_{i}")
+    t = ff.dense(t, top_mlp[-1], name="top_out")
+    t = ff.sigmoid(t, name="ctr")
+    return ff

@@ -1,0 +1,69 @@
+"""Mixture-of-experts example model.
+
+Reference: examples/cpp/mixture_of_experts/moe.cc — gating softmax +
+TopK + GroupBy + per-expert dense nets + Aggregate on MNIST-sized
+inputs. Built here in BOTH styles:
+
+  * build_moe_reference: the reference's composable op pipeline
+    (softmax/top_k/group_by/aggregate) — capability parity.
+  * build_moe_fused: the TPU-first fused MoEFFN with expert parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import FFConfig
+from ..model import FFModel
+
+
+def build_moe_reference(config: Optional[FFConfig] = None,
+                        batch_size: int = None, input_dim: int = 784,
+                        num_classes: int = 10, num_experts: int = 4,
+                        k: int = 2, alpha: float = 2.0,
+                        expert_hidden: int = 64,
+                        mesh=None, strategy=None) -> FFModel:
+    cfg = config or FFConfig()
+    bs = batch_size or cfg.batch_size
+    ff = FFModel(cfg, mesh=mesh, strategy=strategy)
+    x = ff.create_tensor((bs, input_dim), name="input")
+
+    # gating network (moe.cc: dense -> softmax -> top_k)
+    gate = ff.dense(x, num_experts, name="gate_dense")
+    gate = ff.softmax(gate, name="gate_softmax")
+    gate_vals, gate_assign = ff.top_k(gate, k, name="gate_topk")
+
+    # dispatch
+    expert_inputs = ff.group_by(x, gate_assign, num_experts, alpha,
+                                name="group_by")
+
+    # per-expert classifier nets (moe.cc expert blocks)
+    expert_preds = []
+    for i, einp in enumerate(expert_inputs):
+        h = ff.dense(einp, expert_hidden, activation="relu",
+                     name=f"expert{i}_fc1")
+        p = ff.dense(h, num_classes, name=f"expert{i}_fc2")
+        expert_preds.append(p)
+
+    out = ff.aggregate(gate_vals, gate_assign, expert_preds, num_experts,
+                       name="aggregate")
+    out = ff.softmax(out, name="softmax")
+    return ff
+
+
+def build_moe_fused(config: Optional[FFConfig] = None,
+                    batch_size: int = None, input_dim: int = 784,
+                    num_classes: int = 10, num_experts: int = 8,
+                    k: int = 2, expert_hidden: int = 128,
+                    mesh=None, strategy=None) -> FFModel:
+    cfg = config or FFConfig()
+    bs = batch_size or cfg.batch_size
+    ff = FFModel(cfg, mesh=mesh, strategy=strategy)
+    x = ff.create_tensor((bs, input_dim), name="input")
+    t = ff.dense(x, 256, activation="relu", name="stem")
+    t = ff.moe_ffn(t, num_experts=num_experts, k=k,
+                   hidden_dim=expert_hidden, capacity_factor=2.0,
+                   name="moe")
+    t = ff.dense(t, num_classes, name="head")
+    t = ff.softmax(t, name="softmax")
+    return ff

@@ -1,0 +1,44 @@
+"""NMT LSTM.
+
+Reference: nmt/ — a *separate* 3.6k-LoC Legion RNN framework (rnn.cu,
+lstm.cu cuDNN recurrence, embed.cu, softmax_data_parallel.cu, its own
+RnnMapper). Per SURVEY.md section 7 step 8 we do NOT reproduce that
+framework; LSTM is an ordinary op of the main framework (lax.scan cell,
+MXU-batched gate GEMMs) and the NMT model is an encoder-decoder-style
+stacked-LSTM LM built with the normal builder API.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..config import FFConfig
+from ..model import FFModel
+
+
+def build_nmt_lstm(config: Optional[FFConfig] = None,
+                   batch_size: int = None, seq_len: int = 40,
+                   vocab_size: int = 32000, embed_dim: int = 1024,
+                   hidden: int = 1024, num_layers: int = 2,
+                   mesh=None, strategy=None) -> FFModel:
+    """Stacked-LSTM sequence model: embed -> L x LSTM -> dense(vocab)
+    -> softmax over the final position (nmt/rnn.h:91-160 topology,
+    embed_size/hidden 1024 like nmt.cc)."""
+    cfg = config or FFConfig()
+    bs = batch_size or cfg.batch_size
+    ff = FFModel(cfg, mesh=mesh, strategy=strategy)
+    tokens = ff.create_tensor((bs, seq_len), dtype=jnp.int32, name="input")
+
+    # per-token embedding (aggr none keeps the seq dim)
+    t = ff.embedding(tokens, vocab_size, embed_dim, aggr="none",
+                     name="embed")
+    for i in range(num_layers):
+        t = ff.lstm(t, hidden, return_sequences=True, name=f"lstm_{i}")
+    # predict the next token from the last position
+    last = ff.split(t, [seq_len - 1, 1], axis=1, name="last_split")[1]
+    last = ff.reshape(last, (bs, hidden), name="last_reshape")
+    logits = ff.dense(last, vocab_size, name="proj")
+    out = ff.softmax(logits, name="softmax")
+    return ff

@@ -1,0 +1,69 @@
+"""Profiling / tracing.
+
+Reference aux subsystems (SURVEY.md section 5): Legion execution tracing
+(begin/end_trace — already implicit in XLA's trace-once-replay jit),
+per-op `--profiling` cudaEvent prints, and the simulator's DOT taskgraph
+export (in search/simulator.py). This module adds the TPU-native pieces:
+jax.profiler traces and a per-op analytic profile table.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/flexflow_tpu_trace"):
+    """Capture an XLA/TPU profiler trace viewable in TensorBoard
+    (jax.profiler; the analog of Legion's -lg:prof)."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def op_profile(model, peak_flops: Optional[float] = None) -> str:
+    """Analytic per-op table: flops, bytes, weight bytes, est. intensity.
+
+    The analog of the reference's per-op `[Measure Linear] ...` prints
+    (linear.cu:1063-1072) without needing a search run.
+    """
+    lines = [f"{'op':28s} {'type':18s} {'GFLOPs':>10s} {'MB moved':>10s} "
+             f"{'MB weights':>11s} {'intensity':>10s}"]
+    total_f = total_b = 0.0
+    for op in model.ops:
+        f = op.flops()
+        b = op.bytes_accessed()
+        w = op.weight_bytes()
+        total_f += f
+        total_b += b
+        inten = f / b if b else 0.0
+        lines.append(f"{op.name:28s} {op.op_type:18s} {f/1e9:>10.3f} "
+                     f"{b/1e6:>10.2f} {w/1e6:>11.2f} {inten:>10.1f}")
+    lines.append(f"{'TOTAL':28s} {'':18s} {total_f/1e9:>10.3f} "
+                 f"{total_b/1e6:>10.2f}")
+    if peak_flops:
+        lines.append(f"ideal step time at {peak_flops/1e12:.0f} TFLOP/s: "
+                     f"{3*total_f/peak_flops*1e3:.2f} ms (fwd+bwd)")
+    return "\n".join(lines)
+
+
+def time_train_steps(model, batch, steps: int = 20, warmup: int = 3
+                     ) -> float:
+    """Mean seconds per training step, with device sync via a scalar
+    fetch of the last step's loss (remote tunnels do not sync on
+    block_until_ready — the only reliable delimiter is a device->host
+    transfer). Queues all steps before draining, so Python dispatch
+    overlaps device execution exactly as in production loops."""
+    for _ in range(warmup):
+        m = model.train_batch(batch)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = model.train_batch(batch)
+    float(m["loss"])
+    return (time.perf_counter() - t0) / steps

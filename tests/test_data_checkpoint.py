@@ -1,0 +1,95 @@
+"""Data loader + checkpoint/resume tests."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, make_mesh
+from flexflow_tpu.core.checkpoint import restore_model, save_model
+from flexflow_tpu.core.dataloader import (
+    DataLoaderSet,
+    SingleDataLoader,
+    synthetic_batch,
+)
+
+
+def test_single_dataloader_batches_and_reset():
+    data = np.arange(100).reshape(100, 1).astype(np.float32)
+    dl = SingleDataLoader("x", data, batch_size=32)
+    assert dl.num_batches == 3
+    b1 = np.asarray(dl.next_batch())
+    np.testing.assert_allclose(b1[:, 0], np.arange(32))
+    dl.next_batch()
+    dl.next_batch()
+    with pytest.raises(StopIteration):
+        dl.next_batch()
+    dl.reset()
+    np.testing.assert_allclose(np.asarray(dl.next_batch()), b1)
+
+
+def test_dataloader_set_lockstep_shuffle(mesh8):
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = np.arange(64).astype(np.int32)
+    ds = DataLoaderSet({"input": x, "label": y}, batch_size=16,
+                       mesh=mesh8, shuffle=True, seed=1)
+    seen = []
+    for batch in ds:
+        xb = np.asarray(batch["input"])
+        yb = np.asarray(batch["label"])
+        # lockstep: labels index rows of x
+        np.testing.assert_allclose(xb, x[yb])
+        seen.extend(yb.tolist())
+    assert sorted(seen) == list(range(64))
+    assert seen != list(range(64)), "must be shuffled"
+
+
+def test_synthetic_batch_shapes():
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    ff = FFModel(cfg)
+    import jax.numpy as jnp
+    ff.create_tensor((8, 16), name="x")
+    ff.create_tensor((8, 3), dtype=jnp.int32, name="ids")
+    t = ff.dense(ff.input_tensors[0], 4)
+    batch = synthetic_batch(ff)
+    assert batch["x"].shape == (8, 16)
+    assert batch["ids"].dtype == np.int32
+    assert batch["label"].shape == (8,)
+
+
+def _mlp(cfg):
+    ff = FFModel(cfg)
+    x = ff.create_tensor((cfg.batch_size, 16), name="input")
+    t = ff.dense(x, 32, activation="relu")
+    t = ff.softmax(ff.dense(t, 4))
+    ff.compile(optimizer=AdamOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    return ff
+
+
+def test_checkpoint_save_restore_roundtrip(tmp_path):
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    ff = _mlp(cfg)
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 16).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.int32)
+    for _ in range(3):
+        ff.train_batch({"input": x, "label": y})
+    path = str(tmp_path / "ckpt")
+    save_model(ff, path)
+    w_before = ff.get_weights("dense")["kernel"].copy()
+    step_before = int(ff.state.step)
+
+    # train further, then restore and confirm rollback
+    for _ in range(3):
+        ff.train_batch({"input": x, "label": y})
+    assert not np.allclose(ff.get_weights("dense")["kernel"], w_before)
+    restore_model(ff, path)
+    np.testing.assert_allclose(ff.get_weights("dense")["kernel"], w_before)
+    assert int(ff.state.step) == step_before
+
+    # resumed training continues
+    m = ff.train_batch({"input": x, "label": y})
+    assert np.isfinite(float(m["loss"]))
