@@ -1,0 +1,29 @@
+// Internal shared declarations for the native simulator + search.
+#ifndef FLEXFLOW_TPU_SIM_CORE_H
+#define FLEXFLOW_TPU_SIM_CORE_H
+
+#include <cstdint>
+#include <vector>
+
+namespace fftpu {
+
+// One node of the event-simulated task graph.  Mirrors the Python
+// SimTask (flexflow_tpu/search/simulator.py) which itself mirrors the
+// reference SimTask (include/simulator.h:238-390).
+struct Task {
+  double duration = 0.0;
+  int32_t resource = 0;  // tasks sharing a resource id serialize
+  int32_t first_dep = 0; // into TaskGraph::dep_indices
+  int32_t n_deps = 0;
+};
+
+// Priority-queue event loop over contended resources — the native
+// version of TaskGraph.simulate (reference simulator.cc:499-554).
+// Ties on ready-time break by insertion order (FIFO), matching the
+// Python heapq (ready_time, counter) key.
+double simulate(const std::vector<Task> &tasks,
+                const std::vector<int32_t> &dep_indices);
+
+}  // namespace fftpu
+
+#endif

@@ -11,13 +11,22 @@ process-local shards via jax.make_array_from_process_local_data).
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, Optional  # noqa: F401
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..parallel.sharding import batch_sharding
+
+
+def host_to_device(host, mesh) -> jax.Array:
+    """Host batch -> device array sharded over the mesh's data axis.
+    The single place batches land on devices (native and Python paths)."""
+    arr = jnp.asarray(host)
+    if mesh is not None:
+        arr = jax.device_put(arr, batch_sharding(mesh, arr.ndim))
+    return arr
 
 
 class SingleDataLoader:
@@ -62,48 +71,76 @@ class SingleDataLoader:
                 raise StopIteration
         sel = self._order[self._pos:self._pos + self.batch_size]
         self._pos += self.batch_size
-        host = self.data[sel]
-        arr = jnp.asarray(host)
-        if self.mesh is not None:
-            arr = jax.device_put(arr, batch_sharding(self.mesh, arr.ndim))
-        return arr
+        return host_to_device(self.data[sel], self.mesh)
 
 
 class DataLoaderSet:
     """Batches several SingleDataLoaders in lockstep (inputs + label),
-    the shape FFModel.fit consumes."""
+    the shape FFModel.fit consumes.
+
+    When the native runtime is available the per-batch row gather runs
+    on a C++ background thread (csrc/dataloader.cc), double-buffered so
+    host gather overlaps device dispatch — the prefetch analog of the
+    reference's next_batch index-launched copies
+    (flexflow_dataloader.cc:649-740)."""
 
     def __init__(self, arrays: Dict[str, np.ndarray], batch_size: int,
-                 mesh=None, shuffle: bool = True, seed: int = 0):
+                 mesh=None, shuffle: bool = True, seed: int = 0,
+                 use_native: Optional[bool] = None):
         n = {len(v) for v in arrays.values()}
         assert len(n) == 1, "all arrays must have equal sample counts"
         # one shared shuffled order: shuffle once here, not per-loader
         self._order_rng = np.random.RandomState(seed)
+        self.mesh = mesh
         self.loaders = {
             k: SingleDataLoader(k, v, batch_size, mesh=mesh, shuffle=False)
             for k, v in arrays.items()
         }
         self.shuffle = shuffle
         self.batch_size = batch_size
+        self._native = None
+        if use_native is not False:
+            from .. import native
+            if native.available():
+                from ..native.wrappers import NativePrefetchLoader
+                self._native = NativePrefetchLoader(
+                    {k: np.asarray(v) for k, v in arrays.items()},
+                    batch_size, drop_last=True)
+            else:
+                assert use_native is not True, "native loader requested " \
+                    "but the native library is unavailable"
 
     @property
     def num_batches(self) -> int:
         return next(iter(self.loaders.values())).num_batches
 
-    def reset(self) -> None:
+    def _epoch_order(self) -> np.ndarray:
+        order = np.arange(next(iter(self.loaders.values())).num_samples)
         if self.shuffle:
-            order = np.arange(
-                next(iter(self.loaders.values())).num_samples)
             self._order_rng.shuffle(order)
-            for l in self.loaders.values():
-                l._order = order
+        return order
+
+    def reset(self) -> None:
+        order = self._epoch_order()
         for l in self.loaders.values():
+            l._order = order
             l._pos = 0
 
     def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
-        self.reset()
-        for _ in range(self.num_batches):
-            yield {k: l.next_batch() for k, l in self.loaders.items()}
+        if self._native is not None:
+            self._native.start_epoch(self._epoch_order())
+            while True:
+                batch = self._native.next_batch()
+                if batch is None:
+                    return
+                # jnp.asarray copies out of the double buffer before the
+                # next gather can reuse it
+                yield {k: host_to_device(v, self.mesh)
+                       for k, v in batch.items()}
+        else:
+            self.reset()
+            for _ in range(self.num_batches):
+                yield {k: l.next_batch() for k, l in self.loaders.items()}
 
 
 def synthetic_batch(model, label_classes: int = 10, seed: int = 0

@@ -1,0 +1,137 @@
+"""Native runtime bindings.
+
+The reference keeps its runtime (simulator, search loop, data loader) in
+C++ behind a flat C API consumed by Python via cffi
+(python/flexflow_c.h + flexflow_cbinding.py). This package does the
+same with ctypes: `csrc/` holds the C++ sources and `flexflow_tpu_c.h`
+the C API; the shared library is built on first use with g++ (cached by
+source mtime) and every caller has a pure-Python fallback, so the
+framework degrades gracefully on machines without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_CSRC = os.path.join(_REPO_ROOT, "csrc")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libflexflow_tpu_native.so")
+
+_SOURCES = ("simulator.cc", "mcmc.cc", "dataloader.cc")
+_HEADERS = ("flexflow_tpu_c.h", "sim_core.h")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for f in _SOURCES + _HEADERS:
+        p = os.path.join(_CSRC, f)
+        if os.path.exists(p) and os.path.getmtime(p) > lib_mtime:
+            return True
+    return False
+
+
+def build(verbose: bool = False) -> str:
+    """Compile csrc/ into the shared library; returns its path.
+
+    Compiles to a process-unique temp path and renames into place so
+    concurrent builders (pytest-xdist, multi-process JAX) never expose a
+    half-written library to ctypes.CDLL."""
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp_path = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-Wall",
+           "-I", _CSRC,
+           *(os.path.join(_CSRC, s) for s in _SOURCES),
+           "-o", tmp_path, "-lpthread"]
+    if verbose:
+        print("[native]", " ".join(cmd), file=sys.stderr)
+    try:
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+        os.replace(tmp_path, _LIB_PATH)
+    finally:
+        if os.path.exists(tmp_path):
+            os.unlink(tmp_path)
+    return _LIB_PATH
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    vpp = ctypes.POINTER(ctypes.c_void_p)
+
+    lib.ffsim_simulate.restype = ctypes.c_double
+    lib.ffsim_simulate.argtypes = [ctypes.c_int32, f64p, i32p, i32p, i32p]
+
+    lib.ffsearch_mcmc.restype = ctypes.c_double
+    lib.ffsearch_mcmc.argtypes = [
+        ctypes.c_int32, i32p, i32p,
+        f64p, f64p, f64p, f64p, f64p, f64p,
+        ctypes.c_int32, i32p, i32p, i32p, i32p,
+        ctypes.c_int32, ctypes.c_double, ctypes.c_uint64,
+        ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_double, ctypes.c_double, i32p, i32p]
+
+    lib.ffsearch_simulate_assignment.restype = ctypes.c_double
+    lib.ffsearch_simulate_assignment.argtypes = [
+        ctypes.c_int32, i32p,
+        f64p, f64p, f64p, f64p, f64p, f64p,
+        ctypes.c_int32, i32p, i32p,
+        ctypes.c_int32, ctypes.c_double, ctypes.c_double, i32p]
+
+    lib.ffdl_create.restype = ctypes.c_void_p
+    lib.ffdl_create.argtypes = [ctypes.c_int32, vpp, i64p,
+                                ctypes.c_int64, ctypes.c_int32,
+                                ctypes.c_int32]
+    lib.ffdl_start_epoch.restype = None
+    lib.ffdl_start_epoch.argtypes = [ctypes.c_void_p, i64p]
+    lib.ffdl_num_batches.restype = ctypes.c_int32
+    lib.ffdl_num_batches.argtypes = [ctypes.c_void_p]
+    lib.ffdl_next_batch.restype = ctypes.c_int32
+    lib.ffdl_next_batch.argtypes = [ctypes.c_void_p, vpp, i32p]
+    lib.ffdl_destroy.restype = None
+    lib.ffdl_destroy.argtypes = [ctypes.c_void_p]
+
+    lib.flexflow_tpu_native_version.restype = ctypes.c_char_p
+    lib.flexflow_tpu_native_version.argtypes = []
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it if stale; None if unavailable
+    (no toolchain / build failure — callers fall back to Python)."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("FLEXFLOW_TPU_NO_NATIVE"):
+            _load_failed = True
+            return None
+        try:
+            if _needs_build():
+                build()
+            lib = ctypes.CDLL(_LIB_PATH)
+            _declare(lib)
+            _lib = lib
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"[flexflow_tpu.native] falling back to Python "
+                  f"implementations ({e})", file=sys.stderr)
+            _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

@@ -1,0 +1,192 @@
+"""Thin numpy-level wrappers over the native C API."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import get_lib
+
+
+def _i32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int32)
+
+
+def _i64(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int64)
+
+
+def _f64(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.float64)
+
+
+def _p(a: np.ndarray):
+    ct = {np.dtype(np.int32): ctypes.c_int32,
+          np.dtype(np.int64): ctypes.c_int64,
+          np.dtype(np.float64): ctypes.c_double}[a.dtype]
+    return a.ctypes.data_as(ctypes.POINTER(ct))
+
+
+def simulate_taskgraph(durations: Sequence[float], resources: Sequence[int],
+                       dep_indptr: Sequence[int],
+                       dep_indices: Sequence[int]) -> float:
+    """Native event-loop makespan; raises if the library is unavailable."""
+    lib = get_lib()
+    assert lib is not None, "native library unavailable"
+    d = _f64(durations)
+    r = _i32(resources)
+    ip = _i32(dep_indptr)
+    ix = _i32(dep_indices) if len(dep_indices) else np.zeros(1, np.int32)
+    out = lib.ffsim_simulate(len(d), _p(d), _p(r), _p(ip), _p(ix))
+    assert out >= 0, "cycle in task graph"
+    return out
+
+
+class CostTable:
+    """Flattened per-(op, candidate) cost arrays for the native search."""
+
+    def __init__(self, n_cands: Sequence[int]):
+        self.n_cands = _i32(n_cands)
+        self.offsets = _i32(np.concatenate([[0], np.cumsum(n_cands)]))
+        total = int(self.offsets[-1])
+        self.fwd = np.zeros(total)
+        self.bwd = np.zeros(total)
+        self.fwd_comm = np.zeros(total)
+        self.bwd_comm = np.zeros(total)
+        self.sync = np.zeros(total)
+        self.mem = np.zeros(total)
+
+    def set(self, op: int, cand: int, cost) -> None:
+        i = int(self.offsets[op]) + cand
+        self.fwd[i] = cost.fwd
+        self.bwd[i] = cost.bwd
+        self.fwd_comm[i] = cost.fwd_comm
+        self.bwd_comm[i] = cost.bwd_comm
+        self.sync[i] = cost.sync
+        self.mem[i] = cost.mem
+
+
+def mcmc_search(table: CostTable,
+                edges: Sequence[Tuple[int, int]],
+                prop_match: Optional[List[List[int]]],
+                budget: int, alpha: float, seed: int,
+                enable_propagation: bool, overlap_backward_sync: bool,
+                hbm_capacity: float, time_scale: float,
+                init_cand: Sequence[int]) -> Tuple[np.ndarray, float]:
+    """Run the native annealing loop; returns (best candidate per op,
+    best simulated step seconds)."""
+    lib = get_lib()
+    assert lib is not None, "native library unavailable"
+    n_ops = len(table.n_cands)
+    e_src = _i32([e[0] for e in edges])
+    e_dst = _i32([e[1] for e in edges])
+    if prop_match is None:
+        prop_match = [[-1] * int(table.n_cands[s]) for s, _ in edges]
+    prop_off = _i32(np.concatenate(
+        [[0], np.cumsum([len(m) for m in prop_match])])) if edges else \
+        np.zeros(1, np.int32)
+    prop_flat = _i32([v for m in prop_match for v in m]) if edges else \
+        np.zeros(1, np.int32)
+    if len(e_src) == 0:
+        e_src = np.zeros(1, np.int32)
+        e_dst = np.zeros(1, np.int32)
+    init = _i32(init_cand)
+    best = np.zeros(n_ops, np.int32)
+    cost = lib.ffsearch_mcmc(
+        n_ops, _p(table.n_cands), _p(table.offsets),
+        _p(table.fwd), _p(table.bwd), _p(table.fwd_comm),
+        _p(table.bwd_comm), _p(table.sync), _p(table.mem),
+        len(edges), _p(e_src), _p(e_dst), _p(prop_off), _p(prop_flat),
+        budget, alpha, seed, int(enable_propagation),
+        int(overlap_backward_sync), hbm_capacity, time_scale,
+        _p(init), _p(best))
+    return best, float(cost)
+
+
+def simulate_assignment(table: CostTable, edges: Sequence[Tuple[int, int]],
+                        assignment: Sequence[int],
+                        overlap_backward_sync: bool, hbm_capacity: float,
+                        time_scale: float) -> float:
+    lib = get_lib()
+    assert lib is not None, "native library unavailable"
+    n_ops = len(table.n_cands)
+    e_src = _i32([e[0] for e in edges]) if edges else np.zeros(1, np.int32)
+    e_dst = _i32([e[1] for e in edges]) if edges else np.zeros(1, np.int32)
+    a = _i32(assignment)
+    return float(lib.ffsearch_simulate_assignment(
+        n_ops, _p(table.offsets),
+        _p(table.fwd), _p(table.bwd), _p(table.fwd_comm),
+        _p(table.bwd_comm), _p(table.sync), _p(table.mem),
+        len(edges), _p(e_src), _p(e_dst),
+        int(overlap_backward_sync), hbm_capacity, time_scale, _p(a)))
+
+
+class NativePrefetchLoader:
+    """Background-thread batch gatherer over C-contiguous host arrays.
+
+    Gathers shuffled rows of every array into double-buffered contiguous
+    batch buffers on a native thread, overlapping the gather for batch
+    i+1 with device dispatch of batch i."""
+
+    def __init__(self, arrays: Dict[str, np.ndarray], batch_size: int,
+                 drop_last: bool = True):
+        lib = get_lib()
+        assert lib is not None, "native library unavailable"
+        self._lib = lib
+        self.names = list(arrays.keys())
+        self.arrays = [np.ascontiguousarray(arrays[k]) for k in self.names]
+        n = {len(a) for a in self.arrays}
+        assert len(n) == 1, "arrays must have equal sample counts"
+        self.n_samples = n.pop()
+        self.batch_size = batch_size
+        self.row_bytes = _i64([
+            a.nbytes // max(1, len(a)) for a in self.arrays])
+        self.row_shapes = [a.shape[1:] for a in self.arrays]
+        self.dtypes = [a.dtype for a in self.arrays]
+        ptrs = (ctypes.c_void_p * len(self.arrays))(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in self.arrays])
+        self._h = lib.ffdl_create(len(self.arrays), ptrs, _p(self.row_bytes),
+                                  self.n_samples, batch_size, int(drop_last))
+        assert self._h, "ffdl_create failed"
+
+    def start_epoch(self, order: Optional[np.ndarray] = None) -> None:
+        if order is None:
+            order = np.arange(self.n_samples, dtype=np.int64)
+        order = _i64(order)
+        assert len(order) == self.n_samples
+        self._lib.ffdl_start_epoch(self._h, _p(order))
+
+    @property
+    def num_batches(self) -> int:
+        return int(self._lib.ffdl_num_batches(self._h))
+
+    def next_batch(self) -> Optional[Dict[str, np.ndarray]]:
+        """Next batch as zero-copy views into the native double buffer
+        (valid until the following next_batch); None at epoch end."""
+        k = len(self.arrays)
+        out = (ctypes.c_void_p * k)()
+        rows = ctypes.c_int32(0)
+        idx = self._lib.ffdl_next_batch(self._h, out, ctypes.byref(rows))
+        if idx < 0:
+            return None
+        batch = {}
+        for i, name in enumerate(self.names):
+            shape = (rows.value,) + self.row_shapes[i]
+            nbytes = int(np.prod(shape)) * self.dtypes[i].itemsize
+            buf = (ctypes.c_char * nbytes).from_address(out[i])
+            batch[name] = np.frombuffer(buf, dtype=self.dtypes[i]).reshape(
+                shape)
+        return batch
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.ffdl_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -22,7 +22,7 @@ from typing import Dict, List, Optional
 
 from ..parallel.pconfig import OpStrategy, Strategy
 from .machine_model import default_machine_model
-from .simulator import Simulator
+from .simulator import Simulator, op_edges
 
 
 def candidate_maps(op, mesh, cfg) -> List[Dict[str, str]]:
@@ -78,13 +78,19 @@ def candidate_maps(op, mesh, cfg) -> List[Dict[str, str]]:
 
 def optimize(model, budget: int = 1000, alpha: float = 0.05,
              mesh=None, seed: int = 0, verbose: bool = False,
-             simulator: Optional[Simulator] = None) -> Strategy:
+             simulator: Optional[Simulator] = None,
+             use_native: Optional[bool] = None) -> Strategy:
     """Anneal over strategies; returns the best found.
 
     Reference contract: called from compile() when search_budget > 0
     (model.cc:1561-1570); unlike the reference we do NOT exit the process
     after search — the found strategy is used directly (and exported when
     --export is set).
+
+    The annealing loop runs in the native C++ engine (csrc/mcmc.cc) when
+    available — the analog of the reference keeping search+simulation in
+    C++ — with this Python loop as the fallback.  `use_native=False`
+    forces the Python path.
     """
     mesh = mesh or model.mesh
     if mesh is None:
@@ -97,15 +103,16 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
     rng = random.Random(seed)
 
     cands = {op.name: candidate_maps(op, mesh, cfg) for op in model.ops}
-    edges = []
-    producer = {}
-    for op in model.ops:
-        for t in op.outputs:
-            producer[t.uid] = op
-    for op in model.ops:
-        for t in op.inputs:
-            if t.uid in producer:
-                edges.append((producer[t.uid], op))
+
+    if use_native is not False:
+        from .native_search import optimize_native
+        found = optimize_native(model, sim, cands, budget, alpha, seed,
+                                verbose=verbose)
+        if found is not None:
+            return found
+        assert use_native is not True, "native search requested but " \
+            "the native library is unavailable"
+    _, edges = op_edges(model)
 
     current = (model.strategy or Strategy()).copy()
     # materialize every op's map so moves are local

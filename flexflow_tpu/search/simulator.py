@@ -92,6 +92,24 @@ class TaskGraph:
             f.write("}\n")
 
 
+def op_edges(model):
+    """(producer-map, producer->consumer op pairs) in canonical order:
+    iteration over each op's inputs.  Every engine that walks the graph
+    (this simulator, the Python MCMC loop, the native search lowering)
+    MUST derive edges through this one function — backward-dependency
+    construction and propagation moves depend on the exact order."""
+    producer = {}
+    for op in model.ops:
+        for t in op.outputs:
+            producer[t.uid] = op
+    edges = []
+    for op in model.ops:
+        for t in op.inputs:
+            if t.uid in producer:
+                edges.append((producer[t.uid], op))
+    return producer, edges
+
+
 class Simulator:
     def __init__(self, model, mesh, mm: Optional[TPUMachineModel] = None,
                  overlap_backward_sync: bool = True):
@@ -106,16 +124,10 @@ class Simulator:
         self.time_scale = 1.0
         # strategy-independent graph maps, built once (the annealing loop
         # calls simulate() thousands of times)
-        self._producer = {}
-        for op in model.ops:
-            for t in op.outputs:
-                self._producer[t.uid] = op
+        self._producer, edges = op_edges(model)
         self._consumers: Dict[str, list] = {}
-        for op in model.ops:
-            for t in op.inputs:
-                if t.uid in self._producer:
-                    self._consumers.setdefault(
-                        self._producer[t.uid].name, []).append(op)
+        for src, dst in edges:
+            self._consumers.setdefault(src.name, []).append(dst)
 
     def calibrate_end_to_end(self, strategy: Strategy,
                              measured_step_seconds: float) -> float:

@@ -1,0 +1,198 @@
+"""Native C++ runtime tests: event-loop simulator parity vs the Python
+implementation, native MCMC search quality + cost parity, and the
+prefetching data loader vs a plain numpy gather.
+
+(The reference keeps all of this in C++ with no parity oracle; here the
+Python implementations serve as executable specifications.)
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def random_taskgraph(rng, n_tasks=60, n_resources=3, p_edge=0.15):
+    """Random DAG with edges only from earlier to later tasks."""
+    durations = rng.uniform(1e-5, 1e-3, n_tasks)
+    resources = rng.randint(0, n_resources, n_tasks)
+    deps = [[] for _ in range(n_tasks)]
+    for i in range(n_tasks):
+        for j in range(i):
+            if rng.rand() < p_edge:
+                deps[i].append(j)
+    return durations, resources, deps
+
+
+def python_simulate(durations, resources, deps):
+    from flexflow_tpu.search.simulator import TaskGraph
+    g = TaskGraph()
+    tasks = []
+    for i in range(len(durations)):
+        tasks.append(g.add(f"t{i}", float(durations[i]),
+                           str(int(resources[i])),
+                           [tasks[j] for j in deps[i]]))
+    return g.simulate()
+
+
+class TestNativeSimulator:
+    def test_matches_python_on_random_dags(self, rng):
+        for trial in range(10):
+            durations, resources, deps = random_taskgraph(rng)
+            indptr = np.zeros(len(durations) + 1, np.int32)
+            flat = []
+            for i, d in enumerate(deps):
+                flat.extend(d)
+                indptr[i + 1] = len(flat)
+            from flexflow_tpu.native.wrappers import simulate_taskgraph
+            got = simulate_taskgraph(durations, resources, indptr, flat)
+            want = python_simulate(durations, resources, deps)
+            assert got == pytest.approx(want, rel=1e-12), f"trial {trial}"
+
+    def test_chain_and_parallel(self):
+        from flexflow_tpu.native.wrappers import simulate_taskgraph
+        # chain of 3 on one resource: sum
+        got = simulate_taskgraph([1.0, 2.0, 3.0], [0, 0, 0],
+                                 [0, 0, 1, 2], [0, 1])
+        assert got == pytest.approx(6.0)
+        # two independent tasks on different resources: max
+        got = simulate_taskgraph([5.0, 3.0], [0, 1], [0, 0, 0], [])
+        assert got == pytest.approx(5.0)
+        # two independent tasks sharing a resource: serialize
+        got = simulate_taskgraph([5.0, 3.0], [0, 0], [0, 0, 0], [])
+        assert got == pytest.approx(8.0)
+
+
+def _search_model(mesh):
+    from flexflow_tpu import FFConfig, FFModel
+    cfg = FFConfig()
+    cfg.batch_size = 32
+    cfg.enable_parameter_parallel = True
+    cfg.enable_attribute_parallel = True
+    ff = FFModel(cfg, mesh=mesh)
+    x = ff.create_tensor((32, 64), name="input")
+    h = ff.dense(x, 256, activation="relu", name="fc1")
+    h = ff.dense(h, 256, activation="relu", name="fc2")
+    h = ff.dense(h, 10, name="fc3")
+    ff.softmax(h, name="sm")
+    return ff
+
+
+class TestNativeSearch:
+    def test_assignment_cost_matches_python_simulator(self, mesh_2d):
+        from flexflow_tpu.parallel.pconfig import OpStrategy, Strategy
+        from flexflow_tpu.search.mcmc import candidate_maps
+        from flexflow_tpu.search.native_search import lower_to_arrays
+        from flexflow_tpu.search.simulator import Simulator
+        from flexflow_tpu.native.wrappers import simulate_assignment
+
+        ff = _search_model(mesh_2d)
+        sim = Simulator(ff, mesh_2d)
+        cands = {op.name: candidate_maps(op, mesh_2d, ff.config)
+                 for op in ff.ops}
+        init = Strategy()
+        table, edges, _, init_assign, cand_lists = lower_to_arrays(
+            ff, sim, cands, init)
+
+        rng = np.random.RandomState(1)
+        for _ in range(8):
+            assign = [rng.randint(len(l)) for l in cand_lists]
+            strat = Strategy()
+            for i, op in enumerate(ff.ops):
+                strat.set(op.name, OpStrategy(dict(cand_lists[i][assign[i]])))
+            want = sim.simulate(strat)
+            got = simulate_assignment(table, edges, assign, sim.overlap,
+                                      sim.mm.spec.hbm_capacity,
+                                      sim.time_scale)
+            assert got == pytest.approx(want, rel=1e-9)
+
+    def test_native_search_beats_or_matches_dp(self, mesh_2d):
+        from flexflow_tpu.parallel.pconfig import Strategy
+        from flexflow_tpu.search.mcmc import optimize
+        from flexflow_tpu.search.simulator import Simulator
+
+        ff = _search_model(mesh_2d)
+        sim = Simulator(ff, mesh_2d)
+        dp_cost = sim.simulate(Strategy())
+        best = optimize(ff, budget=300, seed=0, simulator=sim,
+                        use_native=True)
+        assert sim.simulate(best) <= dp_cost * (1 + 1e-9)
+
+    def test_python_and_native_agree_on_quality(self, mesh_2d):
+        """Both engines explore the same space; their best costs should
+        land close (stochastic walks, so compare loosely)."""
+        from flexflow_tpu.search.mcmc import optimize
+        from flexflow_tpu.search.simulator import Simulator
+
+        ff = _search_model(mesh_2d)
+        sim = Simulator(ff, mesh_2d)
+        b_native = optimize(ff, budget=400, seed=0, simulator=sim,
+                            use_native=True)
+        b_python = optimize(ff, budget=400, seed=0, simulator=sim,
+                            use_native=False)
+        c_native = sim.simulate(b_native)
+        c_python = sim.simulate(b_python)
+        assert c_native <= c_python * 1.5
+        assert c_python <= c_native * 1.5
+
+
+class TestNativeDataLoader:
+    def test_gather_matches_numpy(self, rng):
+        from flexflow_tpu.native.wrappers import NativePrefetchLoader
+        x = rng.randn(37, 5, 3).astype(np.float32)
+        y = rng.randint(0, 10, 37).astype(np.int32)
+        loader = NativePrefetchLoader({"x": x, "y": y}, batch_size=8)
+        order = rng.permutation(37).astype(np.int64)
+        loader.start_epoch(order)
+        assert loader.num_batches == 4  # drop_last
+        for b in range(4):
+            batch = loader.next_batch()
+            sel = order[b * 8:(b + 1) * 8]
+            np.testing.assert_array_equal(batch["x"], x[sel])
+            np.testing.assert_array_equal(batch["y"], y[sel])
+        assert loader.next_batch() is None
+        loader.close()
+
+    def test_multiple_epochs_and_restart(self, rng):
+        from flexflow_tpu.native.wrappers import NativePrefetchLoader
+        x = np.arange(20, dtype=np.float64).reshape(20, 1)
+        loader = NativePrefetchLoader({"x": x}, batch_size=4)
+        for _ in range(3):
+            order = rng.permutation(20).astype(np.int64)
+            loader.start_epoch(order)
+            seen = []
+            while True:
+                b = loader.next_batch()
+                if b is None:
+                    break
+                seen.extend(b["x"][:, 0].astype(np.int64).tolist())
+            assert seen == order.tolist()
+        # restart mid-epoch must not deadlock or deliver stale rows
+        order = np.arange(20, dtype=np.int64)
+        loader.start_epoch(order)
+        loader.next_batch()
+        loader.start_epoch(order[::-1].copy())
+        b = loader.next_batch()
+        np.testing.assert_array_equal(b["x"][:, 0], order[::-1][:4])
+        loader.close()
+
+    def test_dataloaderset_native_path(self, rng, mesh8):
+        from flexflow_tpu.core.dataloader import DataLoaderSet
+        x = rng.randn(64, 4).astype(np.float32)
+        y = rng.randint(0, 10, 64).astype(np.int32)
+        ds = DataLoaderSet({"input": x, "label": y}, batch_size=16,
+                           mesh=mesh8, shuffle=True, seed=3)
+        assert ds._native is not None
+        batches = list(ds)
+        assert len(batches) == 4
+        got = np.sort(np.concatenate(
+            [np.asarray(b["label"]) for b in batches]))
+        np.testing.assert_array_equal(got, np.sort(y))
+        # epoch 2 reshuffles but preserves the set
+        batches2 = list(ds)
+        got2 = np.sort(np.concatenate(
+            [np.asarray(b["label"]) for b in batches2]))
+        np.testing.assert_array_equal(got2, np.sort(y))
