@@ -1,0 +1,43 @@
+"""Keras-frontend MNIST-style MLP (reference:
+examples/python/keras/mnist_mlp.py).  Uses synthetic data shaped like
+MNIST; pass --accuracy to assert the model learns (reference -a flag /
+accuracy_tests.sh pattern).
+
+  python examples/python/keras/mnist_mlp.py -e 3
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 2
+
+    model = keras.Sequential([
+        keras.layers.Dense(512, activation="relu", input_shape=(784,)),
+        keras.layers.Dense(512, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer=keras.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    # synthetic, but learnable: labels depend on the inputs
+    rng = np.random.RandomState(0)
+    x = rng.randn(1024, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+
+    history = model.fit(x, y, batch_size=64, epochs=epochs)
+    acc = history[-1]["accuracy"]
+    print(f"final accuracy: {acc:.3f}")
+    if "--accuracy" in sys.argv:
+        assert acc > 0.3, f"model failed to learn (accuracy {acc:.3f})"
+
+
+if __name__ == "__main__":
+    top_level_task()

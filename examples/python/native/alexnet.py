@@ -1,0 +1,33 @@
+"""AlexNet training (reference: examples/cpp/AlexNet/alexnet.cc:34-137,
+bootcamp_demo/ff_alexnet_cifar10.py).
+
+  python -m flexflow_tpu examples/python/native/alexnet.py -b 64 -e 2
+  python examples/python/native/alexnet.py --samples 512   # synthetic
+"""
+
+import sys
+
+from flexflow_tpu import FFConfig, SGDOptimizer
+from flexflow_tpu.models import build_alexnet
+
+from common import synthetic_dataset
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    n_samples = 256
+    if "--samples" in sys.argv:
+        n_samples = int(sys.argv[sys.argv.index("--samples") + 1])
+
+    ff = build_alexnet(cfg, image_size=32)
+    ff.compile(optimizer=SGDOptimizer(lr=cfg.learning_rate),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    print(ff.summary())
+
+    x, y = synthetic_dataset(ff, n_samples, num_classes=10, seed=cfg.seed)
+    ff.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,24 @@
+"""CANDLE-Uno drug-response regression (reference: examples/cpp/candle_uno
+— per-feature dense towers concatenated into a final MLP).
+
+  python examples/python/native/candle_uno.py -b 32 -e 1
+"""
+
+from flexflow_tpu import AdamOptimizer, FFConfig
+from flexflow_tpu.models import build_candle_uno
+
+from common import synthetic_dataset
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    ff = build_candle_uno(cfg)
+    ff.compile(optimizer=AdamOptimizer(lr=cfg.learning_rate),
+               loss_type="mean_squared_error", metrics=[])
+    x, y = synthetic_dataset(ff, 4 * cfg.batch_size, regression=True,
+                             seed=cfg.seed)
+    ff.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

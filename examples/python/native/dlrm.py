@@ -1,0 +1,27 @@
+"""DLRM CTR training (reference: examples/cpp/DLRM/dlrm.cc:26-124 —
+bottom MLP, per-feature embedding bags, pairwise interaction, top MLP).
+The reference's per-GPU embedding placement (strategies/dlrm_strategy.cc)
+maps to sharding each table's vocab over the mesh `model` axis.
+
+  python examples/python/native/dlrm.py -b 64 -e 1
+"""
+
+from flexflow_tpu import AdamOptimizer, FFConfig
+from flexflow_tpu.models import build_dlrm
+
+from common import synthetic_dataset
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    ff = build_dlrm(cfg, embedding_vocab_sizes=(1000,) * 8,
+                    embedding_dim=64)
+    ff.compile(optimizer=AdamOptimizer(lr=cfg.learning_rate),
+               loss_type="mean_squared_error", metrics=[])
+    x, y = synthetic_dataset(ff, 4 * cfg.batch_size, regression=True,
+                             int_high=1000, seed=cfg.seed)
+    ff.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,23 @@
+"""Inception-v3 training (reference: examples/cpp/InceptionV3).
+
+  python examples/python/native/inception_v3.py -b 8 -e 1
+"""
+
+from flexflow_tpu import FFConfig, SGDOptimizer
+from flexflow_tpu.models import build_inception_v3
+
+from common import synthetic_dataset
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    ff = build_inception_v3(cfg, image_size=32)
+    ff.compile(optimizer=SGDOptimizer(lr=cfg.learning_rate),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    x, y = synthetic_dataset(ff, 2 * cfg.batch_size, seed=cfg.seed)
+    ff.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,33 @@
+"""Mixture-of-experts training (reference: examples/cpp/mixture_of_experts/
+moe.cc — gating softmax + top-k + group_by + experts + aggregate).
+
+Two variants: --reference uses the explicit group_by/aggregate pipeline
+(op-parity with the reference); the default uses the fused MoE FFN op
+(TPU-first: capacity-bucketed einsum dispatch, EP over the mesh).
+
+  python examples/python/native/moe.py -b 64 -e 1
+  python examples/python/native/moe.py --reference
+"""
+
+import sys
+
+from flexflow_tpu import AdamOptimizer, FFConfig
+from flexflow_tpu.models import build_moe_fused, build_moe_reference
+
+from common import synthetic_dataset
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    build = build_moe_reference if "--reference" in sys.argv \
+        else build_moe_fused
+    ff = build(cfg, input_dim=64, num_experts=4, k=2)
+    ff.compile(optimizer=AdamOptimizer(lr=cfg.learning_rate),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    x, y = synthetic_dataset(ff, 4 * cfg.batch_size, seed=cfg.seed)
+    ff.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,28 @@
+"""Stacked-LSTM NMT-style language model (reference: nmt/ — rebuilt as an
+ordinary model of the main framework per SURVEY.md section 7 step 8, not
+as a separate RNN framework).
+
+  python examples/python/native/nmt_lstm.py -b 32 -e 1
+"""
+
+from flexflow_tpu import AdamOptimizer, FFConfig
+from flexflow_tpu.models import build_nmt_lstm
+
+from common import synthetic_dataset
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    vocab = 2000
+    ff = build_nmt_lstm(cfg, seq_len=20, vocab_size=vocab, embed_dim=128,
+                        hidden=128, num_layers=2)
+    ff.compile(optimizer=AdamOptimizer(lr=cfg.learning_rate),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    x, y = synthetic_dataset(ff, 4 * cfg.batch_size, num_classes=vocab,
+                             int_high=vocab, seed=cfg.seed)
+    ff.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,28 @@
+"""ResNet training (reference: examples/cpp/ResNet).
+
+  python examples/python/native/resnet.py -b 32 -e 1 --depth 18
+"""
+
+import sys
+
+from flexflow_tpu import FFConfig, SGDOptimizer
+from flexflow_tpu.models import build_resnet
+
+from common import synthetic_dataset
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    depth = int(sys.argv[sys.argv.index("--depth") + 1]) \
+        if "--depth" in sys.argv else 18
+
+    ff = build_resnet(cfg, depth=depth, image_size=32)
+    ff.compile(optimizer=SGDOptimizer(lr=cfg.learning_rate),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    x, y = synthetic_dataset(ff, 4 * cfg.batch_size, seed=cfg.seed)
+    ff.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,28 @@
+"""Transformer encoder training (reference: examples/cpp/Transformer —
+512 hidden / 8 heads encoder blocks over synthetic data,
+transformer.cc:28-56).
+
+  python examples/python/native/transformer.py -b 32 -e 1
+  python examples/python/native/transformer.py --search-budget 1000 \
+      --enable-parameter-parallel      # strategy search before training
+"""
+
+from flexflow_tpu import FFConfig, SGDOptimizer
+from flexflow_tpu.models import build_transformer
+
+from common import synthetic_dataset
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    ff = build_transformer(cfg, seq_len=64, hidden=512, num_heads=8,
+                           num_layers=2, ff_dim=2048, num_classes=10)
+    ff.compile(optimizer=SGDOptimizer(lr=cfg.learning_rate),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    x, y = synthetic_dataset(ff, 4 * cfg.batch_size, seed=cfg.seed)
+    ff.fit(x, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

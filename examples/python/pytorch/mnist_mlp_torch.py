@@ -1,0 +1,58 @@
+"""PyTorch-frontend example (reference: examples/python/pytorch/mnist_mlp.py
+— torch.fx-trace a torch module, export the .ff graph file, replay it
+onto an FFModel and train).
+
+  python examples/python/pytorch/mnist_mlp_torch.py -e 1
+"""
+
+import sys
+import tempfile
+
+import numpy as np
+import torch.nn as nn
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.frontends.torchfx import PyTorchModel, export_ff
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 512)
+        self.relu1 = nn.ReLU()
+        self.fc2 = nn.Linear(512, 10)
+        self.sm = nn.Softmax(dim=-1)
+
+    def forward(self, x):
+        return self.sm(self.fc2(self.relu1(self.fc1(x))))
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    batch_size = 64
+
+    # trace -> .ff file -> replay (the reference round-trip,
+    # torch/fx.py + torch/model.py)
+    path = tempfile.mktemp(suffix=".ff")
+    export_ff(MLP(), path)
+    ptm = PyTorchModel(path)
+
+    cfg = FFConfig.from_args()
+    cfg.batch_size = batch_size
+    ff = FFModel(cfg)
+    inp = ff.create_tensor((batch_size, 784), name="input")
+    ptm.apply(ff, [inp])
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(1024, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    ff.fit({"input": x}, y, epochs=epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

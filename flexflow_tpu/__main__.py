@@ -1,0 +1,73 @@
+"""Launcher — `python -m flexflow_tpu [options] script.py [args]`.
+
+The TPU-native analog of the reference's `flexflow_python` interpreter
+binary + `flexflow.py` launcher (python/main.cc:91-107 registers the
+Python top-level task; flexflow/core/flexflow_top.py:164-220 runs the
+user script in script / -c / REPL modes; python/flexflow.py translates
+--nodes/--gpus into Legion -ll:* flags).  Here there is no embedded
+interpreter to bootstrap — JAX is single-controller — so the launcher's
+job is platform setup + script execution:
+
+  python -m flexflow_tpu train.py -b 64 --search-budget 1000
+  python -m flexflow_tpu -c "import flexflow_tpu; print(flexflow_tpu.__name__)"
+  python -m flexflow_tpu --cpu-devices 8 train.py   # virtual CPU mesh
+
+Launcher-only flags (consumed before the script sees argv):
+  --cpu-devices N   force the CPU platform with N virtual devices — the
+                    test rig for multi-chip sharding without TPUs
+  -c CODE           run a code string instead of a script
+Everything else is left on sys.argv for FFConfig.from_args().
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    cpu_devices = None
+    code = None
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--cpu-devices" and i + 1 < len(argv):
+            cpu_devices = int(argv[i + 1])
+            del argv[i:i + 2]
+        elif argv[i] == "-c" and i + 1 < len(argv):
+            code = argv[i + 1]
+            del argv[i:i + 2]
+        else:
+            break
+
+    if cpu_devices is not None:
+        kept = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                if not f.startswith("--xla_force_host_platform_device_count")]
+        os.environ["XLA_FLAGS"] = " ".join(
+            kept + [f"--xla_force_host_platform_device_count={cpu_devices}"])
+        import jax
+        # env var alone can be overridden by image sitecustomize; force it
+        jax.config.update("jax_platforms", "cpu")
+
+    if code is not None:
+        sys.argv = ["-c"] + argv
+        exec(compile(code, "<string>", "exec"), {"__name__": "__main__"})
+        return 0
+
+    if not argv:
+        # REPL mode (reference flexflow_top.py run_repl)
+        import code as code_mod
+        code_mod.interact(banner="flexflow_tpu interactive shell")
+        return 0
+
+    script, script_args = argv[0], argv[1:]
+    sys.argv = [script] + script_args
+    sys.path.insert(0, os.path.dirname(os.path.abspath(script)))
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -133,9 +133,10 @@ class DataLoaderSet:
                 batch = self._native.next_batch()
                 if batch is None:
                     return
-                # jnp.asarray copies out of the double buffer before the
-                # next gather can reuse it
-                yield {k: host_to_device(v, self.mesh)
+                # explicit copy: jax may alias aligned host memory, and
+                # the worker reuses the double buffer after the next
+                # next_batch call
+                yield {k: host_to_device(np.array(v, copy=True), self.mesh)
                        for k, v in batch.items()}
         else:
             self.reset()

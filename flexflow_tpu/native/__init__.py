@@ -127,8 +127,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
             _declare(lib)
             _lib = lib
         except (OSError, subprocess.CalledProcessError) as e:
+            detail = ""
+            stderr = getattr(e, "stderr", None)
+            if stderr:
+                if isinstance(stderr, bytes):
+                    stderr = stderr.decode(errors="replace")
+                detail = f"\n{stderr.strip()}"
             print(f"[flexflow_tpu.native] falling back to Python "
-                  f"implementations ({e})", file=sys.stderr)
+                  f"implementations ({e}){detail}", file=sys.stderr)
             _load_failed = True
     return _lib
 

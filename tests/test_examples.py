@@ -1,0 +1,58 @@
+"""Example-zoo integration tests: run real example scripts through the
+launcher on a small virtual CPU mesh — the reference's
+tests/multi_gpu_tests.sh pattern (run ~30 example scripts through
+flexflow_python; pass = clean exit), minus the need for real devices.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_example(script, *args, cpu_devices=2, timeout=240):
+    cmd = [sys.executable, "-m", "flexflow_tpu",
+           "--cpu-devices", str(cpu_devices),
+           os.path.join(REPO, script), *args]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
+                       timeout=timeout, env=env)
+    assert r.returncode == 0, f"{script} failed:\n{r.stdout}\n{r.stderr}"
+    return r.stdout
+
+
+@pytest.mark.parametrize("script,args", [
+    ("examples/python/native/alexnet.py",
+     ["-b", "8", "--samples", "16", "-e", "1"]),
+    ("examples/python/native/transformer.py", ["-b", "8", "-e", "1"]),
+    ("examples/python/native/dlrm.py", ["-b", "16", "-e", "1"]),
+    ("examples/python/native/moe.py", ["-b", "16", "-e", "1"]),
+])
+def test_native_examples_run(script, args):
+    out = run_example(script, *args)
+    assert "loss" in out
+
+
+def test_keras_mnist_mlp_learns():
+    out = run_example("examples/python/keras/mnist_mlp.py",
+                      "-e", "3", "--accuracy")
+    assert "final accuracy" in out
+
+
+def test_pytorch_frontend_example():
+    run_example("examples/python/pytorch/mnist_mlp_torch.py", "-e", "1")
+
+
+def test_launcher_code_mode():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "flexflow_tpu", "--cpu-devices", "4",
+         "-c", "import jax; print('ndev', jax.device_count())"],
+        capture_output=True, text=True, cwd=REPO, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "ndev 4" in r.stdout
