@@ -3,21 +3,16 @@ declared inputs (reference: syntheticInput when no --dataset is given,
 examples/cpp/AlexNet/alexnet.cc:100-104)."""
 
 import numpy as np
-import jax.numpy as jnp
+
+from flexflow_tpu.core.dataloader import synthetic_inputs
 
 
 def synthetic_dataset(ff, n_samples: int, num_classes: int = 10,
                       seed: int = 0, regression: bool = False,
                       int_high: int = 10):
     """(x dict, y) with n_samples rows matching ff's input tensors."""
-    rng = np.random.RandomState(seed)
-    x = {}
-    for t in ff.input_tensors:
-        shape = (n_samples,) + tuple(t.shape[1:])
-        if jnp.issubdtype(t.dtype, jnp.integer):
-            x[t.name] = rng.randint(0, int_high, shape).astype(np.int32)
-        else:
-            x[t.name] = rng.randn(*shape).astype(np.float32)
+    x = synthetic_inputs(ff, n_samples, seed=seed, int_high=int_high)
+    rng = np.random.RandomState(seed + 1)
     if regression:
         y = rng.randn(n_samples, 1).astype(np.float32)
     else:

@@ -5,6 +5,7 @@ onto an FFModel and train).
   python examples/python/pytorch/mnist_mlp_torch.py -e 1
 """
 
+import os
 import sys
 import tempfile
 
@@ -34,9 +35,10 @@ def top_level_task():
 
     # trace -> .ff file -> replay (the reference round-trip,
     # torch/fx.py + torch/model.py)
-    path = tempfile.mktemp(suffix=".ff")
-    export_ff(MLP(), path)
-    ptm = PyTorchModel(path)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "mnist_mlp.ff")
+        export_ff(MLP(), path)
+        ptm = PyTorchModel(path)
 
     cfg = FFConfig.from_args()
     cfg.batch_size = batch_size

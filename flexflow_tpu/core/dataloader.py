@@ -144,18 +144,28 @@ class DataLoaderSet:
                 yield {k: l.next_batch() for k, l in self.loaders.items()}
 
 
+def synthetic_inputs(model, n_samples: int, seed: int = 0,
+                     int_high: int = 10) -> Dict[str, np.ndarray]:
+    """Synthetic input arrays (n_samples rows) matching the model's
+    declared input tensors (reference: syntheticInput when no --dataset,
+    alexnet.cc:100-104). Integer tensors get uniform ints in
+    [0, int_high); float tensors get standard normals in their dtype."""
+    rng = np.random.RandomState(seed)
+    x = {}
+    for t in model.input_tensors:
+        shape = (n_samples,) + tuple(t.shape[1:])
+        if jnp.issubdtype(t.dtype, jnp.integer):
+            x[t.name] = rng.randint(0, int_high, shape).astype(np.int32)
+        else:
+            x[t.name] = rng.randn(*shape).astype(np.dtype(t.dtype).name)
+    return x
+
+
 def synthetic_batch(model, label_classes: int = 10, seed: int = 0
                     ) -> Dict[str, np.ndarray]:
-    """Synthetic inputs matching the model's declared input tensors
-    (reference: syntheticInput when no --dataset, alexnet.cc:100-104)."""
-    rng = np.random.RandomState(seed)
-    batch = {}
-    for t in model.input_tensors:
-        if jnp.issubdtype(t.dtype, jnp.integer):
-            batch[t.name] = rng.randint(0, 10, t.shape).astype(np.int32)
-        else:
-            batch[t.name] = rng.randn(*t.shape).astype(
-                np.dtype(t.dtype).name)
+    """One synthetic batch (batch-size rows) incl. integer labels."""
     bs = model.input_tensors[0].shape[0]
+    batch = synthetic_inputs(model, bs, seed)
+    rng = np.random.RandomState(seed + 1)
     batch["label"] = rng.randint(0, label_classes, bs).astype(np.int32)
     return batch

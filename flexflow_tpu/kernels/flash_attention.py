@@ -5,14 +5,24 @@ Replaces the reference's single cuDNN fused-MHA call
 blocked kernel that never materializes the (Lq, Lk) score matrix in HBM.
 
 Forward is a Pallas kernel (grid over (batch*heads, q-blocks), inner
-fori_loop over k-blocks with online max/sum rescaling). Backward is a
-custom VJP that recomputes probabilities from the saved logsumexp — exact
-gradients with no saved probability tensor.
+fori_loop over k-blocks with online max/sum rescaling). Backward is two
+Pallas kernels (dq over q-blocks; dk/dv over k-blocks) that recompute
+probabilities from the saved logsumexp — exact gradients with no saved or
+materialized probability tensor.
+
+All MXU dots run in the input dtype (bf16 on TPU) with float32
+accumulation (`preferred_element_type`); softmax statistics stay float32.
+Casting to f32 *before* the dot would push the matmuls off the MXU's
+native bf16 path and cost ~4x.
 
 Layout contract: (batch, seq, heads, head_dim) in/out, matching
 ops/attention.py. head_dim is zero-padded to a multiple of 128 lanes
 (padding is exact: zero d-columns contribute nothing to q.k^T, and padded
 v columns are sliced off the output).
+
+Set `interpret=True` to run the same kernels through the Pallas
+interpreter on CPU — used by tests/test_flash_attention.py on the forced
+CPU platform.
 """
 
 from __future__ import annotations
@@ -34,10 +44,23 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
+def _dot_t(a, b, prec=jnp.float32):
+    """a (m, d) . b^T (d, n) -> (m, n), contracting the last dims."""
+    return jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                               preferred_element_type=prec)
+
+
+def _dot_tt(a, b, prec=jnp.float32):
+    """a^T (k, m) . b (k, n) -> (m, n), contracting the first dims."""
+    return jax.lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=prec)
+
+
+# ---------------------------------------------------------------- forward
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                       block_q, block_k, seq_k, scale, causal):
     qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32)  # (block_q, d)
+    q = q_ref[:]  # (block_q, d), native dtype — bf16 dots ride the MXU
     d = q.shape[-1]
     m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
@@ -51,10 +74,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
+        s = _dot_t(q, k) * scale  # f32 accumulate
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -66,7 +88,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1)
         acc_new = acc * alpha[:, None] + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
@@ -74,7 +96,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     lse_ref[:] = (m + jnp.log(l))[:, None]
 
 
-def _fwd_pallas(q, k, v, *, causal, scale, block_q, block_k):
+def _fwd_pallas(q, k, v, *, causal, scale, block_q, block_k, interpret):
     """q,k,v: (bh, s, d_padded) -> o (bh, sq, d_padded), lse (bh, sq, 1)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -98,56 +120,176 @@ def _fwd_pallas(q, k, v, *, causal, scale, block_q, block_k):
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
+        interpret=interpret,
     )(q, k, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, block_k):
+# --------------------------------------------------------------- backward
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_q, block_k, seq_k, scale, causal):
+    qi = pl.program_id(1)
+    q = q_ref[:]          # (block_q, d)
+    do = do_ref[:]        # (block_q, d)
+    lse = lse_ref[:]      # (block_q, 1) f32
+    delta = delta_ref[:]  # (block_q, 1) f32
+    d = q.shape[-1]
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_kb = seq_k // block_k
+    if causal:
+        num_kb = jnp.minimum(num_kb,
+                             ((qi + 1) * block_q + block_k - 1) // block_k)
+
+    def body(j, acc):
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
+        s = _dot_t(q, k) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        p = jnp.exp(s - lse)         # masked -inf rows exp to exactly 0
+        dp = _dot_t(do, v)           # (block_q, block_k) f32
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        return acc + jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    acc = jax.lax.fori_loop(0, num_kb, body, acc0)
+    dq_ref[:] = acc.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q, block_k, seq_q, scale,
+                          causal):
+    kj = pl.program_id(1)
+    k = k_ref[:]  # (block_k, d)
+    v = v_ref[:]
+    d = k.shape[-1]
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+
+    num_qb = seq_q // block_q
+    start_qb = 0
+    if causal:
+        # q blocks strictly left of this k block see none of it
+        start_qb = (kj * block_k) // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :]
+        do = do_ref[pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[pl.ds(i * block_q, block_q), :]
+        delta = delta_ref[pl.ds(i * block_q, block_q), :]
+        s = _dot_t(q, k) * scale
+        if causal:
+            qpos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        p = jnp.exp(s - lse)
+        dv = dv + _dot_tt(p.astype(do.dtype), do)
+        dp = _dot_t(do, v)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk = dk + _dot_tt(ds, q)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, (dk0, dv0))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, o, lse, do, *, causal, scale, block_q, block_k,
+                interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    # delta_i = rowsum(do * o): cheap elementwise, fused by XLA
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # (bh, sq, 1)
+
+    blk_q = lambda b, i: (b, i, 0)  # noqa: E731
+    full = lambda b, i: (b, 0, 0)  # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, seq_k=sk, scale=scale,
+                          causal=causal),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), blk_q),
+            pl.BlockSpec((None, sk, d), full),
+            pl.BlockSpec((None, sk, d), full),
+            pl.BlockSpec((None, block_q, d), blk_q),
+            pl.BlockSpec((None, block_q, 1), blk_q),
+            pl.BlockSpec((None, block_q, 1), blk_q),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), blk_q),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    blk_k = lambda b, j: (b, j, 0)  # noqa: E731
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          block_k=block_k, seq_q=sq, scale=scale,
+                          causal=causal),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, sq, d), full),
+            pl.BlockSpec((None, block_k, d), blk_k),
+            pl.BlockSpec((None, block_k, d), blk_k),
+            pl.BlockSpec((None, sq, d), full),
+            pl.BlockSpec((None, sq, 1), full),
+            pl.BlockSpec((None, sq, 1), full),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), blk_k),
+            pl.BlockSpec((None, block_k, d), blk_k),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------- custom VJP
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
     o, _ = _fwd_pallas(q, k, v, causal=causal, scale=scale,
-                       block_q=block_q, block_k=block_k)
+                       block_q=block_q, block_k=block_k, interpret=interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     o, lse = _fwd_pallas(q, k, v, causal=causal, scale=scale,
-                         block_q=block_q, block_k=block_k)
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, res, do):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
-    if causal:
-        # top-left alignment (j <= i), matching the forward kernel's
-        # qpos >= kpos mask exactly — required for correct gradients
-        # when seq_q != seq_k.
-        sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool))
-        s = jnp.where(mask, s, -jnp.inf)
-    p = jnp.exp(s - lse)  # (bh, sq, sk); lse broadcasts over last dim
-    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
-    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
-    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1, keepdims=True)
-    ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dq, dk, dv = _bwd_pallas(q, k, v, o, lse, do, causal=causal, scale=scale,
+                             block_q=block_q, block_k=block_k,
+                             interpret=interpret)
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention_bshd(q, k, v, *, causal=False,
-                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                         interpret=False):
     """softmax(QK^T/sqrt(d))V for (b, s, h, d) tensors via Pallas.
 
     Raises on unsupported shapes/platform; callers fall back to XLA.
     """
-    if not _HAS_PLTPU or jax.default_backend() != "tpu":
+    if not interpret and (not _HAS_PLTPU or jax.default_backend() != "tpu"):
         raise NotImplementedError("pallas flash attention requires TPU")
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -167,6 +309,6 @@ def flash_attention_bshd(q, k, v, *, causal=False,
         return x
 
     o = _flash(to_bhd(q, sq), to_bhd(k, sk), to_bhd(v, sk),
-               causal, scale, block_q, block_k)
+               causal, scale, block_q, block_k, interpret)
     o = o[..., :d].reshape(b, h, sq, d)
     return jnp.swapaxes(o, 1, 2)

@@ -1,0 +1,84 @@
+"""Flash-attention Pallas kernels vs XLA reference (interpret mode on CPU).
+
+Reference analog: tests/ops golden tests (SURVEY.md section 4.3) — same
+computation in plain numpy/XLA, assert_allclose on outputs AND gradients.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from flexflow_tpu.kernels.flash_attention import flash_attention_bshd
+
+
+def xla_attention(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / np.sqrt(d)
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((lq, lk), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(128, 128), (128, 256), (256, 128)])
+def test_flash_forward_matches_xla(rng, causal, sq, sk):
+    b, h, d = 2, 2, 64
+    q = jnp.asarray(rng.randn(b, sq, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, sk, h, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, sk, h, d).astype(np.float32))
+    out = flash_attention_bshd(q, k, v, causal=causal, interpret=True)
+    ref = xla_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_xla(rng, causal):
+    b, s, h, d = 2, 128, 2, 64
+    q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+
+    def loss_flash(q, k, v):
+        o = flash_attention_bshd(q, k, v, causal=causal, interpret=True)
+        return jnp.sum(jnp.sin(o.astype(jnp.float32)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(xla_attention(q, k, v, causal)
+                               .astype(jnp.float32)))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-3, atol=2e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_head_dim_padding(rng):
+    # d=32 pads to 128 lanes; padding must be exact
+    b, s, h, d = 1, 128, 2, 32
+    q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    out = flash_attention_bshd(q, k, v, interpret=True)
+    ref = xla_attention(q, k, v, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_bf16(rng):
+    b, s, h, d = 2, 128, 2, 64
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.bfloat16)
+    out = flash_attention_bshd(q, k, v, causal=True, interpret=True)
+    ref = xla_attention(q, k, v, True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=5e-2, atol=5e-2)
