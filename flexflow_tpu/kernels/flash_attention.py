@@ -44,16 +44,23 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
-def _dot_t(a, b, prec=jnp.float32):
+def _dot_t(a, b):
     """a (m, d) . b^T (d, n) -> (m, n), contracting the last dims."""
     return jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
-                               preferred_element_type=prec)
+                               preferred_element_type=jnp.float32)
 
 
-def _dot_tt(a, b, prec=jnp.float32):
+def _dot_tt(a, b):
     """a^T (k, m) . b (k, n) -> (m, n), contracting the first dims."""
     return jax.lax.dot_general(a, b, (((0,), (0,)), ((), ())),
-                               preferred_element_type=prec)
+                               preferred_element_type=jnp.float32)
+
+
+def _causal_mask(s, q0, k0, block_q, block_k):
+    """Mask scores s (block_q, block_k) where q0+i < k0+j (top-left aligned)."""
+    qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(qpos >= kpos, s, -jnp.inf)
 
 
 # ---------------------------------------------------------------- forward
@@ -78,11 +85,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         v = v_ref[pl.ds(j * block_k, block_k), :]
         s = _dot_t(q, k) * scale  # f32 accumulate
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+            s = _causal_mask(s, qi * block_q, j * block_k, block_q, block_k)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -145,11 +148,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[pl.ds(j * block_k, block_k), :]
         s = _dot_t(q, k) * scale
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+            s = _causal_mask(s, qi * block_q, j * block_k, block_q, block_k)
         p = jnp.exp(s - lse)         # masked -inf rows exp to exactly 0
         dp = _dot_t(do, v)           # (block_q, block_k) f32
         ds = (p * (dp - delta) * scale).astype(k.dtype)
@@ -183,11 +182,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[pl.ds(i * block_q, block_q), :]
         s = _dot_t(q, k) * scale
         if causal:
-            qpos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+            s = _causal_mask(s, i * block_q, kj * block_k, block_q, block_k)
         p = jnp.exp(s - lse)
         dv = dv + _dot_tt(p.astype(do.dtype), do)
         dp = _dot_t(do, v)

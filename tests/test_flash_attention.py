@@ -38,11 +38,13 @@ def test_flash_forward_matches_xla(rng, causal, sq, sk):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_grads_match_xla(rng, causal):
-    b, s, h, d = 2, 128, 2, 64
-    q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
-    k = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
-    v = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+@pytest.mark.parametrize("sq,sk,d", [(128, 128, 64), (128, 256, 64),
+                                     (256, 128, 64), (128, 128, 32)])
+def test_flash_grads_match_xla(rng, causal, sq, sk, d):
+    b, h = 2, 2
+    q = jnp.asarray(rng.randn(b, sq, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, sk, h, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, sk, h, d).astype(np.float32))
 
     def loss_flash(q, k, v):
         o = flash_attention_bshd(q, k, v, causal=causal, interpret=True)
