@@ -74,6 +74,13 @@ class Executor:
         self._train_step = None
         self._eval_step = None
         self._last_aux_losses = []
+        # fusion (reference apply_fusion, model.cc:1472): constrain
+        # sharding only at fused-group boundaries.
+        self._sharding_boundary = None
+        if self.config.perform_fusion:
+            from .fusion import boundary_ops, compute_fusion_groups
+            self._sharding_boundary = boundary_ops(
+                compute_fusion_groups(model, self.strategy))
 
     # ---------------- initialization ----------------
     def init_state(self, rng) -> TrainState:
@@ -154,7 +161,9 @@ class Executor:
                 )(op_params, xs)
             else:
                 ys = op.forward(op_params, xs, ctx)
-            if self.mesh is not None:
+            if self.mesh is not None and (
+                    self._sharding_boundary is None
+                    or op.name in self._sharding_boundary):
                 shardings = op_output_sharding(
                     op, self.strategy.for_op(op.name), self.mesh)
                 ys = [jax.lax.with_sharding_constraint(y, s)

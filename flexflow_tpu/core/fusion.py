@@ -1,0 +1,73 @@
+"""Graph fusion pass — the TPU-native reading of the reference FusedOp.
+
+The reference's `apply_fusion` (src/runtime/model.cc:1472-1549) packs
+consecutive ops with identical ParallelConfigs into one `FusedOp`
+(src/ops/fused.cu) so the group launches as a single Legion task. On TPU,
+XLA already fuses elementwise work into matmuls, so the pass's payoff
+moves to the two places op granularity still matters:
+
+  1. The executor pins a `with_sharding_constraint` on every op output;
+     for ops interior to a same-strategy chain that pin is redundant and
+     can block GSPMD from picking cheaper intermediate layouts. Fusion
+     marks interior ops so only group boundaries are constrained.
+  2. The search simulator models one task per op; a fused group costs
+     one compute task (sum of member times, boundary comm only) exactly
+     like the reference simulates a FusedOp as one task.
+
+A group is a chain: op B joins producer A's group iff A and B resolve to
+the same op-strategy axis map, A has exactly one in-graph consumer, and B
+has exactly one in-graph producer (the chain restriction mirrors the
+reference's "same ParallelConfig + contiguous" rule, fused.cu:61).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..parallel.pconfig import Strategy
+
+
+def _strategy_key(strategy: Strategy, op_name: str) -> Tuple:
+    s = strategy.for_op(op_name)
+    return tuple(sorted((k, str(v)) for k, v in s.axis_map.items()))
+
+
+def compute_fusion_groups(model, strategy: Optional[Strategy]
+                          ) -> List[List[str]]:
+    """Partition model.ops (topological order) into same-strategy chains.
+
+    Returns a list of groups, each a list of op names in execution order;
+    singleton groups are included so the result is a partition.
+    """
+    from ..search.simulator import op_edges  # canonical edge derivation
+
+    strategy = strategy or Strategy()
+    producer, edges = op_edges(model)
+    n_consumers: Dict[str, int] = {}
+    for src, _dst in edges:
+        n_consumers[src.name] = n_consumers.get(src.name, 0) + 1
+
+    group_of: Dict[str, int] = {}
+    groups: List[List[str]] = []
+    for op in model.ops:
+        in_producers = {producer[t.uid].name
+                        for t in op.inputs if t.uid in producer}
+        join = None
+        if len(in_producers) == 1:
+            (pname,) = in_producers
+            if (n_consumers.get(pname, 0) == 1
+                    and _strategy_key(strategy, pname)
+                    == _strategy_key(strategy, op.name)):
+                join = group_of[pname]
+        if join is None:
+            group_of[op.name] = len(groups)
+            groups.append([op.name])
+        else:
+            group_of[op.name] = join
+            groups[join].append(op.name)
+    return groups
+
+
+def boundary_ops(groups: List[List[str]]) -> set:
+    """Names of ops that end a fused group (where sharding is pinned)."""
+    return {g[-1] for g in groups}

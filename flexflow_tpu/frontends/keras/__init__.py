@@ -17,10 +17,10 @@ builder calls, optimizer/loss/metric name shims, callbacks. Same usage:
     model.fit(x, y, epochs=5)
 """
 
-from . import layers
+from . import datasets, layers
 from .callbacks import Callback, EarlyStopping, VerifyMetrics
 from .models import Model, Sequential
 from .optimizers import SGD, Adam
 
-__all__ = ["layers", "Model", "Sequential", "SGD", "Adam", "Callback",
-           "EarlyStopping", "VerifyMetrics"]
+__all__ = ["datasets", "layers", "Model", "Sequential", "SGD", "Adam",
+           "Callback", "EarlyStopping", "VerifyMetrics"]

@@ -146,11 +146,12 @@ class FFModel:
                             add_zero_attn: bool = False,
                             causal: bool = False,
                             name: Optional[str] = None,
-                            kernel_initializer="glorot") -> Tensor:
+                            kernel_initializer="glorot",
+                            use_flash: bool = True) -> Tensor:
         op = MultiHeadAttention(
             self, name or self._fresh_name("attention"), [query, key, value],
             embed_dim, num_heads, kdim, vdim, dropout, bias, add_bias_kv,
-            add_zero_attn, causal, kernel_initializer)
+            add_zero_attn, causal, kernel_initializer, use_flash)
         return self.add_op(op).output
 
     # elementwise unary (model.h exp/relu/sigmoid/tanh/elu/scalar ops)

@@ -21,13 +21,15 @@ def build_transformer(config: Optional[FFConfig] = None,
                       hidden: int = 512, num_heads: int = 8,
                       num_layers: int = 6, ff_dim: int = 2048,
                       num_classes: int = 10, dtype=jnp.float32,
-                      mesh=None, strategy=None) -> FFModel:
+                      mesh=None, strategy=None,
+                      use_flash: bool = True) -> FFModel:
     cfg = config or FFConfig()
     bs = batch_size or cfg.batch_size
     ff = FFModel(cfg, mesh=mesh, strategy=strategy)
     t = ff.create_tensor((bs, seq_len, hidden), dtype=dtype, name="input")
     for i in range(num_layers):
         a = ff.multihead_attention(t, t, t, hidden, num_heads,
+                                   use_flash=use_flash,
                                    name=f"layer{i}_attn")
         t = ff.add(a, t, name=f"layer{i}_res1")
         h = ff.dense(t, ff_dim, activation="relu", name=f"layer{i}_ff1")

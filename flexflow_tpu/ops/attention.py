@@ -116,7 +116,7 @@ class MultiHeadAttention(Op):
 
         y = jnp.einsum("bshd,hde->bse", o, params["wo"].astype(o.dtype))
         if self.use_bias:
-            y = y + params["bo"]
+            y = y + params["bo"].astype(y.dtype)
         if self.dropout > 0.0 and ctx.training and ctx.rng is not None:
             keep = 1.0 - self.dropout
             mask = jax.random.bernoulli(ctx.rng, keep, y.shape)
@@ -151,7 +151,15 @@ class MultiHeadAttention(Op):
             v = jnp.concatenate([v, zero], axis=1)
         # flash path handles neither seq_length truncation nor the
         # (now off-block-size) zero-attn row; use XLA for those.
-        if self.use_flash and not has_seq_trunc and not self.add_zero_attn:
+        # Dispatch (measured on v5e): XLA wins at d=64 (lane padding to 128
+        # doubles the kernel's dot FLOPs), flash wins once the materialized
+        # (b,h,sq,sk) score tensor stresses HBM or d fills the lanes.
+        b, sq, h, d = q.shape
+        sk = k.shape[1]
+        score_bytes = b * h * sq * sk * 6  # f32 logits + bf16 probs
+        flash_profitable = (d % 128 == 0 and sk >= 1024) or score_bytes > 2**31
+        if (self.use_flash and flash_profitable
+                and not has_seq_trunc and not self.add_zero_attn):
             from ..kernels.flash_attention import flash_attention_bshd
             try:
                 return flash_attention_bshd(q, k, v, causal=self.causal)

@@ -102,7 +102,7 @@ class Conv2D(Op):
             preferred_element_type=jnp.float32,
         ).astype(x.dtype)
         if self.use_bias:
-            y = y + params["bias"].reshape(1, -1, 1, 1)
+            y = y + params["bias"].reshape(1, -1, 1, 1).astype(y.dtype)
         return [apply_activation(y, self.activation)]
 
     def output_axes(self):
@@ -232,8 +232,8 @@ class BatchNorm(Op):
         shape[1] = -1
         inv = lax.rsqrt(var + self.EPS).reshape(shape).astype(x.dtype)
         mean = mean.reshape(shape).astype(x.dtype)
-        y = (x - mean) * inv * params["scale"].reshape(shape) + params[
-            "bias"].reshape(shape)
+        y = (x - mean) * inv * params["scale"].reshape(shape).astype(
+            x.dtype) + params["bias"].reshape(shape).astype(x.dtype)
         if self.relu:
             y = jax.nn.relu(y)
         return [y]

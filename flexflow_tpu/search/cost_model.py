@@ -34,6 +34,16 @@ class OpCost:
     sync: float         # gradient sync (DP all-reduce) seconds
     mem: float          # bytes resident per device (weights+opt+acts)
 
+    def merge(self, other: "OpCost") -> "OpCost":
+        """Fold another op's cost into one fused task (reference FusedOp:
+        one launch for the group). Interior comm is dropped by the caller
+        by construction (same strategy ⇒ no resharding); boundary comm,
+        grad sync, and memory are additive."""
+        return OpCost(fwd=self.fwd + other.fwd, bwd=self.bwd + other.bwd,
+                      fwd_comm=self.fwd_comm + other.fwd_comm,
+                      bwd_comm=self.bwd_comm + other.bwd_comm,
+                      sync=self.sync + other.sync, mem=self.mem + other.mem)
+
 
 def _axis_size(strategy: OpStrategy, mesh, logical_axis) -> int:
     ax = strategy.mesh_axis_for(logical_axis)

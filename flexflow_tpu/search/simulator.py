@@ -124,10 +124,11 @@ class Simulator:
         self.time_scale = 1.0
         # strategy-independent graph maps, built once (the annealing loop
         # calls simulate() thousands of times)
-        self._producer, edges = op_edges(model)
-        self._consumers: Dict[str, list] = {}
-        for src, dst in edges:
-            self._consumers.setdefault(src.name, []).append(dst)
+        self._producer, _ = op_edges(model)
+        self._ops_by_name = {op.name: op for op in model.ops}
+        # fused-unit partition + edges per strategy signature (fusion
+        # groups depend only on each op's axis map)
+        self._unit_cache: Dict[tuple, tuple] = {}
 
     def calibrate_end_to_end(self, strategy: Strategy,
                              measured_step_seconds: float) -> float:
@@ -150,6 +151,43 @@ class Simulator:
             self._cache[key] = op_cost(op, s, self.mesh, self.mm)
         return self._cache[key]
 
+    def _units_for(self, strategy: Strategy):
+        """(groups, unit_deps, unit_consumers) for this strategy's fusion
+        partition, cached on the per-op axis-map signature (the annealing
+        loop revisits the same few candidates thousands of times)."""
+        if getattr(self.model.config, "perform_fusion", False):
+            sig = tuple(
+                tuple(sorted((k, str(v)) for k, v in
+                             strategy.for_op(op.name).axis_map.items()))
+                for op in self.model.ops)
+        else:
+            sig = ()
+        if sig in self._unit_cache:
+            return self._unit_cache[sig]
+        if sig == ():
+            groups = [[op.name] for op in self.model.ops]
+        else:
+            from ..core.fusion import compute_fusion_groups
+            groups = compute_fusion_groups(self.model, strategy)
+        unit_of = {m: g[-1] for g in groups for m in g}
+        unit_deps: Dict[str, List[str]] = {g[-1]: [] for g in groups}
+        unit_consumers: Dict[str, List[str]] = {}
+        for grp in groups:
+            uid_ = grp[-1]
+            seen = set()
+            for m in grp:
+                for t in self._ops_by_name[m].inputs:
+                    p = self._producer.get(t.uid)
+                    if p is None:
+                        continue
+                    pu = unit_of[p.name]
+                    if pu != uid_ and pu not in seen:
+                        seen.add(pu)
+                        unit_deps[uid_].append(pu)
+                        unit_consumers.setdefault(pu, []).append(uid_)
+        self._unit_cache[sig] = (groups, unit_deps, unit_consumers)
+        return self._unit_cache[sig]
+
     def simulate(self, strategy: Strategy,
                  dot_path: Optional[str] = None) -> float:
         """Estimated seconds per training step under `strategy`."""
@@ -161,49 +199,57 @@ class Simulator:
         """Returns (unscaled step seconds, memory penalty seconds)."""
         g = TaskGraph()
         fwd_tasks: Dict[str, SimTask] = {}
-        producer = self._producer
 
         total_mem = 0.0
         costs = {op.name: self._op_cost(op, strategy)
                  for op in self.model.ops}
 
+        # fusion (reference FusedOp simulated as ONE task per group,
+        # fused.cu fwd/bwd dispatch): each unit is a singleton op or a
+        # same-strategy chain costed as one task (interior comm drops —
+        # same strategy ⇒ no resharding between members).
+        groups, unit_deps, unit_consumers = self._units_for(strategy)
+        unit_cost: Dict[str, OpCost] = {}
+        for grp in groups:
+            c = costs[grp[0]]
+            for m in grp[1:]:
+                c = c.merge(costs[m])
+            unit_cost[grp[-1]] = c
+        unit_order = [g[-1] for g in groups]
+
         # forward chain
-        for op in self.model.ops:
-            c = costs[op.name]
-            deps = [fwd_tasks[producer[t.uid].name]
-                    for t in op.inputs if t.uid in producer]
+        for u in unit_order:
+            c = unit_cost[u]
+            deps = [fwd_tasks[pu] for pu in unit_deps[u] if pu in fwd_tasks]
             if c.fwd_comm > 0:
-                comm = g.add(f"{op.name}:fwd_comm", c.fwd_comm, "comm", deps)
+                comm = g.add(f"{u}:fwd_comm", c.fwd_comm, "comm", deps)
                 deps = deps + [comm]
-            fwd_tasks[op.name] = g.add(f"{op.name}:fwd", c.fwd, "compute",
-                                       deps)
+            fwd_tasks[u] = g.add(f"{u}:fwd", c.fwd, "compute", deps)
             total_mem += c.mem
 
         # backward chain (reverse graph)
-        consumers = self._consumers
         bwd_tasks: Dict[str, SimTask] = {}
         sync_tasks: List[SimTask] = []
-        for op in reversed(self.model.ops):
-            c = costs[op.name]
-            deps = [bwd_tasks[cons.name] for cons in consumers.get(op.name, [])
-                    if cons.name in bwd_tasks]
+        for u in reversed(unit_order):
+            c = unit_cost[u]
+            deps = [bwd_tasks[cons] for cons in unit_consumers.get(u, [])
+                    if cons in bwd_tasks]
             if not deps:
-                deps = [fwd_tasks[self.model.ops[-1].name]]
+                deps = [fwd_tasks[unit_order[-1]]]
             if c.bwd_comm > 0:
-                comm = g.add(f"{op.name}:bwd_comm", c.bwd_comm, "comm", deps)
+                comm = g.add(f"{u}:bwd_comm", c.bwd_comm, "comm", deps)
                 deps = deps + [comm]
-            bwd_tasks[op.name] = g.add(f"{op.name}:bwd", c.bwd, "compute",
-                                       deps)
+            bwd_tasks[u] = g.add(f"{u}:bwd", c.bwd, "compute", deps)
             if c.sync > 0:
                 # grad all-reduce may overlap the rest of backward
                 # (reference overlap flag, simulator.cc:393-497)
-                sync_deps = [bwd_tasks[op.name]]
-                st = g.add(f"{op.name}:grad_sync", c.sync, "comm", sync_deps)
+                sync_deps = [bwd_tasks[u]]
+                st = g.add(f"{u}:grad_sync", c.sync, "comm", sync_deps)
                 sync_tasks.append(st)
 
         if not self.overlap and sync_tasks:
             # serialize syncs after all backward work: model by chaining
-            last_bwd = bwd_tasks[self.model.ops[0].name]
+            last_bwd = bwd_tasks[unit_order[0]]
             for st in sync_tasks:
                 st.deps.append(last_bwd)
 

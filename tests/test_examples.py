@@ -56,3 +56,21 @@ def test_launcher_code_mode():
         capture_output=True, text=True, cwd=REPO, timeout=120, env=env)
     assert r.returncode == 0, r.stderr
     assert "ndev 4" in r.stdout
+
+
+def test_keras_reuters_mlp():
+    out = run_example("examples/python/keras/reuters_mlp.py",
+                      "-e", "1", "-n", "512")
+    assert "final" in out
+
+
+def test_keras_datasets_shapes():
+    from flexflow_tpu.frontends.keras import datasets
+    (xtr, ytr), (xte, yte) = datasets.mnist.load_data()
+    assert xtr.shape == (60000, 28, 28) and yte.shape == (10000,)
+    (xtr, ytr), (xte, yte) = datasets.cifar10.load_data()
+    assert xtr.shape == (50000, 32, 32, 3) and ytr.shape == (50000, 1)
+    (xtr, ytr), _ = datasets.reuters.load_data(num_words=500)
+    assert len(xtr) == 8982 and max(max(s) for s in xtr) < 500
+    padded = datasets.pad_sequences(xtr[:4], maxlen=50)
+    assert padded.shape == (4, 50)

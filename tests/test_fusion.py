@@ -1,0 +1,91 @@
+"""Fusion pass (reference apply_fusion / FusedOp, model.cc:1472-1549):
+same-strategy chains group; executor parity with fusion on/off; simulator
+folds groups into single tasks."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.core.fusion import boundary_ops, compute_fusion_groups
+from flexflow_tpu.parallel.mesh import make_mesh
+from flexflow_tpu.parallel.pconfig import OpStrategy, Strategy
+
+
+def _mlp(cfg, mesh=None, strategy=None):
+    ff = FFModel(cfg, mesh=mesh, strategy=strategy)
+    x = ff.create_tensor((8, 16), name="input")
+    h = ff.dense(x, 32, activation="relu", name="fc1")
+    h = ff.dense(h, 32, activation="relu", name="fc2")
+    h = ff.dense(h, 10, name="fc3")
+    ff.softmax(h, name="sm")
+    return ff
+
+
+def test_chain_groups_into_one():
+    ff = _mlp(FFConfig())
+    groups = compute_fusion_groups(ff, Strategy())
+    # uniform strategy: the whole chain fuses into one group
+    assert groups == [["fc1", "fc2", "fc3", "sm"]]
+    assert boundary_ops(groups) == {"sm"}
+
+
+def test_strategy_change_breaks_group():
+    strat = Strategy(op_strategies={"fc2": OpStrategy(
+                         {"sample": "data", "channel_out": "model"})},
+                     default=OpStrategy({"sample": "data"}))
+    ff = _mlp(FFConfig())
+    groups = compute_fusion_groups(ff, strat)
+    assert ["fc2"] in groups  # fc2's TP strategy isolates it
+    assert boundary_ops(groups) >= {"fc2", "sm"}
+
+
+def test_branch_breaks_group():
+    ff = FFModel(FFConfig())
+    x = ff.create_tensor((8, 16), name="input")
+    h = ff.dense(x, 16, name="a")       # two consumers -> group boundary
+    b1 = ff.relu(h, name="b1")
+    b2 = ff.tanh(h, name="b2")
+    ff.add(b1, b2, name="c")
+    groups = compute_fusion_groups(ff, Strategy())
+    by_head = {g[-1]: g for g in groups}
+    assert by_head["a"] == ["a"]
+    assert by_head["c"] == ["c"]  # two in-graph producers
+
+
+def test_executor_parity_with_fusion(rng):
+    x = rng.randn(16, 16).astype(np.float32)
+    y = rng.randint(0, 10, 16).astype(np.int32)
+    losses = []
+    for fuse in (False, True):
+        cfg = FFConfig()
+        cfg.batch_size = 16
+        cfg.perform_fusion = fuse
+        mesh = make_mesh((4, 2), ("data", "model"))
+        strat = Strategy(default=OpStrategy({"sample": "data",
+                                             "channel_out": "model"}))
+        ff = _mlp(cfg, mesh=mesh, strategy=strat)
+        ff.compile(optimizer=SGDOptimizer(lr=0.1),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=["accuracy"])
+        m = ff.train_batch({"input": x, "label": y})
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+
+
+def test_simulator_fused_taskgraph():
+    from flexflow_tpu.search.simulator import Simulator
+    mesh = make_mesh((8,), ("data",))
+    for fuse in (False, True):
+        cfg = FFConfig()
+        cfg.perform_fusion = fuse
+        ff = _mlp(cfg, mesh=mesh)
+        sim = Simulator(ff, mesh)
+        t = sim.simulate(Strategy(default=OpStrategy({"sample": "data"})))
+        assert t > 0 and np.isfinite(t)
+        if fuse:
+            t_fused = t
+        else:
+            t_unfused = t
+    # fusing drops no compute, so times stay within the comm budget
+    assert t_fused <= t_unfused * 1.01
