@@ -1,7 +1,11 @@
 """Benchmark driver — prints ONE JSON line.
 
-Measures the flagship Transformer-encoder training step on the real TPU
-chip: samples/sec/chip and MFU.
+Default (`python bench.py`): the flagship Transformer-encoder training
+step on the real TPU chip — samples/sec/chip and MFU.
+
+`python bench.py --model M` benchmarks the other BASELINE.md configs
+(alexnet, inception, dlrm, nmt_lstm) the same way; each prints its own
+single JSON line.
 
 Baseline note (BASELINE.md): the reference repo commits no numbers; its
 north star is "MFU within 10% of FlexFlow's own V100-class results".
@@ -10,6 +14,7 @@ workloads, fp32 cuBLAS); we take mfu_baseline = 0.30 and report
 vs_baseline = our_mfu / 0.30 (>1.0 beats the reference).
 """
 
+import argparse
 import json
 import time
 
@@ -19,6 +24,7 @@ MFU_BASELINE = 0.30
 PEAK_FLOPS = {
     # bf16 peak per chip
     "v5litepod": 197e12,  # v5e
+    "v5 lite": 197e12,
     "v5e": 197e12,
     "v5p": 459e12,
     "v4": 275e12,
@@ -29,36 +35,90 @@ PEAK_FLOPS = {
 def detect_peak():
     import jax
     dev = jax.devices()[0]
-    kind = getattr(dev, "device_kind", "cpu").lower().replace(" ", "")
+    kind = getattr(dev, "device_kind", "cpu").lower()
     for k, v in PEAK_FLOPS.items():
-        if k in kind:
+        if k in kind or k in kind.replace(" ", ""):
             return v
     return PEAK_FLOPS["cpu"] if dev.platform == "cpu" else 197e12
 
 
-def main():
-    import jax
+def build(model: str):
+    """Returns (ff, batch_data), compiled and ready to train."""
     import jax.numpy as jnp
     from flexflow_tpu import FFConfig, SGDOptimizer
-    from flexflow_tpu.models.transformer import build_transformer
-
-    batch, seq, hidden, heads, layers, ffd = 32, 512, 512, 8, 6, 2048
-    cfg = FFConfig()
-    cfg.batch_size = batch
-    ff = build_transformer(cfg, batch_size=batch, seq_len=seq, hidden=hidden,
-                           num_heads=heads, num_layers=layers, ff_dim=ffd,
-                           num_classes=10, dtype=jnp.bfloat16)
-    ff.compile(optimizer=SGDOptimizer(lr=0.01),
-               loss_type="sparse_categorical_crossentropy",
-               metrics=[])
-
-    fwd_flops = sum(op.flops() for op in ff.ops)
-    step_flops = 3.0 * fwd_flops  # fwd + ~2x bwd
+    from flexflow_tpu import models as zoo
 
     rng = np.random.RandomState(0)
-    x = rng.randn(batch, seq, hidden).astype(np.float32)
-    y = rng.randint(0, 10, (batch,)).astype(np.int32)
-    batch_data = {"input": jnp.asarray(x, jnp.bfloat16), "label": jnp.asarray(y)}
+    cfg = FFConfig()
+    if model == "transformer":
+        batch, seq, hidden = 32, 512, 512
+        cfg.batch_size = batch
+        ff = zoo.build_transformer(cfg, batch_size=batch, seq_len=seq,
+                                   hidden=hidden, num_heads=8, num_layers=6,
+                                   ff_dim=2048, num_classes=10,
+                                   dtype=jnp.bfloat16)
+        data = {"input": jnp.asarray(
+            rng.randn(batch, seq, hidden), jnp.bfloat16),
+            "label": jnp.asarray(rng.randint(0, 10, (batch,)), jnp.int32)}
+    elif model == "alexnet":
+        batch = 256
+        cfg.batch_size = batch
+        ff = zoo.build_alexnet(cfg, batch_size=batch)
+        data = {"input": jnp.asarray(
+            rng.randn(batch, 3, 32, 32), jnp.float32),
+            "label": jnp.asarray(rng.randint(0, 10, (batch,)), jnp.int32)}
+    elif model == "inception":
+        batch = 32
+        cfg.batch_size = batch
+        ff = zoo.build_inception_v3(cfg, batch_size=batch, image_size=299)
+        data = {"input": jnp.asarray(
+            rng.randn(batch, 3, 299, 299), jnp.float32),
+            "label": jnp.asarray(rng.randint(0, 10, (batch,)), jnp.int32)}
+    elif model == "dlrm":
+        batch = 1024
+        cfg.batch_size = batch
+        vocabs = (1000000,) * 8
+        ff = zoo.build_dlrm(cfg, batch_size=batch,
+                            embedding_vocab_sizes=vocabs)
+        data = {"dense_features": jnp.asarray(
+            rng.randn(batch, 13), jnp.float32),
+            "label": jnp.asarray(
+                rng.rand(batch, 1) > 0.5, jnp.float32)}
+        for i in range(len(vocabs)):
+            data[f"sparse_{i}"] = jnp.asarray(
+                rng.randint(0, vocabs[i], (batch, 1)), jnp.int32)
+    elif model == "nmt_lstm":
+        batch, seq = 64, 40
+        cfg.batch_size = batch
+        ff = zoo.build_nmt_lstm(cfg, batch_size=batch, seq_len=seq)
+        data = {"input": jnp.asarray(
+            rng.randint(0, 32000, (batch, seq)), jnp.int32),
+            "label": jnp.asarray(rng.randint(0, 32000, (batch,)),
+                                 jnp.int32)}
+    else:
+        raise SystemExit(f"unknown --model {model}")
+    loss = ("mean_squared_error" if model == "dlrm"
+            else "sparse_categorical_crossentropy")
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=loss, metrics=[])
+    return ff, data
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="transformer",
+                    choices=["transformer", "alexnet", "inception", "dlrm",
+                             "nmt_lstm"])
+    ap.add_argument("--steps", type=int, default=40)
+    args = ap.parse_args()
+
+    ff, batch_data = build(args.model)
+    batch = next(iter(batch_data.values())).shape[0]
+    fwd_flops = sum(op.flops() for op in ff.ops)
+    # Standard MFU accounting: step = fwd + 2x-fwd backward. (The search
+    # cost model prices attention backward at 4x because flash RECOMPUTES
+    # probabilities — recompute is overhead, not useful work, so it is
+    # deliberately excluded here; counting it would inflate MFU.)
+    step_flops = 3.0 * fwd_flops
 
     # warmup (includes compile). NOTE: through the axon tunnel
     # block_until_ready does not sync; only a device->host transfer does,
@@ -67,18 +127,19 @@ def main():
         m = ff.train_batch(batch_data)
     float(m["loss"])
 
-    steps = 40
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for _ in range(args.steps):
         m = ff.train_batch(batch_data)
     float(m["loss"])  # drains the queued steps
-    dt = (time.perf_counter() - t0) / steps
+    dt = (time.perf_counter() - t0) / args.steps
 
     samples_per_sec = batch / dt
     achieved = step_flops / dt
     mfu = achieved / detect_peak()
     print(json.dumps({
-        "metric": "transformer_encoder_train_samples_per_sec_per_chip",
+        "metric": f"{args.model}_train_samples_per_sec_per_chip"
+        if args.model != "transformer"
+        else "transformer_encoder_train_samples_per_sec_per_chip",
         "value": round(samples_per_sec, 2),
         "unit": "samples/s",
         "vs_baseline": round(mfu / MFU_BASELINE, 4),

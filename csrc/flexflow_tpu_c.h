@@ -128,6 +128,14 @@ int32_t ffdl_next_batch(ffdl_handle_t h, void **out_ptrs, int32_t *out_rows);
 
 void ffdl_destroy(ffdl_handle_t h);
 
+/* Host-side embedding-bag (reference src/ops/embedding_avx2.cc role in
+ * the data pipeline): out[b] = reduce(table[indices[b, :]]) with
+ * mode 0=sum, 1=mean; negative/out-of-range indices are padding and are
+ * skipped.  indices is (batch, bag_size) row-major; out is (batch, dim). */
+void ffdl_embedding_bag(const float *table, int64_t num_entries,
+                        int32_t dim, const int64_t *indices, int64_t batch,
+                        int32_t bag_size, int32_t mode, float *out);
+
 /* ---------------- misc ---------------- */
 const char *flexflow_tpu_native_version(void);
 

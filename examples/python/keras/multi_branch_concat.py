@@ -1,0 +1,46 @@
+"""Functional-API multi-branch model with Concatenate.
+
+Reference: examples/python/keras/ concatenation examples
+(func_cifar10_cnn_concat.py family) — two conv towers over the same
+input merged by Concatenate, exercising the Concat op and multi-branch
+graph emission.
+
+  python examples/python/keras/multi_branch_concat.py -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+
+    inp = keras.layers.Input((3, 32, 32))
+    a = keras.layers.Conv2D(16, (3, 3), padding="same",
+                            activation="relu")(inp)
+    b = keras.layers.Conv2D(16, (5, 5), padding="same",
+                            activation="relu")(inp)
+    t = keras.layers.Concatenate(axis=1)([a, b])
+    t = keras.layers.MaxPooling2D((2, 2))(t)
+    t = keras.layers.Flatten()(t)
+    t = keras.layers.Dense(128, activation="relu")(t)
+    out = keras.layers.Dense(10, activation="softmax")(t)
+
+    model = keras.Model(inputs=inp, outputs=out)
+    model.compile(optimizer=keras.Adam(learning_rate=1e-3),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, 256).astype(np.int32)
+    history = model.fit(x, y, batch_size=32, epochs=epochs)
+    print("final:", history[-1])
+
+
+if __name__ == "__main__":
+    top_level_task()

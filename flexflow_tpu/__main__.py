@@ -13,9 +13,15 @@ job is platform setup + script execution:
   python -m flexflow_tpu --cpu-devices 8 train.py   # virtual CPU mesh
 
 Launcher-only flags (consumed before the script sees argv):
-  --cpu-devices N   force the CPU platform with N virtual devices — the
-                    test rig for multi-chip sharding without TPUs
-  -c CODE           run a code string instead of a script
+  --cpu-devices N     force the CPU platform with N virtual devices — the
+                      test rig for multi-chip sharding without TPUs
+  --coordinator A:P   multi-host: jax.distributed coordinator address
+                      (the analog of the reference's mpirun bootstrap,
+                      python/flexflow.py — one process per host, Legion
+                      control replication → JAX multi-controller SPMD)
+  --num-processes N   multi-host: total process count
+  --process-id I      multi-host: this process's rank
+  -c CODE             run a code string instead of a script
 Everything else is left on sys.argv for FFConfig.from_args().
 """
 
@@ -31,16 +37,32 @@ def main(argv=None) -> int:
 
     cpu_devices = None
     code = None
+    coordinator = num_processes = process_id = None
     i = 0
     while i < len(argv):
         if argv[i] == "--cpu-devices" and i + 1 < len(argv):
             cpu_devices = int(argv[i + 1])
+            del argv[i:i + 2]
+        elif argv[i] == "--coordinator" and i + 1 < len(argv):
+            coordinator = argv[i + 1]
+            del argv[i:i + 2]
+        elif argv[i] == "--num-processes" and i + 1 < len(argv):
+            num_processes = int(argv[i + 1])
+            del argv[i:i + 2]
+        elif argv[i] == "--process-id" and i + 1 < len(argv):
+            process_id = int(argv[i + 1])
             del argv[i:i + 2]
         elif argv[i] == "-c" and i + 1 < len(argv):
             code = argv[i + 1]
             del argv[i:i + 2]
         else:
             break
+
+    if coordinator is not None:
+        import jax
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
 
     if cpu_devices is not None:
         kept = [f for f in os.environ.get("XLA_FLAGS", "").split()

@@ -85,12 +85,20 @@ class cifar10:
         p = _find("cifar-10-batches-py") or _find("cifar-10-python.tar.gz")
         if p and os.path.isdir(p):
             return cifar10._from_batches(p)
-        if p:  # tarball
-            with tarfile.open(p) as tar:
-                tmp = os.path.dirname(p)
-                tar.extractall(tmp)  # noqa: S202 - local trusted cache
-            return cifar10._from_batches(
-                os.path.join(os.path.dirname(p), "cifar-10-batches-py"))
+        if p:  # tarball: extract once (next to it if writable, else /tmp)
+            try:
+                dst = os.path.dirname(p)
+                if not os.access(dst, os.W_OK):
+                    import tempfile
+                    dst = tempfile.mkdtemp(prefix="flexflow_tpu_cifar10_")
+                extracted = os.path.join(dst, "cifar-10-batches-py")
+                if not os.path.isdir(extracted):
+                    with tarfile.open(p) as tar:
+                        tar.extractall(dst)  # noqa: S202 - trusted cache
+                return cifar10._from_batches(extracted)
+            except Exception as e:
+                print(f"[flexflow_tpu.keras.datasets] cifar10 cache "
+                      f"unusable ({e}); using synthetic", file=sys.stderr)
         _warn_synthetic("cifar10")
         (xtr, ytr), (xte, yte) = _synthetic_images(
             (32, 32, 3), 10, 50000, 10000, seed=2)

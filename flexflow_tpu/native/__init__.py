@@ -24,7 +24,7 @@ _CSRC = os.path.join(_REPO_ROOT, "csrc")
 _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
 _LIB_PATH = os.path.join(_BUILD_DIR, "libflexflow_tpu_native.so")
 
-_SOURCES = ("simulator.cc", "mcmc.cc", "dataloader.cc")
+_SOURCES = ("simulator.cc", "mcmc.cc", "dataloader.cc", "embedding_bag.cc")
 _HEADERS = ("flexflow_tpu_c.h", "sim_core.h")
 
 _lock = threading.Lock()
@@ -103,6 +103,12 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ffdl_next_batch.argtypes = [ctypes.c_void_p, vpp, i32p]
     lib.ffdl_destroy.restype = None
     lib.ffdl_destroy.argtypes = [ctypes.c_void_p]
+
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.ffdl_embedding_bag.restype = None
+    lib.ffdl_embedding_bag.argtypes = [
+        f32p, ctypes.c_int64, ctypes.c_int32, i64p, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32, f32p]
 
     lib.flexflow_tpu_native_version.restype = ctypes.c_char_p
     lib.flexflow_tpu_native_version.argtypes = []

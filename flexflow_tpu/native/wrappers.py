@@ -190,3 +190,36 @@ class NativePrefetchLoader:
             self.close()
         except Exception:
             pass
+
+
+def embedding_bag(table: np.ndarray, indices: np.ndarray,
+                  mode: str = "sum") -> np.ndarray:
+    """Host-side embedding-bag (native when available, numpy fallback).
+
+    table (V, D) float32; indices (B, L) int — negative entries are
+    padding. The data-pipeline role of the reference's AVX2 CPU
+    embedding-bag (src/ops/embedding_avx2.cc): pre-reduce multi-hot
+    categorical features before the batch ships to the device."""
+    table = np.ascontiguousarray(table, np.float32)
+    idx = _i64(indices)
+    assert table.ndim == 2 and idx.ndim == 2
+    assert mode in ("sum", "mean")
+    b, bag = idx.shape
+    v, d = table.shape
+    from . import get_lib
+    lib = get_lib()
+    if lib is not None:
+        out = np.empty((b, d), np.float32)
+        lib.ffdl_embedding_bag(
+            table.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(v), ctypes.c_int32(d), _p(idx),
+            ctypes.c_int64(b), ctypes.c_int32(bag),
+            ctypes.c_int32(0 if mode == "sum" else 1),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return out
+    valid = (idx >= 0) & (idx < v)
+    gathered = np.where(valid[..., None], table[np.clip(idx, 0, v - 1)], 0.0)
+    out = gathered.sum(axis=1)
+    if mode == "mean":
+        out /= np.maximum(valid.sum(axis=1, keepdims=True), 1)
+    return out

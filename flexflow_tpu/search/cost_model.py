@@ -36,9 +36,10 @@ class OpCost:
 
     def merge(self, other: "OpCost") -> "OpCost":
         """Fold another op's cost into one fused task (reference FusedOp:
-        one launch for the group). Interior comm is dropped by the caller
-        by construction (same strategy ⇒ no resharding); boundary comm,
-        grad sync, and memory are additive."""
+        one launch for the group). Everything is additive — fwd/bwd_comm
+        model each op's INTRINSIC collectives (e.g. a TP all-reduce),
+        which fusion does not remove; what fusion avoids is resharding
+        between members, and same-strategy chains never had any."""
         return OpCost(fwd=self.fwd + other.fwd, bwd=self.bwd + other.bwd,
                       fwd_comm=self.fwd_comm + other.fwd_comm,
                       bwd_comm=self.bwd_comm + other.bwd_comm,

@@ -104,6 +104,14 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
 
     cands = {op.name: candidate_maps(op, mesh, cfg) for op in model.ops}
 
+    # The native lowering costs one task per op; with fusion on, the
+    # Python simulator folds same-strategy chains, so the engines would
+    # rank strategies differently — route fused searches to Python.
+    if cfg.perform_fusion:
+        if use_native is True:
+            raise ValueError("native search does not support "
+                             "perform_fusion; use the Python engine")
+        use_native = False
     if use_native is not False:
         from .native_search import optimize_native
         found = optimize_native(model, sim, cands, budget, alpha, seed,

@@ -206,8 +206,8 @@ class Simulator:
 
         # fusion (reference FusedOp simulated as ONE task per group,
         # fused.cu fwd/bwd dispatch): each unit is a singleton op or a
-        # same-strategy chain costed as one task (interior comm drops —
-        # same strategy ⇒ no resharding between members).
+        # same-strategy chain costed as one task; member costs (incl.
+        # intrinsic collectives like TP all-reduces) are summed.
         groups, unit_deps, unit_consumers = self._units_for(strategy)
         unit_cost: Dict[str, OpCost] = {}
         for grp in groups:

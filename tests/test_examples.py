@@ -74,3 +74,9 @@ def test_keras_datasets_shapes():
     assert len(xtr) == 8982 and max(max(s) for s in xtr) < 500
     padded = datasets.pad_sequences(xtr[:4], maxlen=50)
     assert padded.shape == (4, 50)
+
+
+def test_keras_multi_branch_concat():
+    out = run_example("examples/python/keras/multi_branch_concat.py",
+                      "-e", "1")
+    assert "final" in out

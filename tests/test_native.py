@@ -196,3 +196,16 @@ class TestNativeDataLoader:
         got2 = np.sort(np.concatenate(
             [np.asarray(b["label"]) for b in batches2]))
         np.testing.assert_array_equal(got2, np.sort(y))
+
+
+def test_embedding_bag_native_vs_numpy(rng):
+    from flexflow_tpu.native.wrappers import embedding_bag
+    table = rng.randn(50, 16).astype(np.float32)
+    idx = rng.randint(-1, 50, (8, 5)).astype(np.int64)  # -1 = padding
+    for mode in ("sum", "mean"):
+        got = embedding_bag(table, idx, mode=mode)
+        valid = idx >= 0
+        ref = np.where(valid[..., None], table[np.clip(idx, 0, 49)], 0).sum(1)
+        if mode == "mean":
+            ref = ref / np.maximum(valid.sum(1, keepdims=True), 1)
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
