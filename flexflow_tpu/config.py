@@ -98,6 +98,9 @@ class FFConfig:
     enable_pipeline_parallel: bool = False
     enable_propagation: bool = False
     machine_model_file: Optional[str] = None
+    # DOT export of the simulated task graph (reference --taskgraph,
+    # simulator.cc:508-556); written by the first simulate() of a search.
+    taskgraph_file: Optional[str] = None
 
     # fusion (reference: --fusion flag, model.cc:1472)
     perform_fusion: bool = False
@@ -151,6 +154,7 @@ class FFConfig:
         "--export": ("export_strategy_file", str),
         "--export-strategy": ("export_strategy_file", str),
         "--machine-model-file": ("machine_model_file", str),
+        "--taskgraph": ("taskgraph_file", str),
         "--seed": ("seed", int),
     }
     _BOOL_FLAGS = {
@@ -181,6 +185,10 @@ class FFConfig:
             if a in self._BOOL_FLAGS:
                 setattr(self, self._BOOL_FLAGS[a], True)
                 i += 1
+                continue
+            if a == "--seq-length" and i + 1 < len(argv):
+                self.iter_config.seq_length = int(argv[i + 1])
+                i += 2
                 continue
             i += 1
 

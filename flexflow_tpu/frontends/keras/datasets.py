@@ -90,7 +90,11 @@ class cifar10:
                 dst = os.path.dirname(p)
                 if not os.access(dst, os.W_OK):
                     import tempfile
-                    dst = tempfile.mkdtemp(prefix="flexflow_tpu_cifar10_")
+                    # fixed path so the extract-once check works across
+                    # calls/processes on a read-only cache
+                    dst = os.path.join(tempfile.gettempdir(),
+                                       "flexflow_tpu_cifar10")
+                    os.makedirs(dst, exist_ok=True)
                 extracted = os.path.join(dst, "cifar-10-batches-py")
                 if not os.path.isdir(extracted):
                     with tarfile.open(p) as tar:

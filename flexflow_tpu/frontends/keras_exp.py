@@ -1,0 +1,159 @@
+"""Experimental frontend: import a REAL tf.keras model.
+
+Reference: python/flexflow/keras_exp/models/model.py:36-424 — walks a
+genuine tf.keras model object (rather than this package's Keras-clone
+layer classes) and replays it onto the framework's builder API.
+
+TensorFlow is not part of this image (zero egress), so the module is
+import-gated: `HAS_TF` is False and `from_tf_keras` raises a clear
+ImportError without TF. With TF present, supported layers mirror the
+reference's handler set (Conv2D/Pooling/Dense/Flatten/Dropout/
+BatchNormalization/Activation/Concatenate/Add/Embedding).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+try:
+    import tensorflow as _tf  # noqa: F401
+    HAS_TF = True
+except Exception:  # pragma: no cover - image ships without TF
+    _tf = None
+    HAS_TF = False
+
+
+def from_tf_keras(tf_model, config=None, batch_size: Optional[int] = None,
+                  mesh=None, strategy=None):
+    """Replay a tf.keras Model onto an FFModel; returns the FFModel.
+
+    Layer coverage follows the reference keras_exp handler set; raises
+    NotImplementedError on anything else so failures are explicit.
+    """
+    if not HAS_TF:
+        raise ImportError(
+            "flexflow_tpu.frontends.keras_exp requires tensorflow, which "
+            "is not installed in this environment; use "
+            "flexflow_tpu.frontends.keras (native clone) or "
+            "frontends.onnx/torchfx instead")
+
+    import numpy as np
+
+    from ..config import FFConfig
+    from ..model import FFModel
+
+    cfg = config or FFConfig()
+    bs = batch_size or cfg.batch_size
+    ff = FFModel(cfg, mesh=mesh, strategy=strategy)
+
+    values = {}  # tf tensor ref -> framework Tensor
+
+    for inp in tf_model.inputs:
+        shape = tuple(int(d) for d in inp.shape[1:])
+        values[inp.ref()] = ff.create_tensor(
+            (bs,) + shape, name=inp.name.split(":")[0])
+
+    for layer in tf_model.layers:
+        ltype = type(layer).__name__
+        if ltype == "InputLayer":
+            continue
+        ins = [values[t.ref()] for t in _flat_inputs(layer)]
+        out = _emit_layer(ff, layer, ltype, ins)
+        for t in _flat_outputs(layer):
+            values[t.ref()] = out
+
+    # import trained weights where shapes line up
+    for layer in tf_model.layers:
+        w = layer.get_weights()
+        if not w:
+            continue
+        try:
+            ours = ff.get_weights(layer.name)
+        except KeyError:
+            continue
+        # pair each tf array with an unused same-shape framework weight
+        # (tf.keras get_weights() order is [kernel, bias, ...]; our dict
+        # order is arbitrary, so match by shape, not position)
+        mapped = {}
+        unused = dict(ours)
+        for tf_arr in w:
+            hit = next((n for n, arr in unused.items()
+                        if tuple(arr.shape) == tuple(np.shape(tf_arr))),
+                       None)
+            if hit is not None:
+                mapped[hit] = np.asarray(tf_arr)
+                del unused[hit]
+        if mapped:
+            ff.set_weights(layer.name, {**ours, **mapped})
+    return ff
+
+
+def _flat_inputs(layer):
+    x = layer.input
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _flat_outputs(layer):
+    x = layer.output
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _emit_layer(ff, layer, ltype, ins):
+    cfgd = layer.get_config()
+    if ltype == "Dense":
+        t = ff.dense(ins[0], cfgd["units"],
+                     activation=_act(cfgd.get("activation")),
+                     use_bias=cfgd.get("use_bias", True), name=layer.name)
+        if cfgd.get("activation") == "softmax":
+            t = ff.softmax(t, name=f"{layer.name}_softmax")
+        return t
+    if ltype == "Conv2D":
+        kh, kw = cfgd["kernel_size"]
+        sh, sw = cfgd["strides"]
+        pad = (kh // 2, kw // 2) if cfgd["padding"] == "same" else (0, 0)
+        return ff.conv2d(ins[0], cfgd["filters"], kh, kw, sh, sw,
+                         pad[0], pad[1],
+                         activation=_act(cfgd.get("activation")),
+                         use_bias=cfgd.get("use_bias", True),
+                         name=layer.name)
+    if ltype in ("MaxPooling2D", "AveragePooling2D"):
+        kh, kw = cfgd["pool_size"]
+        sh, sw = cfgd["strides"] or (kh, kw)
+        pad = (kh // 2, kw // 2) if cfgd.get("padding") == "same" else (0, 0)
+        return ff.pool2d(ins[0], kh, kw, sh, sw, pad[0], pad[1],
+                         pool_type="max" if ltype.startswith("Max")
+                         else "avg", name=layer.name)
+    if ltype == "Flatten":
+        return ff.flat(ins[0], name=layer.name)
+    if ltype == "Dropout":
+        return ff.dropout(ins[0], cfgd["rate"], name=layer.name)
+    if ltype == "BatchNormalization":
+        return ff.batch_norm(ins[0], relu=False, name=layer.name)
+    if ltype == "Activation":
+        return _apply_act(ff, cfgd["activation"], ins[0], layer.name)
+    if ltype == "Concatenate":
+        return ff.concat(ins, axis=cfgd.get("axis", -1), name=layer.name)
+    if ltype == "Add":
+        t = ff.add(ins[0], ins[1], name=layer.name)
+        for j, extra in enumerate(ins[2:]):  # tf.keras Add takes N inputs
+            t = ff.add(t, extra, name=f"{layer.name}_add{j + 2}")
+        return t
+    if ltype == "Embedding":
+        return ff.embedding(ins[0], cfgd["input_dim"], cfgd["output_dim"],
+                            name=layer.name)
+    raise NotImplementedError(f"keras_exp: unsupported layer {ltype}")
+
+
+def _act(name):
+    return name if name in ("relu", "sigmoid", "tanh", "elu", "gelu") \
+        else None
+
+
+def _apply_act(ff, name, t, lname):
+    if name == "softmax":
+        return ff.softmax(t, name=lname)
+    fn = {"relu": ff.relu, "sigmoid": ff.sigmoid, "tanh": ff.tanh,
+          "elu": ff.elu, "gelu": ff.gelu}.get(name)
+    if fn is None:
+        raise NotImplementedError(f"keras_exp: activation {name}")
+    return fn(t, name=lname)

@@ -206,7 +206,6 @@ def embedding_bag(table: np.ndarray, indices: np.ndarray,
     assert mode in ("sum", "mean")
     b, bag = idx.shape
     v, d = table.shape
-    from . import get_lib
     lib = get_lib()
     if lib is not None:
         out = np.empty((b, d), np.float32)

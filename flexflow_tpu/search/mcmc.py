@@ -117,6 +117,8 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
         found = optimize_native(model, sim, cands, budget, alpha, seed,
                                 verbose=verbose)
         if found is not None:
+            if cfg.taskgraph_file:
+                sim.simulate(found, dot_path=cfg.taskgraph_file)
             return found
         assert use_native is not True, "native search requested but " \
             "the native library is unavailable"
@@ -131,6 +133,8 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
 
     searchable = [op for op in model.ops if len(cands[op.name]) > 1]
     if not searchable:
+        if cfg.taskgraph_file:
+            sim.simulate(best, dot_path=cfg.taskgraph_file)
         return best
 
     reset_every = max(1, budget // 100)
@@ -167,4 +171,8 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
 
     if verbose:
         print(f"[search] best estimated step time: {best_cost*1e3:.3f} ms")
+    if cfg.taskgraph_file:
+        # DOT export of the winning strategy's task graph (reference
+        # --taskgraph, simulator.cc:508-556)
+        sim.simulate(best, dot_path=cfg.taskgraph_file)
     return best

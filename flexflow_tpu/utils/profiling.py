@@ -67,3 +67,21 @@ def time_train_steps(model, batch, steps: int = 20, warmup: int = 3
         m = model.train_batch(batch)
     float(m["loss"])
     return (time.perf_counter() - t0) / steps
+
+
+def hlo_cost(model, batch) -> dict:
+    """XLA's own cost analysis of the compiled train step (flops,
+    bytes accessed, per-category breakdown) — the compiled-HLO analog of
+    the reference simulator's measured per-op costs (SURVEY.md section 5
+    prescribes 'per-op cost extraction from compiled HLO'). Complements
+    op_profile (analytic) with what XLA actually emitted after fusion.
+    """
+    import jax
+    ex = model.executor
+    batch = ex.shard_batch(batch)
+    rng = jax.random.PRNGKey(0)
+    compiled = ex.train_step.lower(model.state, batch, rng).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost)

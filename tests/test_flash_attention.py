@@ -84,3 +84,14 @@ def test_flash_bf16(rng):
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         rtol=5e-2, atol=5e-2)
+
+
+def test_flash_unpadded_lanes_matches_xla(rng):
+    # d=64 with pad_lanes=False: Mosaic sub-128-lane path (interpret here)
+    b, s, h, d = 1, 128, 2, 64
+    q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    out = flash_attention_bshd(q, q, q, causal=True, interpret=True,
+                               pad_lanes=False)
+    ref = xla_attention(q, q, q, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)

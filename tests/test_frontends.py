@@ -124,3 +124,12 @@ def test_onnx_importer_gated():
             fonnx.ONNXModel("nonexistent.onnx")
     else:  # pragma: no cover - image has no onnx
         pass
+
+
+def test_keras_exp_gated():
+    from flexflow_tpu.frontends import keras_exp
+    if not keras_exp.HAS_TF:
+        with pytest.raises(ImportError):
+            keras_exp.from_tf_keras(object())
+    else:  # pragma: no cover - image has no TF
+        pass

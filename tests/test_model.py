@@ -111,3 +111,18 @@ def test_summary():
     ff = make_mlp(cfg)
     s = ff.summary()
     assert "dense" in s and "total params" in s
+
+
+def test_hlo_cost_extraction(rng):
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.utils.profiling import hlo_cost
+    cfg = FFConfig(); cfg.batch_size = 8
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 16), name="input")
+    h = ff.dense(x, 32, activation="relu", name="fc1")
+    ff.softmax(ff.dense(h, 10, name="fc2"), name="sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    c = hlo_cost(ff, {"input": rng.randn(8, 16).astype(np.float32),
+                      "label": rng.randint(0, 10, 8).astype(np.int32)})
+    assert c.get("flops", 0) > 0

@@ -139,3 +139,18 @@ def test_simulator_dot_export(tmp_path):
     content = open(dot).read()
     assert "digraph taskgraph" in content
     assert "big1:fwd" in content and "grad_sync" in content
+
+
+def test_taskgraph_flag_exports_dot(tmp_path, mesh8):
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.search.mcmc import optimize
+    cfg = FFConfig()
+    cfg.parse_args(["--taskgraph", str(tmp_path / "tg.dot"),
+                    "--seq-length", "16"])
+    assert cfg.iter_config.seq_length == 16
+    ff = FFModel(cfg, mesh=mesh8)
+    x = ff.create_tensor((16, 8), name="input")
+    ff.softmax(ff.dense(x, 4, name="fc"), name="sm")
+    optimize(ff, budget=5)
+    dot = (tmp_path / "tg.dot").read_text()
+    assert "digraph" in dot and ":fwd" in dot
