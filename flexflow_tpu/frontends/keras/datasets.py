@@ -90,15 +90,25 @@ class cifar10:
                 dst = os.path.dirname(p)
                 if not os.access(dst, os.W_OK):
                     import tempfile
-                    # fixed path so the extract-once check works across
-                    # calls/processes on a read-only cache
+                    # fixed per-user path so the extract-once check works
+                    # across calls/processes on a read-only cache
                     dst = os.path.join(tempfile.gettempdir(),
-                                       "flexflow_tpu_cifar10")
+                                       f"flexflow_tpu_cifar10_{os.getuid()}")
                     os.makedirs(dst, exist_ok=True)
                 extracted = os.path.join(dst, "cifar-10-batches-py")
                 if not os.path.isdir(extracted):
+                    # extract to a unique dir, then atomically rename so
+                    # concurrent processes never see a partial extraction
+                    import tempfile
+                    work = tempfile.mkdtemp(dir=dst)
                     with tarfile.open(p) as tar:
-                        tar.extractall(dst)  # noqa: S202 - trusted cache
+                        tar.extractall(work)  # noqa: S202 - trusted cache
+                    try:
+                        os.rename(os.path.join(work,
+                                               "cifar-10-batches-py"),
+                                  extracted)
+                    except OSError:
+                        pass  # another process won the race
                 return cifar10._from_batches(extracted)
             except Exception as e:
                 print(f"[flexflow_tpu.keras.datasets] cifar10 cache "

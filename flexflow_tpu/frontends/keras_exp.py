@@ -62,29 +62,28 @@ def from_tf_keras(tf_model, config=None, batch_size: Optional[int] = None,
         for t in _flat_outputs(layer):
             values[t.ref()] = out
 
-    # import trained weights where shapes line up
+    # stage trained weights; FFModel.compile applies them after
+    # init_state (state does not exist yet at this point)
+    ops_by_name = {op.name: op for op in ff.ops}
     for layer in tf_model.layers:
         w = layer.get_weights()
-        if not w:
-            continue
-        try:
-            ours = ff.get_weights(layer.name)
-        except KeyError:
+        op = ops_by_name.get(layer.name)
+        if not w or op is None:
             continue
         # pair each tf array with an unused same-shape framework weight
         # (tf.keras get_weights() order is [kernel, bias, ...]; our dict
         # order is arbitrary, so match by shape, not position)
+        specs = op.weight_specs()
         mapped = {}
-        unused = dict(ours)
+        unused = {n: s.shape for n, s in specs.items()}
         for tf_arr in w:
-            hit = next((n for n, arr in unused.items()
-                        if tuple(arr.shape) == tuple(np.shape(tf_arr))),
-                       None)
+            hit = next((n for n, shape in unused.items()
+                        if tuple(shape) == tuple(np.shape(tf_arr))), None)
             if hit is not None:
                 mapped[hit] = np.asarray(tf_arr)
                 del unused[hit]
         if mapped:
-            ff.set_weights(layer.name, {**ours, **mapped})
+            ff.imported_weights[layer.name] = mapped
     return ff
 
 
@@ -101,16 +100,17 @@ def _flat_outputs(layer):
 def _emit_layer(ff, layer, ltype, ins):
     cfgd = layer.get_config()
     if ltype == "Dense":
+        act = cfgd.get("activation")
         t = ff.dense(ins[0], cfgd["units"],
-                     activation=_act(cfgd.get("activation")),
+                     activation=None if act == "softmax" else _act(act),
                      use_bias=cfgd.get("use_bias", True), name=layer.name)
-        if cfgd.get("activation") == "softmax":
+        if act == "softmax":
             t = ff.softmax(t, name=f"{layer.name}_softmax")
         return t
     if ltype == "Conv2D":
         kh, kw = cfgd["kernel_size"]
         sh, sw = cfgd["strides"]
-        pad = (kh // 2, kw // 2) if cfgd["padding"] == "same" else (0, 0)
+        pad = _same_pad(cfgd["padding"], kh, kw, sh, sw, ltype)
         return ff.conv2d(ins[0], cfgd["filters"], kh, kw, sh, sw,
                          pad[0], pad[1],
                          activation=_act(cfgd.get("activation")),
@@ -119,7 +119,7 @@ def _emit_layer(ff, layer, ltype, ins):
     if ltype in ("MaxPooling2D", "AveragePooling2D"):
         kh, kw = cfgd["pool_size"]
         sh, sw = cfgd["strides"] or (kh, kw)
-        pad = (kh // 2, kw // 2) if cfgd.get("padding") == "same" else (0, 0)
+        pad = _same_pad(cfgd.get("padding", "valid"), kh, kw, sh, sw, ltype)
         return ff.pool2d(ins[0], kh, kw, sh, sw, pad[0], pad[1],
                          pool_type="max" if ltype.startswith("Max")
                          else "avg", name=layer.name)
@@ -144,9 +144,27 @@ def _emit_layer(ff, layer, ltype, ins):
     raise NotImplementedError(f"keras_exp: unsupported layer {ltype}")
 
 
+def _same_pad(padding, kh, kw, sh, sw, ltype):
+    """Symmetric padding for TF 'same' — exact only for stride-1 odd
+    kernels; TF pads asymmetrically otherwise, so fail loudly rather
+    than silently shift the windows of an imported trained model."""
+    if padding != "same":
+        return (0, 0)
+    if (sh, sw) != (1, 1) or kh % 2 == 0 or kw % 2 == 0:
+        raise NotImplementedError(
+            f"keras_exp: {ltype} padding='same' with strides {(sh, sw)} "
+            f"kernel {(kh, kw)} needs TF's asymmetric padding, which "
+            "symmetric conv padding cannot represent exactly")
+    return (kh // 2, kw // 2)
+
+
 def _act(name):
-    return name if name in ("relu", "sigmoid", "tanh", "elu", "gelu") \
-        else None
+    if name in (None, "linear"):
+        return None
+    if name in ("relu", "sigmoid", "tanh", "elu", "gelu"):
+        return name
+    # softmax is handled by the Dense caller; anything else fails loudly
+    raise NotImplementedError(f"keras_exp: activation {name!r}")
 
 
 def _apply_act(ff, name, t, lname):

@@ -64,6 +64,9 @@ class FFModel:
         self.executor: Optional[Executor] = None
         self.state: Optional[TrainState] = None
         self.label_tensor: Optional[Tensor] = None
+        # pretrained weights staged by frontends before compile()
+        # (applied after init_state; reference Parameter::set_weights role)
+        self.imported_weights: Dict[str, Dict[str, np.ndarray]] = {}
         self._rng = jax.random.PRNGKey(self.config.seed)
 
     # ---------------- tensors ----------------
@@ -337,6 +340,8 @@ class FFModel:
         self.executor = Executor(self, optimizer, loss_type, metrics,
                                  mesh=self.mesh, strategy=self.strategy)
         self.state = self.executor.init_state(self._next_rng())
+        for op_name, ws in self.imported_weights.items():
+            self.set_weights(op_name, ws)
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)

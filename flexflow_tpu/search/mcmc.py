@@ -104,6 +104,12 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
 
     cands = {op.name: candidate_maps(op, mesh, cfg) for op in model.ops}
 
+    def finish(strategy):
+        """Every return path funnels here so --taskgraph always exports."""
+        if cfg.taskgraph_file:
+            sim.simulate(strategy, dot_path=cfg.taskgraph_file)
+        return strategy
+
     # The native lowering costs one task per op; with fusion on, the
     # Python simulator folds same-strategy chains, so the engines would
     # rank strategies differently — route fused searches to Python.
@@ -117,9 +123,7 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
         found = optimize_native(model, sim, cands, budget, alpha, seed,
                                 verbose=verbose)
         if found is not None:
-            if cfg.taskgraph_file:
-                sim.simulate(found, dot_path=cfg.taskgraph_file)
-            return found
+            return finish(found)
         assert use_native is not True, "native search requested but " \
             "the native library is unavailable"
     _, edges = op_edges(model)
@@ -133,9 +137,7 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
 
     searchable = [op for op in model.ops if len(cands[op.name]) > 1]
     if not searchable:
-        if cfg.taskgraph_file:
-            sim.simulate(best, dot_path=cfg.taskgraph_file)
-        return best
+        return finish(best)
 
     reset_every = max(1, budget // 100)
     for it in range(budget):
@@ -171,8 +173,4 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
 
     if verbose:
         print(f"[search] best estimated step time: {best_cost*1e3:.3f} ms")
-    if cfg.taskgraph_file:
-        # DOT export of the winning strategy's task graph (reference
-        # --taskgraph, simulator.cc:508-556)
-        sim.simulate(best, dot_path=cfg.taskgraph_file)
-    return best
+    return finish(best)

@@ -126,3 +126,16 @@ def test_hlo_cost_extraction(rng):
     c = hlo_cost(ff, {"input": rng.randn(8, 16).astype(np.float32),
                       "label": rng.randint(0, 10, 8).astype(np.int32)})
     assert c.get("flops", 0) > 0
+
+
+def test_imported_weights_applied_at_compile(rng):
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    cfg = FFConfig(); cfg.batch_size = 4
+    ff = FFModel(cfg)
+    x = ff.create_tensor((4, 8), name="input")
+    ff.softmax(ff.dense(x, 3, name="fc"), name="sm")
+    w = rng.randn(8, 3).astype(np.float32)
+    ff.imported_weights["fc"] = {"kernel": w}
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    np.testing.assert_allclose(ff.get_weights("fc")["kernel"], w)
