@@ -1,21 +1,31 @@
-"""Benchmark driver — prints ONE JSON line.
+"""Benchmark driver — prints ONE JSON line on stdout, progress on stderr.
 
 Default (`python bench.py`): the flagship Transformer-encoder training
-step on the real TPU chip — samples/sec/chip and MFU.
+step — samples/sec/chip and MFU vs the 0.30-MFU FlexFlow-V100 baseline
+(BASELINE.md: the reference commits no numbers; its north star is "MFU
+within 10% of FlexFlow's own V100-class results").
+
+Robustness (round-1 postmortem: the axon TPU tunnel's backend init can
+take many minutes or hang, and a single env hiccup zeroed the round's
+perf evidence):
+  - the parent stages attempts in SUBPROCESSES, each with its own
+    timeout: full-size TPU run -> small-preset TPU run -> tiny CPU run,
+    so *some* measured number always lands (rc=0);
+  - each child prints per-phase progress (init/build/compile/steps) to
+    stderr with timestamps;
+  - `--deadline` (or BENCH_DEADLINE_S) bounds the whole ladder.
 
 `python bench.py --model M` benchmarks the other BASELINE.md configs
-(alexnet, inception, dlrm, nmt_lstm) the same way; each prints its own
-single JSON line.
-
-Baseline note (BASELINE.md): the reference repo commits no numbers; its
-north star is "MFU within 10% of FlexFlow's own V100-class results".
-FlexFlow's V100-era transformer training lands around 30% MFU (MLSys'19
-workloads, fp32 cuBLAS); we take mfu_baseline = 0.30 and report
-vs_baseline = our_mfu / 0.30 (>1.0 beats the reference).
+(alexnet, inception, dlrm, nmt_lstm); `--all` sweeps all five and
+writes bench_all.json (the per-round evidence artifact), still printing
+the flagship line last.
 """
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -28,8 +38,23 @@ PEAK_FLOPS = {
     "v5e": 197e12,
     "v5p": 459e12,
     "v4": 275e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,  # v6e device_kind reads "TPU v6 lite"
     "cpu": 1e12,  # nominal, so the script degrades gracefully off-TPU
 }
+
+MODELS = ["transformer", "alexnet", "inception", "dlrm", "nmt_lstm"]
+
+# preset -> per-model shape overrides (batch, plus model-specific dims)
+PRESETS = ("full", "small", "tiny")
+
+
+def log(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+T0 = time.perf_counter()
 
 
 def detect_peak():
@@ -42,7 +67,7 @@ def detect_peak():
     return PEAK_FLOPS["cpu"] if dev.platform == "cpu" else 197e12
 
 
-def build(model: str):
+def build(model: str, preset: str):
     """Returns (ff, batch_data), compiled and ready to train."""
     import jax.numpy as jnp
     from flexflow_tpu import FFConfig, SGDOptimizer
@@ -51,33 +76,39 @@ def build(model: str):
     rng = np.random.RandomState(0)
     cfg = FFConfig()
     if model == "transformer":
-        batch, seq, hidden = 32, 512, 512
+        batch, seq, hidden, layers, ffd = {
+            "full": (32, 512, 512, 6, 2048),
+            "small": (16, 256, 512, 4, 2048),
+            "tiny": (8, 64, 128, 2, 256),
+        }[preset]
         cfg.batch_size = batch
         ff = zoo.build_transformer(cfg, batch_size=batch, seq_len=seq,
-                                   hidden=hidden, num_heads=8, num_layers=6,
-                                   ff_dim=2048, num_classes=10,
-                                   dtype=jnp.bfloat16)
+                                   hidden=hidden, num_heads=8,
+                                   num_layers=layers, ff_dim=ffd,
+                                   num_classes=10, dtype=jnp.bfloat16)
         data = {"input": jnp.asarray(
             rng.randn(batch, seq, hidden), jnp.bfloat16),
             "label": jnp.asarray(rng.randint(0, 10, (batch,)), jnp.int32)}
     elif model == "alexnet":
-        batch = 256
+        batch = {"full": 256, "small": 128, "tiny": 16}[preset]
         cfg.batch_size = batch
         ff = zoo.build_alexnet(cfg, batch_size=batch)
         data = {"input": jnp.asarray(
             rng.randn(batch, 3, 32, 32), jnp.float32),
             "label": jnp.asarray(rng.randint(0, 10, (batch,)), jnp.int32)}
     elif model == "inception":
-        batch = 32
+        batch = {"full": 32, "small": 16, "tiny": 4}[preset]
+        size = {"full": 299, "small": 299, "tiny": 75}[preset]
         cfg.batch_size = batch
-        ff = zoo.build_inception_v3(cfg, batch_size=batch, image_size=299)
+        ff = zoo.build_inception_v3(cfg, batch_size=batch, image_size=size)
         data = {"input": jnp.asarray(
-            rng.randn(batch, 3, 299, 299), jnp.float32),
+            rng.randn(batch, 3, size, size), jnp.float32),
             "label": jnp.asarray(rng.randint(0, 10, (batch,)), jnp.int32)}
     elif model == "dlrm":
-        batch = 1024
+        batch = {"full": 1024, "small": 512, "tiny": 64}[preset]
+        vocab = {"full": 1000000, "small": 100000, "tiny": 1000}[preset]
         cfg.batch_size = batch
-        vocabs = (1000000,) * 8
+        vocabs = (vocab,) * 8
         ff = zoo.build_dlrm(cfg, batch_size=batch,
                             embedding_vocab_sizes=vocabs)
         data = {"dense_features": jnp.asarray(
@@ -88,7 +119,8 @@ def build(model: str):
             data[f"sparse_{i}"] = jnp.asarray(
                 rng.randint(0, vocabs[i], (batch, 1)), jnp.int32)
     elif model == "nmt_lstm":
-        batch, seq = 64, 40
+        batch, seq = {"full": (64, 40), "small": (32, 40),
+                      "tiny": (8, 10)}[preset]
         cfg.batch_size = batch
         ff = zoo.build_nmt_lstm(cfg, batch_size=batch, seq_len=seq)
         data = {"input": jnp.asarray(
@@ -103,15 +135,22 @@ def build(model: str):
     return ff, data
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="transformer",
-                    choices=["transformer", "alexnet", "inception", "dlrm",
-                             "nmt_lstm"])
-    ap.add_argument("--steps", type=int, default=40)
-    args = ap.parse_args()
+def run_child(model: str, preset: str, steps: int) -> int:
+    """Measure in THIS process; print the JSON line. Progress to stderr."""
+    log(f"child start: model={model} preset={preset}")
+    import jax
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # the image's sitecustomize sets jax_platforms="axon,cpu" via
+        # jax.config, which beats the JAX_PLATFORMS env var — override
+        # the same way (tests/conftest.py does identically)
+        jax.config.update("jax_platforms", "cpu")
+    log("initializing backend (jax.devices)...")
+    devs = jax.devices()
+    platform = devs[0].platform
+    log(f"backend up: {devs[0].device_kind} ({platform}) x{len(devs)}")
 
-    ff, batch_data = build(args.model)
+    ff, batch_data = build(model, preset)
+    log("model built + compiled graph-side; warming up (jit compile)...")
     batch = next(iter(batch_data.values())).shape[0]
     fwd_flops = sum(op.flops() for op in ff.ops)
     # Standard MFU accounting: step = fwd + 2x-fwd backward. (The search
@@ -123,28 +162,181 @@ def main():
     # warmup (includes compile). NOTE: through the axon tunnel
     # block_until_ready does not sync; only a device->host transfer does,
     # so we force a scalar fetch to delimit timing regions.
-    for _ in range(3):
+    t_c = time.perf_counter()
+    m = ff.train_batch(batch_data)
+    float(m["loss"])
+    log(f"first step (compile) done in {time.perf_counter() - t_c:.1f}s")
+    for _ in range(2):
         m = ff.train_batch(batch_data)
     float(m["loss"])
+    log(f"warmup done; timing {steps} steps...")
 
     t0 = time.perf_counter()
-    for _ in range(args.steps):
+    for _ in range(steps):
         m = ff.train_batch(batch_data)
     float(m["loss"])  # drains the queued steps
-    dt = (time.perf_counter() - t0) / args.steps
+    dt = (time.perf_counter() - t0) / steps
+    log(f"steps done: {dt * 1e3:.2f} ms/step")
 
     samples_per_sec = batch / dt
     achieved = step_flops / dt
     mfu = achieved / detect_peak()
+    suffix = "" if platform != "cpu" else "_cpu_fallback"
+    metric = (f"{model}_train_samples_per_sec_per_chip"
+              if model != "transformer"
+              else "transformer_encoder_train_samples_per_sec_per_chip")
     print(json.dumps({
-        "metric": f"{args.model}_train_samples_per_sec_per_chip"
-        if args.model != "transformer"
-        else "transformer_encoder_train_samples_per_sec_per_chip",
+        "metric": metric + suffix,
         "value": round(samples_per_sec, 2),
         "unit": "samples/s",
         "vs_baseline": round(mfu / MFU_BASELINE, 4),
-    }))
+        "extra": {"mfu": round(mfu, 4), "ms_per_step": round(dt * 1e3, 3),
+                  "preset": preset, "platform": platform,
+                  "batch": batch, "steps": steps},
+    }), flush=True)
+    return 0
+
+
+def try_child(model, preset, steps, timeout, force_cpu=False):
+    """Run one attempt in a subprocess; returns parsed JSON dict or None."""
+    env = dict(os.environ)
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_FORCE_CPU"] = "1"
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--model", model, "--preset", preset, "--steps", str(steps)]
+    log(f"attempt: preset={preset} cpu={force_cpu} timeout={timeout:.0f}s")
+    try:
+        r = subprocess.run(cmd, env=env, timeout=timeout,
+                           stdout=subprocess.PIPE, stderr=sys.stderr)
+    except subprocess.TimeoutExpired:
+        log(f"attempt timed out after {timeout:.0f}s")
+        return None
+    if r.returncode != 0:
+        log(f"attempt failed rc={r.returncode}")
+        return None
+    for line in reversed(r.stdout.decode().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    log("attempt produced no JSON line")
+    return None
+
+
+_tpu_probe_result = None
+
+
+def probe_tpu(timeout=120):
+    """Can the ambient (axon/TPU) backend come up at all? Cached across
+    models in an --all sweep. A dead relay hangs jax.devices() forever,
+    so this is a subprocess with a hard timeout."""
+    global _tpu_probe_result
+    if _tpu_probe_result is not None:
+        return _tpu_probe_result
+    log(f"probing TPU backend (timeout {timeout}s)...")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices()[0]; "
+             "print(d.platform, d.device_kind)"],
+            timeout=timeout, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        out = r.stdout.decode().strip()
+        # the sitecustomize registers platforms "axon,cpu" — a fast axon
+        # failure still exits 0 on the CPU fallback, so check the
+        # platform actually resolved, not just the return code
+        _tpu_probe_result = (r.returncode == 0 and bool(out)
+                             and not out.startswith("cpu"))
+        if _tpu_probe_result:
+            log(f"TPU backend OK: {out}")
+        else:
+            log(f"TPU backend init failed (got: {out or 'no output'})")
+    except subprocess.TimeoutExpired:
+        log("TPU backend init timed out (relay down or lease stuck)")
+        _tpu_probe_result = False
+    return _tpu_probe_result
+
+
+def run_ladder(model, steps, deadline_at, allow_cpu_fallback=True):
+    """probe -> TPU full (retry) -> TPU small -> CPU tiny; never returns
+    empty-handed while the CPU fallback can run. Returns dict|None."""
+    remaining = lambda: deadline_at - time.perf_counter()  # noqa: E731
+    # reserve time for the guaranteed CPU fallback
+    reserve = 150 if allow_cpu_fallback else 0
+    if probe_tpu(min(120, max(30, remaining() - reserve))):
+        # backend comes up: give full-size runs real budgets, retry once
+        # (transient tunnel hiccups), then degrade to the small preset
+        attempts = [("full", 420), ("full", 420), ("small", 300)]
+    else:
+        # backend didn't come up in the probe window: one hail-mary full
+        # attempt (init may just be slow), then straight to CPU
+        attempts = [("full", 300)]
+    for preset, cap in attempts:
+        budget = remaining() - reserve
+        if budget < 60:
+            break
+        res = try_child(model, preset, steps, min(cap, budget), False)
+        if res:
+            return res
+    if allow_cpu_fallback and remaining() > 30:
+        res = try_child(model, "tiny", max(5, steps // 4),
+                        remaining(), force_cpu=True)
+        if res:
+            return res
+    return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="transformer", choices=MODELS)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--preset", default="full", choices=PRESETS)
+    ap.add_argument("--child", action="store_true",
+                    help="internal: measure in-process, no retry ladder")
+    ap.add_argument("--all", action="store_true",
+                    help="sweep all five BASELINE.md configs; write "
+                         "bench_all.json; print the flagship line last")
+    ap.add_argument("--deadline", type=float,
+                    default=float(os.environ.get("BENCH_DEADLINE_S", 900)))
+    args = ap.parse_args()
+
+    if args.child:
+        return run_child(args.model, args.preset, args.steps)
+
+    deadline_at = time.perf_counter() + args.deadline
+    if args.all:
+        results = {}
+        others = [m for m in MODELS if m != "transformer"]
+        # flagship last so it gets whatever time remains guaranteed; each
+        # other model needs at least reserve(150) + one real attempt, so
+        # floor the slot at 400s — a short --deadline stretches rather
+        # than silently demoting every model to the CPU fallback
+        per = max(400.0, (args.deadline - 300) / len(others))
+        for m in others:
+            results[m] = run_ladder(m, args.steps,
+                                    time.perf_counter() + per)
+        results["transformer"] = run_ladder("transformer", args.steps,
+                                            deadline_at)
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "bench_all.json"), "w") as f:
+            json.dump(results, f, indent=2)
+        log(f"sweep done: { {k: bool(v) for k, v in results.items()} }")
+        flag = results["transformer"]
+        if flag:
+            print(json.dumps(flag), flush=True)
+            return 0
+        return 1
+
+    res = run_ladder(args.model, args.steps, deadline_at)
+    if res:
+        print(json.dumps(res), flush=True)
+        return 0
+    log("all attempts failed")
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

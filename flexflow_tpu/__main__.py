@@ -35,6 +35,14 @@ import sys
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
 
+    if argv and argv[0] in ("--help", "-h"):
+        print("usage: flexflow-tpu [--cpu-devices N] "
+              "[--coordinator HOST:PORT --num-processes N --process-id I] "
+              "(SCRIPT [ARGS...] | -c CODE | <no args for REPL>)\n\n"
+              "Runs a user script under the flexflow_tpu runtime "
+              "(reference: flexflow_python / python/flexflow.py launcher).")
+        return 0
+
     cpu_devices = None
     code = None
     coordinator = num_processes = process_id = None
@@ -59,6 +67,15 @@ def main(argv=None) -> int:
             break
 
     if coordinator is not None:
+        # outside auto-detecting cluster environments (GKE/SLURM), JAX
+        # cannot infer these; fail with a launcher error, not a deep
+        # jax.distributed traceback (reference launcher python/flexflow.py
+        # derives ranks from mpirun for the same reason)
+        if num_processes is None or process_id is None:
+            print("flexflow_tpu: --coordinator requires --num-processes "
+                  "and --process-id (they are only auto-detected inside "
+                  "cluster environments like SLURM/GKE)", file=sys.stderr)
+            return 2
         import jax
         jax.distributed.initialize(coordinator_address=coordinator,
                                    num_processes=num_processes,

@@ -139,7 +139,8 @@ class reuters:
 
     @staticmethod
     def load_data(num_words: int = None, maxlen: int = None,
-                  test_split: float = 0.2, seed: int = 113) -> Arrays:
+                  test_split: float = 0.2, seed: int = 113,
+                  skip_top: int = 0, oov_char: int = 2) -> Arrays:
         p = _find("reuters.npz")
         if p:
             with np.load(p, allow_pickle=True) as f:
@@ -150,9 +151,18 @@ class reuters:
             if maxlen:  # Keras semantics: drop sequences longer than maxlen
                 keep = [i for i, x in enumerate(xs) if len(x) <= maxlen]
                 xs, labels = xs[keep], labels[keep]
-            if num_words:
-                xs = np.array([[w for w in x if w < num_words]
-                               for x in xs], dtype=object)
+            if num_words or skip_top:
+                # Keras/reference semantics (reference reuters.py:79-80):
+                # words outside [skip_top, num_words) become oov_char so
+                # sequence lengths are preserved (oov_char=None drops them)
+                hi = num_words or np.inf
+                if oov_char is None:
+                    xs = np.array([[w for w in x if skip_top <= w < hi]
+                                   for x in xs], dtype=object)
+                else:
+                    xs = np.array([[w if skip_top <= w < hi else oov_char
+                                    for w in x]
+                                   for x in xs], dtype=object)
             split = int(len(xs) * (1 - test_split))
             return ((xs[:split], labels[:split]),
                     (xs[split:], labels[split:]))

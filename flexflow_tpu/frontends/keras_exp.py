@@ -4,16 +4,29 @@ Reference: python/flexflow/keras_exp/models/model.py:36-424 — walks a
 genuine tf.keras model object (rather than this package's Keras-clone
 layer classes) and replays it onto the framework's builder API.
 
-TensorFlow is not part of this image (zero egress), so the module is
-import-gated: `HAS_TF` is False and `from_tf_keras` raises a clear
-ImportError without TF. With TF present, supported layers mirror the
-reference's handler set (Conv2D/Pooling/Dense/Flatten/Dropout/
-BatchNormalization/Activation/Concatenate/Add/Embedding).
+TensorFlow is not part of this image (zero egress), but the importer
+never needs the ``tensorflow`` module itself: every access goes through
+the *model object's* own protocol (``.inputs``, ``.layers``,
+``layer.get_config()``, ``layer.get_weights()``), so any object that
+duck-types tf.keras works — which is also how the handler table is
+exercised in tests without TF (tests/test_frontends.py). `HAS_TF`
+reports whether real TF is importable for callers that want to build
+models here.
+
+Weight import is an explicit per-layer-type mapping (NOT shape
+matching): tf Conv2D kernels are HWIO and are transposed to this
+framework's OIHW (ops/conv.py weight_specs); Dense kernels are (in,out)
+on both sides; BatchNormalization's [gamma, beta, moving_mean,
+moving_variance] map positionally to scale/bias params and
+running_mean/running_var *state*. Any tf array that fails to map
+raises — same fail-loudly policy as _same_pad/_act.
 """
 
 from __future__ import annotations
 
 from typing import Optional
+
+import numpy as np
 
 try:
     import tensorflow as _tf  # noqa: F401
@@ -25,20 +38,12 @@ except Exception:  # pragma: no cover - image ships without TF
 
 def from_tf_keras(tf_model, config=None, batch_size: Optional[int] = None,
                   mesh=None, strategy=None):
-    """Replay a tf.keras Model onto an FFModel; returns the FFModel.
+    """Replay a tf.keras Model (or duck-typed equivalent) onto an
+    FFModel; returns the FFModel.
 
     Layer coverage follows the reference keras_exp handler set; raises
     NotImplementedError on anything else so failures are explicit.
     """
-    if not HAS_TF:
-        raise ImportError(
-            "flexflow_tpu.frontends.keras_exp requires tensorflow, which "
-            "is not installed in this environment; use "
-            "flexflow_tpu.frontends.keras (native clone) or "
-            "frontends.onnx/torchfx instead")
-
-    import numpy as np
-
     from ..config import FFConfig
     from ..model import FFModel
 
@@ -67,24 +72,77 @@ def from_tf_keras(tf_model, config=None, batch_size: Optional[int] = None,
     ops_by_name = {op.name: op for op in ff.ops}
     for layer in tf_model.layers:
         w = layer.get_weights()
-        op = ops_by_name.get(layer.name)
-        if not w or op is None:
+        if not w:
             continue
-        # pair each tf array with an unused same-shape framework weight
-        # (tf.keras get_weights() order is [kernel, bias, ...]; our dict
-        # order is arbitrary, so match by shape, not position)
-        specs = op.weight_specs()
-        mapped = {}
-        unused = {n: s.shape for n, s in specs.items()}
-        for tf_arr in w:
-            hit = next((n for n, shape in unused.items()
-                        if tuple(shape) == tuple(np.shape(tf_arr))), None)
-            if hit is not None:
-                mapped[hit] = np.asarray(tf_arr)
-                del unused[hit]
-        if mapped:
-            ff.imported_weights[layer.name] = mapped
+        op = ops_by_name.get(layer.name)
+        if op is None:
+            raise ValueError(
+                f"keras_exp: layer {layer.name!r} has weights but no "
+                f"emitted op of that name — import bug")
+        params, states = _map_layer_weights(type(layer).__name__, layer, w, op)
+        if params:
+            ff.imported_weights[layer.name] = params
+        if states:
+            ff.imported_states[layer.name] = states
     return ff
+
+
+def _map_layer_weights(ltype, layer, w, op):
+    """Explicit tf->framework weight mapping per layer type. Returns
+    (params, states) dicts; raises on any array that cannot map."""
+    specs = op.weight_specs()
+    params, states = {}, {}
+
+    def take(name, arr, transpose=None):
+        if transpose is not None:
+            arr = np.transpose(arr, transpose)
+        spec = specs.get(name)
+        if spec is None or tuple(spec.shape) != tuple(np.shape(arr)):
+            raise ValueError(
+                f"keras_exp: {layer.name} ({ltype}) weight {name!r} "
+                f"shape {np.shape(arr)} does not match framework spec "
+                f"{tuple(spec.shape) if spec else None}")
+        params[name] = np.asarray(arr)
+
+    if ltype == "Dense":
+        # tf kernel (in, out) == framework Linear kernel (in, out)
+        take("kernel", w[0])
+        if len(w) > 1:
+            take("bias", w[1])
+    elif ltype == "Conv2D":
+        # tf HWIO -> framework OIHW (ops/conv.py weight_specs)
+        take("kernel", w[0], transpose=(3, 2, 0, 1))
+        if len(w) > 1:
+            take("bias", w[1])
+    elif ltype == "Embedding":
+        # tf embeddings (vocab, dim) == framework kernel (vocab, dim)
+        take("kernel", w[0])
+    elif ltype == "BatchNormalization":
+        cfgd = layer.get_config()
+        if not (cfgd.get("scale", True) and cfgd.get("center", True)):
+            raise NotImplementedError(
+                "keras_exp: BatchNormalization with scale=False or "
+                "center=False changes get_weights() order")
+        if len(w) != 4:
+            raise ValueError(
+                f"keras_exp: BatchNormalization {layer.name} expected 4 "
+                f"arrays [gamma, beta, moving_mean, moving_variance], "
+                f"got {len(w)}")
+        gamma, beta, mmean, mvar = w
+        take("scale", gamma)
+        take("bias", beta)
+        sspecs = op.state_specs()
+        for name, arr in (("running_mean", mmean), ("running_var", mvar)):
+            if tuple(sspecs[name].shape) != tuple(np.shape(arr)):
+                raise ValueError(
+                    f"keras_exp: BN {layer.name} state {name} shape "
+                    f"{np.shape(arr)} != {tuple(sspecs[name].shape)}")
+            states[name] = np.asarray(arr)
+    else:
+        raise NotImplementedError(
+            f"keras_exp: layer {ltype} ({layer.name}) has weights but no "
+            f"weight-import mapping")
+    return params, states
 
 
 def _flat_inputs(layer):

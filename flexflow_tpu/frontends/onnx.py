@@ -5,13 +5,18 @@ input_dict)` with per-node handlers (Conv, Gemm->dense, MaxPool/
 AveragePool, BatchNormalization, Concat, Split, Flatten, Relu, Softmax,
 Reshape, Add/Sub/Mul, Dropout; onnx/model.py:74-340).
 
-Gated on the `onnx` package (not in this image's environment); the
-handler table is complete so it activates wherever onnx is installed.
+The handler table operates on a neutral node form (`GraphNode`:
+op_type/input/output/name + plain-dict attrs), so it is fully
+executable without the `onnx` package: `ONNXModel.from_graph(nodes,
+initializers)` builds one directly (used by tests and any frontend
+that can produce the node list). Loading a real `.onnx` file/proto
+still requires `onnx` and is gated per-call.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence
 
 import numpy as np
 
@@ -21,6 +26,16 @@ try:
     HAS_ONNX = True
 except ImportError:  # pragma: no cover - onnx absent in CI image
     HAS_ONNX = False
+
+
+@dataclass
+class GraphNode:
+    """Neutral ONNX node: what the handlers consume."""
+    op_type: str
+    input: List[str]
+    output: List[str]
+    name: str = ""
+    attrs: Dict = field(default_factory=dict)
 
 
 def _sym_pads(attrs, node):
@@ -35,39 +50,58 @@ def _sym_pads(attrs, node):
     return pads
 
 
+def _proto_attrs(node) -> Dict:
+    out = {}
+    for a in node.attribute:
+        if a.type == onnx.AttributeProto.INT:
+            out[a.name] = a.i
+        elif a.type == onnx.AttributeProto.INTS:
+            out[a.name] = list(a.ints)
+        elif a.type == onnx.AttributeProto.FLOAT:
+            out[a.name] = a.f
+        elif a.type == onnx.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+    return out
+
+
 class ONNXModel:
     def __init__(self, path_or_model):
         if not HAS_ONNX:
             raise ImportError(
-                "the `onnx` package is required for the ONNX importer; "
-                "pip install onnx")
-        self.model = (onnx.load(path_or_model)
-                      if isinstance(path_or_model, str) else path_or_model)
+                "the `onnx` package is required to load .onnx files; "
+                "pip install onnx (or build the graph with "
+                "ONNXModel.from_graph)")
+        model = (onnx.load(path_or_model)
+                 if isinstance(path_or_model, str) else path_or_model)
         self.inits = {t.name: numpy_helper.to_array(t)
-                      for t in self.model.graph.initializer}
+                      for t in model.graph.initializer}
+        self.nodes = [GraphNode(n.op_type, list(n.input), list(n.output),
+                                n.name, _proto_attrs(n))
+                      for n in model.graph.node]
 
-    @staticmethod
-    def _attrs(node) -> Dict:
-        out = {}
-        for a in node.attribute:
-            if a.type == onnx.AttributeProto.INT:
-                out[a.name] = a.i
-            elif a.type == onnx.AttributeProto.INTS:
-                out[a.name] = list(a.ints)
-            elif a.type == onnx.AttributeProto.FLOAT:
-                out[a.name] = a.f
-            elif a.type == onnx.AttributeProto.STRING:
-                out[a.name] = a.s.decode()
-        return out
+    @classmethod
+    def from_graph(cls, nodes: Sequence[GraphNode],
+                   initializers: Dict[str, np.ndarray]) -> "ONNXModel":
+        """Build from pre-parsed nodes — no `onnx` dependency."""
+        self = cls.__new__(cls)
+        self.inits = dict(initializers)
+        self.nodes = list(nodes)
+        return self
 
     def apply(self, ffmodel, input_dict: Dict[str, "Tensor"]):
         """Emit the graph onto ffmodel; input_dict maps ONNX graph input
-        names to framework tensors. Returns the output tensor."""
+        names to framework tensors. Returns the output tensor.
+
+        Trained initializer weights are staged on
+        `ffmodel.imported_weights`/`imported_states` (applied by
+        compile()); call `import_weights(ffmodel)` instead when the
+        model is already compiled."""
         values = dict(input_dict)
         pending_weights: Dict[str, Dict[str, np.ndarray]] = {}
+        pending_states: Dict[str, Dict[str, np.ndarray]] = {}
         out = None
-        for node in self.model.graph.node:
-            a = self._attrs(node)
+        for node in self.nodes:
+            a = node.attrs
             ins = node.input
             name = node.name or node.output[0]
             if node.op_type == "Conv":
@@ -80,6 +114,7 @@ class ONNXModel:
                                    sw, pads[0], pads[1],
                                    groups=a.get("group", 1),
                                    use_bias=bias is not None, name=name)
+                # ONNX Conv weight layout is OIHW == framework layout
                 pending_weights[name] = {"kernel": w} | (
                     {"bias": bias} if bias is not None else {})
             elif node.op_type == "Gemm":
@@ -118,6 +153,11 @@ class ONNXModel:
                                        name=name)
                 pending_weights[name] = {"scale": self.inits[ins[1]],
                                          "bias": self.inits[ins[2]]}
+                # inputs 3/4 = input_mean, input_var -> running stats
+                if len(ins) > 4:
+                    pending_states[name] = {
+                        "running_mean": self.inits[ins[3]],
+                        "running_var": self.inits[ins[4]]}
             elif node.op_type == "Concat":
                 t = ffmodel.concat([values[i] for i in ins],
                                    axis=a.get("axis", 1), name=name)
@@ -163,9 +203,21 @@ class ONNXModel:
             values[node.output[0]] = t
             out = t
         self.pending_weights = pending_weights
+        self.pending_states = pending_states
+        # stage for compile(); harmless if import_weights is called instead
+        ffmodel.imported_weights.update(
+            {k: {n: np.asarray(v) for n, v in w.items()}
+             for k, w in pending_weights.items()})
+        ffmodel.imported_states.update(
+            {k: {n: np.asarray(v) for n, v in s.items()}
+             for k, s in pending_states.items()})
         return out
 
     def import_weights(self, ffmodel) -> None:
+        """Apply pending weights to an already-compiled model."""
         for name, w in self.pending_weights.items():
             ffmodel.set_weights(name, {k: np.asarray(v)
                                        for k, v in w.items()})
+        for name, s in self.pending_states.items():
+            ffmodel.set_states(name, {k: np.asarray(v)
+                                      for k, v in s.items()})

@@ -67,6 +67,8 @@ class FFModel:
         # pretrained weights staged by frontends before compile()
         # (applied after init_state; reference Parameter::set_weights role)
         self.imported_weights: Dict[str, Dict[str, np.ndarray]] = {}
+        # non-trainable state staged the same way (BN running stats)
+        self.imported_states: Dict[str, Dict[str, np.ndarray]] = {}
         self._rng = jax.random.PRNGKey(self.config.seed)
 
     # ---------------- tensors ----------------
@@ -342,6 +344,8 @@ class FFModel:
         self.state = self.executor.init_state(self._next_rng())
         for op_name, ws in self.imported_weights.items():
             self.set_weights(op_name, ws)
+        for op_name, ss in self.imported_states.items():
+            self.set_states(op_name, ss)
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -395,7 +399,12 @@ class FFModel:
                 batch["label"] = y[sel]
                 m = self.train_batch(batch)
                 epoch_metrics.append(m)
-            # fold metrics on host (reference: UPDATE_METRICS future fold)
+            # fold metrics on host (reference: UPDATE_METRICS future fold).
+            # One bulk device->host transfer for the whole epoch — per-scalar
+            # float(v) would issue steps*keys tiny transfers (ruinous through
+            # a TPU tunnel); reference folds through futures for the same
+            # reason (model.cc:2084-2108).
+            epoch_metrics = jax.device_get(epoch_metrics)
             agg = {}
             for m in epoch_metrics:
                 for k, v in m.items():
@@ -426,6 +435,7 @@ class FFModel:
             sharded = self.executor.shard_batch(batch)
             _, m = self.executor.eval_step(self.state, sharded)
             step_metrics.append(m)  # device scalars; convert once at end
+        step_metrics = jax.device_get(step_metrics)  # one bulk transfer
         agg: Dict[str, float] = {}
         for m in step_metrics:
             for k, v in m.items():
@@ -451,6 +461,15 @@ class FFModel:
     def set_weights(self, op_name: str, weights: Dict[str, np.ndarray]):
         cur = self.state.params[op_name]
         for k, v in weights.items():
+            assert cur[k].shape == v.shape, (op_name, k, cur[k].shape, v.shape)
+            cur[k] = jnp.asarray(v, cur[k].dtype)
+
+    def set_states(self, op_name: str, states: Dict[str, np.ndarray]):
+        """Host set of non-trainable op state (e.g. BN running stats) —
+        same role as set_weights for the reference's non-Parameter
+        regions."""
+        cur = self.state.states[op_name]
+        for k, v in states.items():
             assert cur[k].shape == v.shape, (op_name, k, cur[k].shape, v.shape)
             cur[k] = jnp.asarray(v, cur[k].dtype)
 

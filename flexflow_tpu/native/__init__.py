@@ -18,9 +18,10 @@ import sys
 import threading
 from typing import Optional
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-_CSRC = os.path.join(_REPO_ROOT, "csrc")
+# csrc/ lives inside the package (shipped as package-data in the wheel,
+# pyproject.toml), so installed copies can build the native runtime too
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CSRC = os.path.join(_PKG_ROOT, "csrc")
 _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
 _LIB_PATH = os.path.join(_BUILD_DIR, "libflexflow_tpu_native.so")
 

@@ -1,0 +1,272 @@
+"""Executable frontend-handler tests (VERDICT round-1 item 7).
+
+The reference CI runs tests/onnx/test_onnx_import.py against real onnx;
+this image has neither onnx nor tensorflow, so these tests drive the
+SAME handler tables through their dependency-free entry points:
+
+- ONNX: `ONNXModel.from_graph` with hand-built `GraphNode`s — a
+  conv/pool/gemm/concat/BN graph imports, matches a torch forward with
+  identical weights, and trains.
+- keras_exp: `from_tf_keras` on duck-typed stand-ins for tf.keras model
+  and layer objects (the importer only uses the object protocol), which
+  proves the HWIO->OIHW conv transpose, BN gamma/beta/mean/var staging,
+  and the fail-loudly paths.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.frontends.onnx import GraphNode, ONNXModel
+from flexflow_tpu.frontends.keras_exp import from_tf_keras
+
+
+# --------------------------------------------------------------------------
+# ONNX handler table (no onnx package)
+# --------------------------------------------------------------------------
+
+class TorchRef(nn.Module):
+    """conv -> relu -> maxpool -> BN -> flatten -> gemm, mirroring the
+    ONNX graph below."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2d(3, 8, 3, padding=1)
+        self.pool = nn.MaxPool2d(2, 2)
+        self.bn = nn.BatchNorm2d(8).eval()
+        self.fc = nn.Linear(8 * 8 * 8, 4)
+
+    def forward(self, x):
+        x = self.pool(torch.relu(self.conv(x)))
+        x = self.bn(x)
+        return self.fc(torch.flatten(x, 1))
+
+
+def _onnx_graph_from_torch(tm: TorchRef):
+    """Hand-build the GraphNode list + initializers for TorchRef."""
+    sd = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+    nodes = [
+        GraphNode("Conv", ["x", "conv_w", "conv_b"], ["c1"], "conv",
+                  {"kernel_shape": [3, 3], "strides": [1, 1],
+                   "pads": [1, 1, 1, 1]}),
+        GraphNode("Relu", ["c1"], ["r1"], "relu1"),
+        GraphNode("MaxPool", ["r1"], ["p1"], "pool",
+                  {"kernel_shape": [2, 2], "strides": [2, 2]}),
+        GraphNode("BatchNormalization",
+                  ["p1", "bn_scale", "bn_bias", "bn_mean", "bn_var"],
+                  ["b1"], "bn"),
+        GraphNode("Flatten", ["b1"], ["f1"], "flatten"),
+        GraphNode("Gemm", ["f1", "fc_w", "fc_b"], ["out"], "fc",
+                  {"transB": 1}),
+    ]
+    inits = {
+        "conv_w": sd["conv.weight"],          # OIHW, framework layout
+        "conv_b": sd["conv.bias"],
+        "bn_scale": sd["bn.weight"],
+        "bn_bias": sd["bn.bias"],
+        "bn_mean": sd["bn.running_mean"],
+        "bn_var": sd["bn.running_var"],
+        "fc_w": sd["fc.weight"],              # (out, in), transB=1
+        "fc_b": sd["fc.bias"],
+    }
+    return nodes, inits
+
+
+def test_onnx_graph_matches_torch_and_trains():
+    torch.manual_seed(0)
+    tm = TorchRef().eval()
+    # give BN non-trivial running stats
+    with torch.no_grad():
+        tm.bn.running_mean.uniform_(-0.5, 0.5)
+        tm.bn.running_var.uniform_(0.5, 1.5)
+    nodes, inits = _onnx_graph_from_torch(tm)
+    om = ONNXModel.from_graph(nodes, inits)
+
+    cfg = FFConfig()
+    cfg.batch_size = 4
+    ff = FFModel(cfg)
+    x = ff.create_tensor((4, 3, 16, 16), name="x")
+    out = om.apply(ff, {"x": x})
+    assert out.shape == (4, 4)
+    ff.softmax(out)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 3, 16, 16).astype(np.float32)
+    values, _ = ff.executor.forward_values(
+        ff.state.params, ff.state.states, {"x": xv}, False, None)
+    got = np.asarray(values[out.uid])
+    want = tm(torch.from_numpy(xv)).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=2e-3)
+
+    # and it trains
+    m = ff.train_batch({"x": xv,
+                        "label": rng.randint(0, 4, (4,)).astype(np.int32)})
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_onnx_concat_split_elementwise_handlers():
+    nodes = [
+        GraphNode("Split", ["x"], ["s0", "s1"], "split", {"axis": 1}),
+        GraphNode("Relu", ["s0"], ["r0"], "relu0"),
+        GraphNode("Tanh", ["s1"], ["t1"], "tanh1"),
+        GraphNode("Concat", ["r0", "t1"], ["cat"], "cat", {"axis": 1}),
+        GraphNode("Add", ["cat", "x"], ["add"], "add"),
+        GraphNode("Softmax", ["add"], ["sm"], "sm"),
+    ]
+    om = ONNXModel.from_graph(nodes, {})
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    ff = FFModel(cfg)
+    x = ff.create_tensor((2, 8), name="x")
+    out = om.apply(ff, {"x": x})
+    ff.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
+    xv = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+    values, _ = ff.executor.forward_values(
+        ff.state.params, ff.state.states, {"x": xv}, False, None)
+    got = np.asarray(values[out.uid])
+    want = np.concatenate([np.maximum(xv[:, :4], 0),
+                           np.tanh(xv[:, 4:])], axis=1) + xv
+    want = np.exp(want - want.max(-1, keepdims=True))
+    want /= want.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_onnx_asymmetric_pad_rejected():
+    nodes = [GraphNode("Conv", ["x", "w"], ["y"], "conv",
+                       {"kernel_shape": [2, 2], "strides": [1, 1],
+                        "pads": [0, 0, 1, 1]})]
+    om = ONNXModel.from_graph(
+        nodes, {"w": np.zeros((4, 3, 2, 2), np.float32)})
+    ff = FFModel(FFConfig())
+    x = ff.create_tensor((2, 3, 8, 8), name="x")
+    with pytest.raises(NotImplementedError, match="asymmetric"):
+        om.apply(ff, {"x": x})
+
+
+# --------------------------------------------------------------------------
+# keras_exp handler table (no tensorflow package) — duck-typed tf.keras
+# --------------------------------------------------------------------------
+
+class FakeTensor:
+    def __init__(self, name, shape):
+        self.name = name
+        self.shape = shape  # tf convention: (None, ...features)
+
+    def ref(self):
+        return id(self)
+
+
+class _FakeLayer:
+    def __init__(self, name, cfg, weights, inputs, output):
+        self.name = name
+        self._cfg = cfg
+        self._weights = weights
+        self.input = inputs if len(inputs) > 1 else inputs[0]
+        self.output = output
+
+    def get_config(self):
+        return dict(self._cfg)
+
+    def get_weights(self):
+        return list(self._weights)
+
+
+# handler dispatch is on type(layer).__name__, so mint one class per type
+def _layer_cls(tname):
+    return type(tname, (_FakeLayer,), {})
+
+
+class FakeKerasModel:
+    def __init__(self, inputs, layers):
+        self.inputs = inputs
+        self.layers = layers
+
+
+def _build_fake_tf_cnn(torch_cnn):
+    """Duck-typed tf.keras model mirroring conv->relu->pool->bn->flatten
+    ->dense, with tf-layout weights taken from the torch module."""
+    sd = {k: v.detach().numpy() for k, v in torch_cnn.state_dict().items()}
+    inp = FakeTensor("input", (None, 3, 16, 16))
+    c1 = FakeTensor("conv_out", (None, 8, 16, 16))
+    p1 = FakeTensor("pool_out", (None, 8, 8, 8))
+    b1 = FakeTensor("bn_out", (None, 8, 8, 8))
+    f1 = FakeTensor("flat_out", (None, 512))
+    d1 = FakeTensor("dense_out", (None, 4))
+    conv_hwio = np.transpose(sd["conv.weight"], (2, 3, 1, 0))  # OIHW->HWIO
+    layers = [
+        _layer_cls("Conv2D")(
+            "conv", {"filters": 8, "kernel_size": (3, 3),
+                     "strides": (1, 1), "padding": "same",
+                     "activation": "relu", "use_bias": True},
+            [conv_hwio, sd["conv.bias"]], [inp], c1),
+        _layer_cls("MaxPooling2D")(
+            "pool", {"pool_size": (2, 2), "strides": (2, 2),
+                     "padding": "valid"}, [], [c1], p1),
+        _layer_cls("BatchNormalization")(
+            "bn", {"scale": True, "center": True},
+            [sd["bn.weight"], sd["bn.bias"], sd["bn.running_mean"],
+             sd["bn.running_var"]], [p1], b1),
+        _layer_cls("Flatten")("flatten", {}, [], [b1], f1),
+        _layer_cls("Dense")(
+            "fc", {"units": 4, "activation": "linear", "use_bias": True},
+            [sd["fc.weight"].T, sd["fc.bias"]], [f1], d1),
+    ]
+    return FakeKerasModel([inp], layers), d1
+
+
+def test_keras_exp_imports_tf_layouts_and_matches_torch():
+    torch.manual_seed(1)
+    tm = TorchRef().eval()
+    with torch.no_grad():
+        tm.bn.running_mean.uniform_(-0.5, 0.5)
+        tm.bn.running_var.uniform_(0.5, 1.5)
+    fake, _out = _build_fake_tf_cnn(tm)
+
+    cfg = FFConfig()
+    cfg.batch_size = 4
+    ff = from_tf_keras(fake, config=cfg, batch_size=4)
+    # conv kernel must be staged back in OIHW
+    assert ff.imported_weights["conv"]["kernel"].shape == (8, 3, 3, 3)
+    np.testing.assert_allclose(ff.imported_weights["conv"]["kernel"],
+                               tm.conv.weight.detach().numpy())
+    # BN running stats staged as state, not silently dropped
+    np.testing.assert_allclose(ff.imported_states["bn"]["running_mean"],
+                               tm.bn.running_mean.numpy())
+    ff.softmax(ff.ops[-1].outputs[0])
+    ff.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 3, 16, 16).astype(np.float32)
+    dense_out = ff.ops[-2].outputs[0]  # pre-softmax logits
+    values, _ = ff.executor.forward_values(
+        ff.state.params, ff.state.states, {"input": xv}, False, None)
+    got = np.asarray(values[dense_out.uid])
+    want = tm(torch.from_numpy(xv)).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=2e-3)
+
+
+def test_keras_exp_unmappable_weight_raises():
+    inp = FakeTensor("input", (None, 8))
+    out = FakeTensor("dense_out", (None, 4))
+    bad = _layer_cls("Dense")(
+        "fc", {"units": 4, "activation": "linear", "use_bias": True},
+        [np.zeros((9, 4), np.float32)], [inp], out)  # wrong in_dim
+    fake = FakeKerasModel([inp], [bad])
+    with pytest.raises(ValueError, match="does not match"):
+        from_tf_keras(fake, batch_size=2)
+
+
+def test_keras_exp_same_pad_stride_fails_loudly():
+    inp = FakeTensor("input", (None, 3, 16, 16))
+    out = FakeTensor("conv_out", (None, 8, 8, 8))
+    conv = _layer_cls("Conv2D")(
+        "conv", {"filters": 8, "kernel_size": (3, 3), "strides": (2, 2),
+                 "padding": "same", "activation": None, "use_bias": False},
+        [], [inp], out)
+    fake = FakeKerasModel([inp], [conv])
+    with pytest.raises(NotImplementedError, match="asymmetric"):
+        from_tf_keras(fake, batch_size=2)
