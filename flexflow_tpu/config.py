@@ -97,6 +97,11 @@ class FFConfig:
     enable_expert_parallel: bool = False
     enable_pipeline_parallel: bool = False
     enable_propagation: bool = False
+    # search the mesh factorization (parallel DEGREE) too: 8 devices ->
+    # dp8 vs dp4xtp2 vs dp2xtp4 ... (the reference samples ND part counts
+    # in get_random_parallel_config, model.cc:512; here the degree comes
+    # from the mesh, so the search enumerates mesh shapes).
+    search_mesh_shapes: bool = False
     machine_model_file: Optional[str] = None
     # DOT export of the simulated task graph (reference --taskgraph,
     # simulator.cc:508-556); written by the first simulate() of a search.
@@ -169,6 +174,7 @@ class FFConfig:
         "--enable-expert-parallel": "enable_expert_parallel",
         "--enable-pipeline-parallel": "enable_pipeline_parallel",
         "--enable-propagation": "enable_propagation",
+        "--search-mesh-shapes": "search_mesh_shapes",
         "--synthetic-input": "synthetic_input",
     }
 

@@ -333,9 +333,18 @@ class FFModel:
             self.strategy = Strategy.load(self.config.import_strategy_file)
 
         if self.config.search_budget > 0:
-            from .search.mcmc import optimize
-            self.strategy = optimize(self, budget=self.config.search_budget,
-                                     alpha=self.config.search_alpha)
+            if self.config.search_mesh_shapes:
+                # joint (strategy, mesh-factorization) search — the
+                # degree dimension of the reference's space (model.cc:512)
+                from .search.mcmc import optimize_with_mesh
+                self.strategy, self.mesh = optimize_with_mesh(
+                    self, budget=self.config.search_budget,
+                    alpha=self.config.search_alpha)
+            else:
+                from .search.mcmc import optimize
+                self.strategy = optimize(
+                    self, budget=self.config.search_budget,
+                    alpha=self.config.search_alpha)
             if self.config.export_strategy_file:
                 self.strategy.save(self.config.export_strategy_file)
 

@@ -41,12 +41,32 @@ class ParallelConfig:
         return self.num_parts == self.dims[0]
 
 
+DEVICE_KEY = "__devices__"
+
+
 @dataclasses.dataclass
 class OpStrategy:
     """Maps an op's logical axes to mesh axes. axis_map values may be a
-    mesh axis name, a tuple of axis names (multi-axis sharding), or None."""
+    mesh axis name, a tuple of axis names (multi-axis sharding), or None.
+
+    Device-explicit placement (the reference's `ParallelConfig.device_ids`,
+    include/config.h:47-73 — what lets DLRM pin each embedding table to
+    one device): the reserved `__devices__` axis_map entry binds the op to
+    an explicit device-index tuple instead of the mesh-uniform SPMD
+    program. The simulator gives such ops their own compute resources
+    (concurrency across disjoint devices) and the cost model prices the
+    gather of their outputs; see search/cost_model.py."""
 
     axis_map: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if DEVICE_KEY in self.axis_map:  # normalize for keying/dedup
+            self.axis_map[DEVICE_KEY] = tuple(self.axis_map[DEVICE_KEY])
+
+    @property
+    def device_ids(self) -> Optional[tuple]:
+        """Explicit device placement, or None for mesh-uniform SPMD."""
+        return self.axis_map.get(DEVICE_KEY)
 
     def mesh_axis_for(self, logical_axis: Optional[str]):
         if logical_axis is None:

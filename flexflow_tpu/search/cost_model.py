@@ -26,6 +26,18 @@ MATMUL_OPS = {"linear", "conv2d", "batch_matmul", "multihead_attention",
 
 
 @dataclasses.dataclass
+class PipelineCost:
+    """Per-stage costs for event-loop expansion of a pipelined op
+    (reference simulator.cc:330-629 expands every task; our Python
+    simulator expands pipeline units into (microbatch, stage) tasks)."""
+    stages: int
+    microbatches: int
+    fwd_stage: float    # compute seconds of ONE (microbatch, stage) tick
+    bwd_stage: float
+    hop: float          # ppermute seconds per inter-stage activation hop
+
+
+@dataclasses.dataclass
 class OpCost:
     fwd: float          # compute seconds, sharded
     bwd: float
@@ -33,6 +45,11 @@ class OpCost:
     bwd_comm: float
     sync: float         # gradient sync (DP all-reduce) seconds
     mem: float          # bytes resident per device (weights+opt+acts)
+    # set for pipeline_blocks ops with layer->pipe mapped; fwd/bwd then
+    # hold the closed-form GPipe makespan (used by the native engine's
+    # one-task-per-op lowering) while the Python simulator replaces them
+    # with the expanded per-stage schedule.
+    pipeline: Optional[PipelineCost] = None
 
     def merge(self, other: "OpCost") -> "OpCost":
         """Fold another op's cost into one fused task (reference FusedOp:
@@ -43,7 +60,8 @@ class OpCost:
         return OpCost(fwd=self.fwd + other.fwd, bwd=self.bwd + other.bwd,
                       fwd_comm=self.fwd_comm + other.fwd_comm,
                       bwd_comm=self.bwd_comm + other.bwd_comm,
-                      sync=self.sync + other.sync, mem=self.mem + other.mem)
+                      sync=self.sync + other.sync, mem=self.mem + other.mem,
+                      pipeline=self.pipeline or other.pipeline)
 
 
 def _axis_size(strategy: OpStrategy, mesh, logical_axis) -> int:
@@ -101,6 +119,29 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
     bwd_comm = 0.0
     sync = 0.0
 
+    # --- device-explicit placement (reference ParallelConfig.device_ids,
+    # config.h:47-73; DLRM per-table strategies dlrm_strategy.cc:1-50):
+    # the op runs whole on its device set — no sample/model sharding —
+    # and its output is gathered to the rest of the mesh (priced as one
+    # ring all-gather); gradients flow back the same path. No DP weight
+    # replica exists, so there is no gradient sync. Memory is averaged
+    # over the mesh (exact when equal-size placed ops round-robin over
+    # all devices, as the DLRM strategy does).
+    devices = strategy.device_ids
+    if devices:
+        k = max(1, len(devices))
+        n = max(1, int(mesh.size))
+        fwd = mm.compute_time(flops / k,
+                              (act_bytes + in_bytes + w_bytes) / k, is_mm)
+        bwd = BWD_FACTOR_BY_TYPE.get(op.op_type, BWD_FLOP_FACTOR) * fwd
+        if n > k:
+            fwd_comm = mm.all_gather(act_bytes, n)
+            bwd_comm = mm.all_gather(act_bytes, n)
+        mem = (w_bytes * (1.0 + optimizer_state_mult) + act_bytes * 2) \
+            * k / n
+        return OpCost(fwd=fwd, bwd=bwd, fwd_comm=fwd_comm,
+                      bwd_comm=bwd_comm, sync=0.0, mem=mem)
+
     fwd = mm.compute_time(flops / shards,
                           (act_bytes + in_bytes + w_bytes) / shards, is_mm)
     bwd = BWD_FACTOR_BY_TYPE.get(op.op_type, BWD_FLOP_FACTOR) * fwd
@@ -135,15 +176,26 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
         fwd_comm += 2 * mm.all_to_all(disp_bytes / ep, ep, ep_ax)
         bwd_comm += 2 * mm.all_to_all(disp_bytes / ep, ep, ep_ax)
 
-    # --- PP: GPipe bubble inflates compute; per-tick activation hop
+    # --- PP: stages divide the layer stack, so per-device compute is
+    # fwd/pp; the GPipe schedule stretches that by the bubble factor
+    # (M + pp - 1)/M. fwd/bwd carry the closed-form makespan (native
+    # engine's one-task-per-op view); `pipeline` carries the per-stage
+    # tick costs so the Python simulator can run the real schedule.
+    pipeline = None
     if pp > 1 and op.op_type == "pipeline_blocks":
         M = op.num_microbatches
-        bubble = (M + pp - 1) / M
+        fwd_stage = fwd / (pp * M)
+        bwd_stage = bwd / (pp * M)
+        mb_bytes = in_bytes / max(1, dp) / M
+        hop = mm.ppermute(mb_bytes, pp_ax)
+        pipeline = PipelineCost(stages=pp, microbatches=M,
+                                fwd_stage=fwd_stage, bwd_stage=bwd_stage,
+                                hop=hop)
+        bubble = (M + pp - 1) / (M * pp)
         fwd *= bubble
         bwd *= bubble
-        mb_bytes = in_bytes / max(1, dp) / M
-        fwd_comm += (M + pp - 1) * mm.ppermute(mb_bytes, pp_ax)
-        bwd_comm += (M + pp - 1) * mm.ppermute(mb_bytes, pp_ax)
+        fwd_comm += (M + pp - 1) * hop
+        bwd_comm += (M + pp - 1) * hop
 
     # --- DP gradient sync: all-reduce of each weight's grad over the
     # data axis (the reference's NCCL all-reduce / PS update+prefetch,
@@ -160,4 +212,4 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
     mem = w_per_dev * (1.0 + optimizer_state_mult) + act_per_dev * 2
 
     return OpCost(fwd=fwd, bwd=bwd, fwd_comm=fwd_comm, bwd_comm=bwd_comm,
-                  sync=sync, mem=mem)
+                  sync=sync, mem=mem, pipeline=pipeline)

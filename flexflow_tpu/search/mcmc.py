@@ -20,13 +20,17 @@ import math
 import random
 from typing import Dict, List, Optional
 
-from ..parallel.pconfig import OpStrategy, Strategy
+from ..parallel.pconfig import DEVICE_KEY, OpStrategy, Strategy
 from .machine_model import default_machine_model
 from .simulator import Simulator, op_edges
 
 
-def candidate_maps(op, mesh, cfg) -> List[Dict[str, str]]:
-    """Enumerate legal axis maps for one op on this mesh."""
+def candidate_maps(op, mesh, cfg, op_index: int = 0) -> List[Dict[str, str]]:
+    """Enumerate legal axis maps for one op on this mesh.
+
+    `op_index` seeds the round-robin device for device-explicit placement
+    candidates (the reference's DLRM strategy generator assigns table i
+    to GPU i % n, dlrm_strategy.py)."""
     axes = mesh.shape
     cands: List[Dict[str, str]] = []
     base: Dict[str, str] = {}
@@ -47,6 +51,16 @@ def candidate_maps(op, mesh, cfg) -> List[Dict[str, str]]:
             cands.append({**base, "head": model_ax})
         if cfg.enable_parameter_parallel and op.op_type == "embedding":
             cands.append({**base, "vocab": model_ax})
+
+    # device-explicit placement ("Operator"/"Parameter" dims of SOAP:
+    # reference ParallelConfig.device_ids, config.h:47-73) — pin the
+    # whole op to one device, round-robin by op index like the DLRM
+    # strategy generator. Offered for embeddings (the op the reference
+    # places per-device) when the mesh has more than one device.
+    n_dev = int(mesh.size) if hasattr(mesh, "size") else 1
+    if (cfg.enable_parameter_parallel and op.op_type == "embedding"
+            and n_dev > 1):
+        cands.append({DEVICE_KEY: (op_index % n_dev,)})
 
     if cfg.enable_sequence_parallel and "seq" in axes:
         if op.op_type in ("multihead_attention", "linear", "lstm",
@@ -76,6 +90,101 @@ def candidate_maps(op, mesh, cfg) -> List[Dict[str, str]]:
     return out
 
 
+def _divisor_splits(n: int, num_axes: int):
+    """All tuples (d0..dk) with product n, each di >= 1."""
+    if num_axes == 1:
+        yield (n,)
+        return
+    d = 1
+    while d <= n:
+        if n % d == 0:
+            for rest in _divisor_splits(n // d, num_axes - 1):
+                yield (d,) + rest
+        d += 1
+
+
+def enumerate_mesh_shapes(n_devices: int, model, cfg
+                          ) -> List[Dict[str, int]]:
+    """Candidate mesh factorizations of `n_devices` over the axes this
+    model + the search gates can actually use.
+
+    The degree analog of the reference sampling ND part counts
+    (`get_random_parallel_config` model.cc:512; linear.cu:1074-1107
+    out-channel divisors): the TPU strategy space fixes degrees via the
+    mesh, so searching degrees = searching mesh shapes."""
+    op_types = {op.op_type for op in model.ops}
+    axes = ["data"]
+    if ((cfg.enable_parameter_parallel or cfg.enable_attribute_parallel)
+            and op_types & {"linear", "conv2d", "multihead_attention",
+                            "embedding", "lstm", "moe_ffn"}):
+        axes.append("model")
+    if (cfg.enable_sequence_parallel
+            and op_types & {"multihead_attention", "linear", "lstm",
+                            "moe_ffn"}):
+        axes.append("seq")
+    if cfg.enable_expert_parallel and "moe_ffn" in op_types:
+        axes.append("expert")
+    if cfg.enable_pipeline_parallel and "pipeline_blocks" in op_types:
+        axes.append("pipe")
+    shapes = []
+    seen = set()
+    for split in _divisor_splits(n_devices, len(axes)):
+        # drop size-1 axes (except data, which names the default axis)
+        shape = {ax: s for ax, s in zip(axes, split)
+                 if s > 1 or ax == "data"}
+        key = tuple(sorted(shape.items()))
+        if key not in seen:
+            seen.add(key)
+            shapes.append(shape)
+    return shapes
+
+
+def optimize_with_mesh(model, budget: int = 1000, alpha: float = 0.05,
+                       devices=None, seed: int = 0, verbose: bool = False):
+    """Search strategy AND mesh factorization jointly: enumerate mesh
+    shapes of the device count, anneal within each, return the
+    (strategy, mesh) pair with the best simulated step time.
+
+    Reference analog: the MCMC search samples parallel DEGREES per op
+    (model.cc:512); GSPMD fixes degrees at mesh construction, so the
+    degree search moves to the outer loop. Activated by
+    --search-mesh-shapes (FFConfig.search_mesh_shapes)."""
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    if devices is None:
+        devices = (list(model.mesh.devices.flat) if model.mesh is not None
+                   else list(jax.devices()))
+    n = len(devices)
+    cfg = model.config
+    shapes = enumerate_mesh_shapes(n, model, cfg)
+    per_budget = max(50, budget // max(1, len(shapes)))
+    best = None  # (cost, strategy, mesh)
+    for shape in shapes:
+        mesh = make_mesh(tuple(shape.values()), tuple(shape.keys()),
+                         devices)
+        sim = Simulator(
+            model, mesh,
+            default_machine_model(mesh,
+                                  machine_file=cfg.machine_model_file),
+            overlap_backward_sync=cfg.search_overlap_backward_update)
+        strat = optimize(model, budget=per_budget, alpha=alpha, mesh=mesh,
+                         seed=seed, verbose=False, simulator=sim)
+        cost = sim.simulate(strat)
+        if verbose:
+            print(f"[search/mesh] {shape}: {cost*1e3:.3f} ms/step")
+        if best is None or cost < best[0]:
+            best = (cost, strat, mesh, sim)
+    if verbose:
+        print(f"[search/mesh] best: {dict(best[2].shape)} "
+              f"at {best[0]*1e3:.3f} ms/step")
+    if cfg.taskgraph_file:  # re-export for the WINNING mesh (inner runs
+        # each wrote their own shape's graph; last is not best)
+        best[3].simulate(best[1], dot_path=cfg.taskgraph_file)
+    return best[1], best[2]
+
+
 def optimize(model, budget: int = 1000, alpha: float = 0.05,
              mesh=None, seed: int = 0, verbose: bool = False,
              simulator: Optional[Simulator] = None,
@@ -102,7 +211,8 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
         overlap_backward_sync=cfg.search_overlap_backward_update)
     rng = random.Random(seed)
 
-    cands = {op.name: candidate_maps(op, mesh, cfg) for op in model.ops}
+    cands = {op.name: candidate_maps(op, mesh, cfg, op_index=i)
+             for i, op in enumerate(model.ops)}
 
     def finish(strategy):
         """Every return path funnels here so --taskgraph always exports."""
@@ -110,13 +220,21 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
             sim.simulate(strategy, dot_path=cfg.taskgraph_file)
         return strategy
 
-    # The native lowering costs one task per op; with fusion on, the
-    # Python simulator folds same-strategy chains, so the engines would
-    # rank strategies differently — route fused searches to Python.
-    if cfg.perform_fusion:
+    # The native lowering costs one task per op on a single compute
+    # resource; the Python simulator additionally folds fused chains,
+    # expands GPipe schedules, and models per-device concurrency for
+    # placed ops — searches needing any of those route to Python so both
+    # engines never rank the same candidates differently.
+    needs_python = (
+        cfg.perform_fusion
+        or any(DEVICE_KEY in m for lst in cands.values() for m in lst)
+        or ("pipe" in mesh.shape
+            and any(op.op_type == "pipeline_blocks" for op in model.ops)))
+    if needs_python:
         if use_native is True:
-            raise ValueError("native search does not support "
-                             "perform_fusion; use the Python engine")
+            raise ValueError(
+                "native search does not support fusion, device placement, "
+                "or pipeline expansion; use the Python engine")
         use_native = False
     if use_native is not False:
         from .native_search import optimize_native

@@ -28,7 +28,9 @@ from .machine_model import TPUMachineModel, default_machine_model
 class SimTask:
     name: str
     duration: float
-    resource: str               # "compute" or "comm"
+    resource: object            # one hashable key ("compute"/"comm"/
+    # ("stage", u, k)) or a LIST of keys the task occupies simultaneously
+    # (a placed op's device set; an SPMD op holding every device)
     deps: List["SimTask"] = dataclasses.field(default_factory=list)
     # runtime state
     unresolved: int = 0
@@ -47,13 +49,17 @@ class TaskGraph:
         return t
 
     def simulate(self) -> float:
-        """Priority-queue event loop (reference simulator.cc:499-554)."""
+        """Priority-queue event loop (reference simulator.cc:499-554).
+        A task may occupy several resources at once (tuple resource) —
+        this is how per-device concurrency is modeled: ops bound to
+        disjoint device sets proceed in parallel, overlapping sets
+        serialize (reference: per-device task queues in slice_task)."""
         children: Dict[int, List[SimTask]] = {}
         for t in self.tasks:
             t.unresolved = len(t.deps)
             for d in t.deps:
                 children.setdefault(id(d), []).append(t)
-        free: Dict[str, float] = {}
+        free: Dict[object, float] = {}
         counter = 0
         q = []
         for t in self.tasks:
@@ -64,9 +70,12 @@ class TaskGraph:
         done = 0
         while q:
             ready, _, t = heapq.heappop(q)
-            start = max(ready, free.get(t.resource, 0.0))
+            keys = t.resource if isinstance(t.resource, list) \
+                else (t.resource,)
+            start = max([ready] + [free.get(k, 0.0) for k in keys])
             t.finish_time = start + t.duration
-            free[t.resource] = t.finish_time
+            for k in keys:
+                free[k] = t.finish_time
             makespan = max(makespan, t.finish_time)
             done += 1
             for c in children.get(id(t), []):
@@ -217,14 +226,43 @@ class Simulator:
             unit_cost[grp[-1]] = c
         unit_order = [g[-1] for g in groups]
 
+        # compute-resource assignment: mesh-uniform SPMD units share one
+        # "compute" stream; a device-placed unit (OpStrategy.device_ids)
+        # occupies only its own devices, so disjoint placements run
+        # concurrently (reference: ops with disjoint ParallelConfig
+        # device_ids proceed in parallel under Legion's dataflow).
+        singleton = {grp[-1] for grp in groups if len(grp) == 1}
+        placed = {u: strategy.for_op(u).device_ids for u in unit_order
+                  if u in singleton and strategy.for_op(u).device_ids}
+        all_devs = [("dev", i) for i in range(int(self.mesh.size))] \
+            if placed else []
+
+        def res_for(u):
+            if u in placed:
+                return [("dev", int(i)) for i in placed[u]]
+            return ["compute"] + all_devs if placed else "compute"
+
+        # pipeline units (singleton pipeline_blocks with layer->pipe):
+        # expanded into the real (microbatch, stage) GPipe schedule over
+        # per-stage resources instead of one closed-form task (the event
+        # loop the reference runs for every task, simulator.cc:330-629).
+        expanded = {u for u in unit_order
+                    if unit_cost[u].pipeline is not None and u in singleton}
+        pipe_fwd_exit: Dict[str, List[List[SimTask]]] = {}
+
         # forward chain
         for u in unit_order:
             c = unit_cost[u]
             deps = [fwd_tasks[pu] for pu in unit_deps[u] if pu in fwd_tasks]
+            if u in expanded:
+                fwd_tasks[u] = self._expand_pipeline_fwd(
+                    g, u, c.pipeline, deps, pipe_fwd_exit)
+                total_mem += c.mem
+                continue
             if c.fwd_comm > 0:
                 comm = g.add(f"{u}:fwd_comm", c.fwd_comm, "comm", deps)
                 deps = deps + [comm]
-            fwd_tasks[u] = g.add(f"{u}:fwd", c.fwd, "compute", deps)
+            fwd_tasks[u] = g.add(f"{u}:fwd", c.fwd, res_for(u), deps)
             total_mem += c.mem
 
         # backward chain (reverse graph)
@@ -236,10 +274,14 @@ class Simulator:
                     if cons in bwd_tasks]
             if not deps:
                 deps = [fwd_tasks[unit_order[-1]]]
-            if c.bwd_comm > 0:
-                comm = g.add(f"{u}:bwd_comm", c.bwd_comm, "comm", deps)
-                deps = deps + [comm]
-            bwd_tasks[u] = g.add(f"{u}:bwd", c.bwd, "compute", deps)
+            if u in expanded:
+                bwd_tasks[u] = self._expand_pipeline_bwd(
+                    g, u, c.pipeline, deps, pipe_fwd_exit[u])
+            else:
+                if c.bwd_comm > 0:
+                    comm = g.add(f"{u}:bwd_comm", c.bwd_comm, "comm", deps)
+                    deps = deps + [comm]
+                bwd_tasks[u] = g.add(f"{u}:bwd", c.bwd, res_for(u), deps)
             if c.sync > 0:
                 # grad all-reduce may overlap the rest of backward
                 # (reference overlap flag, simulator.cc:393-497)
@@ -257,6 +299,57 @@ class Simulator:
         if dot_path:
             g.export_dot(dot_path)
         return step_time, self.mm.memory_penalty(total_mem)
+
+    def _expand_pipeline_fwd(self, g, u, pc, ext_deps, pipe_fwd_exit):
+        """Emit the GPipe forward: microbatch m flows stage 0..S-1, one
+        hop between stages; stage k is its own resource, so the bubble
+        emerges from the event loop rather than a closed form. Returns a
+        zero-duration join task (= the unit's fwd handle)."""
+        S, M = pc.stages, pc.microbatches
+        rows: List[List[SimTask]] = []
+        for m in range(M):
+            row = []
+            prev = None
+            for k in range(S):
+                deps = list(ext_deps) if k == 0 else []
+                if prev is not None:
+                    if pc.hop > 0:
+                        h = g.add(f"{u}:f{m}.hop{k}", pc.hop, "comm",
+                                  [prev])
+                        deps.append(h)
+                    else:
+                        deps.append(prev)
+                prev = g.add(f"{u}:f{m}.s{k}", pc.fwd_stage,
+                             ("stage", u, k), deps)
+                row.append(prev)
+            rows.append(row)
+        pipe_fwd_exit[u] = rows
+        join = g.add(f"{u}:fwd_join", 0.0, ("join", u, "f"),
+                     [r[-1] for r in rows])
+        return join
+
+    def _expand_pipeline_bwd(self, g, u, pc, ext_deps, fwd_rows):
+        """GPipe backward: microbatch m flows stage S-1..0 (each bwd tick
+        also depends on that microbatch's forward at the same stage —
+        stashed activations)."""
+        S, M = pc.stages, pc.microbatches
+        exits = []
+        for m in range(M):
+            prev = None
+            for k in reversed(range(S)):
+                deps = list(ext_deps) if k == S - 1 else []
+                deps.append(fwd_rows[m][k])
+                if prev is not None:
+                    if pc.hop > 0:
+                        h = g.add(f"{u}:b{m}.hop{k}", pc.hop, "comm",
+                                  [prev])
+                        deps.append(h)
+                    else:
+                        deps.append(prev)
+                prev = g.add(f"{u}:b{m}.s{k}", pc.bwd_stage,
+                             ("stage", u, k), deps)
+            exits.append(prev)
+        return g.add(f"{u}:bwd_join", 0.0, ("join", u, "b"), exits)
 
     def memory_per_device(self, strategy: Strategy) -> float:
         return sum(self._op_cost(op, strategy).mem for op in self.model.ops)

@@ -1,0 +1,244 @@
+"""Round-2 auto-parallelization upgrades (VERDICT items 4/5/6):
+
+- device-explicit placement in the strategy space (reference
+  `ParallelConfig.device_ids`, include/config.h:47-73; DLRM per-table
+  strategies examples/cpp/DLRM/strategies/dlrm_strategy.cc:1-50),
+- per-device compute resources + GPipe event-loop expansion in the
+  simulator (reference event loop simulator.cc:330-629),
+- mesh-factorization ("degree") search (reference
+  get_random_parallel_config samples part counts, model.cc:512).
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, Strategy, make_mesh
+from flexflow_tpu.models import build_dlrm
+from flexflow_tpu.parallel.pconfig import DEVICE_KEY, OpStrategy
+from flexflow_tpu.search.cost_model import PipelineCost
+from flexflow_tpu.search.mcmc import (
+    enumerate_mesh_shapes,
+    optimize,
+    optimize_with_mesh,
+)
+from flexflow_tpu.search.simulator import Simulator, TaskGraph
+
+
+# ---------------------------------------------------------------- pipeline
+
+def build_pipe_model(num_layers=4, num_microbatches=4, batch=64,
+                     hidden=256):
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    cfg.enable_pipeline_parallel = True
+    ff = FFModel(cfg)
+    x = ff.create_tensor((batch, hidden), name="input")
+
+    def block(sub, t):
+        h = sub.dense(t, hidden, activation="relu", name="blk_ff")
+        return sub.add(h, t, name="blk_res")
+
+    t = ff.pipeline_blocks(x, block, num_layers,
+                           num_microbatches=num_microbatches)
+    t = ff.softmax(ff.dense(t, 4, name="head"), name="sm")
+    return ff
+
+
+def pp_strategy():
+    return Strategy(default=OpStrategy({"sample": "data",
+                                        "layer": "pipe"}))
+
+
+def test_gpipe_expansion_exact_makespan():
+    """The event-loop expansion must reproduce the GPipe schedule exactly:
+    with uniform stages and no hop cost, forward takes (M+S-1) ticks and
+    backward another (M+S-1) ticks after the forward join."""
+    S, M, f, b = 4, 6, 1.0, 2.0
+    pc = PipelineCost(stages=S, microbatches=M, fwd_stage=f, bwd_stage=b,
+                      hop=0.0)
+    sim = Simulator.__new__(Simulator)  # only the expansion methods used
+    g = TaskGraph()
+    exits = {}
+    join_f = sim._expand_pipeline_fwd(g, "u", pc, [], exits)
+    sim._expand_pipeline_bwd(g, "u", pc, [join_f], exits["u"])
+    makespan = g.simulate()
+    assert makespan == pytest.approx((M + S - 1) * (f + b)), makespan
+
+
+def test_pipeline_sim_bubble_shrinks_with_microbatches():
+    """At compute-dominant shapes more microbatches shrink the bubble.
+    (At tiny shapes the per-hop ICI latency rightly dominates and MORE
+    microbatches lose — the tradeoff the event loop models and the old
+    closed form could not.)"""
+    mesh = make_mesh((2, 4), ("data", "pipe"))
+    times = {}
+    for m in (2, 8):
+        ff = build_pipe_model(num_layers=8, num_microbatches=m,
+                              batch=1024, hidden=4096)
+        sim = Simulator(ff, mesh)
+        times[m] = sim.simulate(pp_strategy())
+    assert times[8] < times[2], times
+
+
+def test_pipeline_sim_pp_speeds_up_deep_stack():
+    """Mapping layer->pipe divides per-device compute by the stage count;
+    the simulated step must improve despite the bubble (the pre-round-2
+    closed form priced PP as a pure slowdown — VERDICT weak #4)."""
+    ff = build_pipe_model(num_layers=8, num_microbatches=8, batch=4096,
+                          hidden=4096)
+    mesh = make_mesh((1, 4), ("data", "pipe"))
+    sim = Simulator(ff, mesh)
+    t_pp = sim.simulate(pp_strategy())
+    t_stack = sim.simulate(Strategy())  # layer unmapped: one-device scan
+    assert t_pp < t_stack, (t_pp, t_stack)
+
+
+def test_pipeline_event_loop_close_to_closed_form():
+    """The native engine keeps the closed-form GPipe makespan; the Python
+    event loop must stay close on a pure pipeline (same model, bounded
+    divergence) so the engines rank candidates consistently."""
+    ff = build_pipe_model(num_layers=8, num_microbatches=4, batch=256,
+                          hidden=1024)
+    mesh = make_mesh((1, 4), ("data", "pipe"))
+    sim = Simulator(ff, mesh)
+    strat = pp_strategy()
+    t_loop = sim.simulate(strat)
+    # closed form from the op costs (what the native lowering sees)
+    total = 0.0
+    for op in ff.ops:
+        c = sim._op_cost(op, strat)
+        total += c.fwd + c.bwd + c.fwd_comm + c.bwd_comm
+    # the loop schedules M*(S-1) real hops vs the form's (M+S-1), so a
+    # comm-heavy shape diverges upward; a compute-heavy one downward
+    # (overlap). Bounded either way keeps the engines' rankings close.
+    assert total * 0.5 <= t_loop <= total * 1.5, (t_loop, total)
+
+
+# ------------------------------------------------------- device placement
+
+def vocab_sharded(ff):
+    s = Strategy()
+    for op in ff.ops:
+        if op.op_type == "embedding":
+            s.set(op.name, OpStrategy({"vocab": "model"}))
+    return s
+
+
+def table_placed(ff, n_dev):
+    s = Strategy()
+    k = 0
+    for op in ff.ops:
+        if op.op_type == "embedding":
+            s.set(op.name, OpStrategy({DEVICE_KEY: (k % n_dev,)}))
+            k += 1
+    return s
+
+
+def build_dlrm_for_search(vocab=100_000, batch=1024):
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    cfg.enable_parameter_parallel = True
+    return build_dlrm(cfg, batch_size=batch,
+                      embedding_vocab_sizes=(vocab,) * 8)
+
+
+def test_per_table_placement_beats_vocab_sharding_simulated():
+    """The reference's DLRM headline: one table per device beats sharding
+    every table (concurrent lookups + an all-gather instead of a
+    serialized psum per table)."""
+    ff = build_dlrm_for_search()
+    mesh = make_mesh((1, 8), ("data", "model"))
+    sim = Simulator(ff, mesh)
+    t_vocab = sim.simulate(vocab_sharded(ff))
+    t_placed = sim.simulate(table_placed(ff, 8))
+    assert t_placed < t_vocab, (t_placed, t_vocab)
+
+
+def test_search_places_tables_across_devices():
+    """VERDICT #4 done-condition: search places the 8 tables across the 8
+    devices and beats vocab-sharding in simulated time."""
+    ff = build_dlrm_for_search()
+    mesh = make_mesh((1, 8), ("data", "model"))
+    ff.mesh = mesh
+    best = optimize(ff, budget=600, alpha=0.05, mesh=mesh, seed=0)
+    sim = Simulator(ff, mesh)
+    assert sim.simulate(best) <= sim.simulate(vocab_sharded(ff))
+    placed_devs = [best.for_op(op.name).device_ids
+                   for op in ff.ops if op.op_type == "embedding"]
+    placed_devs = [d for d in placed_devs if d]
+    assert len(placed_devs) >= 4, placed_devs
+    # round-robin candidates spread over distinct devices
+    assert len({d[0] for d in placed_devs}) == len(placed_devs)
+
+
+def test_placed_strategy_roundtrips_via_json(tmp_path):
+    ff = build_dlrm_for_search()
+    s = table_placed(ff, 8)
+    path = str(tmp_path / "strategy.json")
+    s.save(path)
+    loaded = Strategy.load(path)
+    emb = next(op.name for op in ff.ops if op.op_type == "embedding")
+    assert loaded.for_op(emb).device_ids == s.for_op(emb).device_ids
+    assert isinstance(loaded.for_op(emb).device_ids, tuple)
+
+
+def test_native_engine_rejects_placement_candidates():
+    ff = build_dlrm_for_search()
+    mesh = make_mesh((1, 8), ("data", "model"))
+    ff.mesh = mesh
+    with pytest.raises(ValueError, match="device placement"):
+        optimize(ff, budget=10, mesh=mesh, use_native=True)
+
+
+# ----------------------------------------------------------- degree search
+
+def build_tp_heavy(batch=8, hidden=8192):
+    cfg = FFConfig()
+    cfg.batch_size = batch
+    cfg.enable_parameter_parallel = True
+    ff = FFModel(cfg)
+    x = ff.create_tensor((batch, hidden), name="input")
+    t = ff.dense(x, hidden, activation="relu", name="big1")
+    t = ff.dense(t, hidden, activation="relu", name="big2")
+    t = ff.dense(t, 10, name="head")
+    t = ff.softmax(t)
+    return ff
+
+
+def test_enumerate_mesh_shapes_uses_gates():
+    ff = build_tp_heavy()
+    shapes = enumerate_mesh_shapes(8, ff, ff.config)
+    assert {"data": 8} in shapes
+    assert {"data": 4, "model": 2} in shapes
+    assert {"data": 1, "model": 8} in shapes
+    ff.config.enable_parameter_parallel = False
+    assert enumerate_mesh_shapes(8, ff, ff.config) == [{"data": 8}]
+
+
+def test_mesh_shape_search_finds_tp_degree():
+    """VERDICT #5 done-condition: given 8 devices and a TP-heavy model,
+    the search returns a mesh with a model axis (dp4xtp2 / dp2xtp4 /
+    tp8) over pure dp8 without the user pre-choosing the mesh."""
+    ff = build_tp_heavy()
+    strat, mesh = optimize_with_mesh(ff, budget=400, seed=0)
+    assert mesh.shape.get("model", 1) >= 2, dict(mesh.shape)
+    big_maps = [strat.for_op(n).axis_map for n in ("big1", "big2")]
+    assert any(m.get("channel_out") == "model" for m in big_maps), big_maps
+
+
+def test_mesh_shape_search_wired_into_compile():
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.search_budget = 60
+    cfg.search_mesh_shapes = True
+    cfg.enable_parameter_parallel = True
+    ff = FFModel(cfg)
+    x = ff.create_tensor((16, 64), name="input")
+    t = ff.dense(x, 256, activation="relu")
+    t = ff.softmax(ff.dense(t, 4))
+    ff.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
+    assert ff.mesh is not None and int(ff.mesh.size) == 8
+    rng = np.random.RandomState(0)
+    m = ff.train_batch({"input": rng.randn(16, 64).astype(np.float32),
+                        "label": rng.randint(0, 4, 16).astype(np.int32)})
+    assert np.isfinite(float(m["loss"]))
