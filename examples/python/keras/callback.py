@@ -1,0 +1,39 @@
+"""Callback example (reference: examples/python/keras/callback.py;
+tests/multi_gpu_tests.sh): EarlyStopping + the accuracy-verification
+callback from accuracy_tests.sh.
+
+  python examples/python/keras/callback.py -e 10
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 10
+
+    model = keras.Sequential([
+        keras.layers.Dense(128, activation="relu", input_shape=(64,)),
+        keras.layers.Dense(4, activation="softmax"),
+    ])
+    model.compile(optimizer=keras.SGD(learning_rate=0.1),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 64).astype(np.float32)
+    w = rng.randn(64, 4).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+
+    stop = keras.EarlyStopping(monitor="loss", patience=2, min_delta=1e-4)
+    hist = model.fit(x, y, batch_size=64, epochs=epochs, callbacks=[stop])
+    print(f"trained {len(hist)} epochs (early stop at patience=2); "
+          f"final accuracy: {hist[-1]['accuracy']:.3f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,48 @@
+"""Functional-API AlexNet on CIFAR-10 shapes (reference:
+examples/python/keras/func_cifar10_alexnet.py; tests/multi_gpu_tests.sh
+and bootcamp_demo/ff_alexnet_cifar10.py).
+
+  python examples/python/keras/func_cifar10_alexnet.py -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+
+    inp = keras.layers.Input((3, 32, 32))
+    t = keras.layers.Conv2D(64, (5, 5), strides=(1, 1), padding="same",
+                            activation="relu")(inp)
+    t = keras.layers.MaxPooling2D((2, 2))(t)
+    t = keras.layers.Conv2D(192, (5, 5), padding="same",
+                            activation="relu")(t)
+    t = keras.layers.MaxPooling2D((2, 2))(t)
+    t = keras.layers.Conv2D(384, (3, 3), padding="same",
+                            activation="relu")(t)
+    t = keras.layers.Conv2D(256, (3, 3), padding="same",
+                            activation="relu")(t)
+    t = keras.layers.MaxPooling2D((2, 2))(t)
+    t = keras.layers.Flatten()(t)
+    t = keras.layers.Dense(512, activation="relu")(t)
+    t = keras.layers.Dropout(0.5)(t)
+    out = keras.layers.Dense(10, activation="softmax")(t)
+    model = keras.Model(inputs=inp, outputs=out)
+    model.compile(optimizer=keras.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, 128).astype(np.int32)
+    hist = model.fit(x, y, batch_size=32, epochs=epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,39 @@
+"""Functional-API MNIST MLP (reference:
+examples/python/keras/func_mnist_mlp.py; tests/multi_gpu_tests.sh).
+
+  python examples/python/keras/func_mnist_mlp.py -e 3 --accuracy
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 2
+
+    inp = keras.layers.Input((784,))
+    t = keras.layers.Dense(512, activation="relu")(inp)
+    t = keras.layers.Dense(512, activation="relu")(t)
+    out = keras.layers.Dense(10, activation="softmax")(t)
+    model = keras.Model(inputs=inp, outputs=out)
+    model.compile(optimizer=keras.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(1024, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    hist = model.fit(x, y, batch_size=64, epochs=epochs)
+    acc = hist[-1]["accuracy"]
+    print(f"final accuracy: {acc:.3f}")
+    if "--accuracy" in sys.argv:
+        assert acc > 0.3, acc
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,41 @@
+"""Sequential-model CIFAR-10 CNN (reference:
+examples/python/keras/seq_cifar10_cnn.py; tests/multi_gpu_tests.sh).
+
+  python examples/python/keras/seq_cifar10_cnn.py -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+
+    model = keras.Sequential([
+        keras.layers.Conv2D(32, (3, 3), padding="same", activation="relu",
+                            input_shape=(3, 32, 32)),
+        keras.layers.Conv2D(32, (3, 3), padding="same", activation="relu"),
+        keras.layers.MaxPooling2D((2, 2)),
+        keras.layers.Conv2D(64, (3, 3), padding="same", activation="relu"),
+        keras.layers.MaxPooling2D((2, 2)),
+        keras.layers.Flatten(),
+        keras.layers.Dense(256, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer=keras.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, 256).astype(np.int32)
+    hist = model.fit(x, y, batch_size=32, epochs=epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,42 @@
+"""Sequential-model MNIST MLP (reference:
+examples/python/keras/seq_mnist_mlp.py; first entry in
+tests/multi_gpu_tests.sh).
+
+  python examples/python/keras/seq_mnist_mlp.py -e 3 --accuracy
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 2
+
+    model = keras.Sequential([
+        keras.layers.Dense(512, activation="relu", input_shape=(784,)),
+        keras.layers.Dropout(0.2),
+        keras.layers.Dense(512, activation="relu"),
+        keras.layers.Dropout(0.2),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer=keras.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(1024, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    hist = model.fit(x, y, batch_size=64, epochs=epochs)
+    acc = hist[-1]["accuracy"]
+    print(f"final accuracy: {acc:.3f}")
+    if "--accuracy" in sys.argv:
+        assert acc > 0.3, acc
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,42 @@
+"""Elementwise-op exercise (reference: examples/python/keras/unary.py;
+tests/multi_gpu_tests.sh): Activation layers + Add/Subtract/Multiply
+merges through the Keras frontend.
+
+  python examples/python/keras/unary.py -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+
+    inp = keras.layers.Input((64,))
+    t = keras.layers.Dense(64)(inp)
+    t = keras.layers.Activation("relu")(t)
+    u = keras.layers.Dense(64)(inp)
+    u = keras.layers.Activation("sigmoid")(u)
+    s = keras.layers.Add()([t, u])
+    d = keras.layers.Subtract()([t, u])
+    m = keras.layers.Multiply()([s, d])
+    m = keras.layers.Activation("tanh")(m)
+    out = keras.layers.Dense(4, activation="softmax")(m)
+    model = keras.Model(inputs=inp, outputs=out)
+    model.compile(optimizer=keras.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 64).astype(np.float32)
+    y = rng.randint(0, 4, 256).astype(np.int32)
+    hist = model.fit(x, y, batch_size=32, epochs=epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

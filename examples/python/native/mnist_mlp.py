@@ -1,0 +1,39 @@
+"""MNIST-style MLP on the native builder API (reference:
+examples/python/native/mnist_mlp.py; run by tests/multi_gpu_tests.sh).
+
+  python -m flexflow_tpu examples/python/native/mnist_mlp.py -b 64 -e 3
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, SGDOptimizer, FFModel
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor((cfg.batch_size, 784), name="input")
+    t = ff.dense(x, 512, activation="relu")
+    t = ff.dense(t, 512, activation="relu")
+    t = ff.dense(t, 10)
+    t = ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    # synthetic but learnable: labels depend linearly on the inputs
+    rng = np.random.RandomState(cfg.seed)
+    xs = rng.randn(1024, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    ys = np.argmax(xs @ w, axis=1).astype(np.int32)
+    hist = ff.fit({"input": xs}, ys, epochs=cfg.epochs)
+    acc = hist[-1]["accuracy"]
+    print(f"final accuracy: {acc:.3f}")
+    if "--accuracy" in sys.argv:
+        assert acc > 0.3, f"model failed to learn ({acc:.3f})"
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,37 @@
+"""Layer introspection example (reference:
+examples/python/native/print_layers.py; run by tests/multi_gpu_tests.sh):
+builds a small net, prints the per-op summary, then trains one epoch.
+
+  python -m flexflow_tpu examples/python/native/print_layers.py -e 1
+"""
+
+from flexflow_tpu import FFConfig, SGDOptimizer, FFModel
+
+from common import synthetic_dataset
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor((cfg.batch_size, 784), name="input")
+    t = ff.dense(x, 128, activation="relu", name="fc1")
+    t = ff.dropout(t, 0.2, name="drop")
+    t = ff.dense(t, 10, name="fc2")
+    t = ff.softmax(t, name="probs")
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    print(ff.summary())
+    for op in ff.ops:
+        ws = {n: s.shape for n, s in op.weight_specs().items()}
+        print(f"  {op.name:12s} {op.op_type:16s} "
+              f"out={op.outputs[0].shape} weights={ws}")
+
+    xs, ys = synthetic_dataset(ff, 128, num_classes=10, seed=cfg.seed)
+    hist = ff.fit(xs, ys, epochs=cfg.epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

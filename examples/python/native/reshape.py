@@ -1,0 +1,34 @@
+"""Reshape/transpose exercise (reference:
+examples/python/native/reshape.py; tests/multi_gpu_tests.sh).
+
+  python -m flexflow_tpu examples/python/native/reshape.py -e 1
+"""
+
+from flexflow_tpu import FFConfig, SGDOptimizer, FFModel
+
+from common import synthetic_dataset
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    bs = cfg.batch_size
+    ff = FFModel(cfg)
+    x = ff.create_tensor((bs, 8, 8), name="input")
+    t = ff.reshape(x, (bs, 64))
+    t = ff.dense(t, 64, activation="relu")
+    t = ff.reshape(t, (bs, 8, 8))
+    t = ff.transpose(t, [0, 2, 1])
+    t = ff.reshape(t, (bs, 64))
+    t = ff.dense(t, 10)
+    t = ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    xs, ys = synthetic_dataset(ff, 256, num_classes=10, seed=cfg.seed)
+    hist = ff.fit(xs, ys, epochs=cfg.epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,32 @@
+"""Split/concat round-trip example (reference:
+examples/python/native/split.py; run by tests/multi_gpu_tests.sh).
+
+  python -m flexflow_tpu examples/python/native/split.py -b 32 -e 1
+"""
+
+from flexflow_tpu import FFConfig, SGDOptimizer, FFModel
+
+from common import synthetic_dataset
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor((cfg.batch_size, 64), name="input")
+    a, b = ff.split(x, 2, axis=1)       # two (bs, 32) halves
+    a = ff.dense(a, 32, activation="relu")
+    b = ff.dense(b, 32, activation="tanh")
+    t = ff.concat([a, b], axis=1)
+    t = ff.dense(t, 10)
+    t = ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    xs, ys = synthetic_dataset(ff, 256, num_classes=10, seed=cfg.seed)
+    hist = ff.fit(xs, ys, epochs=cfg.epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -102,6 +102,12 @@ class FFConfig:
     # in get_random_parallel_config, model.cc:512; here the degree comes
     # from the mesh, so the search enumerates mesh shapes).
     search_mesh_shapes: bool = False
+    # offer device-explicit placement candidates (__devices__ bindings,
+    # reference ParallelConfig.device_ids) to the search. OPT-IN: GSPMD
+    # executes such strategies as replication (the executable form of
+    # per-table placement is DistributedEmbedding's table sharding), so
+    # they are for strategy-space exploration/export tooling.
+    enable_device_placement: bool = False
     machine_model_file: Optional[str] = None
     # DOT export of the simulated task graph (reference --taskgraph,
     # simulator.cc:508-556); written by the first simulate() of a search.
@@ -175,6 +181,7 @@ class FFConfig:
         "--enable-pipeline-parallel": "enable_pipeline_parallel",
         "--enable-propagation": "enable_propagation",
         "--search-mesh-shapes": "search_mesh_shapes",
+        "--enable-device-placement": "enable_device_placement",
         "--synthetic-input": "synthetic_input",
     }
 

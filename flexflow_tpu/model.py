@@ -63,6 +63,7 @@ class FFModel:
         self.strategy = strategy
         self.executor: Optional[Executor] = None
         self.state: Optional[TrainState] = None
+        self.simulator = None  # set by calibrate_simulator()
         self.label_tensor: Optional[Tensor] = None
         # pretrained weights staged by frontends before compile()
         # (applied after init_state; reference Parameter::set_weights role)
@@ -116,6 +117,22 @@ class FFModel:
                        num_entries, out_dim, aggr, kernel_initializer)
         return self.add_op(op).output
 
+    def distributed_embedding(self, inputs: Sequence[Tensor],
+                              num_entries: int, out_dim: int,
+                              aggr: str = "sum",
+                              name: Optional[str] = None,
+                              kernel_initializer="glorot") -> List[Tensor]:
+        """E same-vocab embedding bags as one table-axis-shardable stacked
+        weight — the executable form of the reference's per-device table
+        placement (DLRM strategies, dlrm_strategy.cc:1-50). Returns one
+        (batch, out_dim) tensor per input, in order."""
+        from .ops import DistributedEmbedding
+        op = DistributedEmbedding(
+            self, name or self._fresh_name("dist_embedding"), list(inputs),
+            num_entries, out_dim, aggr, kernel_initializer)
+        self.add_op(op)
+        return list(op.outputs)
+
     def pool2d(self, input: Tensor, kernel_h: int, kernel_w: int,
                stride_h: int, stride_w: int, padding_h: int, padding_w: int,
                pool_type: str = "max", activation=None,
@@ -152,7 +169,7 @@ class FFModel:
                             causal: bool = False,
                             name: Optional[str] = None,
                             kernel_initializer="glorot",
-                            use_flash: bool = True) -> Tensor:
+                            use_flash=None) -> Tensor:
         op = MultiHeadAttention(
             self, name or self._fresh_name("attention"), [query, key, value],
             embed_dim, num_heads, kdim, vdim, dropout, bias, add_bias_kv,
@@ -348,6 +365,17 @@ class FFModel:
             if self.config.export_strategy_file:
                 self.strategy.save(self.config.export_strategy_file)
 
+        if self.strategy is not None:
+            placed = [n for n, s in self.strategy.op_strategies.items()
+                      if s.device_ids]
+            if placed:
+                import warnings
+                warnings.warn(
+                    f"strategy pins {placed} to explicit devices; GSPMD "
+                    f"executes device-explicit placement as replication "
+                    f"— use distributed_embedding table sharding for an "
+                    f"executable equivalent")
+
         self.executor = Executor(self, optimizer, loss_type, metrics,
                                  mesh=self.mesh, strategy=self.strategy)
         self.state = self.executor.init_state(self._next_rng())
@@ -381,6 +409,49 @@ class FFModel:
         self.state, metrics = self.executor.train_step(
             self.state, batch, self._next_rng())
         return metrics
+
+    def calibrate_simulator(self, batch: Optional[Dict] = None,
+                            steps: int = 10):
+        """Ground the execution simulator in a real measured step (the
+        analog of the reference grounding every simulated cost in real
+        on-device kernel timings, src/runtime/model.cu:20-62): measure
+        `steps` training steps, set the simulator's end-to-end time
+        scale, and keep it as `self.simulator` for later queries.
+
+        Returns (measured_step_seconds, predicted_step_seconds) where the
+        prediction is the simulator's PRE-calibration estimate — the
+        number to hold against the MLSys'19 <30% simulator-error envelope
+        (BASELINE.md). Requires compile() first."""
+        from .parallel.mesh import single_device_mesh
+        from .search.measure import calibrated_machine_model
+        from .search.simulator import Simulator
+
+        assert self.executor is not None, "compile() before calibrating"
+        if batch is None:
+            from .core.dataloader import synthetic_batch
+            batch = synthetic_batch(self)
+        mesh = self.mesh or single_device_mesh()
+        sim = Simulator(
+            self, mesh,
+            calibrated_machine_model(
+                mesh, machine_file=self.config.machine_model_file),
+            overlap_backward_sync=(
+                self.config.search_overlap_backward_update))
+        strategy = self.strategy or Strategy()
+        predicted = sim.simulate(strategy)
+        # warmup (jit compile), then measure; a device->host scalar fetch
+        # delimits timing (block_until_ready does not sync through the
+        # remote TPU tunnel)
+        m = self.train_batch(batch)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            m = self.train_batch(batch)
+        float(m["loss"])
+        measured = (time.perf_counter() - t0) / steps
+        sim.calibrate_end_to_end(strategy, measured)
+        self.simulator = sim
+        return measured, predicted
 
     def fit(self, x: Dict[str, np.ndarray], y: np.ndarray,
             batch_size: Optional[int] = None, epochs: Optional[int] = None,
@@ -471,7 +542,13 @@ class FFModel:
         cur = self.state.params[op_name]
         for k, v in weights.items():
             assert cur[k].shape == v.shape, (op_name, k, cur[k].shape, v.shape)
-            cur[k] = jnp.asarray(v, cur[k].dtype)
+            # convert on HOST, then device_put with the parameter's
+            # sharding: only each device's shard transfers, and the
+            # strategy's placement survives (a bare jnp.asarray would
+            # stage the whole array on the default device — an OOM for
+            # weights that are sharded precisely because they don't fit)
+            host = np.asarray(v, dtype=np.dtype(cur[k].dtype))
+            cur[k] = jax.device_put(host, cur[k].sharding)
 
     def set_states(self, op_name: str, states: Dict[str, np.ndarray]):
         """Host set of non-trainable op state (e.g. BN running stats) —
@@ -480,7 +557,8 @@ class FFModel:
         cur = self.state.states[op_name]
         for k, v in states.items():
             assert cur[k].shape == v.shape, (op_name, k, cur[k].shape, v.shape)
-            cur[k] = jnp.asarray(v, cur[k].dtype)
+            host = np.asarray(v, dtype=np.dtype(cur[k].dtype))
+            cur[k] = jax.device_put(host, cur[k].sharding)
 
     def summary(self) -> str:
         lines = [f"{'op':30s} {'type':20s} {'output':24s} {'params':>12s}"]

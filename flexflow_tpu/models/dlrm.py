@@ -24,7 +24,13 @@ def build_dlrm(config: Optional[FFConfig] = None, batch_size: int = None,
                embedding_bag_size: int = 1, embedding_dim: int = 64,
                bot_mlp: Sequence[int] = (512, 256, 64),
                top_mlp: Sequence[int] = (512, 256, 1),
-               mesh=None, strategy=None) -> FFModel:
+               mesh=None, strategy=None,
+               stacked_tables: bool = False) -> FFModel:
+    """stacked_tables=True uses one DistributedEmbedding over all sparse
+    features (requires equal vocab sizes): the executable analog of the
+    reference's per-GPU table placement — map its `table` axis to a mesh
+    axis and each device hosts vocab-complete tables
+    (dlrm_strategy.cc:1-50)."""
     cfg = config or FFConfig()
     bs = batch_size or cfg.batch_size
     ff = FFModel(cfg, mesh=mesh, strategy=strategy)
@@ -45,12 +51,22 @@ def build_dlrm(config: Optional[FFConfig] = None, batch_size: int = None,
         "last bot_mlp width must equal embedding_dim")
 
     # embedding bags (dlrm.cc create_emb; vocab-shardable for ICI
-    # parameter parallelism)
-    embs = [
-        ff.embedding(s, vocab, embedding_dim, aggr="sum", name=f"emb_{i}")
-        for i, (s, vocab) in enumerate(zip(sparse_ins,
-                                           embedding_vocab_sizes))
-    ]
+    # parameter parallelism, or table-sharded when stacked)
+    if stacked_tables:
+        vocabs = set(embedding_vocab_sizes)
+        assert len(vocabs) == 1, (
+            "stacked_tables requires equal vocab sizes, got "
+            f"{sorted(vocabs)}")
+        embs = ff.distributed_embedding(
+            sparse_ins, embedding_vocab_sizes[0], embedding_dim,
+            aggr="sum", name="emb_tables")
+    else:
+        embs = [
+            ff.embedding(s, vocab, embedding_dim, aggr="sum",
+                         name=f"emb_{i}")
+            for i, (s, vocab) in enumerate(zip(sparse_ins,
+                                               embedding_vocab_sizes))
+        ]
 
     # pairwise dot-product interaction (dlrm.cc interact_features):
     # stack features (bs, F, D), compute (bs, F, F) gram via batch_matmul

@@ -22,7 +22,7 @@ def build_transformer(config: Optional[FFConfig] = None,
                       num_layers: int = 6, ff_dim: int = 2048,
                       num_classes: int = 10, dtype=jnp.float32,
                       mesh=None, strategy=None,
-                      use_flash: bool = True) -> FFModel:
+                      use_flash=None) -> FFModel:
     cfg = config or FFConfig()
     bs = batch_size or cfg.batch_size
     ff = FFModel(cfg, mesh=mesh, strategy=strategy)

@@ -48,6 +48,7 @@ WIDTH = "width"
 EXPERT = "expert"
 VOCAB = "vocab"
 LAYER = "layer"
+TABLE = "table"  # stacked embedding tables (DLRM per-table placement)
 REPLICA = None  # dimension never split
 
 

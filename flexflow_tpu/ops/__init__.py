@@ -17,7 +17,7 @@ from .tensor_ops import (
     TopK,
     BatchMatmul,
 )
-from .embedding import Embedding
+from .embedding import DistributedEmbedding, Embedding
 from .attention import MultiHeadAttention
 from .moe import GroupBy, Aggregate
 from .moe_ffn import MoEFFN
@@ -41,6 +41,7 @@ __all__ = [
     "Reverse",
     "TopK",
     "BatchMatmul",
+    "DistributedEmbedding",
     "Embedding",
     "MultiHeadAttention",
     "GroupBy",

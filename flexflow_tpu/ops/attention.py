@@ -41,7 +41,7 @@ class MultiHeadAttention(Op):
                  use_bias: bool = False, add_bias_kv: bool = False,
                  add_zero_attn: bool = False, causal: bool = False,
                  kernel_initializer: str = "glorot",
-                 use_flash: bool = True):
+                 use_flash=None):
         super().__init__(model, name, inputs)
         q, k, v = inputs
         self.embed_dim = int(embed_dim)
@@ -151,14 +151,25 @@ class MultiHeadAttention(Op):
             v = jnp.concatenate([v, zero], axis=1)
         # flash path handles neither seq_length truncation nor the
         # (now off-block-size) zero-attn row; use XLA for those.
-        # Dispatch (measured on v5e): XLA wins at d=64 (lane padding to 128
-        # doubles the kernel's dot FLOPs), flash wins once the materialized
-        # (b,h,sq,sk) score tensor stresses HBM or d fills the lanes.
+        #
+        # use_flash is tri-state: None = auto (measured heuristic below),
+        # True = force the Pallas kernel whenever shapes allow (caller
+        # override), False = never.
+        #
+        # Auto heuristic, measured on v5e (b8/h8, 2026-07 sweep; see
+        # tests_tpu/test_flash_tpu.py): at d=64 the 128-lane padding
+        # doubles the kernel's dot FLOPs and XLA ties or wins (s1024: 4.1
+        # vs 4.8ms fwd); at d=128 flash wins from s>=1024 (causal s1024:
+        # 4.3 vs 5.2ms; s2048: 5.0 vs 7.3ms fwd, 9.7 vs 12.1ms bwd), and
+        # at any d once the materialized (b,h,sq,sk) score tensor would
+        # stress HBM. pad_lanes=False for d=64 showed no consistent win
+        # in the same sweep, so it stays opt-in via flash_attention_bshd.
         b, sq, h, d = q.shape
         sk = k.shape[1]
         score_bytes = b * h * sq * sk * 6  # f32 logits + bf16 probs
         flash_profitable = (d % 128 == 0 and sk >= 1024) or score_bytes > 2**31
-        if (self.use_flash and flash_profitable
+        if ((self.use_flash is True
+             or (self.use_flash is None and flash_profitable))
                 and not has_seq_trunc and not self.add_zero_attn):
             from ..kernels.flash_attention import flash_attention_bshd
             try:

@@ -18,7 +18,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..op import CHANNEL_OUT, SAMPLE, VOCAB, Op, OpContext, WeightSpec, register_op
+from ..op import (
+    CHANNEL_OUT,
+    SAMPLE,
+    TABLE,
+    VOCAB,
+    Op,
+    OpContext,
+    WeightSpec,
+    register_op,
+)
 
 AGGR_MODE_NONE = "none"
 AGGR_MODE_SUM = "sum"
@@ -83,3 +92,92 @@ class Embedding(Op):
     def flops(self) -> float:
         bag = self.inputs[0].shape[-1] if len(self.inputs[0].shape) > 1 else 1
         return float(self.inputs[0].shape[0] * bag * self.out_dim)
+
+
+@register_op
+class DistributedEmbedding(Op):
+    """E same-vocab embedding bags as ONE stacked (E, vocab, dim) weight
+    whose `table` logical axis maps to a mesh axis — the EXECUTABLE form
+    of the reference's per-device table placement (DLRM strategies pin
+    table i to GPU i, examples/cpp/DLRM/strategies/dlrm_strategy.cc:1-50;
+    GSPMD cannot address single devices, so whole-table-per-device
+    becomes table-axis sharding: with E == mesh-axis size each device
+    holds exactly one vocab-complete table, lookups run concurrently
+    where the tables live, and XLA inserts the output all-gather the
+    simulator prices for placed ops).
+
+    Inputs: E index tensors of shape (batch, bag); outputs: E tensors of
+    shape (batch, dim) in the same order (drop-in for a list of
+    `Embedding` ops, models/dlrm.py)."""
+
+    op_type = "distributed_embedding"
+
+    def __init__(self, model, name, inputs, num_entries: int, out_dim: int,
+                 aggr: str = AGGR_MODE_SUM,
+                 kernel_initializer: str = "glorot"):
+        super().__init__(model, name, inputs)
+        assert len(inputs) >= 1
+        bag = inputs[0].shape
+        assert len(bag) == 2, (
+            f"distributed_embedding inputs must be (batch, bag), got "
+            f"{bag}; reshape 1-D indices to (batch, 1)")
+        for t in inputs:
+            assert tuple(t.shape) == tuple(bag), (
+                "all sparse inputs must share (batch, bag) shape")
+        self.num_tables = len(inputs)
+        self.num_entries = int(num_entries)
+        self.out_dim = int(out_dim)
+        self.aggr = aggr
+        self.kernel_initializer = kernel_initializer
+        self.attrs = {"num_tables": self.num_tables,
+                      "num_entries": num_entries, "out_dim": out_dim,
+                      "aggr": aggr}
+
+    def output_shapes(self):
+        bs = self.inputs[0].shape[0]
+        if self.aggr == AGGR_MODE_NONE:
+            return [tuple(self.inputs[0].shape) + (self.out_dim,)] \
+                * self.num_tables
+        return [(bs, self.out_dim)] * self.num_tables
+
+    def output_dtypes(self):
+        return [jnp.dtype(jnp.float32)] * self.num_tables
+
+    def weight_specs(self):
+        return {
+            "kernel": WeightSpec(
+                shape=(self.num_tables, self.num_entries, self.out_dim),
+                initializer=self.kernel_initializer,
+                axes=(TABLE, VOCAB, CHANNEL_OUT),
+                fan_in=self.num_entries, fan_out=self.out_dim,
+            )
+        }
+
+    def forward(self, params, xs, ctx: OpContext):
+        tables = params["kernel"]  # (E, vocab, dim)
+        ids = jnp.stack([x.astype(jnp.int32) for x in xs], axis=0)
+        # per-table gather, vmapped over the stacked axis: sharded on
+        # `table`, each device gathers only from its resident tables and
+        # GSPMD all-gathers the (E, batch, bag, dim) result
+        emb = jax.vmap(lambda w, i: jnp.take(w, i, axis=0))(tables, ids)
+        if self.aggr == AGGR_MODE_SUM:
+            emb = jnp.sum(emb, axis=-2)
+        elif self.aggr == AGGR_MODE_AVG:
+            emb = jnp.mean(emb, axis=-2)
+        return [emb[e] for e in range(self.num_tables)]
+
+    def output_axes(self):
+        n = len(self.outputs[0].shape)  # 3-D when aggr == "none"
+        axes = [None] * n
+        axes[0] = SAMPLE
+        axes[-1] = CHANNEL_OUT
+        return [tuple(axes)] * self.num_tables
+
+    def input_axes(self):
+        axes = [None] * len(self.inputs[0].shape)
+        axes[0] = SAMPLE
+        return [tuple(axes)] * self.num_tables
+
+    def flops(self) -> float:
+        bs, bag = self.inputs[0].shape[0], self.inputs[0].shape[-1]
+        return float(self.num_tables * bs * bag * self.out_dim)

@@ -158,11 +158,28 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
 
     # --- embedding vocab sharding: output psum over vocab axis
     vocab = _axis_size(strategy, mesh, "vocab")
-    if vocab > 1 and op.op_type == "embedding":
+    if vocab > 1 and op.op_type in ("embedding", "distributed_embedding"):
         fwd_comm += mm.all_reduce(act_bytes / dp, vocab,
                                   _axis_name(strategy, "vocab"))
         bwd_comm += mm.all_reduce(act_bytes / dp, vocab,
                                   _axis_name(strategy, "vocab"))
+
+    # --- table sharding (DistributedEmbedding): vocab-complete tables
+    # distributed over the axis — lookups run where the tables live,
+    # outputs all-gather (the executable form of per-device placement)
+    table = _axis_size(strategy, mesh, "table")
+    if table > 1 and op.op_type == "distributed_embedding" \
+            and op.num_tables % table != 0:
+        # the executor's spec_for_axes silently drops a non-dividing
+        # axis (weight stays replicated) — price it the same way
+        table = 1
+    if table > 1 and op.op_type == "distributed_embedding":
+        fwd /= table
+        bwd /= table
+        fwd_comm += mm.all_gather(act_bytes / dp, table,
+                                  _axis_name(strategy, "table"))
+        bwd_comm += mm.all_gather(act_bytes / dp, table,
+                                  _axis_name(strategy, "table"))
 
     # --- SP ring attention: (S-1) kv-shard hops each way
     if sp > 1 and op.op_type == "multihead_attention":
@@ -201,13 +218,14 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
     # data axis (the reference's NCCL all-reduce / PS update+prefetch,
     # optimizer_kernel.cu:113-180)
     if dp > 1 and w_bytes > 0:
-        # weights sharded over model/expert/pipe/vocab axes reduce
+        # weights sharded over model/expert/pipe/vocab/table axes reduce
         # per-device grad bytes proportionally
-        sync = mm.all_reduce(w_bytes / max(1, eff_tp * ep * pp * vocab),
-                             dp, _axis_name(strategy, "sample"))
+        sync = mm.all_reduce(
+            w_bytes / max(1, eff_tp * ep * pp * vocab * table),
+            dp, _axis_name(strategy, "sample"))
 
     # --- memory: weights (+ optimizer state) + activations per device
-    w_per_dev = w_bytes / max(1, eff_tp * ep * pp * vocab)
+    w_per_dev = w_bytes / max(1, eff_tp * ep * pp * vocab * table)
     act_per_dev = act_bytes / shards
     mem = w_per_dev * (1.0 + optimizer_state_mult) + act_per_dev * 2
 

@@ -21,7 +21,7 @@ import random
 from typing import Dict, List, Optional
 
 from ..parallel.pconfig import DEVICE_KEY, OpStrategy, Strategy
-from .machine_model import default_machine_model
+from .measure import calibrated_machine_model
 from .simulator import Simulator, op_edges
 
 
@@ -51,15 +51,21 @@ def candidate_maps(op, mesh, cfg, op_index: int = 0) -> List[Dict[str, str]]:
             cands.append({**base, "head": model_ax})
         if cfg.enable_parameter_parallel and op.op_type == "embedding":
             cands.append({**base, "vocab": model_ax})
+        if cfg.enable_parameter_parallel \
+                and op.op_type == "distributed_embedding":
+            cands.append({**base, "vocab": model_ax})
+            cands.append({**base, "table": model_ax})
 
     # device-explicit placement ("Operator"/"Parameter" dims of SOAP:
     # reference ParallelConfig.device_ids, config.h:47-73) — pin the
     # whole op to one device, round-robin by op index like the DLRM
-    # strategy generator. Offered for embeddings (the op the reference
-    # places per-device) when the mesh has more than one device.
+    # strategy generator. OPT-IN (--enable-device-placement): GSPMD
+    # executes these as replication, so by default the search only
+    # offers executable candidates (table sharding on
+    # distributed_embedding is the executable placement form).
     n_dev = int(mesh.size) if hasattr(mesh, "size") else 1
-    if (cfg.enable_parameter_parallel and op.op_type == "embedding"
-            and n_dev > 1):
+    if (getattr(cfg, "enable_device_placement", False)
+            and op.op_type == "embedding" and n_dev > 1):
         cands.append({DEVICE_KEY: (op_index % n_dev,)})
 
     if cfg.enable_sequence_parallel and "seq" in axes:
@@ -159,15 +165,18 @@ def optimize_with_mesh(model, budget: int = 1000, alpha: float = 0.05,
     n = len(devices)
     cfg = model.config
     shapes = enumerate_mesh_shapes(n, model, cfg)
-    per_budget = max(50, budget // max(1, len(shapes)))
+    # budget is the TOTAL iteration count across all factorizations
+    # (reference --budget semantics): a per-shape floor would silently
+    # multiply a deliberately small budget several-fold
+    per_budget = max(1, budget // max(1, len(shapes)))
     best = None  # (cost, strategy, mesh)
     for shape in shapes:
         mesh = make_mesh(tuple(shape.values()), tuple(shape.keys()),
                          devices)
         sim = Simulator(
             model, mesh,
-            default_machine_model(mesh,
-                                  machine_file=cfg.machine_model_file),
+            calibrated_machine_model(
+                mesh, machine_file=cfg.machine_model_file),
             overlap_backward_sync=cfg.search_overlap_backward_update)
         strat = optimize(model, budget=per_budget, alpha=alpha, mesh=mesh,
                          seed=seed, verbose=False, simulator=sim)
@@ -207,7 +216,8 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
     cfg = model.config
     sim = simulator or Simulator(
         model, mesh,
-        default_machine_model(mesh, machine_file=cfg.machine_model_file),
+        calibrated_machine_model(mesh,
+                                 machine_file=cfg.machine_model_file),
         overlap_backward_sync=cfg.search_overlap_backward_update)
     rng = random.Random(seed)
 
@@ -228,6 +238,12 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
     needs_python = (
         cfg.perform_fusion
         or any(DEVICE_KEY in m for lst in cands.values() for m in lst)
+        # an imported/init strategy can carry placements even when the
+        # candidate space offers none (lower_to_arrays appends the init
+        # map into the native candidate lists)
+        or (model.strategy is not None
+            and any(s.device_ids
+                    for s in model.strategy.op_strategies.values()))
         or ("pipe" in mesh.shape
             and any(op.op_type == "pipeline_blocks" for op in model.ops)))
     if needs_python:

@@ -131,6 +131,9 @@ class Simulator:
         # (calibrate_end_to_end); scales predictions without changing the
         # relative ordering the search depends on.
         self.time_scale = 1.0
+        # calibrated fixed dispatch cost added once per simulated step
+        # (strategy-independent; never changes the ranking)
+        self.step_overhead = self.mm.efficiency.get("step_overhead_s", 0.0)
         # strategy-independent graph maps, built once (the annealing loop
         # calls simulate() thousands of times)
         self._producer, _ = op_edges(model)
@@ -143,11 +146,25 @@ class Simulator:
                              measured_step_seconds: float) -> float:
         """Set time_scale so the *step-time* part of simulate(strategy)
         equals the measured step time (the memory penalty is excluded
-        from scaling) — the TPU analog of the reference grounding its
-        model in real kernel measurements. Returns the scale applied."""
+        from scaling, and the calibrated fixed dispatch overhead is
+        subtracted from the measurement first) — the TPU analog of the
+        reference grounding its model in real kernel measurements.
+        Returns the scale applied."""
         raw, _penalty = self._simulate_raw(strategy)
+        if measured_step_seconds <= self.step_overhead:
+            # overhead-bound step: subtracting would zero the scale and
+            # make every strategy simulate identically — drop the
+            # overhead split and scale against the whole measurement
+            import warnings
+            warnings.warn(
+                f"measured step ({measured_step_seconds*1e6:.0f}us) is "
+                f"within the calibrated dispatch overhead "
+                f"({self.step_overhead*1e6:.0f}us); calibrating without "
+                f"the overhead split")
+            self.step_overhead = 0.0
         if raw > 0:
-            self.time_scale = measured_step_seconds / raw
+            self.time_scale = (measured_step_seconds
+                               - self.step_overhead) / raw
         return self.time_scale
 
     def _op_cost(self, op, strategy: Strategy) -> OpCost:
@@ -199,9 +216,12 @@ class Simulator:
 
     def simulate(self, strategy: Strategy,
                  dot_path: Optional[str] = None) -> float:
-        """Estimated seconds per training step under `strategy`."""
+        """Estimated seconds per training step under `strategy`. The
+        calibrated fixed dispatch cost (measure_step_overhead) is added
+        once per step — strategy-independent, so it never changes the
+        ranking, only absolute accuracy."""
         step_time, penalty = self._simulate_raw(strategy, dot_path)
-        return step_time * self.time_scale + penalty
+        return step_time * self.time_scale + penalty + self.step_overhead
 
     def _simulate_raw(self, strategy: Strategy,
                       dot_path: Optional[str] = None):

@@ -31,10 +31,37 @@ def run_example(script, *args, cpu_devices=2, timeout=240):
     ("examples/python/native/transformer.py", ["-b", "8", "-e", "1"]),
     ("examples/python/native/dlrm.py", ["-b", "16", "-e", "1"]),
     ("examples/python/native/moe.py", ["-b", "16", "-e", "1"]),
+    ("examples/python/native/mnist_mlp.py", ["-b", "64", "-e", "1"]),
+    ("examples/python/native/mnist_cnn.py",
+     ["-b", "16", "--samples", "32", "-e", "1"]),
+    ("examples/python/native/cifar10_cnn.py",
+     ["-b", "16", "--samples", "32", "-e", "1"]),
+    ("examples/python/native/split.py", ["-b", "32", "-e", "1"]),
+    ("examples/python/native/print_layers.py", ["-b", "32", "-e", "1"]),
+    ("examples/python/native/reshape.py", ["-b", "32", "-e", "1"]),
 ])
 def test_native_examples_run(script, args):
     out = run_example(script, *args)
-    assert "loss" in out
+    assert "loss" in out or "accuracy" in out
+
+
+# the reference's multi_gpu_tests.sh Keras legs: sequential, functional,
+# and misc (callback/unary) scripts, pass = clean exit + a final metric
+@pytest.mark.parametrize("script", [
+    "examples/python/keras/seq_mnist_mlp.py",
+    "examples/python/keras/seq_mnist_cnn.py",
+    "examples/python/keras/seq_cifar10_cnn.py",
+    "examples/python/keras/func_mnist_mlp.py",
+    "examples/python/keras/func_mnist_mlp_concat.py",
+    "examples/python/keras/func_mnist_cnn_concat.py",
+    "examples/python/keras/func_cifar10_alexnet.py",
+    "examples/python/keras/func_cifar10_cnn_concat.py",
+    "examples/python/keras/callback.py",
+    "examples/python/keras/unary.py",
+])
+def test_keras_examples_run(script):
+    out = run_example(script, "-e", "1")
+    assert "final" in out
 
 
 def test_keras_mnist_mlp_learns():

@@ -52,7 +52,15 @@ def pp_strategy():
 def test_gpipe_expansion_exact_makespan():
     """The event-loop expansion must reproduce the GPipe schedule exactly:
     with uniform stages and no hop cost, forward takes (M+S-1) ticks and
-    backward another (M+S-1) ticks after the forward join."""
+    backward another (M+S-1) ticks after the forward join.
+
+    Note on wall-clock validation: on the forced 8-device CPU platform
+    all "devices" share the same physical cores, so the bubble the
+    schedule models never appears in measured step time (measured
+    M=2 vs M=8 ratio ~1.05 where disjoint hardware would show ~1.8) —
+    schedule structure is validated exactly here, and absolute
+    simulator-vs-real time is validated on real hardware by the
+    TPU-gated calibration test (test_calibration_tpu.py)."""
     S, M, f, b = 4, 6, 1.0, 2.0
     pc = PipelineCost(stages=S, microbatches=M, fwd_stage=f, bwd_stage=b,
                       hop=0.0)
@@ -138,6 +146,9 @@ def build_dlrm_for_search(vocab=100_000, batch=1024):
     cfg = FFConfig()
     cfg.batch_size = batch
     cfg.enable_parameter_parallel = True
+    # device-explicit candidates are opt-in (they execute as replication
+    # under GSPMD; the executable form is distributed_embedding)
+    cfg.enable_device_placement = True
     return build_dlrm(cfg, batch_size=batch,
                       embedding_vocab_sizes=(vocab,) * 8)
 
