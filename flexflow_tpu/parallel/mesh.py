@@ -53,6 +53,11 @@ class MachineSpec:
     ici_latency: float = 1e-6
     dcn_bandwidth: float = 25e9
     dcn_latency: float = 10e-6
+    # chips sharing one host NIC: DCN collectives funnel every local
+    # chip's traffic through it, so effective per-chip DCN bandwidth is
+    # dcn_bandwidth/chips_per_host (the reference's EnhancedMachineModel
+    # models the same shared-NIC congestion, machine_model.cc:172+)
+    chips_per_host: int = 4
 
     @staticmethod
     def v5e(num_chips: int = 1) -> "MachineSpec":

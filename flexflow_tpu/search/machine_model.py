@@ -46,7 +46,12 @@ class TPUMachineModel:
     # ---- collectives (ring formulas over the relevant axis) ----
     def _bw_lat(self, axis: Optional[str]):
         if axis is not None and axis in self.dcn_axes:
-            return (self.spec.dcn_bandwidth, self.spec.dcn_latency)
+            # shared-NIC congestion: every chip on the host funnels its
+            # cross-host traffic through one NIC (reference
+            # EnhancedMachineModel congestion, machine_model.cc:172+)
+            sharers = max(1, self.spec.chips_per_host)
+            return (self.spec.dcn_bandwidth / sharers,
+                    self.spec.dcn_latency)
         return (self.spec.ici_bandwidth * self.efficiency["collective"],
                 self.spec.ici_latency)
 
@@ -116,12 +121,14 @@ def default_machine_model(mesh=None, spec: Optional[MachineSpec] = None,
                 spec = MachineSpec()
         except Exception:
             pass
+    file_keys = set()
     if machine_file:
         with open(machine_file) as f:
             data = json.load(f)
         for k, v in data.items():
             if hasattr(spec, k):
                 setattr(spec, k, v)
+                file_keys.add(k)
     dcn_axes = ()
     if mesh is not None:
         spec.num_chips = int(mesh.size)
@@ -129,6 +136,10 @@ def default_machine_model(mesh=None, spec: Optional[MachineSpec] = None,
             import jax
             if jax.process_count() > 1 and "data" in mesh.shape:
                 dcn_axes = ("data",)
+                # autodetected topology must not clobber an explicit
+                # machine-file value (the documented override path)
+                if "chips_per_host" not in file_keys:
+                    spec.chips_per_host = max(1, jax.local_device_count())
         except Exception:
             pass
     return TPUMachineModel(spec=spec, dcn_axes=dcn_axes)

@@ -12,7 +12,18 @@ the TPU tunnel up. Everything skips cleanly off-TPU.
 import pytest
 
 
+import os
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
 def pytest_collection_modifyitems(config, items):
+    # session-scoped hook: only gate items that live in THIS directory
+    # (a mixed `pytest tests/ tests_tpu/` run must not skip tests/)
+    ours = [it for it in items
+            if str(getattr(it, "path", "")).startswith(_HERE)]
+    if not ours:
+        return
     import jax
     try:
         on_tpu = jax.default_backend() == "tpu"
@@ -20,5 +31,5 @@ def pytest_collection_modifyitems(config, items):
         on_tpu = False
     if not on_tpu:
         skip = pytest.mark.skip(reason="requires a real TPU backend")
-        for item in items:
+        for item in ours:
             item.add_marker(skip)
