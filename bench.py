@@ -92,17 +92,20 @@ def build(model: str, preset: str):
     elif model == "alexnet":
         batch = {"full": 256, "small": 128, "tiny": 16}[preset]
         cfg.batch_size = batch
-        ff = zoo.build_alexnet(cfg, batch_size=batch)
+        # bf16 activations (weights f32): MXU-native mixed precision,
+        # same mode the transformer config benches in
+        ff = zoo.build_alexnet(cfg, batch_size=batch, dtype=jnp.bfloat16)
         data = {"input": jnp.asarray(
-            rng.randn(batch, 3, 32, 32), jnp.float32),
+            rng.randn(batch, 3, 32, 32), jnp.bfloat16),
             "label": jnp.asarray(rng.randint(0, 10, (batch,)), jnp.int32)}
     elif model == "inception":
         batch = {"full": 32, "small": 16, "tiny": 4}[preset]
         size = {"full": 299, "small": 299, "tiny": 75}[preset]
         cfg.batch_size = batch
-        ff = zoo.build_inception_v3(cfg, batch_size=batch, image_size=size)
+        ff = zoo.build_inception_v3(cfg, batch_size=batch, image_size=size,
+                                    dtype=jnp.bfloat16)
         data = {"input": jnp.asarray(
-            rng.randn(batch, 3, size, size), jnp.float32),
+            rng.randn(batch, 3, size, size), jnp.bfloat16),
             "label": jnp.asarray(rng.randint(0, 10, (batch,)), jnp.int32)}
     elif model == "dlrm":
         batch = {"full": 1024, "small": 512, "tiny": 64}[preset]

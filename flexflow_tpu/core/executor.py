@@ -247,10 +247,16 @@ class Executor:
     def shard_batch(self, batch: Dict[str, np.ndarray]):
         """Place a host batch on device(s), sharded over the data axis —
         the TPU analog of SingleDataLoader::next_batch's per-part copies
-        (flexflow_dataloader.cc:649-740)."""
+        (flexflow_dataloader.cc:649-740). Inputs are cast to their
+        DECLARED tensor dtype (a bf16 model fed f32 numpy trains in bf16,
+        like the reference loader honoring the region's type)."""
+        declared = {t.name: t.dtype for t in self.model.input_tensors}
         out = {}
         for k, v in batch.items():
             arr = jnp.asarray(v)
+            want = declared.get(k)
+            if want is not None and arr.dtype != want:
+                arr = arr.astype(want)
             if self.mesh is not None:
                 out[k] = jax.device_put(
                     arr, batch_sharding(self.mesh, arr.ndim))

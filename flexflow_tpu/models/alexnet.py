@@ -15,11 +15,16 @@ from ..model import FFModel
 
 def build_alexnet(config: Optional[FFConfig] = None, batch_size: int = None,
                   num_classes: int = 10, image_size: int = 32,
-                  mesh=None, strategy=None) -> FFModel:
+                  mesh=None, strategy=None, dtype=None) -> FFModel:
+    """dtype=jnp.bfloat16 runs activations in bf16 (weights stay f32,
+    cast per-op) — the idiomatic TPU mixed-precision training mode that
+    keeps the convs on the MXU's native bf16 path."""
+    import jax.numpy as jnp
     cfg = config or FFConfig()
     bs = batch_size or cfg.batch_size
     ff = FFModel(cfg, mesh=mesh, strategy=strategy)
-    x = ff.create_tensor((bs, 3, image_size, image_size), name="input")
+    x = ff.create_tensor((bs, 3, image_size, image_size),
+                         dtype=dtype or jnp.float32, name="input")
 
     if image_size >= 64:
         # ImageNet-scale geometry (alexnet.cc:60-80)

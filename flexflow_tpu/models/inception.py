@@ -88,11 +88,15 @@ def _inception_e(ff, t, name):
 def build_inception_v3(config: Optional[FFConfig] = None,
                        batch_size: int = None, num_classes: int = 10,
                        image_size: int = 299, mesh=None,
-                       strategy=None) -> FFModel:
+                       strategy=None, dtype=None) -> FFModel:
+    """dtype=jnp.bfloat16 runs activations in bf16 (weights stay f32,
+    cast per-op) — mixed precision on the MXU's native path."""
+    import jax.numpy as jnp
     cfg = config or FFConfig()
     bs = batch_size or cfg.batch_size
     ff = FFModel(cfg, mesh=mesh, strategy=strategy)
-    x = ff.create_tensor((bs, 3, image_size, image_size), name="input")
+    x = ff.create_tensor((bs, 3, image_size, image_size),
+                         dtype=dtype or jnp.float32, name="input")
 
     if image_size >= 128:
         t = _conv_bn(ff, x, 32, 3, 3, 2, 2, 0, 0, "stem1")

@@ -92,6 +92,10 @@ class Conv2D(Op):
     def forward(self, params, xs, ctx: OpContext):
         (x,) = xs
         ph, pw = self.padding
+        # no preferred_element_type: the MXU accumulates bf16 convs in
+        # f32 natively, and conv's gradient transpose rejects the mixed
+        # f32-cotangent/bf16-operand pair the flag would create (unlike
+        # dot_general's); output dtype follows the activations.
         y = lax.conv_general_dilated(
             x,
             params["kernel"].astype(x.dtype),
@@ -99,8 +103,7 @@ class Conv2D(Op):
             padding=[(ph, ph), (pw, pw)],
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=self.groups,
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+        )
         if self.use_bias:
             y = y + params["bias"].reshape(1, -1, 1, 1).astype(y.dtype)
         return [apply_activation(y, self.activation)]

@@ -112,6 +112,7 @@ def default_machine_model(mesh=None, spec: Optional[MachineSpec] = None,
     fields via JSON — the analog of the reference's machine config file
     (machine_config_example). A multi-host run marks the mesh's `data`
     axis as DCN-resident (cross-slice collectives priced at DCN rates)."""
+    user_spec = spec is not None
     if spec is None:
         spec = MachineSpec.v5e()
         try:
@@ -137,8 +138,8 @@ def default_machine_model(mesh=None, spec: Optional[MachineSpec] = None,
             if jax.process_count() > 1 and "data" in mesh.shape:
                 dcn_axes = ("data",)
                 # autodetected topology must not clobber an explicit
-                # machine-file value (the documented override path)
-                if "chips_per_host" not in file_keys:
+                # value — from the machine file OR a caller-built spec
+                if "chips_per_host" not in file_keys and not user_spec:
                     spec.chips_per_host = max(1, jax.local_device_count())
         except Exception:
             pass

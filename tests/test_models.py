@@ -41,6 +41,25 @@ def test_alexnet_smoke():
                       "label": rng.randint(0, 10, 8).astype(np.int32)})
 
 
+def test_alexnet_bf16_mixed_precision_trains():
+    """bf16 activations / f32 weights (the mode bench.py measures in):
+    the conv gradient transpose must accept the mixed pair (regression:
+    preferred_element_type=f32 made jax.grad of conv raise on bf16
+    inputs)."""
+    import jax.numpy as jnp
+
+    ff = build_alexnet(_cfg(8), batch_size=8, image_size=32,
+                       dtype=jnp.bfloat16)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    assert ff.state.params["conv2d"]["kernel"].dtype == jnp.float32
+    rng = np.random.RandomState(0)
+    m = _train_steps(
+        ff, {"input": rng.randn(8, 3, 32, 32).astype(np.float32),
+             "label": rng.randint(0, 10, 8).astype(np.int32)})
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_resnet18_smoke():
     ff = build_resnet(_cfg(4), depth=18, batch_size=4, image_size=32)
     ff.compile(optimizer=SGDOptimizer(lr=0.01),

@@ -19,9 +19,11 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 
 def pytest_collection_modifyitems(config, items):
     # session-scoped hook: only gate items that live in THIS directory
-    # (a mixed `pytest tests/ tests_tpu/` run must not skip tests/)
-    ours = [it for it in items
-            if str(getattr(it, "path", "")).startswith(_HERE)]
+    # (a mixed `pytest tests/ tests_tpu/` run must not skip tests/).
+    # fspath exists across pytest versions; the trailing separator stops
+    # a sibling tests_tpu_* dir from matching.
+    prefix = _HERE + os.path.sep
+    ours = [it for it in items if str(it.fspath).startswith(prefix)]
     if not ours:
         return
     import jax
