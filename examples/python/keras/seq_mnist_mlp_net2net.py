@@ -1,0 +1,62 @@
+"""Net2Net-style example (reference:
+examples/python/keras/seq_mnist_mlp_net2net.py; tests/multi_gpu_tests.sh):
+train a teacher MLP, widen it, seed the student's weights from the
+teacher (host get/set weights — the reference Parameter::get/set role),
+and continue training.
+
+  python examples/python/keras/seq_mnist_mlp_net2net.py -e 2
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def make(width):
+    model = keras.Sequential([
+        keras.layers.Dense(width, activation="relu", input_shape=(784,)),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer=keras.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    return model
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 2
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(1024, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+
+    teacher = make(128)
+    teacher.fit(x, y, batch_size=64, epochs=epochs)
+
+    # widen 128 -> 256: copy the teacher's columns, random-init the rest
+    student = make(256)
+    student.fit(x, y, batch_size=64, epochs=1)  # builds the FFModel
+    t_ff, s_ff = teacher.ffmodel, student.ffmodel
+    t_ops = [op.name for op in t_ff.ops if op.op_type == "linear"]
+    s_ops = [op.name for op in s_ff.ops if op.op_type == "linear"]
+    tw0 = t_ff.get_weights(t_ops[0])
+    sw0 = {k: v.copy() for k, v in s_ff.get_weights(s_ops[0]).items()}
+    sw0["kernel"][:, :128] = tw0["kernel"]
+    sw0["bias"][:128] = tw0["bias"]
+    s_ff.set_weights(s_ops[0], sw0)
+    tw1 = t_ff.get_weights(t_ops[1])
+    sw1 = {k: v.copy() for k, v in s_ff.get_weights(s_ops[1]).items()}
+    sw1["kernel"][:128, :] = tw1["kernel"]
+    sw1["bias"][:] = tw1["bias"]
+    s_ff.set_weights(s_ops[1], sw1)
+
+    hist = student.fit(x, y, batch_size=64, epochs=epochs)
+    print(f"final accuracy: {hist[-1]['accuracy']:.3f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -253,10 +253,11 @@ class Executor:
         declared = {t.name: t.dtype for t in self.model.input_tensors}
         out = {}
         for k, v in batch.items():
-            arr = jnp.asarray(v)
             want = declared.get(k)
-            if want is not None and arr.dtype != want:
-                arr = arr.astype(want)
+            # single-pass conversion: asarray+astype would materialize
+            # the batch twice on device per step
+            arr = jnp.asarray(v, dtype=want) if want is not None \
+                else jnp.asarray(v)
             if self.mesh is not None:
                 out[k] = jax.device_put(
                     arr, batch_sharding(self.mesh, arr.ndim))

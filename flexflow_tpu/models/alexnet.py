@@ -9,6 +9,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax.numpy as jnp
+
 from ..config import FFConfig
 from ..model import FFModel
 
@@ -19,7 +21,6 @@ def build_alexnet(config: Optional[FFConfig] = None, batch_size: int = None,
     """dtype=jnp.bfloat16 runs activations in bf16 (weights stay f32,
     cast per-op) — the idiomatic TPU mixed-precision training mode that
     keeps the convs on the MXU's native bf16 path."""
-    import jax.numpy as jnp
     cfg = config or FFConfig()
     bs = batch_size or cfg.batch_size
     ff = FFModel(cfg, mesh=mesh, strategy=strategy)

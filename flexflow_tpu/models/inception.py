@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax.numpy as jnp
+
 from ..config import FFConfig
 from ..model import FFModel
 
@@ -91,7 +93,6 @@ def build_inception_v3(config: Optional[FFConfig] = None,
                        strategy=None, dtype=None) -> FFModel:
     """dtype=jnp.bfloat16 runs activations in bf16 (weights stay f32,
     cast per-op) — mixed precision on the MXU's native path."""
-    import jax.numpy as jnp
     cfg = config or FFConfig()
     bs = batch_size or cfg.batch_size
     ff = FFModel(cfg, mesh=mesh, strategy=strategy)

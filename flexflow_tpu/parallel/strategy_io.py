@@ -26,9 +26,21 @@ from .pconfig import OpStrategy, ParallelConfig, Strategy
 
 def op_parallel_config(op: Op, strategy: OpStrategy, mesh) -> ParallelConfig:
     """Derive the reference-style view: per-output-dim split counts +
-    explicit device ids (row-major over the mesh submesh used)."""
+    explicit device ids (row-major over the mesh submesh used).
+
+    A device-explicit OpStrategy (the reference's own device_ids,
+    config.h:47-73) exports unsplit dims with its literal device list —
+    exactly how the DLRM strategy files pinned tables
+    (dlrm_strategy.cc:1-50)."""
     out_axes = op.output_axes()[0] if op.outputs else ()
     out_shape = op.outputs[0].shape if op.outputs else ()
+    if strategy.device_ids:
+        # device_type "tpu_pin" marks an EXPLICIT placement: the format
+        # cannot otherwise distinguish "pinned to device 0" from the
+        # default single-part [0] device list
+        return ParallelConfig(device_type="tpu_pin",
+                              dims=[1] * max(1, len(out_axes)),
+                              device_ids=list(strategy.device_ids))
     dims = []
     used_axes = []
     for i, ax in enumerate(out_axes):
@@ -70,9 +82,10 @@ def load_strategies_from_file(model, mesh, path: str) -> Strategy:
     strat = Strategy()
     for line in tokens[1:n + 1]:
         parts = line.split()
-        name, _dev = parts[0], parts[1]
+        name, dev_type = parts[0], parts[1]
         ndims = int(parts[2])
         dims = [int(x) for x in parts[3:3 + ndims]]
+        device_ids = [int(x) for x in parts[3 + ndims:]]
         op = ops_by_name.get(name)
         if op is None:
             continue
@@ -87,5 +100,23 @@ def load_strategies_from_file(model, mesh, path: str) -> Strategy:
                     axis_map[out_axes[i]] = mesh_ax
                     used.add(mesh_ax)
                     break
+        # explicit placement: the "tpu_pin" device-type marker, or an
+        # unsplit op whose device list differs from the default range
+        # (how the reference's DLRM strategy files pin tables)
+        n_parts = int(np.prod(dims)) if dims else 1
+        if device_ids and (dev_type == "tpu_pin"
+                           or (not axis_map
+                               and device_ids != list(range(n_parts)))):
+            from .pconfig import DEVICE_KEY
+            axis_map = {DEVICE_KEY: tuple(device_ids)}
+        elif (axis_map and device_ids
+                and device_ids != list(range(n_parts))):
+            # split AND explicitly placed: the mesh-axis mapping cannot
+            # carry the id list — be honest about the approximation
+            import warnings
+            warnings.warn(
+                f"strategy file op {name!r}: explicit device ids "
+                f"{device_ids} on a split op are not representable as a "
+                f"mesh-axis mapping; loading the split only")
         strat.set(name, OpStrategy(axis_map))
     return strat

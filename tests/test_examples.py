@@ -39,6 +39,7 @@ def run_example(script, *args, cpu_devices=2, timeout=240):
     ("examples/python/native/split.py", ["-b", "32", "-e", "1"]),
     ("examples/python/native/print_layers.py", ["-b", "32", "-e", "1"]),
     ("examples/python/native/reshape.py", ["-b", "32", "-e", "1"]),
+    ("examples/python/native/mnist_mlp_attach.py", ["-b", "64", "-e", "1"]),
 ])
 def test_native_examples_run(script, args):
     out = run_example(script, *args)
@@ -62,6 +63,18 @@ def test_native_examples_run(script, args):
 def test_keras_examples_run(script):
     out = run_example(script, "-e", "1")
     assert "final" in out
+
+
+def test_keras_net2net_example():
+    out = run_example("examples/python/keras/seq_mnist_mlp_net2net.py",
+                      "-e", "1")
+    assert "final accuracy" in out
+
+
+def test_pytorch_cnn_example():
+    out = run_example("examples/python/pytorch/mnist_cnn_torch.py",
+                      "-e", "1")
+    assert "final loss" in out
 
 
 def test_keras_mnist_mlp_learns():

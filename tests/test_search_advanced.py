@@ -193,6 +193,27 @@ def test_placed_strategy_roundtrips_via_json(tmp_path):
     assert isinstance(loaded.for_op(emb).device_ids, tuple)
 
 
+def test_placed_strategy_roundtrips_via_reference_text(tmp_path):
+    """The reference text format carries explicit device ids natively
+    (strategy.cc:95-189; DLRM strategy files pin tables by id) — placed
+    strategies must survive export/import through it."""
+    from flexflow_tpu.parallel.strategy_io import (
+        load_strategies_from_file,
+        save_strategies_to_file,
+    )
+
+    ff = build_dlrm_for_search()
+    mesh = make_mesh((1, 8), ("data", "model"))
+    s = table_placed(ff, 8)
+    path = str(tmp_path / "strategy.txt")
+    save_strategies_to_file(ff, s, mesh, path)
+    loaded = load_strategies_from_file(ff, mesh, path)
+    for op in ff.ops:
+        if op.op_type == "embedding":
+            assert loaded.for_op(op.name).device_ids == \
+                s.for_op(op.name).device_ids, op.name
+
+
 def test_native_engine_rejects_placement_candidates():
     ff = build_dlrm_for_search()
     mesh = make_mesh((1, 8), ("data", "model"))
