@@ -1,0 +1,47 @@
+"""keras_exp-frontend example (reference:
+examples/python/keras_exp/mnist_mlp.py — import a REAL tf.keras model
+object). Import-gated: without tensorflow this prints a clear skip
+message and exits 0.
+
+  python examples/python/keras_exp/func_mnist_mlp_exp.py -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends.keras_exp import HAS_TF
+
+
+def top_level_task():
+    if not HAS_TF:
+        print("tensorflow not installed; skipping "
+              "(pip install tensorflow to run)")
+        return
+
+    from tensorflow import keras as tfk
+
+    from flexflow_tpu.frontends.keras_exp import from_tf_keras
+
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+
+    inp = tfk.Input((784,))
+    t = tfk.layers.Dense(256, activation="relu")(inp)
+    out = tfk.layers.Dense(10, activation="softmax")(t)
+    tf_model = tfk.Model(inp, out)
+
+    ff = from_tf_keras(tf_model, batch_size=64)
+    ff.compile(loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    hist = ff.fit({ff.input_tensors[0].name: x}, y, epochs=epochs)
+    print(f"final accuracy: {hist[-1]['accuracy']:.3f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,61 @@
+"""ONNX-frontend example (reference: examples/python/onnx/mnist_mlp.py
+— import an ONNX graph and train it). Import-gated: without the `onnx`
+package this prints a clear skip message and exits 0, matching the
+frontend's fail-loudly-only-when-used policy.
+
+  python examples/python/onnx/mnist_mlp_onnx.py -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.frontends.onnx import HAS_ONNX
+
+
+def top_level_task():
+    if not HAS_ONNX:
+        print("onnx not installed; skipping (pip install onnx to run)")
+        return
+    try:
+        import torch
+        import torch.nn as nn
+    except ImportError:
+        print("onnx not installed with torch; this example exports the "
+              "test graph via torch.onnx (pip install torch to run)")
+        return
+
+    from flexflow_tpu.frontends.onnx import ONNXModel
+
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = 64
+
+    module = nn.Sequential(nn.Linear(784, 256), nn.ReLU(),
+                           nn.Linear(256, 10), nn.Softmax(dim=-1))
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".onnx") as f:
+        torch.onnx.export(module, torch.randn(bs, 784), f.name,
+                          input_names=["input"])
+        om = ONNXModel(f.name)
+
+    cfg = FFConfig.from_args()
+    cfg.batch_size = bs
+    ff = FFModel(cfg)
+    inp = ff.create_tensor((bs, 784), name="input")
+    om.apply(ff, {"input": inp})
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    hist = ff.fit({"input": x}, y, epochs=epochs)
+    print(f"final accuracy: {hist[-1]['accuracy']:.3f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -45,9 +45,13 @@ def top_level_task():
 
     module = CNN()
     with tempfile.TemporaryDirectory() as td:
+        # graph round-trips through the .ff text format (weights do not
+        # travel in it — the reference's format is graph-only too)
         path = os.path.join(td, "mnist_cnn.ff")
         export_ff(module, path)
-        ptm = PyTorchModel(path)
+        PyTorchModel(path)  # parse check of the exported file
+    # weight import needs the live module (PyTorchModel.import_weights)
+    ptm = PyTorchModel(module)
 
     cfg = FFConfig.from_args()
     cfg.batch_size = batch_size
@@ -57,6 +61,7 @@ def top_level_task():
     ff.compile(optimizer=SGDOptimizer(lr=0.01),
                loss_type="sparse_categorical_crossentropy",
                metrics=["accuracy"])
+    ptm.import_weights(ff)  # start from the torch module's weights
 
     rng = np.random.RandomState(0)
     x = rng.randn(128, 1, 28, 28).astype(np.float32)

@@ -4,14 +4,13 @@ Reference: python/flexflow/keras_exp/models/model.py:36-424 — walks a
 genuine tf.keras model object (rather than this package's Keras-clone
 layer classes) and replays it onto the framework's builder API.
 
-TensorFlow is not part of this image (zero egress), but the importer
-never needs the ``tensorflow`` module itself: every access goes through
-the *model object's* own protocol (``.inputs``, ``.layers``,
-``layer.get_config()``, ``layer.get_weights()``), so any object that
-duck-types tf.keras works — which is also how the handler table is
-exercised in tests without TF (tests/test_frontends.py). `HAS_TF`
-reports whether real TF is importable for callers that want to build
-models here.
+The importer never needs the ``tensorflow`` module itself: every access
+goes through the *model object's* own protocol (``.inputs``,
+``.layers``, ``layer.get_config()``, ``layer.get_weights()``), so any
+object that duck-types tf.keras works — the handler table is exercised
+both deps-free through stubs and, when TF is importable (`HAS_TF`),
+against real tf.keras models (tests/test_frontends.py). Keras 2 and
+Keras 3 symbolic tensors are both supported (`_tref`).
 
 Weight import is an explicit per-layer-type mapping (NOT shape
 matching): tf Conv2D kernels are HWIO and are transposed to this
@@ -36,6 +35,14 @@ except Exception:  # pragma: no cover - image ships without TF
     HAS_TF = False
 
 
+def _tref(t):
+    """Hashable key for a tf/keras symbolic tensor: Keras 2 tensors need
+    .ref() (not hashable themselves); Keras 3 KerasTensors have no
+    .ref() and are identity-keyed."""
+    ref = getattr(t, "ref", None)
+    return ref() if callable(ref) else id(t)
+
+
 def from_tf_keras(tf_model, config=None, batch_size: Optional[int] = None,
                   mesh=None, strategy=None):
     """Replay a tf.keras Model (or duck-typed equivalent) onto an
@@ -55,17 +62,17 @@ def from_tf_keras(tf_model, config=None, batch_size: Optional[int] = None,
 
     for inp in tf_model.inputs:
         shape = tuple(int(d) for d in inp.shape[1:])
-        values[inp.ref()] = ff.create_tensor(
+        values[_tref(inp)] = ff.create_tensor(
             (bs,) + shape, name=inp.name.split(":")[0])
 
     for layer in tf_model.layers:
         ltype = type(layer).__name__
         if ltype == "InputLayer":
             continue
-        ins = [values[t.ref()] for t in _flat_inputs(layer)]
+        ins = [values[_tref(t)] for t in _flat_inputs(layer)]
         out = _emit_layer(ff, layer, ltype, ins)
         for t in _flat_outputs(layer):
-            values[t.ref()] = out
+            values[_tref(t)] = out
 
     # stage trained weights; FFModel.compile applies them after
     # init_state (state does not exist yet at this point)
@@ -157,6 +164,17 @@ def _flat_outputs(layer):
 
 def _emit_layer(ff, layer, ltype, ins):
     cfgd = layer.get_config()
+    # this framework's image layout is NCHW (reference examples parity);
+    # real tf.keras defaults to channels_last — fail loudly rather than
+    # silently treating H as the channel dim. (Stub models without the
+    # key are assumed channels_first.)
+    if (ltype in ("Conv2D", "MaxPooling2D", "AveragePooling2D")
+            and cfgd.get("data_format", "channels_first")
+            == "channels_last"):
+        raise NotImplementedError(
+            f"keras_exp: {ltype} ({layer.name}) uses channels_last; "
+            f"build the tf model with data_format='channels_first' "
+            f"(weights import fine either way — kernels are HWIO)")
     if ltype == "Dense":
         act = cfgd.get("activation")
         t = ff.dense(ins[0], cfgd["units"],

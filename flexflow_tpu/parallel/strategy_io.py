@@ -16,12 +16,13 @@ axis maps exactly; this module is the compatibility layer.
 
 from __future__ import annotations
 
+import warnings
 from typing import Dict, List
 
 import numpy as np
 
 from ..op import Op
-from .pconfig import OpStrategy, ParallelConfig, Strategy
+from .pconfig import DEVICE_KEY, OpStrategy, ParallelConfig, Strategy
 
 
 def op_parallel_config(op: Op, strategy: OpStrategy, mesh) -> ParallelConfig:
@@ -38,6 +39,11 @@ def op_parallel_config(op: Op, strategy: OpStrategy, mesh) -> ParallelConfig:
         # device_type "tpu_pin" marks an EXPLICIT placement: the format
         # cannot otherwise distinguish "pinned to device 0" from the
         # default single-part [0] device list
+        if any(k != DEVICE_KEY for k in strategy.axis_map):
+            warnings.warn(
+                f"strategy for {op.name!r} combines mesh-axis splits "
+                f"with explicit device ids; the text format carries the "
+                f"placement only (mirror of the lossy import case)")
         return ParallelConfig(device_type="tpu_pin",
                               dims=[1] * max(1, len(out_axes)),
                               device_ids=list(strategy.device_ids))
@@ -107,13 +113,11 @@ def load_strategies_from_file(model, mesh, path: str) -> Strategy:
         if device_ids and (dev_type == "tpu_pin"
                            or (not axis_map
                                and device_ids != list(range(n_parts)))):
-            from .pconfig import DEVICE_KEY
             axis_map = {DEVICE_KEY: tuple(device_ids)}
         elif (axis_map and device_ids
                 and device_ids != list(range(n_parts))):
             # split AND explicitly placed: the mesh-axis mapping cannot
             # carry the id list — be honest about the approximation
-            import warnings
             warnings.warn(
                 f"strategy file op {name!r}: explicit device ids "
                 f"{device_ids} on a split op are not representable as a "

@@ -77,6 +77,24 @@ def test_pytorch_cnn_example():
     assert "final loss" in out
 
 
+def test_bootcamp_demo():
+    out = run_example("bootcamp_demo/ff_alexnet_cifar10.py",
+                      "-b", "16", "--samples", "64", "-e", "1")
+    assert "final accuracy" in out
+
+
+@pytest.mark.parametrize("script,gate_msg", [
+    ("examples/python/onnx/mnist_mlp_onnx.py", "onnx not installed"),
+    ("examples/python/keras_exp/func_mnist_mlp_exp.py",
+     "tensorflow not installed"),
+])
+def test_gated_frontend_examples(script, gate_msg):
+    """Deps-gated examples exit 0 either way: a final metric when the
+    dep is present, the documented skip message when it is not."""
+    out = run_example(script, "-e", "1")
+    assert gate_msg in out or "final accuracy" in out
+
+
 def test_keras_mnist_mlp_learns():
     out = run_example("examples/python/keras/mnist_mlp.py",
                       "-e", "3", "--accuracy")

@@ -270,3 +270,54 @@ def test_keras_exp_same_pad_stride_fails_loudly():
     fake = FakeKerasModel([inp], [conv])
     with pytest.raises(NotImplementedError, match="asymmetric"):
         from_tf_keras(fake, batch_size=2)
+
+
+# ---- real-TF leg (TF ships in the bench image; skip cleanly without) ----
+# NOTE: guarded per-test, NOT via module-level importorskip — that would
+# skip the deps-free stub tests above whenever TF is absent.
+
+try:
+    import tensorflow as tf
+    _HAS_TF = True
+except ImportError:
+    tf = None
+    _HAS_TF = False
+
+needs_tf = pytest.mark.skipif(not _HAS_TF, reason="tensorflow not installed")
+
+
+@needs_tf
+def test_keras_exp_real_tf_dense_model_matches_predict():
+    """Import a REAL tf.keras model (Keras 2 or 3 symbolic tensors both
+    go through _tref) and match tf's own forward numerics."""
+    tfk = tf.keras
+    inp = tfk.Input((12,))
+    t = tfk.layers.Dense(16, activation="relu", name="fc1")(inp)
+    out = tfk.layers.Dense(4, name="fc2")(t)
+    tf_model = tfk.Model(inp, out)
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    ff = from_tf_keras(tf_model, config=cfg, batch_size=8)
+    ff.softmax(ff.ops[-1].outputs[0])
+    ff.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(8, 12).astype(np.float32)
+    want = tf_model.predict(xv, verbose=0)
+    logits = ff.ops[-2].outputs[0]
+    values, _ = ff.executor.forward_values(
+        ff.state.params, ff.state.states,
+        {ff.input_tensors[0].name: xv}, False, None)
+    np.testing.assert_allclose(np.asarray(values[logits.uid]), want,
+                               atol=1e-4)
+
+
+@needs_tf
+def test_keras_exp_real_tf_channels_last_conv_fails_loudly():
+    tfk = tf.keras
+    inp = tfk.Input((16, 16, 3))
+    out = tfk.layers.Conv2D(8, 3, name="conv")(inp)  # channels_last
+    tf_model = tfk.Model(inp, out)
+    with pytest.raises(NotImplementedError, match="channels_last"):
+        from_tf_keras(tf_model, batch_size=2)
