@@ -53,9 +53,22 @@ double ffsim_simulate(int32_t n_tasks,
  * for edge e and source-candidate i, prop_match[prop_offsets[e] + i] is
  * the destination op's candidate with the same axis map, or -1.
  *
+ * Device-explicit placements (OpStrategy.device_ids): place_off is a
+ * CSR indptr (len total_cands+1) into place_ids; a candidate with a
+ * non-empty slice runs only on those device resources, so disjoint
+ * placements proceed concurrently while SPMD candidates hold every
+ * device.  n_dev is the mesh device count.
+ *
+ * Pipeline candidates (layer->pipe): pipe_stages[cand] > 1 expands the
+ * op into the (microbatch, stage) GPipe schedule over per-stage
+ * resources using pipe_mb/pipe_fwd_stage/pipe_bwd_stage/pipe_hop
+ * (PipelineCost fields) — the candidate's fwd/bwd/fwd_comm/bwd_comm are
+ * ignored, exactly like the Python expansion.
+ *
  * init_cand[op] seeds the walk (pure data parallelism by default);
  * best_out[op] receives the best candidate found.  Returns the best
- * simulated step time in seconds (including memory penalty). */
+ * simulated step time in seconds (including memory penalty and the
+ * calibrated per-step dispatch overhead). */
 double ffsearch_mcmc(int32_t n_ops,
                      const int32_t *n_cands,
                      const int32_t *cand_offsets,
@@ -65,6 +78,14 @@ double ffsearch_mcmc(int32_t n_ops,
                      const double *cost_bwd_comm,
                      const double *cost_sync,
                      const double *cost_mem,
+                     const int32_t *place_off,
+                     const int32_t *place_ids,
+                     const int32_t *pipe_stages,
+                     const int32_t *pipe_mb,
+                     const double *pipe_fwd_stage,
+                     const double *pipe_bwd_stage,
+                     const double *pipe_hop,
+                     int32_t n_dev,
                      int32_t n_edges,
                      const int32_t *edge_src,
                      const int32_t *edge_dst,
@@ -77,6 +98,7 @@ double ffsearch_mcmc(int32_t n_ops,
                      int32_t overlap_backward_sync,
                      double hbm_capacity,
                      double time_scale,
+                     double step_overhead,
                      const int32_t *init_cand,
                      int32_t *best_out);
 
@@ -90,12 +112,21 @@ double ffsearch_simulate_assignment(int32_t n_ops,
                                     const double *cost_bwd_comm,
                                     const double *cost_sync,
                                     const double *cost_mem,
+                                    const int32_t *place_off,
+                                    const int32_t *place_ids,
+                                    const int32_t *pipe_stages,
+                                    const int32_t *pipe_mb,
+                                    const double *pipe_fwd_stage,
+                                    const double *pipe_bwd_stage,
+                                    const double *pipe_hop,
+                                    int32_t n_dev,
                                     int32_t n_edges,
                                     const int32_t *edge_src,
                                     const int32_t *edge_dst,
                                     int32_t overlap_backward_sync,
                                     double hbm_capacity,
                                     double time_scale,
+                                    double step_overhead,
                                     const int32_t *assignment);
 
 /* ---------------- data loader ----------------

@@ -24,6 +24,22 @@ struct Task {
 double simulate(const std::vector<Task> &tasks,
                 const std::vector<int32_t> &dep_indices);
 
+// Multi-resource variant: a task occupies EVERY resource in its slice
+// of res_indices simultaneously (the Python TaskGraph list-resource
+// convention — a placed op's device set, an SPMD op holding all
+// devices, per-stage pipeline resources).
+struct MTask {
+  double duration = 0.0;
+  int32_t first_res = 0;  // into res_indices
+  int32_t n_res = 0;
+  int32_t first_dep = 0;  // into dep_indices
+  int32_t n_deps = 0;
+};
+
+double simulate_multi(const std::vector<MTask> &tasks,
+                      const std::vector<int32_t> &res_indices,
+                      const std::vector<int32_t> &dep_indices);
+
 }  // namespace fftpu
 
 #endif

@@ -80,17 +80,20 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.ffsearch_mcmc.argtypes = [
         ctypes.c_int32, i32p, i32p,
         f64p, f64p, f64p, f64p, f64p, f64p,
+        i32p, i32p, i32p, i32p, f64p, f64p, f64p, ctypes.c_int32,
         ctypes.c_int32, i32p, i32p, i32p, i32p,
         ctypes.c_int32, ctypes.c_double, ctypes.c_uint64,
         ctypes.c_int32, ctypes.c_int32,
-        ctypes.c_double, ctypes.c_double, i32p, i32p]
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, i32p, i32p]
 
     lib.ffsearch_simulate_assignment.restype = ctypes.c_double
     lib.ffsearch_simulate_assignment.argtypes = [
         ctypes.c_int32, i32p,
         f64p, f64p, f64p, f64p, f64p, f64p,
+        i32p, i32p, i32p, i32p, f64p, f64p, f64p, ctypes.c_int32,
         ctypes.c_int32, i32p, i32p,
-        ctypes.c_int32, ctypes.c_double, ctypes.c_double, i32p]
+        ctypes.c_int32, ctypes.c_double, ctypes.c_double,
+        ctypes.c_double, i32p]
 
     lib.ffdl_create.restype = ctypes.c_void_p
     lib.ffdl_create.argtypes = [ctypes.c_int32, vpp, i64p,

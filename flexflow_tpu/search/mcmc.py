@@ -230,27 +230,15 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
             sim.simulate(strategy, dot_path=cfg.taskgraph_file)
         return strategy
 
-    # The native lowering costs one task per op on a single compute
-    # resource; the Python simulator additionally folds fused chains,
-    # expands GPipe schedules, and models per-device concurrency for
-    # placed ops — searches needing any of those route to Python so both
-    # engines never rank the same candidates differently.
-    needs_python = (
-        cfg.perform_fusion
-        or any(DEVICE_KEY in m for lst in cands.values() for m in lst)
-        # an imported/init strategy can carry placements even when the
-        # candidate space offers none (lower_to_arrays appends the init
-        # map into the native candidate lists)
-        or (model.strategy is not None
-            and any(s.device_ids
-                    for s in model.strategy.op_strategies.values()))
-        or ("pipe" in mesh.shape
-            and any(op.op_type == "pipeline_blocks" for op in model.ops)))
-    if needs_python:
+    # The native engine mirrors the Python simulator task-for-task —
+    # including per-device resources for placed candidates and GPipe
+    # event-loop expansion (csrc/mcmc.cc). The one remaining Python-only
+    # capability is FUSION folding (same-strategy chains costed as one
+    # task), so fused searches route to the Python engine.
+    if cfg.perform_fusion:
         if use_native is True:
-            raise ValueError(
-                "native search does not support fusion, device placement, "
-                "or pipeline expansion; use the Python engine")
+            raise ValueError("native search does not support "
+                             "perform_fusion; use the Python engine")
         use_native = False
     if use_native is not False:
         from .native_search import optimize_native

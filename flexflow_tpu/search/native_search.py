@@ -47,11 +47,13 @@ def lower_to_arrays(model, sim: Simulator, cands: Dict[str, list],
                    if _map_key(m) == init_map)
         init_assign.append(idx)
 
-    table = CostTable([len(l) for l in cand_lists])
+    table = CostTable([len(l) for l in cand_lists],
+                      n_devices=int(sim.mesh.size))
     for i, op in enumerate(ops):
         for j, m in enumerate(cand_lists[i]):
-            table.set(i, j, op_cost(op, OpStrategy(dict(m)), sim.mesh,
-                                    sim.mm))
+            s = OpStrategy(dict(m))
+            table.set(i, j, op_cost(op, s, sim.mesh, sim.mm),
+                      devices=s.device_ids)
 
     _, op_pairs = op_edges(model)
     edges: List[Tuple[int, int]] = [
@@ -85,7 +87,8 @@ def optimize_native(model, sim: Simulator, cands: Dict[str, list],
         overlap_backward_sync=sim.overlap,
         hbm_capacity=sim.mm.spec.hbm_capacity,
         time_scale=sim.time_scale,
-        init_cand=init_assign)
+        init_cand=init_assign,
+        step_overhead=sim.step_overhead)
 
     best = init.copy()
     for i, op in enumerate(model.ops):

@@ -214,12 +214,76 @@ def test_placed_strategy_roundtrips_via_reference_text(tmp_path):
                 s.for_op(op.name).device_ids, op.name
 
 
-def test_native_engine_rejects_placement_candidates():
+def test_native_engine_parity_with_placement_candidates():
+    """The native engine mirrors the Python simulator task-for-task,
+    including per-device resources for placed candidates — random
+    assignments over the DLRM placement space must cost identically in
+    both engines (csrc/mcmc.cc simulate_assignment)."""
+    from flexflow_tpu import native
+    if not native.available():
+        pytest.skip("native library unavailable")
+    from flexflow_tpu.native.wrappers import simulate_assignment
+    from flexflow_tpu.search.mcmc import candidate_maps
+    from flexflow_tpu.search.native_search import lower_to_arrays
+
     ff = build_dlrm_for_search()
     mesh = make_mesh((1, 8), ("data", "model"))
-    ff.mesh = mesh
-    with pytest.raises(ValueError, match="device placement"):
-        optimize(ff, budget=10, mesh=mesh, use_native=True)
+    sim = Simulator(ff, mesh)
+    cands = {op.name: candidate_maps(op, mesh, ff.config, op_index=i)
+             for i, op in enumerate(ff.ops)}
+    table, edges, _, _, cand_lists = lower_to_arrays(
+        ff, sim, cands, Strategy())
+
+    import numpy as np
+    rng = np.random.RandomState(7)
+    for _ in range(6):
+        assign = [rng.randint(len(l)) for l in cand_lists]
+        strat = Strategy()
+        for i, op in enumerate(ff.ops):
+            strat.set(op.name, OpStrategy(dict(cand_lists[i][assign[i]])))
+        want = sim.simulate(strat)
+        got = simulate_assignment(table, edges, assign, sim.overlap,
+                                  sim.mm.spec.hbm_capacity,
+                                  sim.time_scale,
+                                  step_overhead=sim.step_overhead)
+        assert got == pytest.approx(want, rel=1e-9), assign
+
+
+def test_native_engine_parity_with_pipeline_expansion():
+    """GPipe event-loop expansion parity: pipelined candidates must cost
+    identically through the native and Python engines."""
+    from flexflow_tpu import native
+    if not native.available():
+        pytest.skip("native library unavailable")
+    from flexflow_tpu.native.wrappers import simulate_assignment
+    from flexflow_tpu.search.mcmc import candidate_maps
+    from flexflow_tpu.search.native_search import lower_to_arrays
+
+    ff = build_pipe_model(num_layers=4, num_microbatches=4)
+    mesh = make_mesh((2, 4), ("data", "pipe"))
+    sim = Simulator(ff, mesh)
+    cands = {op.name: candidate_maps(op, mesh, ff.config, op_index=i)
+             for i, op in enumerate(ff.ops)}
+    table, edges, _, _, cand_lists = lower_to_arrays(
+        ff, sim, cands, Strategy())
+
+    import numpy as np
+    rng = np.random.RandomState(3)
+    tried_pipe = False
+    for _ in range(8):
+        assign = [rng.randint(len(l)) for l in cand_lists]
+        strat = Strategy()
+        for i, op in enumerate(ff.ops):
+            m = dict(cand_lists[i][assign[i]])
+            tried_pipe = tried_pipe or m.get("layer") == "pipe"
+            strat.set(op.name, OpStrategy(m))
+        want = sim.simulate(strat)
+        got = simulate_assignment(table, edges, assign, sim.overlap,
+                                  sim.mm.spec.hbm_capacity,
+                                  sim.time_scale,
+                                  step_overhead=sim.step_overhead)
+        assert got == pytest.approx(want, rel=1e-9), assign
+    assert tried_pipe  # the space actually contained pipelined candidates
 
 
 # ----------------------------------------------------------- degree search
