@@ -26,14 +26,18 @@ def _checkpointer(use_async: bool = False):
 
 
 def save_checkpoint(path: str, state: TrainState,
-                    use_async: bool = False, force: bool = True):
+                    use_async: bool = False, force: bool = True,
+                    checkpointer=None):
     """Save a TrainState to `path` (a directory).
 
     With use_async=True the write happens in a background thread and the
     AsyncCheckpointer is RETURNED — the caller must keep it and call
     wait_until_finished() (or close()) before relying on the checkpoint
-    or exiting; the checkpoint is uncommitted until then."""
-    ckptr = _checkpointer(use_async)
+    or exiting; the checkpoint is uncommitted until then. Pass the
+    returned checkpointer back as `checkpointer` on subsequent saves to
+    reuse it (orbax serializes against the in-flight save itself; one
+    background thread for the whole loop instead of one per save)."""
+    ckptr = checkpointer or _checkpointer(use_async)
     payload = {
         "params": state.params,
         "states": state.states,
@@ -73,3 +77,7 @@ def save_model(model, path: str, use_async: bool = False):
 
 def restore_model(model, path: str) -> None:
     model.state = restore_checkpoint(path, model.state)
+    # resync the per-step training-rng mirror so the restored run's
+    # stochastic ops (dropout) continue the exact stream of the
+    # uninterrupted one (FFModel._train_rng keys on this counter)
+    model._host_step = int(model.state.step)

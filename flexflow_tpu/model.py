@@ -9,6 +9,7 @@ of Legion partitions/launchers (SURVEY.md section 7).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -379,6 +380,7 @@ class FFModel:
         self.executor = Executor(self, optimizer, loss_type, metrics,
                                  mesh=self.mesh, strategy=self.strategy)
         self.state = self.executor.init_state(self._next_rng())
+        self._host_step = 0  # mirrors state.step for the train rng
         for op_name, ws in self.imported_weights.items():
             self.set_weights(op_name, ws)
         for op_name, ss in self.imported_states.items():
@@ -386,6 +388,15 @@ class FFModel:
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _train_rng(self):
+        """Per-step training rng (dropout etc.), keyed on a host-side
+        step mirror instead of a split chain so a checkpoint-resumed run
+        reproduces the exact stream of the uninterrupted one (the mirror
+        is re-synced from state.step at resume, fit())."""
+        sub = jax.random.fold_in(self._rng, self._host_step)
+        self._host_step += 1
         return sub
 
     # reference-parity train-loop primitives (model.cc:1414-1461). On TPU
@@ -407,7 +418,7 @@ class FFModel:
         """One optimizer step; returns metrics dict of scalars."""
         batch = self.executor.shard_batch(batch)
         self.state, metrics = self.executor.train_step(
-            self.state, batch, self._next_rng())
+            self.state, batch, self._train_rng())
         return metrics
 
     def calibrate_simulator(self, batch: Optional[Dict] = None,
@@ -455,50 +466,104 @@ class FFModel:
 
     def fit(self, x: Dict[str, np.ndarray], y: np.ndarray,
             batch_size: Optional[int] = None, epochs: Optional[int] = None,
-            shuffle: bool = True, verbose: bool = True):
+            shuffle: bool = True, verbose: bool = True,
+            checkpoint_dir: Optional[str] = None,
+            checkpoint_every: int = 1):
         """Keras-style fit over host numpy arrays (reference:
-        base_model.py:195-255 + _train loop :347-424)."""
+        base_model.py:195-255 + _train loop :347-424).
+
+        `checkpoint_dir` enables the elastic-recovery story the reference
+        lacks (SURVEY 5: no failure handling): the full TrainState is
+        saved asynchronously every `checkpoint_every` epochs, and a
+        re-run with the same directory resumes from the newest epoch —
+        kill the process at any point and simply run it again."""
         bs = batch_size or self.config.batch_size
         ep = epochs or self.config.epochs
         names = list(x.keys())
         n = len(y)
         steps = n // bs
         # persistent across fit() calls so per-epoch shuffles differ even
-        # when a wrapper drives one epoch at a time (keras frontend)
+        # when a wrapper drives one epoch at a time (keras frontend);
+        # _fit_epochs_drawn counts permutations already consumed so a
+        # checkpoint resume replays exactly the missing prefix
         if not hasattr(self, "_fit_rng"):
             self._fit_rng = np.random.RandomState(self.config.seed)
+            self._fit_epochs_drawn = 0
         rng = self._fit_rng
+
+        def draw_perm():
+            self._fit_epochs_drawn += 1
+            return rng.permutation(n)
+
         history = []
-        for epoch in range(ep):
-            idx = rng.permutation(n) if shuffle else np.arange(n)
-            epoch_metrics = []
-            t0 = time.time()
-            for s in range(steps):
-                sel = idx[s * bs:(s + 1) * bs]
-                batch = {k: x[k][sel] for k in names}
-                batch["label"] = y[sel]
-                m = self.train_batch(batch)
-                epoch_metrics.append(m)
-            # fold metrics on host (reference: UPDATE_METRICS future fold).
-            # One bulk device->host transfer for the whole epoch — per-scalar
-            # float(v) would issue steps*keys tiny transfers (ruinous through
-            # a TPU tunnel); reference folds through futures for the same
-            # reason (model.cc:2084-2108).
-            epoch_metrics = jax.device_get(epoch_metrics)
-            agg = {}
-            for m in epoch_metrics:
-                for k, v in m.items():
-                    agg[k] = agg.get(k, 0.0) + float(v)
-            dt = time.time() - t0
-            out = {"epoch": epoch, "loss": agg.get("loss", 0.0) / max(1, steps),
-                   "throughput": steps * bs / dt}
-            if "correct" in agg:
-                out["accuracy"] = agg["correct"] / agg["count"]
-            history.append(out)
-            if verbose:
-                acc = f" accuracy={out.get('accuracy', float('nan')):.4f}"
-                print(f"epoch {epoch}: loss={out['loss']:.4f}{acc} "
-                      f"({out['throughput']:.1f} samples/s)")
+        start_epoch = 0
+        ckptr = None  # one async checkpointer reused across the run
+        if checkpoint_dir:
+            from .core.checkpoint import restore_model, save_checkpoint
+            done = sorted(
+                int(d[len("epoch_"):]) for d in (
+                    os.listdir(checkpoint_dir)
+                    if os.path.isdir(checkpoint_dir) else [])
+                if d.startswith("epoch_")
+                and d[len("epoch_"):].isdigit())
+            if done:
+                start_epoch = done[-1] + 1
+                restore_model(self, os.path.join(checkpoint_dir,
+                                                 f"epoch_{done[-1]}"))
+                # replay ONLY the missing prefix of the shuffle stream so
+                # resumed epochs see the permutations the uninterrupted
+                # run would have (a same-object continuation has already
+                # consumed _fit_epochs_drawn of them)
+                if shuffle:
+                    while self._fit_epochs_drawn < start_epoch:
+                        draw_perm()
+                if verbose:
+                    print(f"resuming from {checkpoint_dir} at epoch "
+                          f"{start_epoch}")
+        try:
+            for epoch in range(start_epoch, ep):
+                idx = draw_perm() if shuffle else np.arange(n)
+                epoch_metrics = []
+                t0 = time.time()
+                for s in range(steps):
+                    sel = idx[s * bs:(s + 1) * bs]
+                    batch = {k: x[k][sel] for k in names}
+                    batch["label"] = y[sel]
+                    m = self.train_batch(batch)
+                    epoch_metrics.append(m)
+                # fold metrics on host (reference: UPDATE_METRICS future
+                # fold). One bulk device->host transfer for the whole
+                # epoch — per-scalar float(v) would issue steps*keys tiny
+                # transfers (ruinous through a TPU tunnel); reference
+                # folds through futures too (model.cc:2084-2108).
+                epoch_metrics = jax.device_get(epoch_metrics)
+                agg = {}
+                for m in epoch_metrics:
+                    for k, v in m.items():
+                        agg[k] = agg.get(k, 0.0) + float(v)
+                dt = time.time() - t0
+                out = {"epoch": epoch,
+                       "loss": agg.get("loss", 0.0) / max(1, steps),
+                       "throughput": steps * bs / dt}
+                if "correct" in agg:
+                    out["accuracy"] = agg["correct"] / agg["count"]
+                history.append(out)
+                if verbose:
+                    acc = (f" accuracy={out['accuracy']:.4f}"
+                           if "accuracy" in out else "")
+                    print(f"epoch {epoch}: loss={out['loss']:.4f}{acc} "
+                          f"({out['throughput']:.1f} samples/s)")
+                if checkpoint_dir \
+                        and (epoch + 1) % max(1, checkpoint_every) == 0:
+                    # reused AsyncCheckpointer: orbax serializes against
+                    # the in-flight save itself
+                    ckptr = save_checkpoint(
+                        os.path.join(checkpoint_dir, f"epoch_{epoch}"),
+                        self.state, use_async=True, checkpointer=ckptr)
+        finally:
+            if ckptr is not None:  # commit in-flight saves even on
+                ckptr.wait_until_finished()  # Ctrl-C / mid-epoch errors
+                ckptr.close()
         return history
 
     def evaluate(self, x: Dict[str, np.ndarray], y: np.ndarray,
