@@ -46,6 +46,21 @@ class Model:
         self.stop_training = False
 
     # ---- graph emission ----
+    def _walk(self, mapping: Dict[int, object], node_fn):
+        """Memoized DFS over the recorded KTensor DAG from inputs (seeded
+        in `mapping`) to outputs, applying node_fn(kt, mapped_inputs) at
+        each layer invocation — shared by FFModel emission and nested
+        replay."""
+        def visit(kt: KTensor):
+            if kt.uid in mapping:
+                return mapping[kt.uid]
+            ins = [visit(i) for i in kt.inputs]
+            out = node_fn(kt, ins)
+            mapping[kt.uid] = out
+            return out
+
+        return [visit(o) for o in self.outputs]
+
     def _emit(self, batch_size: int) -> FFModel:
         cfg = self.config or FFConfig()
         cfg.batch_size = batch_size
@@ -54,18 +69,50 @@ class Model:
         for kt in self.inputs:
             mapping[kt.uid] = ff.create_tensor(
                 (batch_size,) + kt.shape, dtype=kt.dtype, name=kt.ff_name)
-
-        def emit(kt: KTensor):
-            if kt.uid in mapping:
-                return mapping[kt.uid]
-            ins = [emit(i) for i in kt.inputs]
-            out = kt.layer.emit(ff, ins)
-            mapping[kt.uid] = out
-            return out
-
-        for out in self.outputs:
-            emit(out)
+        self._walk(mapping, lambda kt, ins: kt.layer.emit(ff, ins))
         return ff
+
+    # ---- nested models (reference: models used as layers in the
+    # func_*_nested / seq_*_nested examples) ----
+    def __call__(self, inputs):
+        """Use this model as a layer inside another model: replays the
+        recorded layer graph onto the caller's symbolic tensors, making
+        the nested layers part of the outer graph.
+
+        Single-use: calling the same Model twice would need weight
+        sharing between the two copies (keras semantics), which this
+        frontend does not implement — it raises instead of silently
+        duplicating weights."""
+        if getattr(self, "_nested_called", False):
+            raise NotImplementedError(
+                f"model {self.name!r} already used as a layer once; "
+                f"reuse would require weight sharing between the copies")
+        if self.ffmodel is not None:
+            # trained/compiled weights live in this model's own FFModel;
+            # the replay would re-emit FRESH weights into the outer
+            # graph — fail loudly rather than silently dropping training
+            # (same policy as the reuse case above)
+            raise NotImplementedError(
+                f"model {self.name!r} was already compiled/trained; "
+                f"nesting would silently reinitialize its weights — "
+                f"nest it before training, or transfer weights via "
+                f"get_weights/set_weights after compiling the outer "
+                f"model")
+        if not self.inputs and hasattr(self, "_build_graph"):
+            self._build_graph()  # Sequential builds lazily
+        assert self.inputs and self.outputs, (
+            "model has no recorded graph to nest")
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        assert len(ins) == len(self.inputs), (
+            f"nested model {self.name!r} takes {len(self.inputs)} "
+            f"inputs, got {len(ins)}")
+        mapping = {kt.uid: new for kt, new in zip(self.inputs, ins)}
+        outs = self._walk(
+            mapping,
+            lambda kt, new_ins: kt.layer(
+                new_ins if len(new_ins) > 1 else new_ins[0]))
+        self._nested_called = True  # only after a successful replay
+        return outs if len(outs) > 1 else outs[0]
 
     # ---- keras API ----
     def compile(self, optimizer="sgd", loss="sparse_categorical_crossentropy",

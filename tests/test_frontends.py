@@ -126,3 +126,35 @@ def test_onnx_file_load_gated():
             fonnx.ONNXModel("nonexistent.onnx")
     else:  # pragma: no cover - image has no onnx
         pass
+
+
+def test_keras_nested_model_as_layer():
+    """Models as layers (reference func_*_nested examples): the nested
+    model's graph replays into the outer graph; reuse fails loudly
+    (weight sharing is not implemented)."""
+    from flexflow_tpu.frontends import keras
+
+    inner_in = keras.layers.Input((8,))
+    inner_out = keras.layers.Dense(16, activation="relu")(inner_in)
+    inner = keras.Model(inputs=inner_in, outputs=inner_out, name="inner")
+
+    outer_in = keras.layers.Input((8,))
+    t = inner(outer_in)
+    out = keras.layers.Dense(4, activation="softmax")(t)
+    outer = keras.Model(inputs=outer_in, outputs=out)
+    outer.compile(optimizer=keras.SGD(learning_rate=0.1),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 8).astype(np.float32)
+    w = rng.randn(8, 4).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    h = outer.fit(x, y, batch_size=32, epochs=8, verbose=False)
+    assert h[-1]["accuracy"] > 0.5, h[-1]
+
+    # the nested dense really is part of the outer FFModel graph
+    types = [op.op_type for op in outer.ffmodel.ops]
+    assert types.count("linear") == 2, types
+
+    with pytest.raises(NotImplementedError, match="weight sharing"):
+        inner(outer_in)
