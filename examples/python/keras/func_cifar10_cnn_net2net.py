@@ -1,0 +1,55 @@
+"""Net2Net on a functional CNN (reference:
+examples/python/keras/func_cifar10_cnn_net2net.py;
+tests/multi_gpu_tests.sh): widen a conv layer and seed the student's
+filters from the teacher via host get/set weights.
+
+  python examples/python/keras/func_cifar10_cnn_net2net.py -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def make(filters):
+    inp = keras.layers.Input((3, 32, 32))
+    t = keras.layers.Conv2D(filters, (3, 3), padding="same",
+                            activation="relu", name="conv_w")(inp)
+    t = keras.layers.MaxPooling2D((2, 2))(t)
+    t = keras.layers.Flatten()(t)
+    out = keras.layers.Dense(10, activation="softmax", name="head")(t)
+    m = keras.Model(inputs=inp, outputs=out)
+    m.compile(optimizer=keras.SGD(learning_rate=0.01),
+              loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    return m
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, 256).astype(np.int32)
+
+    teacher = make(16)
+    teacher.fit(x, y, batch_size=32, epochs=epochs)
+
+    student = make(32)   # widened conv: 16 -> 32 filters
+    s_ff = student.build_model(batch_size=32)  # weights exist, untrained
+    t_ff = teacher.ffmodel
+    tw = t_ff.get_weights("conv_w")
+    sw = {k: v.copy() for k, v in s_ff.get_weights("conv_w").items()}
+    sw["kernel"][:16] = tw["kernel"]   # OIHW: copy teacher's filters
+    sw["bias"][:16] = tw["bias"]
+    s_ff.set_weights("conv_w", sw)
+
+    hist = student.fit(x, y, batch_size=32, epochs=epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,39 @@
+"""Functional-API MLP with chained Concatenates (reference:
+examples/python/keras/func_mnist_mlp_concat2.py; tests/multi_gpu_tests.sh).
+
+  python examples/python/keras/func_mnist_mlp_concat2.py -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+
+    inp = keras.layers.Input((784,))
+    a = keras.layers.Dense(128, activation="relu")(inp)
+    b = keras.layers.Dense(128, activation="tanh")(inp)
+    c = keras.layers.Dense(128, activation="sigmoid")(inp)
+    ab = keras.layers.Concatenate(axis=1)([a, b])
+    abc = keras.layers.Concatenate(axis=1)([ab, c])
+    t = keras.layers.Dense(64, activation="relu")(abc)
+    out = keras.layers.Dense(10, activation="softmax")(t)
+    model = keras.Model(inputs=inp, outputs=out)
+    model.compile(optimizer=keras.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(512, 784).astype(np.float32)
+    y = rng.randint(0, 10, 512).astype(np.int32)
+    hist = model.fit(x, y, batch_size=64, epochs=epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -39,8 +39,8 @@ def top_level_task():
 
     # widen 128 -> 256: copy the teacher's columns, random-init the rest
     student = make(256)
-    student.fit(x, y, batch_size=64, epochs=1)  # builds the FFModel
-    t_ff, s_ff = teacher.ffmodel, student.ffmodel
+    s_ff = student.build_model(batch_size=64)  # weights exist, untrained
+    t_ff = teacher.ffmodel
     t_ops = [op.name for op in t_ff.ops if op.op_type == "linear"]
     s_ops = [op.name for op in s_ff.ops if op.op_type == "linear"]
     tw0 = t_ff.get_weights(t_ops[0])

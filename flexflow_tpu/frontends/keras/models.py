@@ -202,6 +202,14 @@ class Model:
             outs.append(out[:valid])
         return np.concatenate(outs, axis=0)
 
+    def build_model(self, batch_size: int = 64) -> FFModel:
+        """Force FFModel construction (after compile()) without training
+        a step — for host weight access before the first fit(), e.g.
+        net2net weight surgery (examples/python/keras/*_net2net.py).
+        Returns the built FFModel."""
+        self._ensure_ff(self._batch_size or batch_size)
+        return self.ffmodel
+
     def summary(self):
         self._ensure_ff(self._batch_size or 64)
         print(self.ffmodel.summary())

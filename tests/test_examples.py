@@ -61,6 +61,8 @@ def test_native_examples_run(script, args):
     "examples/python/keras/unary.py",
     "examples/python/keras/func_cifar10_cnn_nested.py",
     "examples/python/keras/seq_mnist_cnn_nested.py",
+    "examples/python/keras/func_mnist_mlp_concat2.py",
+    "examples/python/keras/func_cifar10_cnn_net2net.py",
 ])
 def test_keras_examples_run(script):
     out = run_example(script, "-e", "1")
