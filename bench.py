@@ -125,7 +125,8 @@ def build(model: str, preset: str):
         batch, seq = {"full": (64, 40), "small": (32, 40),
                       "tiny": (8, 10)}[preset]
         cfg.batch_size = batch
-        ff = zoo.build_nmt_lstm(cfg, batch_size=batch, seq_len=seq)
+        ff = zoo.build_nmt_lstm(cfg, batch_size=batch, seq_len=seq,
+                                dtype=jnp.bfloat16)
         data = {"input": jnp.asarray(
             rng.randint(0, 32000, (batch, seq)), jnp.int32),
             "label": jnp.asarray(rng.randint(0, 32000, (batch,)),

@@ -113,9 +113,10 @@ class FFModel:
 
     def embedding(self, input: Tensor, num_entries: int, out_dim: int,
                   aggr: str = "sum", name: Optional[str] = None,
-                  kernel_initializer="glorot") -> Tensor:
+                  kernel_initializer="glorot", dtype=None) -> Tensor:
         op = Embedding(self, name or self._fresh_name("embedding"), [input],
-                       num_entries, out_dim, aggr, kernel_initializer)
+                       num_entries, out_dim, aggr, kernel_initializer,
+                       dtype=dtype)
         return self.add_op(op).output
 
     def distributed_embedding(self, inputs: Sequence[Tensor],

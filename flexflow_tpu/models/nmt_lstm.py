@@ -22,10 +22,14 @@ def build_nmt_lstm(config: Optional[FFConfig] = None,
                    batch_size: int = None, seq_len: int = 40,
                    vocab_size: int = 32000, embed_dim: int = 1024,
                    hidden: int = 1024, num_layers: int = 2,
-                   mesh=None, strategy=None) -> FFModel:
+                   mesh=None, strategy=None, dtype=None) -> FFModel:
     """Stacked-LSTM sequence model: embed -> L x LSTM -> dense(vocab)
     -> softmax over the final position (nmt/rnn.h:91-160 topology,
-    embed_size/hidden 1024 like nmt.cc)."""
+    embed_size/hidden 1024 like nmt.cc).
+
+    dtype=jnp.bfloat16 runs activations (and thus the LSTM recurrence's
+    per-step GEMMs) in bf16 on the MXU's native path; weights stay f32,
+    gates accumulate f32."""
     cfg = config or FFConfig()
     bs = batch_size or cfg.batch_size
     ff = FFModel(cfg, mesh=mesh, strategy=strategy)
@@ -33,7 +37,7 @@ def build_nmt_lstm(config: Optional[FFConfig] = None,
 
     # per-token embedding (aggr none keeps the seq dim)
     t = ff.embedding(tokens, vocab_size, embed_dim, aggr="none",
-                     name="embed")
+                     name="embed", dtype=dtype)
     for i in range(num_layers):
         t = ff.lstm(t, hidden, return_sequences=True, name=f"lstm_{i}")
     # predict the next token from the last position

@@ -39,12 +39,17 @@ class Embedding(Op):
     op_type = "embedding"
 
     def __init__(self, model, name, inputs, num_entries: int, out_dim: int,
-                 aggr: str = AGGR_MODE_SUM, kernel_initializer: str = "glorot"):
+                 aggr: str = AGGR_MODE_SUM, kernel_initializer: str = "glorot",
+                 dtype=None):
         super().__init__(model, name, inputs)
         self.num_entries = int(num_entries)
         self.out_dim = int(out_dim)
         self.aggr = aggr
         self.kernel_initializer = kernel_initializer
+        # output/activation dtype; the table itself stays f32 (mixed
+        # precision: downstream compute follows the activation dtype)
+        self.out_dtype = jnp.dtype(dtype) if dtype is not None \
+            else jnp.dtype(jnp.float32)
         self.attrs = {"num_entries": num_entries, "out_dim": out_dim,
                       "aggr": aggr}
 
@@ -56,7 +61,7 @@ class Embedding(Op):
         return [(in_shape[0], self.out_dim)]
 
     def output_dtypes(self):
-        return [jnp.dtype(jnp.float32)]
+        return [self.out_dtype]
 
     def weight_specs(self):
         return {
@@ -75,7 +80,7 @@ class Embedding(Op):
             emb = jnp.sum(emb, axis=-2)
         elif self.aggr == AGGR_MODE_AVG:
             emb = jnp.mean(emb, axis=-2)
-        return [emb]
+        return [emb.astype(self.out_dtype)]
 
     def output_axes(self):
         n = len(self.outputs[0].shape)
