@@ -321,9 +321,9 @@ class FFModel:
 
     def lstm(self, input: Tensor, hidden_size: int,
              return_sequences: bool = True,
-             name: Optional[str] = None) -> Tensor:
+             name: Optional[str] = None, use_pallas=None) -> Tensor:
         op = LSTM(self, name or self._fresh_name("lstm"), [input],
-                  hidden_size, return_sequences)
+                  hidden_size, return_sequences, use_pallas=use_pallas)
         return self.add_op(op).output
 
     # ---------------- compile / train ----------------

@@ -29,12 +29,19 @@ class LSTM(Op):
 
     def __init__(self, model, name, inputs, hidden_size: int,
                  return_sequences: bool = True,
-                 kernel_initializer: str = "glorot"):
+                 kernel_initializer: str = "glorot",
+                 use_pallas=None):
         super().__init__(model, name, inputs)
         self.hidden_size = int(hidden_size)
         self.in_dim = inputs[0].shape[-1]
         self.return_sequences = return_sequences
         self.kernel_initializer = kernel_initializer
+        # tri-state like attention's use_flash: None = scan (default
+        # until the kernel is measured profitable on hardware), True =
+        # force the Pallas multi-timestep kernel (kernels/lstm_scan.py —
+        # wh resident in VMEM across steps instead of re-read from HBM
+        # every timestep), False = never.
+        self.use_pallas = use_pallas
         self.attrs = {"hidden_size": hidden_size,
                       "return_sequences": return_sequences}
 
@@ -67,6 +74,15 @@ class LSTM(Op):
                       preferred_element_type=jnp.float32)
               .reshape(b, t, 4 * h) + bias)
         xg = jnp.swapaxes(xg, 0, 1)  # (T, B, 4H) for scan
+
+        if self.use_pallas is True:
+            from ..kernels.lstm_scan import lstm_sequence
+            ys = lstm_sequence(xg.astype(x.dtype), wh.astype(x.dtype),
+                               jnp.zeros((b, h), x.dtype),
+                               jnp.zeros((b, h), x.dtype))
+            if self.return_sequences:
+                return [jnp.swapaxes(ys, 0, 1)]
+            return [ys[-1]]
 
         def cell(carry, xg_t):
             h_prev, c_prev = carry
