@@ -119,6 +119,14 @@ class FFConfig:
     # remat: trade FLOPs for HBM (no reference analog; TPU-first)
     remat: bool = False
 
+    # sparse embedding updates: when the optimizer's exact rule can be
+    # applied row-wise (SGD, no momentum/decay), embedding tables whose
+    # index tensors are graph inputs skip the dense-gradient sweep and
+    # get a scatter update over the touched rows only (reference analog:
+    # scatter-add embedding backward, src/ops/embedding.cu; essential
+    # for DLRM-scale vocabularies where a dense step writes GBs).
+    sparse_embedding_updates: bool = True
+
     # synthetic input when no dataset is provided (reference: config.h:131)
     synthetic_input: bool = False
 
@@ -184,6 +192,9 @@ class FFConfig:
         "--enable-device-placement": "enable_device_placement",
         "--synthetic-input": "synthetic_input",
     }
+    _NEG_BOOL_FLAGS = {
+        "--no-sparse-embedding": "sparse_embedding_updates",
+    }
 
     def parse_args(self, argv: Sequence[str]) -> None:
         i = 0
@@ -197,6 +208,10 @@ class FFConfig:
                 continue
             if a in self._BOOL_FLAGS:
                 setattr(self, self._BOOL_FLAGS[a], True)
+                i += 1
+                continue
+            if a in self._NEG_BOOL_FLAGS:
+                setattr(self, self._NEG_BOOL_FLAGS[a], False)
                 i += 1
                 continue
             if a == "--seq-length" and i + 1 < len(argv):

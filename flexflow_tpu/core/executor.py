@@ -72,7 +72,9 @@ class Executor:
         self.mesh = mesh
         self.strategy = strategy or Strategy()
         self._train_step = None
+        self._train_step_multi = None
         self._eval_step = None
+        self._sparse_ops_cache = None
         self._last_aux_losses = []
         # fusion (reference apply_fusion, model.cc:1472): constrain
         # sharding only at fused-group boundaries.
@@ -192,28 +194,116 @@ class Executor:
             loss = loss + aux
         return loss, (logits, new_states)
 
-    # ---------------- step builders ----------------
-    def build_train_step(self):
-        cfg = self.config
+    # ---------------- sparse-table routing ----------------
+    def _sparse_table_ops(self) -> Dict[str, Op]:
+        """Embedding-family ops eligible for the sparse-update path:
+        their index tensors are graph INPUTS (so the executor can gather
+        the touched rows before differentiation) and the optimizer's
+        exact rule is expressible row-wise (Optimizer.supports_sparse).
+        Reference analog: the scatter-add embedding backward + per-table
+        update of src/ops/embedding.cu — the dense-gradient alternative
+        writes the full (vocab, dim) table's worth of zeros + updates
+        every step, ruinous at DLRM scale."""
+        if self._sparse_ops_cache is not None:
+            return self._sparse_ops_cache
+        from ..ops.embedding import DistributedEmbedding, Embedding
+        out: Dict[str, Op] = {}
+        if (self.config.sparse_embedding_updates and self.optimizer
+                and self.optimizer.supports_sparse()):
+            input_uids = {t.uid for t in self.model.input_tensors}
+            for op in self.model.ops:
+                if not isinstance(op, (Embedding, DistributedEmbedding)):
+                    continue
+                if all(t.uid in input_uids for t in op.inputs):
+                    out[op.name] = op
+        self._sparse_ops_cache = out
+        return out
 
-        def train_step(state: TrainState, batch: Dict[str, jax.Array], rng):
-            seq_length = cfg.iter_config.seq_length
-            grad_fn = jax.value_and_grad(
-                self._outputs_and_loss, argnums=0, has_aux=True)
-            (loss, (logits, new_states)), grads = grad_fn(
-                state.params, state.states, batch, True, rng, seq_length)
+    # ---------------- step builders ----------------
+    def _step_body(self, state: TrainState, batch: Dict[str, jax.Array],
+                   rng) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        """One optimizer step (pure; shared by the single-step and the
+        scanned multi-step compilations)."""
+        from ..ops.embedding import DistributedEmbedding
+        seq_length = self.config.iter_config.seq_length
+        sparse_ops = self._sparse_table_ops()
+        diff_params = state.params
+        sparse_idx: Dict[str, jax.Array] = {}
+        if sparse_ops:
+            # pre-gather the touched rows OUTSIDE the differentiated
+            # function; forward consumes them via the "__rows__" override
+            # and autodiff returns row-gradients instead of a dense table
+            diff_params = dict(state.params)
+            for name, op in sparse_ops.items():
+                table = state.params[name]["kernel"]
+                if isinstance(op, DistributedEmbedding):
+                    idx = jnp.stack([batch[t.name].astype(jnp.int32)
+                                     for t in op.inputs])
+                    rows = jax.vmap(
+                        lambda w, i: jnp.take(w, i, axis=0))(table, idx)
+                else:
+                    idx = batch[op.inputs[0].name].astype(jnp.int32)
+                    rows = jnp.take(table, idx, axis=0)
+                sparse_idx[name] = idx
+                diff_params[name] = {"__rows__": rows}
+        grad_fn = jax.value_and_grad(
+            self._outputs_and_loss, argnums=0, has_aux=True)
+        (loss, (logits, new_states)), grads = grad_fn(
+            diff_params, state.states, batch, True, rng, seq_length)
+        if sparse_ops:
+            dense_params = {k: v for k, v in state.params.items()
+                            if k not in sparse_ops}
+            dense_grads = {k: grads[k] for k in dense_params}
+            new_params, new_opt = self.optimizer.update(
+                dense_params, dense_grads, state.opt_state, state.step)
+            new_params = dict(new_params)
+            for name, op in sparse_ops.items():
+                table = state.params[name]["kernel"]
+                g = grads[name]["__rows__"]
+                dim = table.shape[-1]
+                if isinstance(op, DistributedEmbedding):
+                    ntab = table.shape[0]
+                    newt = jax.vmap(self.optimizer.sparse_update)(
+                        table,
+                        sparse_idx[name].reshape(ntab, -1),
+                        g.reshape(ntab, -1, dim))
+                else:
+                    newt = self.optimizer.sparse_update(
+                        table, sparse_idx[name].reshape(-1),
+                        g.reshape(-1, dim))
+                new_params[name] = {**state.params[name], "kernel": newt}
+        else:
             new_params, new_opt = self.optimizer.update(
                 state.params, grads, state.opt_state, state.step)
-            metrics = {"loss": loss}
-            if "label" in batch and self.metric_names:
-                sparse = self.loss_name.startswith("sparse")
-                metrics.update(M.compute_metrics(
-                    self.metric_names, logits, batch["label"], sparse))
-            return TrainState(new_params, new_states, new_opt,
-                              state.step + 1), metrics
+        metrics = {"loss": loss}
+        if "label" in batch and self.metric_names:
+            sparse = self.loss_name.startswith("sparse")
+            metrics.update(M.compute_metrics(
+                self.metric_names, logits, batch["label"], sparse))
+        return TrainState(new_params, new_states, new_opt,
+                          state.step + 1), metrics
 
-        jitted = jax.jit(train_step, donate_argnums=(0,))
+    def build_train_step(self):
+        jitted = jax.jit(self._step_body, donate_argnums=(0,))
         return jitted
+
+    def build_train_step_multi(self):
+        """K optimizer steps per device dispatch, via `lax.scan` over the
+        leading (step) axis of a stacked batch. This is the TPU analog of
+        the reference's Legion trace record/replay (begin_trace/end_trace,
+        SURVEY.md 3.3): one host round trip launches many iterations, so
+        per-dispatch latency (severe through a remote-TPU tunnel) is
+        amortized instead of paid per step. Metrics come back stacked
+        with a leading (K,) axis."""
+
+        def train_multi(state: TrainState, batches, rngs):
+            def body(st, xs):
+                batch, rng = xs
+                return self._step_body(st, batch, rng)
+
+            return jax.lax.scan(body, state, (batches, rngs))
+
+        return jax.jit(train_multi, donate_argnums=(0,))
 
     def build_eval_step(self):
         cfg = self.config
@@ -236,6 +326,12 @@ class Executor:
         if self._train_step is None:
             self._train_step = self.build_train_step()
         return self._train_step
+
+    @property
+    def train_step_multi(self):
+        if self._train_step_multi is None:
+            self._train_step_multi = self.build_train_step_multi()
+        return self._train_step_multi
 
     @property
     def eval_step(self):
@@ -261,6 +357,38 @@ class Executor:
             if self.mesh is not None:
                 out[k] = jax.device_put(
                     arr, batch_sharding(self.mesh, arr.ndim))
+            else:
+                out[k] = arr
+        return out
+
+
+    def shard_batch_stacked(self, batches: List[Dict[str, np.ndarray]]):
+        """Stack K host batches along a new leading (step) axis and place
+        them on device for `train_step_multi` — the data axis moves to
+        dim 1, the step axis stays unsharded (each scan iteration
+        consumes one slice). Values that already live on device are
+        stacked device-side (never round-tripped through the host — a
+        device->host pull per dispatch would dwarf the dispatch cost the
+        multi-step path exists to amortize)."""
+        declared = {t.name: t.dtype for t in self.model.input_tensors}
+        keys = batches[0].keys()
+        out = {}
+        for k in keys:
+            vals = [b[k] for b in batches]
+            want = declared.get(k)
+            if all(isinstance(v, jax.Array) for v in vals):
+                arr = jnp.stack([
+                    v if want is None or v.dtype == want else v.astype(want)
+                    for v in vals])
+            else:
+                stacked = np.stack([np.asarray(v) for v in vals])
+                arr = jnp.asarray(stacked, dtype=want) if want is not None \
+                    else jnp.asarray(stacked)
+            if self.mesh is not None:
+                # spec of one step-slice, shifted right past the step axis
+                sh = batch_sharding(self.mesh, arr.ndim - 1)
+                spec = P(None, *sh.spec) if sh.spec else P()
+                out[k] = jax.device_put(arr, NamedSharding(self.mesh, spec))
             else:
                 out[k] = arr
         return out

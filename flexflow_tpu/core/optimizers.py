@@ -31,6 +31,22 @@ class Optimizer:
         """Returns (new_params, new_state)."""
         raise NotImplementedError
 
+    def supports_sparse(self) -> bool:
+        """Whether `sparse_update` applies this optimizer's exact rule
+        from (indices, row-gradients) alone. The executor routes large
+        embedding tables through the sparse path only when this holds —
+        otherwise they take the ordinary dense-gradient path."""
+        return False
+
+    def sparse_update(self, w, idx, g):
+        """Scatter-apply the update for the touched rows only: `w` is the
+        full (vocab, dim) table, `idx` (n,) row ids (duplicates allowed),
+        `g` (n, dim) the gradient of those gathered rows. The TPU analog
+        of the reference's scatter-add embedding backward + per-table
+        update (src/ops/embedding.cu), skipping the dense zeros+scatter+
+        axpy sweep over millions of untouched rows."""
+        raise NotImplementedError
+
 
 class SGDOptimizer(Optimizer):
     """Reference: sgd_update kernel (optimizer_kernel.cu:24-60):
@@ -81,6 +97,18 @@ class SGDOptimizer(Optimizer):
             new_v.append(nv)
         return (jax.tree_util.tree_unflatten(treedef, new_p),
                 {"v": jax.tree_util.tree_unflatten(treedef, new_v)})
+
+    def supports_sparse(self) -> bool:
+        # w -= lr * g row-wise is EXACTLY the dense rule when there is no
+        # momentum (no per-row state to carry) and no weight decay (decay
+        # touches every row, not just the gathered ones); duplicate
+        # indices accumulate commutatively through scatter-add, matching
+        # the dense scatter-of-sums.
+        return self.momentum == 0.0 and self.weight_decay == 0.0
+
+    def sparse_update(self, w, idx, g):
+        upd = (-self.lr) * g.astype(jnp.float32)
+        return w.at[idx].add(upd.astype(w.dtype))
 
 
 class AdamOptimizer(Optimizer):

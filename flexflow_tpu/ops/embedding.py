@@ -74,8 +74,15 @@ class Embedding(Op):
 
     def forward(self, params, xs, ctx: OpContext):
         (idx,) = xs
-        table = params["kernel"]
-        emb = jnp.take(table, idx.astype(jnp.int32), axis=0)
+        if "__rows__" in params:
+            # sparse-update path (executor pre-gathered the touched rows
+            # outside the differentiated function): the gradient flows to
+            # the ROWS, not the full table, and the optimizer applies a
+            # scatter update — the TPU analog of the reference's
+            # scatter-add embedding backward (src/ops/embedding.cu)
+            emb = params["__rows__"]
+        else:
+            emb = jnp.take(params["kernel"], idx.astype(jnp.int32), axis=0)
         if self.aggr == AGGR_MODE_SUM:
             emb = jnp.sum(emb, axis=-2)
         elif self.aggr == AGGR_MODE_AVG:
@@ -159,12 +166,15 @@ class DistributedEmbedding(Op):
         }
 
     def forward(self, params, xs, ctx: OpContext):
-        tables = params["kernel"]  # (E, vocab, dim)
-        ids = jnp.stack([x.astype(jnp.int32) for x in xs], axis=0)
-        # per-table gather, vmapped over the stacked axis: sharded on
-        # `table`, each device gathers only from its resident tables and
-        # GSPMD all-gathers the (E, batch, bag, dim) result
-        emb = jax.vmap(lambda w, i: jnp.take(w, i, axis=0))(tables, ids)
+        if "__rows__" in params:
+            emb = params["__rows__"]  # (E, batch, bag, dim) pre-gathered
+        else:
+            tables = params["kernel"]  # (E, vocab, dim)
+            ids = jnp.stack([x.astype(jnp.int32) for x in xs], axis=0)
+            # per-table gather, vmapped over the stacked axis: sharded on
+            # `table`, each device gathers only from its resident tables
+            # and GSPMD all-gathers the (E, batch, bag, dim) result
+            emb = jax.vmap(lambda w, i: jnp.take(w, i, axis=0))(tables, ids)
         if self.aggr == AGGR_MODE_SUM:
             emb = jnp.sum(emb, axis=-2)
         elif self.aggr == AGGR_MODE_AVG:

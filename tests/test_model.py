@@ -139,3 +139,53 @@ def test_imported_weights_applied_at_compile(rng):
     ff.compile(optimizer=SGDOptimizer(lr=0.1),
                loss_type="sparse_categorical_crossentropy", metrics=[])
     np.testing.assert_allclose(ff.get_weights("fc")["kernel"], w)
+
+
+def test_train_batches_matches_sequential():
+    """The scanned multi-step dispatch (train_batches, the trace-replay
+    analog of alexnet.cc:106-111 begin/end_trace) must reproduce the
+    single-step stream EXACTLY: same rng fold_in sequence, same updates."""
+    import jax
+
+    rng = np.random.RandomState(3)
+    batches = [{"input": rng.randn(8, 16).astype(np.float32),
+                "label": rng.randint(0, 4, (8,))} for _ in range(4)]
+
+    def build():
+        cfg = FFConfig()
+        cfg.batch_size = 8
+        ff = FFModel(cfg)
+        t = ff.create_tensor((8, 16), name="input")
+        h = ff.dense(t, 32, activation="relu")
+        h = ff.dropout(h, 0.1)
+        ff.dense(h, 4)
+        ff.compile(optimizer=SGDOptimizer(lr=0.1),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=["accuracy"])
+        return ff
+
+    seq = build()
+    seq_losses = [float(seq.train_batch(b)["loss"]) for b in batches]
+
+    grouped = build()
+    ms = grouped.train_batches(batches[:3])   # one dispatch, 3 steps
+    tail = grouped.train_batch(batches[3])    # ragged tail, single step
+    assert jax.device_get(ms["loss"]).shape == (3,)
+    got = list(jax.device_get(ms["loss"])) + [float(tail["loss"])]
+    np.testing.assert_allclose(seq_losses, got, rtol=1e-6)
+    name = seq.ops[-1].name
+    for k, v in seq.get_weights(name).items():
+        np.testing.assert_allclose(v, grouped.get_weights(name)[k],
+                                   rtol=1e-5)
+
+
+def test_fit_steps_per_dispatch():
+    ff = make_mlp()
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    x, y = synthetic_classification()
+    h1 = ff.fit({"input": x}, y, epochs=2, steps_per_dispatch=4,
+                verbose=False)
+    assert len(h1) == 2
+    assert h1[-1]["loss"] < h1[0]["loss"]

@@ -1,0 +1,114 @@
+"""Sparse embedding updates (executor fast path).
+
+Reference: src/ops/embedding.cu scatter-add backward + per-table update —
+the dense-gradient alternative materializes a full (vocab, dim) gradient
+every step, which at DLRM scale (8 x 1M x 64 tables) writes GBs of HBM
+per step for a few thousand touched rows. The executor's sparse path
+gathers the touched rows before differentiation and scatter-applies the
+optimizer rule to those rows only; it must be numerically IDENTICAL to
+the dense path for eligible optimizers (SGD, momentum=0, decay=0).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, SGDOptimizer
+
+
+def _build_embedding_model(sparse: bool, optimizer, distributed=False):
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.sparse_embedding_updates = sparse
+    ff = FFModel(cfg)
+    if distributed:
+        ids = [ff.create_tensor((16, 2), dtype=np.int32, name=f"sparse_{i}")
+               for i in range(4)]
+        embs = ff.distributed_embedding(ids, num_entries=64, out_dim=8)
+        t = ff.concat(embs, axis=1)
+    else:
+        idx = ff.create_tensor((16, 2), dtype=np.int32, name="input")
+        t = ff.embedding(idx, num_entries=64, out_dim=8, aggr="sum")
+    t = ff.dense(t, 4)
+    ff.compile(optimizer=optimizer,
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    return ff
+
+
+def _batches(distributed=False, n=3):
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(n):
+        b = {"label": rng.randint(0, 4, (16,)).astype(np.int32)}
+        if distributed:
+            for i in range(4):
+                b[f"sparse_{i}"] = rng.randint(0, 64, (16, 2)).astype(
+                    np.int32)
+        else:
+            # duplicate indices ON PURPOSE: scatter-add must accumulate
+            # them exactly like the dense gradient does
+            idx = rng.randint(0, 8, (16, 2)).astype(np.int32)
+            b["input"] = idx
+        out.append(b)
+    return out
+
+
+@pytest.mark.parametrize("distributed", [False, True])
+def test_sparse_matches_dense_sgd(distributed):
+    batches = _batches(distributed)
+    ff_sparse = _build_embedding_model(True, SGDOptimizer(lr=0.05),
+                                       distributed)
+    ff_dense = _build_embedding_model(False, SGDOptimizer(lr=0.05),
+                                      distributed)
+    emb_name = next(op.name for op in ff_sparse.ops
+                    if "embedding" in op.op_type)
+    assert emb_name in ff_sparse.executor._sparse_table_ops()
+    assert not ff_dense.executor._sparse_table_ops()
+    for b in batches:
+        ls = float(ff_sparse.train_batch(b)["loss"])
+        ld = float(ff_dense.train_batch(b)["loss"])
+        np.testing.assert_allclose(ls, ld, rtol=1e-6)
+    ws = ff_sparse.get_weights(emb_name)["kernel"]
+    wd = ff_dense.get_weights(emb_name)["kernel"]
+    np.testing.assert_allclose(ws, wd, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_ineligible_optimizers_fall_back():
+    # Adam needs per-row m/v state -> dense path
+    ff = _build_embedding_model(True, AdamOptimizer(lr=0.01))
+    assert not ff.executor._sparse_table_ops()
+    # SGD with momentum carries velocity for every row -> dense path
+    ff = _build_embedding_model(True, SGDOptimizer(lr=0.01, momentum=0.9))
+    assert not ff.executor._sparse_table_ops()
+    both = _batches()[0]
+    ff.train_batch(both)  # and it still trains
+
+
+def test_sparse_requires_input_indices():
+    """An embedding fed by a COMPUTED tensor (not a graph input) cannot
+    be pre-gathered and must take the dense path."""
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    ff = FFModel(cfg)
+    idx = ff.create_tensor((8, 4), dtype=np.int32, name="input")
+    r = ff.reshape(idx, (8, 2, 2))
+    r = ff.reshape(r, (8, 4))
+    t = ff.embedding(r, num_entries=32, out_dim=8, aggr="sum")
+    ff.dense(t, 4)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    assert not ff.executor._sparse_table_ops()
+    rng = np.random.RandomState(1)
+    m = ff.train_batch({"input": rng.randint(0, 32, (8, 4)),
+                        "label": rng.randint(0, 4, (8,))})
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_sparse_with_multi_step_dispatch():
+    """The scanned multi-step path must route sparse updates too."""
+    batches = _batches(n=4)
+    seq = _build_embedding_model(True, SGDOptimizer(lr=0.05))
+    grouped = _build_embedding_model(True, SGDOptimizer(lr=0.05))
+    seq_losses = [float(seq.train_batch(b)["loss"]) for b in batches]
+    got = jax.device_get(grouped.train_batches(batches)["loss"])
+    np.testing.assert_allclose(seq_losses, got, rtol=1e-6)
