@@ -1,0 +1,52 @@
+"""candle_uno on the Keras frontend (reference:
+examples/python/keras/candle_uno/ — cancer-drug-response MLP with
+multiple feature towers concatenated; examples/cpp/candle_uno).
+
+  python examples/python/keras/candle_uno.py -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+FEATURE_SHAPES = {"dose": 1, "cell.rnaseq": 64, "drug.descriptors": 48}
+
+
+def tower(width_list, inp):
+    t = inp
+    for w in width_list:
+        t = keras.layers.Dense(w, activation="relu")(t)
+    return t
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+
+    inputs, towers = [], []
+    for name, dim in FEATURE_SHAPES.items():
+        inp = keras.layers.Input((dim,))
+        inputs.append(inp)
+        towers.append(tower([64, 64], inp) if dim > 1 else inp)
+    t = keras.layers.Concatenate(axis=1)(towers)
+    for _ in range(3):
+        t = keras.layers.Dense(128, activation="relu")(t)
+    out = keras.layers.Dense(1)(t)
+    model = keras.Model(inputs=inputs, outputs=out)
+    model.compile(optimizer=keras.SGD(learning_rate=0.01),
+                  loss="mean_squared_error", metrics=["mse"])
+
+    rng = np.random.RandomState(0)
+    n = 512
+    xs = [rng.randn(n, d).astype(np.float32)
+          for d in FEATURE_SHAPES.values()]
+    y = rng.rand(n, 1).astype(np.float32)
+    hist = model.fit(xs, y, batch_size=64, epochs=epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,59 @@
+"""Functional-API Net2Net example (reference:
+examples/python/keras/func_mnist_mlp_net2net.py; tests/multi_gpu_tests.sh):
+teacher -> widened student with teacher-seeded weights, functional API.
+
+  python examples/python/keras/func_mnist_mlp_net2net.py -e 2
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def make(width):
+    inp = keras.layers.Input((784,))
+    t = keras.layers.Dense(width, activation="relu")(inp)
+    out = keras.layers.Dense(10, activation="softmax")(t)
+    model = keras.Model(inputs=inp, outputs=out)
+    model.compile(optimizer=keras.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    return model
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 2
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(1024, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+
+    teacher = make(128)
+    teacher.fit(x, y, batch_size=64, epochs=epochs)
+
+    student = make(256)
+    s_ff = student.build_model(batch_size=64)
+    t_ff = teacher.ffmodel
+    t_ops = [op.name for op in t_ff.ops if op.op_type == "linear"]
+    s_ops = [op.name for op in s_ff.ops if op.op_type == "linear"]
+    tw0 = t_ff.get_weights(t_ops[0])
+    sw0 = {k: v.copy() for k, v in s_ff.get_weights(s_ops[0]).items()}
+    sw0["kernel"][:, :128] = tw0["kernel"]
+    sw0["bias"][:128] = tw0["bias"]
+    s_ff.set_weights(s_ops[0], sw0)
+    tw1 = t_ff.get_weights(t_ops[1])
+    sw1 = {k: v.copy() for k, v in s_ff.get_weights(s_ops[1]).items()}
+    sw1["kernel"][:128, :] = tw1["kernel"]
+    sw1["bias"][:] = tw1["bias"]
+    s_ff.set_weights(s_ops[1], sw1)
+
+    hist = student.fit(x, y, batch_size=64, epochs=epochs)
+    print(f"final accuracy: {hist[-1]['accuracy']:.3f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

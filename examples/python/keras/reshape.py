@@ -1,0 +1,37 @@
+"""Keras Reshape layer example (reference:
+examples/python/keras/reshape.py).
+
+  python examples/python/keras/reshape.py -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+
+    inp = keras.layers.Input((784,))
+    t = keras.layers.Reshape((1, 28, 28))(inp)
+    t = keras.layers.Conv2D(16, (3, 3), activation="relu")(t)
+    t = keras.layers.MaxPooling2D((2, 2))(t)
+    t = keras.layers.Flatten()(t)
+    out = keras.layers.Dense(10, activation="softmax")(t)
+    model = keras.Model(inputs=inp, outputs=out)
+    model.compile(optimizer=keras.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 784).astype(np.float32)
+    y = rng.randint(0, 10, 256).astype(np.int32)
+    hist = model.fit(x, y, batch_size=32, epochs=epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

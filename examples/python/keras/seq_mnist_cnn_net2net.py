@@ -1,0 +1,57 @@
+"""Sequential Net2Net on a CNN (reference:
+examples/python/keras/seq_mnist_cnn_net2net.py; tests/multi_gpu_tests.sh):
+widen the conv stack's channel count, seed from the teacher via host
+get/set weights (the reference Parameter::get/set role).
+
+  python examples/python/keras/seq_mnist_cnn_net2net.py -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def make(channels):
+    model = keras.Sequential([
+        keras.layers.Conv2D(channels, (3, 3), activation="relu",
+                            input_shape=(1, 28, 28)),
+        keras.layers.MaxPooling2D((2, 2)),
+        keras.layers.Flatten(),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+    model.compile(optimizer=keras.SGD(learning_rate=0.02),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    return model
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, 256).astype(np.int32)
+
+    teacher = make(16)
+    teacher.fit(x, y, batch_size=32, epochs=epochs)
+
+    student = make(32)
+    s_ff = student.build_model(batch_size=32)
+    t_ff = teacher.ffmodel
+    t_conv = next(op.name for op in t_ff.ops if op.op_type == "conv2d")
+    s_conv = next(op.name for op in s_ff.ops if op.op_type == "conv2d")
+    tw = t_ff.get_weights(t_conv)
+    sw = {k: v.copy() for k, v in s_ff.get_weights(s_conv).items()}
+    sw["kernel"][:16] = tw["kernel"]  # OIHW: copy the teacher's filters
+    sw["bias"][:16] = tw["bias"]
+    s_ff.set_weights(s_conv, sw)
+
+    hist = student.fit(x, y, batch_size=32, epochs=epochs)
+    print(f"final accuracy: {hist[-1]['accuracy']:.3f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,57 @@
+"""BERT-proxy: a stack of transformer encoder blocks on the native
+builder API (reference: examples/python/native/bert_proxy_native.py —
+BERT-Large-shaped MHA+FFN blocks on synthetic data).
+
+Sized down by default so it runs anywhere; pass --hidden/--layers to
+scale up toward the reference's 1024/24.
+
+  python -m flexflow_tpu examples/python/native/bert_proxy_native.py -b 8 -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, SGDOptimizer, FFModel
+
+
+def arg(flag, default, typ=int):
+    return typ(sys.argv[sys.argv.index(flag) + 1]) \
+        if flag in sys.argv else default
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    bs = cfg.batch_size
+    seq = arg("--seq-length", 64)
+    hidden = arg("--hidden", 128)
+    heads = arg("--heads", 8)
+    layers = arg("--layers", 2)
+
+    ff = FFModel(cfg)
+    t = ff.create_tensor((bs, seq, hidden), name="input")
+    for i in range(layers):
+        # self-attention + residual
+        a = ff.multihead_attention(t, t, t, embed_dim=hidden,
+                                   num_heads=heads, name=f"mha_{i}")
+        t = ff.add(t, a, name=f"res_a_{i}")
+        # FFN (4x) + residual, GELU like BERT
+        f = ff.dense(t, 4 * hidden, activation="gelu", name=f"ffn_up_{i}")
+        f = ff.dense(f, hidden, name=f"ffn_down_{i}")
+        t = ff.add(t, f, name=f"res_f_{i}")
+    t = ff.reshape(t, (bs, seq * hidden))
+    t = ff.dense(t, 2)  # NSP-style head
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(cfg.seed)
+    x = rng.randn(4 * bs, seq, hidden).astype(np.float32)
+    y = rng.randint(0, 2, 4 * bs).astype(np.int32)
+    hist = ff.fit({"input": x}, y, epochs=cfg.epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,41 @@
+"""CIFAR-10 CNN with concatenated conv branches on the native builder
+API (reference: examples/python/native/cifar10_cnn_concat.py; run by
+tests/multi_gpu_tests.sh).
+
+  python -m flexflow_tpu examples/python/native/cifar10_cnn_concat.py -b 16 -e 1
+"""
+
+import sys
+
+from flexflow_tpu import FFConfig, SGDOptimizer, FFModel
+
+from common import synthetic_dataset
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    ff = FFModel(cfg)
+    x = ff.create_tensor((cfg.batch_size, 3, 32, 32), name="input")
+    a = ff.conv2d(x, 32, 3, 3, 1, 1, 1, 1, activation="relu", name="br_a")
+    b = ff.conv2d(x, 32, 5, 5, 1, 1, 2, 2, activation="relu", name="br_b")
+    t = ff.concat([a, b], axis=1)
+    t = ff.conv2d(t, 64, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 256, activation="relu")
+    t = ff.dense(t, 10)
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    n = 256
+    if "--samples" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--samples") + 1])
+    xs, ys = synthetic_dataset(ff, n, num_classes=10, seed=cfg.seed)
+    hist = ff.fit(xs, ys, epochs=cfg.epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,72 @@
+"""PyTorch-frontend CIFAR-10 CNN with a residual add (reference:
+examples/python/pytorch/cifar10_cnn_torch.py — torch.fx trace, export
+.ff, replay + train).
+
+  python examples/python/pytorch/cifar10_cnn_torch.py -e 1
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+import torch.nn as nn
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.frontends.torchfx import PyTorchModel, export_ff
+
+
+class CNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 32, 3, padding=1)
+        self.relu1 = nn.ReLU()
+        self.conv2 = nn.Conv2d(32, 32, 3, padding=1)
+        self.relu2 = nn.ReLU()
+        self.pool = nn.MaxPool2d(2)
+        self.flat = nn.Flatten()
+        self.fc1 = nn.Linear(32 * 16 * 16, 256)
+        self.relu3 = nn.ReLU()
+        self.fc2 = nn.Linear(256, 10)
+        self.sm = nn.Softmax(dim=-1)
+
+    def forward(self, x):
+        a = self.relu1(self.conv1(x))
+        b = self.relu2(self.conv2(a))
+        t = a + b  # residual add traces to ElementBinary
+        t = self.pool(t)
+        t = self.relu3(self.fc1(self.flat(t)))
+        return self.sm(self.fc2(t))
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    batch_size = 16
+
+    module = CNN()
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "cifar10_cnn.ff")
+        export_ff(module, path)  # graph-only .ff roundtrip check
+        PyTorchModel(path)
+    ptm = PyTorchModel(module)
+
+    cfg = FFConfig.from_args()
+    cfg.batch_size = batch_size
+    ff = FFModel(cfg)
+    inp = ff.create_tensor((batch_size, 3, 32, 32), name="input")
+    ptm.apply(ff, [inp])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    ptm.import_weights(ff)  # start from the torch module's weights
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, 128).astype(np.int32)
+    hist = ff.fit({"input": x}, y, epochs=epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -174,6 +174,23 @@ class Flatten(Layer):
         return ff.flat(ins[0], name=self.name)
 
 
+class Reshape(Layer):
+    """Batch-preserving reshape (reference keras frontend Reshape →
+    FFModel::reshape; target_shape excludes the batch dim)."""
+
+    def __init__(self, target_shape, name=None, **kw):
+        super().__init__(name, kw.get("input_shape"))
+        self.target_shape = tuple(int(s) for s in target_shape)
+
+    def output_shape(self, in_shapes):
+        return self.target_shape
+
+    def emit(self, ff, ins):
+        bs = ins[0].shape[0]
+        return ff.reshape(ins[0], (bs,) + self.target_shape,
+                          name=self.name)
+
+
 class Dropout(Layer):
     def __init__(self, rate, name=None, **kw):
         super().__init__(name, kw.get("input_shape"))

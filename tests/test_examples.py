@@ -40,6 +40,11 @@ def run_example(script, *args, cpu_devices=2, timeout=240):
     ("examples/python/native/print_layers.py", ["-b", "32", "-e", "1"]),
     ("examples/python/native/reshape.py", ["-b", "32", "-e", "1"]),
     ("examples/python/native/mnist_mlp_attach.py", ["-b", "64", "-e", "1"]),
+    ("examples/python/native/multi_head_attention.py",
+     ["-b", "8", "-e", "1"]),
+    ("examples/python/native/bert_proxy_native.py", ["-b", "8", "-e", "1"]),
+    ("examples/python/native/cifar10_cnn_concat.py",
+     ["-b", "8", "--samples", "32", "-e", "1"]),
 ])
 def test_native_examples_run(script, args):
     out = run_example(script, *args)
@@ -63,6 +68,12 @@ def test_native_examples_run(script, args):
     "examples/python/keras/seq_mnist_cnn_nested.py",
     "examples/python/keras/func_mnist_mlp_concat2.py",
     "examples/python/keras/func_cifar10_cnn_net2net.py",
+    "examples/python/keras/func_mnist_cnn.py",
+    "examples/python/keras/func_cifar10_cnn.py",
+    "examples/python/keras/func_mnist_mlp_net2net.py",
+    "examples/python/keras/seq_mnist_cnn_net2net.py",
+    "examples/python/keras/reshape.py",
+    "examples/python/keras/candle_uno.py",
 ])
 def test_keras_examples_run(script):
     out = run_example(script, "-e", "1")
@@ -79,6 +90,18 @@ def test_pytorch_cnn_example():
     out = run_example("examples/python/pytorch/mnist_cnn_torch.py",
                       "-e", "1")
     assert "final loss" in out
+
+
+def test_pytorch_cifar10_residual_example():
+    out = run_example("examples/python/pytorch/cifar10_cnn_torch.py",
+                      "-e", "1")
+    assert "final loss" in out
+
+
+def test_tensor_attach_example():
+    out = run_example("examples/python/native/tensor_attach.py",
+                      "-b", "32", "-e", "1")
+    assert "attach roundtrip OK" in out
 
 
 def test_bootcamp_demo():

@@ -158,3 +158,22 @@ def test_keras_nested_model_as_layer():
 
     with pytest.raises(NotImplementedError, match="weight sharing"):
         inner(outer_in)
+
+
+def test_keras_reshape_layer():
+    from flexflow_tpu.frontends import keras
+
+    inp = keras.layers.Input((784,))
+    t = keras.layers.Reshape((1, 28, 28))(inp)
+    t = keras.layers.Conv2D(8, (3, 3), activation="relu")(t)
+    t = keras.layers.Flatten()(t)
+    out = keras.layers.Dense(10, activation="softmax")(t)
+    model = keras.Model(inputs=inp, outputs=out)
+    model.compile(optimizer=keras.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 784).astype(np.float32)
+    y = rng.randint(0, 10, 64).astype(np.int32)
+    hist = model.fit(x, y, batch_size=32, epochs=1)
+    assert np.isfinite(hist[-1]["loss"])
