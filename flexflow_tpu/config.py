@@ -119,6 +119,11 @@ class FFConfig:
     # remat: trade FLOPs for HBM (no reference analog; TPU-first)
     remat: bool = False
 
+    # compute layout for Conv2D/Pool2D/BatchNorm: "NCHW" (logical, the
+    # reference's layout) or "NHWC" (channels on the TPU lane dim; ops
+    # transpose at their boundaries and XLA cancels the interior pairs).
+    conv_layout: str = "NCHW"
+
     # sparse embedding updates: when the optimizer's exact rule can be
     # applied row-wise (SGD, no momentum/decay), embedding tables whose
     # index tensors are graph inputs skip the dense-gradient sweep and
@@ -126,6 +131,14 @@ class FFConfig:
     # scatter-add embedding backward, src/ops/embedding.cu; essential
     # for DLRM-scale vocabularies where a dense step writes GBs).
     sparse_embedding_updates: bool = True
+
+    # opt-in: also use the sparse path when the optimizer only has a
+    # LAZY sparse form (SGD+momentum, Adam): touched rows get the exact
+    # rule on coalesced gradients, untouched rows keep stale state
+    # (momentum does not decay, Adam m/v do not advance) — the
+    # torch.optim.SparseAdam trade. Off by default because it changes
+    # optimizer semantics, not just cost.
+    sparse_embedding_lazy: bool = False
 
     # synthetic input when no dataset is provided (reference: config.h:131)
     synthetic_input: bool = False
@@ -146,6 +159,16 @@ class FFConfig:
     def __post_init__(self):
         if self.argv is not None:
             self.parse_args(self.argv)
+        self.validate()
+
+    def validate(self) -> None:
+        """Reject silently-ignorable values (conv_layout falls back to
+        NCHW on any non-"NHWC" string, which would be an undetectable
+        perf misconfiguration). Called from __post_init__ and compile."""
+        if self.conv_layout not in ("NCHW", "NHWC"):
+            raise ValueError(
+                f"conv_layout must be 'NCHW' or 'NHWC', got "
+                f"{self.conv_layout!r}")
 
     @classmethod
     def from_args(cls, argv: Optional[Sequence[str]] = None) -> "FFConfig":
@@ -175,6 +198,7 @@ class FFConfig:
         "--machine-model-file": ("machine_model_file", str),
         "--taskgraph": ("taskgraph_file", str),
         "--seed": ("seed", int),
+        "--conv-layout": ("conv_layout", str),
     }
     _BOOL_FLAGS = {
         "--profiling": "profiling",
@@ -191,6 +215,7 @@ class FFConfig:
         "--search-mesh-shapes": "search_mesh_shapes",
         "--enable-device-placement": "enable_device_placement",
         "--synthetic-input": "synthetic_input",
+        "--sparse-embedding-lazy": "sparse_embedding_lazy",
     }
     _NEG_BOOL_FLAGS = {
         "--no-sparse-embedding": "sparse_embedding_updates",

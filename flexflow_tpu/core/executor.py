@@ -198,18 +198,22 @@ class Executor:
     def _sparse_table_ops(self) -> Dict[str, Op]:
         """Embedding-family ops eligible for the sparse-update path:
         their index tensors are graph INPUTS (so the executor can gather
-        the touched rows before differentiation) and the optimizer's
-        exact rule is expressible row-wise (Optimizer.supports_sparse).
-        Reference analog: the scatter-add embedding backward + per-table
-        update of src/ops/embedding.cu — the dense-gradient alternative
-        writes the full (vocab, dim) table's worth of zeros + updates
-        every step, ruinous at DLRM scale."""
+        the touched rows before differentiation) and the optimizer has a
+        sparse row form (Optimizer.sparse_mode): "exact" is used freely,
+        "lazy" (stale untouched rows, SparseAdam-style) only when
+        config.sparse_embedding_lazy opts in. Reference analog: the
+        scatter-add embedding backward + per-table update of
+        src/ops/embedding.cu — the dense-gradient alternative writes the
+        full (vocab, dim) table's worth of zeros + updates every step,
+        ruinous at DLRM scale."""
         if self._sparse_ops_cache is not None:
             return self._sparse_ops_cache
         from ..ops.embedding import DistributedEmbedding, Embedding
         out: Dict[str, Op] = {}
-        if (self.config.sparse_embedding_updates and self.optimizer
-                and self.optimizer.supports_sparse()):
+        mode = (self.optimizer.sparse_mode() if self.optimizer else None)
+        allowed = mode == "exact" or (
+            mode == "lazy" and self.config.sparse_embedding_lazy)
+        if self.config.sparse_embedding_updates and allowed:
             input_uids = {t.uid for t in self.model.input_tensors}
             for op in self.model.ops:
                 if not isinstance(op, (Embedding, DistributedEmbedding)):
@@ -254,24 +258,39 @@ class Executor:
             dense_params = {k: v for k, v in state.params.items()
                             if k not in sparse_ops}
             dense_grads = {k: grads[k] for k in dense_params}
+            # optimizer state mirrors params at the top (op-name) level
+            # for both built-ins ({"v": {op: ...}} / {"m","v"}): split
+            # out the sparse tables' slots so the dense update's tree
+            # structures match, then merge the scatter-updated slots back
+            dense_opt = {slot: {k: v for k, v in tree.items()
+                                if k not in sparse_ops}
+                         for slot, tree in state.opt_state.items()}
             new_params, new_opt = self.optimizer.update(
-                dense_params, dense_grads, state.opt_state, state.step)
+                dense_params, dense_grads, dense_opt, state.step)
             new_params = dict(new_params)
+            new_opt = {slot: dict(tree) for slot, tree in new_opt.items()}
             for name, op in sparse_ops.items():
                 table = state.params[name]["kernel"]
                 g = grads[name]["__rows__"]
                 dim = table.shape[-1]
+                slots = {slot: tree[name]["kernel"]
+                         for slot, tree in state.opt_state.items()
+                         if name in tree}
                 if isinstance(op, DistributedEmbedding):
                     ntab = table.shape[0]
-                    newt = jax.vmap(self.optimizer.sparse_update)(
-                        table,
-                        sparse_idx[name].reshape(ntab, -1),
-                        g.reshape(ntab, -1, dim))
+                    newt, new_slots = jax.vmap(
+                        lambda w_, i_, g_, s_: self.optimizer.
+                        sparse_update(w_, i_, g_, s_, state.step)
+                    )(table, sparse_idx[name].reshape(ntab, -1),
+                      g.reshape(ntab, -1, dim), slots)
                 else:
-                    newt = self.optimizer.sparse_update(
+                    newt, new_slots = self.optimizer.sparse_update(
                         table, sparse_idx[name].reshape(-1),
-                        g.reshape(-1, dim))
+                        g.reshape(-1, dim), slots, state.step)
                 new_params[name] = {**state.params[name], "kernel": newt}
+                for slot, arr in new_slots.items():
+                    new_opt[slot][name] = {
+                        **state.opt_state[slot][name], "kernel": arr}
         else:
             new_params, new_opt = self.optimizer.update(
                 state.params, grads, state.opt_state, state.step)

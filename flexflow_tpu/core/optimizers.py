@@ -21,6 +21,31 @@ import jax
 import jax.numpy as jnp
 
 
+def coalesce_rows(idx, g, vocab: int):
+    """Static-shape duplicate coalescing for sparse row updates: sort
+    the indices, segment-sum gradients of equal indices, and park unused
+    slots at an out-of-range row (scatters use mode='drop').
+
+    Returns (uidx, gsum) with the SAME length n as the input — slot j
+    holds a unique row id and the summed gradient of all its duplicates
+    (or row=vocab, g=0 padding). Needed because stateful row rules
+    (momentum, Adam) are not additive: applying the rule per-duplicate
+    differs from applying it once to the summed gradient, which is what
+    the dense path computes (torch coalesces sparse grads the same way).
+    """
+    n = idx.shape[0]
+    order = jnp.argsort(idx)
+    sidx = idx[order]
+    sg = g[order]
+    newseg = jnp.concatenate([jnp.ones((1,), jnp.int32),
+                              (sidx[1:] != sidx[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(newseg) - 1          # 0..u-1 ranks, static shape
+    gsum = jax.ops.segment_sum(sg, seg, num_segments=n)
+    uidx = jnp.full((n,), vocab, dtype=sidx.dtype)  # padding = OOB row
+    uidx = uidx.at[seg].set(sidx)          # last dupe wins; same value
+    return uidx, gsum
+
+
 class Optimizer:
     name = "optimizer"
 
@@ -31,20 +56,30 @@ class Optimizer:
         """Returns (new_params, new_state)."""
         raise NotImplementedError
 
-    def supports_sparse(self) -> bool:
-        """Whether `sparse_update` applies this optimizer's exact rule
-        from (indices, row-gradients) alone. The executor routes large
-        embedding tables through the sparse path only when this holds —
-        otherwise they take the ordinary dense-gradient path."""
-        return False
+    def sparse_mode(self):
+        """How `sparse_update` relates to the dense rule:
+        - "exact": identical result (plain SGD — scatter-add IS the
+          dense update restricted to the touched rows);
+        - "lazy": touched rows get the exact rule on COALESCED gradients,
+          untouched rows keep stale state (momentum does not decay, Adam
+          m/v do not advance) — torch.optim.SparseAdam semantics;
+        - None: no sparse form (weight decay touches every row).
+        The executor uses "exact" freely and "lazy" only when
+        FFConfig.sparse_embedding_lazy opts in."""
+        return None
 
-    def sparse_update(self, w, idx, g):
+    def supports_sparse(self) -> bool:
+        return self.sparse_mode() == "exact"
+
+    def sparse_update(self, w, idx, g, slots, step):
         """Scatter-apply the update for the touched rows only: `w` is the
         full (vocab, dim) table, `idx` (n,) row ids (duplicates allowed),
-        `g` (n, dim) the gradient of those gathered rows. The TPU analog
-        of the reference's scatter-add embedding backward + per-table
-        update (src/ops/embedding.cu), skipping the dense zeros+scatter+
-        axpy sweep over millions of untouched rows."""
+        `g` (n, dim) the gradient of those gathered rows, `slots` this
+        table's optimizer-state arrays (e.g. {"v": (vocab, dim)}), `step`
+        the global step counter. Returns (new_w, new_slots). The TPU
+        analog of the reference's scatter-add embedding backward +
+        per-table update (src/ops/embedding.cu), skipping the dense
+        zeros+scatter+axpy sweep over millions of untouched rows."""
         raise NotImplementedError
 
 
@@ -98,17 +133,31 @@ class SGDOptimizer(Optimizer):
         return (jax.tree_util.tree_unflatten(treedef, new_p),
                 {"v": jax.tree_util.tree_unflatten(treedef, new_v)})
 
-    def supports_sparse(self) -> bool:
+    def sparse_mode(self):
         # w -= lr * g row-wise is EXACTLY the dense rule when there is no
         # momentum (no per-row state to carry) and no weight decay (decay
         # touches every row, not just the gathered ones); duplicate
         # indices accumulate commutatively through scatter-add, matching
-        # the dense scatter-of-sums.
-        return self.momentum == 0.0 and self.weight_decay == 0.0
+        # the dense scatter-of-sums. With momentum the velocity of
+        # untouched rows would decay in the dense rule -> lazy only.
+        if self.weight_decay != 0.0:
+            return None
+        return "exact" if self.momentum == 0.0 else "lazy"
 
-    def sparse_update(self, w, idx, g):
-        upd = (-self.lr) * g.astype(jnp.float32)
-        return w.at[idx].add(upd.astype(w.dtype))
+    def sparse_update(self, w, idx, g, slots, step):
+        if self.momentum == 0.0:
+            upd = (-self.lr) * g.astype(jnp.float32)
+            return w.at[idx].add(upd.astype(w.dtype)), slots
+        vocab = w.shape[0]
+        uidx, gsum = coalesce_rows(idx, g.astype(jnp.float32), vocab)
+        v_rows = slots["v"].at[uidx].get(mode="fill", fill_value=0.0)
+        v_rows = self.momentum * v_rows + gsum
+        step_dir = gsum + self.momentum * v_rows if self.nesterov \
+            else v_rows
+        new_w = w.at[uidx].add((-self.lr * step_dir).astype(w.dtype),
+                               mode="drop")
+        new_v = slots["v"].at[uidx].set(v_rows, mode="drop")
+        return new_w, {"v": new_v}
 
 
 class AdamOptimizer(Optimizer):
@@ -160,3 +209,24 @@ class AdamOptimizer(Optimizer):
             {"m": jax.tree_util.tree_unflatten(treedef, new_m),
              "v": jax.tree_util.tree_unflatten(treedef, new_v)},
         )
+
+    def sparse_mode(self):
+        # lazy-Adam: touched rows advance m/v and step with the bias-
+        # corrected alpha_t; untouched rows keep stale m/v (torch
+        # SparseAdam). Weight decay would touch every row -> dense.
+        return "lazy" if self.weight_decay == 0.0 else None
+
+    def sparse_update(self, w, idx, g, slots, step):
+        t = step.astype(jnp.float32) + 1.0
+        alpha_t = self.lr * jnp.sqrt(1.0 - self.beta2 ** t) / (
+            1.0 - self.beta1 ** t)
+        vocab = w.shape[0]
+        uidx, gsum = coalesce_rows(idx, g.astype(jnp.float32), vocab)
+        m = slots["m"].at[uidx].get(mode="fill", fill_value=0.0)
+        v = slots["v"].at[uidx].get(mode="fill", fill_value=0.0)
+        m = self.beta1 * m + (1 - self.beta1) * gsum
+        v = self.beta2 * v + (1 - self.beta2) * gsum * gsum
+        delta = -alpha_t * m / (jnp.sqrt(v) + self.epsilon)
+        return (w.at[uidx].add(delta.astype(w.dtype), mode="drop"),
+                {"m": slots["m"].at[uidx].set(m, mode="drop"),
+                 "v": slots["v"].at[uidx].set(v, mode="drop")})

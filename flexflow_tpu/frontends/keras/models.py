@@ -133,7 +133,7 @@ class Model:
 
     def fit(self, x, y, batch_size: int = 64, epochs: int = 1,
             callbacks: Sequence = (), shuffle: bool = True,
-            verbose: bool = True):
+            verbose: bool = True, steps_per_dispatch: int = 1):
         xs = x if isinstance(x, (list, tuple)) else [x]
         bs = self._batch_size or batch_size
         self._ensure_ff(bs)  # builds Sequential graphs lazily
@@ -156,7 +156,8 @@ class Model:
                 cb.on_epoch_begin(epoch)
             h = self.ffmodel.fit(inputs, np.asarray(y), batch_size=bs,
                                  epochs=1, shuffle=shuffle,
-                                 verbose=False)
+                                 verbose=False,
+                                 steps_per_dispatch=steps_per_dispatch)
             logs = h[-1]
             logs["epoch"] = epoch
             history.append(logs)

@@ -340,6 +340,7 @@ class FFModel:
         """Reference: FFModel::compile (model.cc:1551-1796). Runs strategy
         search when config.search_budget > 0, builds the executor, and
         initializes parameters (sharded per strategy)."""
+        self.config.validate()  # catch post-construction field edits
         if mesh is not None:
             self.mesh = mesh
         if strategy is not None:

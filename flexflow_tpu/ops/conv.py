@@ -5,9 +5,12 @@ auto-selection — on TPU, XLA picks the conv strategy during compilation, so
 the whole algorithm-selection machinery at conv_2d.cu:173-260 disappears),
 src/ops/pool_2d.cu, src/ops/batch_norm.cu, src/ops/flat.cu.
 
-Layout: the graph-level API is NCHW to match reference examples 1:1;
-XLA's layout assignment re-tiles for the MXU internally, so we do not
-hand-transpose to NHWC.
+Layout: the graph-level API is NCHW to match reference examples 1:1.
+`FFConfig.conv_layout = "NHWC"` makes Conv2D/Pool2D/BatchNorm COMPUTE in
+NHWC (channels on the TPU's 128-lane minor dim): each op transposes in
+and out, and XLA's algebraic simplifier cancels the adjacent pairs
+inside conv->bn->pool chains, leaving layout conversions only at chain
+boundaries. Logical shapes everywhere stay NCHW.
 """
 
 from __future__ import annotations
@@ -92,21 +95,29 @@ class Conv2D(Op):
     def forward(self, params, xs, ctx: OpContext):
         (x,) = xs
         ph, pw = self.padding
+        nhwc = self.model.config.conv_layout == "NHWC"
         # no preferred_element_type: the MXU accumulates bf16 convs in
         # f32 natively, and conv's gradient transpose rejects the mixed
         # f32-cotangent/bf16-operand pair the flag would create (unlike
         # dot_general's); output dtype follows the activations.
+        if nhwc:
+            x = jnp.transpose(x, (0, 2, 3, 1))
         y = lax.conv_general_dilated(
             x,
             params["kernel"].astype(x.dtype),
             window_strides=self.stride,
             padding=[(ph, ph), (pw, pw)],
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=(("NHWC", "OIHW", "NHWC") if nhwc
+                               else ("NCHW", "OIHW", "NCHW")),
             feature_group_count=self.groups,
         )
+        bshape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
         if self.use_bias:
-            y = y + params["bias"].reshape(1, -1, 1, 1).astype(y.dtype)
-        return [apply_activation(y, self.activation)]
+            y = y + params["bias"].reshape(bshape).astype(y.dtype)
+        y = apply_activation(y, self.activation)
+        if nhwc:
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return [y]
 
     def output_axes(self):
         return [(SAMPLE, CHANNEL_OUT, HEIGHT, WIDTH)]
@@ -152,9 +163,16 @@ class Pool2D(Op):
         kh, kw = self.kernel
         sh, sw = self.stride
         ph, pw = self.padding
-        window = (1, 1, kh, kw)
-        strides = (1, 1, sh, sw)
-        pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        nhwc = self.model.config.conv_layout == "NHWC"
+        if nhwc:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+            window = (1, kh, kw, 1)
+            strides = (1, sh, sw, 1)
+            pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        else:
+            window = (1, 1, kh, kw)
+            strides = (1, 1, sh, sw)
+            pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
         if self.pool_type == self.POOL_MAX:
             init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
             y = lax.reduce_window(x, init, lax.max, window, strides, pads)
@@ -162,7 +180,10 @@ class Pool2D(Op):
             summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
             # cuDNN CUDNN_POOLING_AVERAGE_COUNT_INCLUDE_PADDING semantics
             y = summed / float(kh * kw)
-        return [apply_activation(y, self.activation)]
+        y = apply_activation(y, self.activation)
+        if nhwc:
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return [y]
 
     def output_axes(self):
         return [(SAMPLE, CHANNEL, HEIGHT, WIDTH)]
@@ -214,8 +235,16 @@ class BatchNorm(Op):
 
     def forward(self, params, xs, ctx: OpContext):
         (x,) = xs
-        reduce_axes = (0, 2, 3) if x.ndim == 4 else tuple(
-            i for i in range(x.ndim) if i != 1)
+        nhwc = (x.ndim == 4
+                and self.model.config.conv_layout == "NHWC")
+        if nhwc:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+            reduce_axes = (0, 1, 2)
+            ch_axis = 3
+        else:
+            reduce_axes = (0, 2, 3) if x.ndim == 4 else tuple(
+                i for i in range(x.ndim) if i != 1)
+            ch_axis = 1
         if ctx.training:
             xf = x.astype(jnp.float32)
             mean = jnp.mean(xf, axis=reduce_axes)
@@ -232,13 +261,15 @@ class BatchNorm(Op):
             ctx.state_out["running_mean"] = mean
             ctx.state_out["running_var"] = var
         shape = [1] * x.ndim
-        shape[1] = -1
+        shape[ch_axis] = -1
         inv = lax.rsqrt(var + self.EPS).reshape(shape).astype(x.dtype)
         mean = mean.reshape(shape).astype(x.dtype)
         y = (x - mean) * inv * params["scale"].reshape(shape).astype(
             x.dtype) + params["bias"].reshape(shape).astype(x.dtype)
         if self.relu:
             y = jax.nn.relu(y)
+        if nhwc:
+            y = jnp.transpose(y, (0, 3, 1, 2))
         return [y]
 
     def output_axes(self):

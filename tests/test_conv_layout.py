@@ -1,0 +1,44 @@
+"""conv_layout="NHWC" must be a pure layout change: identical numerics
+to the default NCHW compute path (reference examples are NCHW; on TPU
+the NHWC compute form puts channels on the 128-lane minor dim and XLA
+cancels the per-op transpose pairs inside conv chains)."""
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+
+def _build(layout):
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.conv_layout = layout
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 3, 16, 16), name="input")
+    t = ff.conv2d(x, 16, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = ff.batch_norm(t, relu=True)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0, pool_type="avg")
+    t = ff.flat(t)
+    t = ff.dense(t, 4)
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    return ff
+
+def test_nhwc_matches_nchw():
+    rng = np.random.RandomState(0)
+    batches = [{"input": rng.randn(8, 3, 16, 16).astype(np.float32),
+                "label": rng.randint(0, 4, (8,))} for _ in range(3)]
+    a, b = _build("NCHW"), _build("NHWC")
+    for batch in batches:
+        la = float(a.train_batch(batch)["loss"])
+        lb = float(b.train_batch(batch)["loss"])
+        np.testing.assert_allclose(la, lb, rtol=2e-5)
+    for op in a.ops:
+        if not op.weight_specs():
+            continue
+        wa = a.get_weights(op.name)
+        wb = b.get_weights(op.name)
+        for k in wa:
+            np.testing.assert_allclose(wa[k], wb[k], rtol=2e-4,
+                                       atol=2e-5)

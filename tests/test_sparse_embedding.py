@@ -112,3 +112,70 @@ def test_sparse_with_multi_step_dispatch():
     seq_losses = [float(seq.train_batch(b)["loss"]) for b in batches]
     got = jax.device_get(grouped.train_batches(batches)["loss"])
     np.testing.assert_allclose(seq_losses, got, rtol=1e-6)
+
+
+def _build_small_vocab(sparse, lazy, optimizer, distributed=False):
+    """vocab=8 model where every batch TOUCHES EVERY ROW (with
+    duplicates): lazy sparse semantics then coincide with dense exactly
+    (no stale rows), so lazy-vs-dense equality is a full-rule check of
+    the coalesced stateful row updates. distributed=True routes through
+    the vmap-over-slots branch (stacked tables, per-table coalescing)."""
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.sparse_embedding_updates = sparse
+    cfg.sparse_embedding_lazy = lazy
+    ff = FFModel(cfg)
+    if distributed:
+        ids = [ff.create_tensor((16, 2), dtype=np.int32,
+                                name=f"sparse_{i}") for i in range(2)]
+        embs = ff.distributed_embedding(ids, num_entries=8, out_dim=8)
+        t = ff.concat(embs, axis=1)
+    else:
+        idx = ff.create_tensor((16, 2), dtype=np.int32, name="input")
+        t = ff.embedding(idx, num_entries=8, out_dim=8, aggr="sum")
+    t = ff.dense(t, 4)
+    ff.compile(optimizer=optimizer,
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    return ff
+
+
+def _all_rows_batches(n=4, distributed=False):
+    rng = np.random.RandomState(7)
+    out = []
+    for _ in range(n):
+        b = {"label": rng.randint(0, 4, (16,)).astype(np.int32)}
+        keys = ["sparse_0", "sparse_1"] if distributed else ["input"]
+        for k in keys:
+            # 32 slots over vocab 8: every row appears, dupes guaranteed
+            idx = np.concatenate([np.arange(8), rng.randint(0, 8, 24)])
+            rng.shuffle(idx)
+            b[k] = idx.reshape(16, 2).astype(np.int32)
+        out.append(b)
+    return out
+
+
+@pytest.mark.parametrize("distributed", [False, True])
+@pytest.mark.parametrize("opt", [
+    lambda: AdamOptimizer(lr=0.01),
+    lambda: SGDOptimizer(lr=0.05, momentum=0.9),
+    lambda: SGDOptimizer(lr=0.05, momentum=0.9, nesterov=True),
+])
+def test_lazy_sparse_matches_dense_when_all_rows_touched(opt, distributed):
+    batches = _all_rows_batches(distributed=distributed)
+    ff_lazy = _build_small_vocab(True, True, opt(), distributed)
+    ff_dense = _build_small_vocab(False, False, opt(), distributed)
+    emb = next(o.name for o in ff_lazy.ops
+               if "embedding" in o.op_type)
+    assert emb in ff_lazy.executor._sparse_table_ops()
+    for b in batches:
+        ll = float(ff_lazy.train_batch(b)["loss"])
+        ld = float(ff_dense.train_batch(b)["loss"])
+        np.testing.assert_allclose(ll, ld, rtol=1e-5)
+    np.testing.assert_allclose(
+        ff_lazy.get_weights(emb)["kernel"],
+        ff_dense.get_weights(emb)["kernel"], rtol=1e-4, atol=1e-6)
+
+
+def test_lazy_requires_opt_in():
+    ff = _build_small_vocab(True, False, AdamOptimizer(lr=0.01))
+    assert not ff.executor._sparse_table_ops()
