@@ -22,15 +22,31 @@ LOSS_BCE = "binary_crossentropy"
 LOSS_IDENTITY = "identity"
 
 
+def flatten_sparse_labels(preds, labels):
+    """Normalize sparse int labels against predictions: (batch,) /
+    (batch, 1) labels pass through; PER-POSITION labels (batch, t...)
+    matching preds (batch, t..., vocab) — the seq2seq teacher-forcing
+    case (reference nmt/ trains per-timestep softmaxes,
+    softmax_data_parallel.cu) — flatten BOTH so each position scores as
+    one sample. Single source of truth for loss AND metrics: they must
+    agree on which positions they score."""
+    labels = labels.astype(jnp.int32)
+    if (labels.ndim >= 2 and labels.ndim == preds.ndim - 1
+            and labels.shape == preds.shape[:-1]):
+        return preds.reshape(-1, preds.shape[-1]), labels.reshape(-1)
+    return preds, labels.reshape(labels.shape[0])
+
+
 def sparse_categorical_crossentropy(logits_or_probs, labels,
                                     from_logits: bool = False):
-    """labels: int (batch,) or (batch, 1). The reference applies this to
-    *softmax outputs* (the graph ends in Softmax, loss takes probs)."""
-    labels = labels.reshape(labels.shape[0]).astype(jnp.int32)
+    """labels: int (batch,) / (batch, 1) or per-position (see
+    flatten_sparse_labels). The reference applies this to *softmax
+    outputs* (the graph ends in Softmax, loss takes probs)."""
+    preds, labels = flatten_sparse_labels(logits_or_probs, labels)
     if from_logits:
-        logp = jax.nn.log_softmax(logits_or_probs, axis=-1)
+        logp = jax.nn.log_softmax(preds, axis=-1)
     else:
-        logp = jnp.log(jnp.clip(logits_or_probs, 1e-12, 1.0))
+        logp = jnp.log(jnp.clip(preds, 1e-12, 1.0))
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)
     return jnp.mean(nll)
 

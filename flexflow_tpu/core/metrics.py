@@ -54,12 +54,15 @@ def compute_metrics(metric_names: Sequence[str], preds: jax.Array,
     """Pure-JAX metric computation; returns scalar sums/counts so results
     are exact under any sharding (mean taken on host)."""
     out: Dict[str, jax.Array] = {}
-    n = preds.shape[0]
-    out["count"] = jnp.asarray(n, jnp.int32)
     if sparse:
-        lbl = labels.reshape(labels.shape[0]).astype(jnp.int32)
+        # same normalization as the loss (per-position seq2seq labels
+        # flatten) so accuracy and CCE score identical positions
+        from .losses import flatten_sparse_labels
+        preds, lbl = flatten_sparse_labels(preds, labels)
     else:
         lbl = None
+    n = preds.shape[0]
+    out["count"] = jnp.asarray(n, jnp.int32)
     for m in metric_names:
         if m == METRICS_ACCURACY:
             pred_cls = jnp.argmax(preds, axis=-1).astype(jnp.int32)

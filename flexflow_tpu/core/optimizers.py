@@ -68,9 +68,6 @@ class Optimizer:
         FFConfig.sparse_embedding_lazy opts in."""
         return None
 
-    def supports_sparse(self) -> bool:
-        return self.sparse_mode() == "exact"
-
     def sparse_update(self, w, idx, g, slots, step):
         """Scatter-apply the update for the touched rows only: `w` is the
         full (vocab, dim) table, `idx` (n,) row ids (duplicates allowed),

@@ -8,7 +8,7 @@ from .inception import build_inception_v3
 from .dlrm import build_dlrm
 from .moe import build_moe_fused, build_moe_reference
 from .candle_uno import build_candle_uno
-from .nmt_lstm import build_nmt_lstm
+from .nmt_lstm import build_nmt_lstm, build_nmt_seq2seq
 
 __all__ = [
     "build_alexnet",
@@ -20,4 +20,5 @@ __all__ = [
     "build_moe_fused",
     "build_candle_uno",
     "build_nmt_lstm",
+    "build_nmt_seq2seq",
 ]

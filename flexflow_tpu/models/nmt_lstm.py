@@ -46,3 +46,48 @@ def build_nmt_lstm(config: Optional[FFConfig] = None,
     logits = ff.dense(last, vocab_size, name="proj")
     out = ff.softmax(logits, name="softmax")
     return ff
+
+
+def build_nmt_seq2seq(config: Optional[FFConfig] = None,
+                      batch_size: int = None, src_len: int = 20,
+                      tgt_len: int = 20, vocab_size: int = 16000,
+                      embed_dim: int = 512, hidden: int = 512,
+                      num_layers: int = 2, attn_heads: int = 1,
+                      mesh=None, strategy=None, dtype=None) -> FFModel:
+    """Encoder-decoder NMT with attention, teacher-forced: the full
+    shape of the reference's nmt/ framework (nmt/rnn.h:91-160 —
+    encoder/decoder LSTM stacks over SharedVariable weights; its
+    per-timestep softmax_data_parallel.cu becomes one per-position
+    softmax + sequence sparse-CCE here). Inputs "src" (bs, src_len) and
+    "tgt" (bs, tgt_len) int tokens; output (bs, tgt_len, vocab)
+    probabilities — train with label = next-token ids (bs, tgt_len).
+
+    Decoder->encoder attention runs through the framework's
+    multihead_attention (flash/Pallas path), generalizing the
+    reference's fixed alignment-free decoder."""
+    cfg = config or FFConfig()
+    bs = batch_size or cfg.batch_size
+    ff = FFModel(cfg, mesh=mesh, strategy=strategy)
+    src = ff.create_tensor((bs, src_len), dtype=jnp.int32, name="src")
+    tgt = ff.create_tensor((bs, tgt_len), dtype=jnp.int32, name="tgt")
+
+    enc = ff.embedding(src, vocab_size, embed_dim, aggr="none",
+                       name="src_embed", dtype=dtype)
+    for i in range(num_layers):
+        enc = ff.lstm(enc, hidden, return_sequences=True,
+                      name=f"enc_lstm_{i}")
+
+    dec = ff.embedding(tgt, vocab_size, embed_dim, aggr="none",
+                       name="tgt_embed", dtype=dtype)
+    for i in range(num_layers):
+        dec = ff.lstm(dec, hidden, return_sequences=True,
+                      name=f"dec_lstm_{i}")
+
+    # Luong-style attention over encoder states + combine
+    ctx = ff.multihead_attention(dec, enc, enc, embed_dim=hidden,
+                                 num_heads=attn_heads, name="cross_attn")
+    t = ff.concat([dec, ctx], axis=2, name="attn_concat")
+    t = ff.dense(t, hidden, activation="tanh", name="attn_combine")
+    logits = ff.dense(t, vocab_size, name="proj")
+    ff.softmax(logits, axis=-1, name="softmax")
+    return ff
