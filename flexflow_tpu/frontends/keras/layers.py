@@ -174,6 +174,34 @@ class Flatten(Layer):
         return ff.flat(ins[0], name=self.name)
 
 
+class LayerNormalization(Layer):
+    """Normalizes over the last axis (keras default axis=-1) ->
+    FFModel.layer_norm. Fail-loudly policy (like the module's _same_pad/
+    _act): unsupported keras configurations raise instead of silently
+    normalizing the wrong thing."""
+
+    def __init__(self, axis=-1, epsilon=1e-3, center=True, scale=True,
+                 name=None, **kw):
+        super().__init__(name, kw.get("input_shape"))
+        self.axis = axis
+        self.epsilon = epsilon
+        if center != scale:
+            raise NotImplementedError(
+                "LayerNormalization with center != scale would train a "
+                "parameter keras would not create; use both or neither")
+        self.affine = bool(center and scale)
+
+    def emit(self, ff, ins):
+        rank = len(ins[0].shape)
+        if self.axis not in (-1, rank - 1):
+            raise NotImplementedError(
+                f"LayerNormalization axis={self.axis}: only last-dim "
+                f"normalization is supported")
+        return ff.layer_norm(ins[0], eps=self.epsilon,
+                             elementwise_affine=self.affine,
+                             name=self.name)
+
+
 class Reshape(Layer):
     """Batch-preserving reshape (reference keras frontend Reshape →
     FFModel::reshape; target_shape excludes the batch dim)."""

@@ -158,6 +158,25 @@ class ONNXModel:
                     pending_states[name] = {
                         "running_mean": self.inits[ins[3]],
                         "running_var": self.inits[ins[4]]}
+            elif node.op_type == "LayerNormalization":
+                # opset-17 node: axis must be the last dim (the only
+                # form the framework op supports)
+                axis = a.get("axis", -1)
+                rank = len(values[ins[0]].shape)
+                if axis not in (-1, rank - 1):
+                    raise NotImplementedError(
+                        f"LayerNormalization axis={axis}; only last-dim "
+                        f"normalization is supported")
+                # Scale is a REQUIRED opset-17 input; like Conv/Gemm/BN
+                # above, a non-initializer Scale fails loudly rather
+                # than silently dropping the affine transform
+                scale = self.inits[ins[1]]
+                t = ffmodel.layer_norm(
+                    values[ins[0]], eps=a.get("epsilon", 1e-5),
+                    elementwise_affine=True, name=name)
+                bias = (self.inits[ins[2]] if len(ins) > 2
+                        else np.zeros_like(scale))
+                pending_weights[name] = {"scale": scale, "bias": bias}
             elif node.op_type == "Concat":
                 t = ffmodel.concat([values[i] for i in ins],
                                    axis=a.get("axis", 1), name=name)

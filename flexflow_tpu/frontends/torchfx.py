@@ -105,6 +105,14 @@ def _module_desc(name, m, ins, target) -> _OpDesc:
     if isinstance(m, nn.Embedding):
         return _OpDesc(name, "embedding", ins, target=target,
                        vocab=m.num_embeddings, dim=m.embedding_dim)
+    if isinstance(m, nn.LayerNorm):
+        if len(m.normalized_shape) != 1:
+            raise NotImplementedError(
+                f"nn.LayerNorm over {m.normalized_shape}: only last-dim "
+                f"LayerNorm is supported")
+        return _OpDesc(name, "layer_norm", ins, target=target,
+                       eps=m.eps,
+                       affine=int(m.elementwise_affine))
     raise NotImplementedError(f"unsupported torch module {type(m)}")
 
 
@@ -208,6 +216,11 @@ class PyTorchModel:
             elif d.op_type == "batch_norm":
                 values[d.name] = ffmodel.batch_norm(
                     values[d.inputs[0]], relu=False, name=d.name)
+            elif d.op_type == "layer_norm":
+                values[d.name] = ffmodel.layer_norm(
+                    values[d.inputs[0]], eps=float(a.get("eps", 1e-5)),
+                    elementwise_affine=bool(int(a.get("affine", 1))),
+                    name=d.name)
             elif d.op_type == "pool2d":
                 k, s, p = int(a["k"]), int(a["s"]), int(a["p"])
                 values[d.name] = ffmodel.pool2d(
@@ -278,6 +291,10 @@ class PyTorchModel:
                 w["bias"] = m.bias.detach().numpy()
             elif isinstance(m, nn.Embedding):
                 w["kernel"] = m.weight.detach().numpy()
+            elif isinstance(m, nn.LayerNorm):
+                if m.elementwise_affine:
+                    w["scale"] = m.weight.detach().numpy()
+                    w["bias"] = m.bias.detach().numpy()
             if w:
                 ffmodel.set_weights(d.name, w)
 

@@ -123,7 +123,8 @@ class FFModel:
                               num_entries: int, out_dim: int,
                               aggr: str = "sum",
                               name: Optional[str] = None,
-                              kernel_initializer="glorot") -> List[Tensor]:
+                              kernel_initializer="glorot",
+                              dtype=None) -> List[Tensor]:
         """E same-vocab embedding bags as one table-axis-shardable stacked
         weight — the executable form of the reference's per-device table
         placement (DLRM strategies, dlrm_strategy.cc:1-50). Returns one
@@ -131,7 +132,7 @@ class FFModel:
         from .ops import DistributedEmbedding
         op = DistributedEmbedding(
             self, name or self._fresh_name("dist_embedding"), list(inputs),
-            num_entries, out_dim, aggr, kernel_initializer)
+            num_entries, out_dim, aggr, kernel_initializer, dtype)
         self.add_op(op)
         return list(op.outputs)
 
@@ -148,6 +149,14 @@ class FFModel:
                    name: Optional[str] = None) -> Tensor:
         op = BatchNorm(self, name or self._fresh_name("batch_norm"),
                        [input], relu)
+        return self.add_op(op).output
+
+    def layer_norm(self, input: Tensor, eps: float = 1e-5,
+                   elementwise_affine: bool = True,
+                   name: Optional[str] = None) -> Tensor:
+        from .ops import LayerNorm
+        op = LayerNorm(self, name or self._fresh_name("layer_norm"),
+                       [input], eps, elementwise_affine)
         return self.add_op(op).output
 
     def batch_matmul(self, a: Tensor, b: Tensor,

@@ -25,7 +25,7 @@ def build_dlrm(config: Optional[FFConfig] = None, batch_size: int = None,
                bot_mlp: Sequence[int] = (512, 256, 64),
                top_mlp: Sequence[int] = (512, 256, 1),
                mesh=None, strategy=None,
-               stacked_tables: bool = False) -> FFModel:
+               stacked_tables: bool = False, dtype=None) -> FFModel:
     """stacked_tables=True uses one DistributedEmbedding over all sparse
     features (requires equal vocab sizes): the executable analog of the
     reference's per-GPU table placement — map its `table` axis to a mesh
@@ -35,7 +35,8 @@ def build_dlrm(config: Optional[FFConfig] = None, batch_size: int = None,
     bs = batch_size or cfg.batch_size
     ff = FFModel(cfg, mesh=mesh, strategy=strategy)
 
-    dense_in = ff.create_tensor((bs, dense_dim), name="dense_features")
+    dense_in = ff.create_tensor((bs, dense_dim), name="dense_features",
+                                dtype=dtype or jnp.float32)
     sparse_ins = [
         ff.create_tensor((bs, embedding_bag_size), dtype=jnp.int32,
                          name=f"sparse_{i}")
@@ -59,11 +60,11 @@ def build_dlrm(config: Optional[FFConfig] = None, batch_size: int = None,
             f"{sorted(vocabs)}")
         embs = ff.distributed_embedding(
             sparse_ins, embedding_vocab_sizes[0], embedding_dim,
-            aggr="sum", name="emb_tables")
+            aggr="sum", name="emb_tables", dtype=dtype)
     else:
         embs = [
             ff.embedding(s, vocab, embedding_dim, aggr="sum",
-                         name=f"emb_{i}")
+                         name=f"emb_{i}", dtype=dtype)
             for i, (s, vocab) in enumerate(zip(sparse_ins,
                                                embedding_vocab_sizes))
         ]

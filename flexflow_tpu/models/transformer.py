@@ -22,17 +22,23 @@ def build_transformer(config: Optional[FFConfig] = None,
                       num_layers: int = 6, ff_dim: int = 2048,
                       num_classes: int = 10, dtype=jnp.float32,
                       mesh=None, strategy=None,
-                      use_flash=None) -> FFModel:
+                      use_flash=None, layer_norm: bool = False) -> FFModel:
+    """layer_norm=True builds pre-LN blocks (modern practice; the
+    reference Transformer example has no normalization at all,
+    transformer.cc:28-56, so the default keeps its exact topology)."""
     cfg = config or FFConfig()
     bs = batch_size or cfg.batch_size
     ff = FFModel(cfg, mesh=mesh, strategy=strategy)
     t = ff.create_tensor((bs, seq_len, hidden), dtype=dtype, name="input")
     for i in range(num_layers):
-        a = ff.multihead_attention(t, t, t, hidden, num_heads,
+        a_in = ff.layer_norm(t, name=f"layer{i}_ln1") if layer_norm else t
+        a = ff.multihead_attention(a_in, a_in, a_in, hidden, num_heads,
                                    use_flash=use_flash,
                                    name=f"layer{i}_attn")
         t = ff.add(a, t, name=f"layer{i}_res1")
-        h = ff.dense(t, ff_dim, activation="relu", name=f"layer{i}_ff1")
+        f_in = ff.layer_norm(t, name=f"layer{i}_ln2") if layer_norm else t
+        h = ff.dense(f_in, ff_dim, activation="relu",
+                     name=f"layer{i}_ff1")
         h = ff.dense(h, hidden, name=f"layer{i}_ff2")
         t = ff.add(h, t, name=f"layer{i}_res2")
     # classification head over the first position (avoids a giant

@@ -7,7 +7,7 @@ kernels under flexflow_tpu/kernels/.
 
 from .linear import Linear
 from .conv import Conv2D, Pool2D, BatchNorm, Flat
-from .elementwise import ElementUnary, ElementBinary, Dropout, Softmax
+from .elementwise import ElementUnary, ElementBinary, Dropout, LayerNorm, Softmax
 from .tensor_ops import (
     Concat,
     Split,
@@ -34,6 +34,7 @@ __all__ = [
     "ElementBinary",
     "Dropout",
     "Softmax",
+    "LayerNorm",
     "Concat",
     "Split",
     "Reshape",

@@ -13,7 +13,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-from ..op import SAMPLE, CHANNEL, SEQ, Op, OpContext, register_op
+from ..op import SAMPLE, CHANNEL, SEQ, Op, OpContext, WeightSpec, register_op
 
 
 def _passthrough_axes(shape):
@@ -31,7 +31,14 @@ def _passthrough_axes(shape):
 
 
 class PassthroughAxesMixin:
-    """Shared logical-axis labeling for rank-preserving ops."""
+    """Shared logical-axis labeling for rank-preserving ops: outputs
+    carry the same SAMPLE/SEQ/CHANNEL labels as the input."""
+
+    def output_axes(self):
+        return _passthrough_axes(self.outputs[0].shape)
+
+    def input_axes(self):
+        return [_passthrough_axes(t.shape)[0] for t in self.inputs]
 
 
 
@@ -154,12 +161,55 @@ class Softmax(PassthroughAxesMixin, Op):
         (x,) = xs
         return [jax.nn.softmax(x, axis=self.axis)]
 
-
-    def output_axes(self):
-        return _passthrough_axes(self.outputs[0].shape)
-
-    def input_axes(self):
-        return [_passthrough_axes(t.shape)[0] for t in self.inputs]
-
     def flops(self) -> float:
         return 5.0 * self.inputs[0].num_elements
+
+
+@register_op
+class LayerNorm(PassthroughAxesMixin, Op):
+    """Normalize over the LAST dim with learned scale/bias.
+
+    No reference analog — FlexFlow ships only BatchNorm
+    (src/ops/batch_norm.cu); this is a TPU-first addition because
+    modern transformer blocks (pre-LN) depend on it. Statistics in f32
+    regardless of activation dtype (mirrors BatchNorm here).
+    """
+
+    op_type = "layer_norm"
+
+    def __init__(self, model, name, inputs, eps: float = 1e-5,
+                 elementwise_affine: bool = True):
+        super().__init__(model, name, inputs)
+        self.eps = float(eps)
+        self.elementwise_affine = elementwise_affine
+        self.num_channels = inputs[0].shape[-1]
+        self.attrs = {"eps": eps,
+                      "elementwise_affine": elementwise_affine}
+
+    def output_shapes(self):
+        return [tuple(self.inputs[0].shape)]
+
+    def weight_specs(self):
+        if not self.elementwise_affine:
+            return {}
+        c = self.num_channels
+        return {
+            "scale": WeightSpec((c,), initializer="ones",
+                                axes=(CHANNEL,)),
+            "bias": WeightSpec((c,), initializer="zeros",
+                               axes=(CHANNEL,)),
+        }
+
+    def forward(self, params, xs, ctx: OpContext):
+        (x,) = xs
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        if self.elementwise_affine:
+            y = y * params["scale"].astype(jnp.float32) \
+                + params["bias"].astype(jnp.float32)
+        return [y.astype(x.dtype)]
+
+    def flops(self) -> float:
+        return 8.0 * self.inputs[0].num_elements

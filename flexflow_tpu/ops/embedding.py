@@ -126,7 +126,7 @@ class DistributedEmbedding(Op):
 
     def __init__(self, model, name, inputs, num_entries: int, out_dim: int,
                  aggr: str = AGGR_MODE_SUM,
-                 kernel_initializer: str = "glorot"):
+                 kernel_initializer: str = "glorot", dtype=None):
         super().__init__(model, name, inputs)
         assert len(inputs) >= 1
         bag = inputs[0].shape
@@ -141,6 +141,8 @@ class DistributedEmbedding(Op):
         self.out_dim = int(out_dim)
         self.aggr = aggr
         self.kernel_initializer = kernel_initializer
+        self.out_dtype = jnp.dtype(dtype) if dtype is not None \
+            else jnp.dtype(jnp.float32)
         self.attrs = {"num_tables": self.num_tables,
                       "num_entries": num_entries, "out_dim": out_dim,
                       "aggr": aggr}
@@ -153,7 +155,7 @@ class DistributedEmbedding(Op):
         return [(bs, self.out_dim)] * self.num_tables
 
     def output_dtypes(self):
-        return [jnp.dtype(jnp.float32)] * self.num_tables
+        return [self.out_dtype] * self.num_tables
 
     def weight_specs(self):
         return {
@@ -179,7 +181,8 @@ class DistributedEmbedding(Op):
             emb = jnp.sum(emb, axis=-2)
         elif self.aggr == AGGR_MODE_AVG:
             emb = jnp.mean(emb, axis=-2)
-        return [emb[e] for e in range(self.num_tables)]
+        return [emb[e].astype(self.out_dtype)
+                for e in range(self.num_tables)]
 
     def output_axes(self):
         n = len(self.outputs[0].shape)  # 3-D when aggr == "none"

@@ -321,3 +321,28 @@ def test_keras_exp_real_tf_channels_last_conv_fails_loudly():
     tf_model = tfk.Model(inp, out)
     with pytest.raises(NotImplementedError, match="channels_last"):
         from_tf_keras(tf_model, batch_size=2)
+
+
+def test_onnx_layer_norm_handler():
+    scale = np.linspace(0.5, 1.5, 8).astype(np.float32)
+    bias = np.linspace(-1, 1, 8).astype(np.float32)
+    nodes = [
+        GraphNode("LayerNormalization", ["x", "w", "b"], ["ln"], "ln",
+                  {"epsilon": 1e-5, "axis": -1}),
+        GraphNode("Relu", ["ln"], ["r"], "relu"),
+    ]
+    om = ONNXModel.from_graph(nodes, {"w": scale, "b": bias})
+    cfg = FFConfig()
+    cfg.batch_size = 2
+    ff = FFModel(cfg)
+    x = ff.create_tensor((2, 8), name="x")
+    out = om.apply(ff, {"x": x})
+    ff.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
+    xv = np.random.RandomState(2).randn(2, 8).astype(np.float32)
+    values, _ = ff.executor.forward_values(
+        ff.state.params, ff.state.states, {"x": xv}, False, None)
+    got = np.asarray(values[out.uid])
+    mu = xv.mean(-1, keepdims=True)
+    var = xv.var(-1, keepdims=True)
+    want = np.maximum((xv - mu) / np.sqrt(var + 1e-5) * scale + bias, 0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

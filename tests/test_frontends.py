@@ -177,3 +177,37 @@ def test_keras_reshape_layer():
     y = rng.randint(0, 10, 64).astype(np.int32)
     hist = model.fit(x, y, batch_size=32, epochs=1)
     assert np.isfinite(hist[-1]["loss"])
+
+
+def test_torchfx_layer_norm_roundtrip():
+    import torch
+    import torch.nn as nn
+
+    from flexflow_tpu.frontends.torchfx import PyTorchModel
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, 32)
+            self.ln = nn.LayerNorm(32)
+            self.out = nn.Linear(32, 4)
+            self.sm = nn.Softmax(dim=-1)
+
+        def forward(self, x):
+            return self.sm(self.out(self.ln(self.fc(x))))
+
+    mod = M()
+    ptm = PyTorchModel(mod)
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    ff = FFModel(cfg)
+    inp = ff.create_tensor((8, 16), name="input")
+    ptm.apply(ff, [inp])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    ptm.import_weights(ff)
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    got = np.asarray(ff.forward({"input": x}))
+    want = mod(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

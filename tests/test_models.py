@@ -183,3 +183,25 @@ def test_nmt_seq2seq_learns():
     assert last < first * 0.5, (first, last)
     # per-position accuracy counts every (batch, position) slot
     assert int(m["count"]) == 8 * 5
+
+
+def test_transformer_pre_ln_learns():
+    from flexflow_tpu.models import build_transformer
+
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    ff = build_transformer(cfg, batch_size=16, seq_len=8, hidden=32,
+                           num_heads=4, num_layers=2, ff_dim=64,
+                           num_classes=4, layer_norm=True)
+    assert any(op.op_type == "layer_norm" for op in ff.ops)
+    ff.compile(optimizer=AdamOptimizer(lr=0.003),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 8, 32).astype(np.float32)
+    ys = (xs[:, 0, 0] > 0).astype(np.int32)
+    first = float(ff.train_batch({"input": xs[:16],
+                                  "label": ys[:16]})["loss"])
+    for _ in range(40):
+        m = ff.train_batch({"input": xs[:16], "label": ys[:16]})
+    assert float(m["loss"]) < first

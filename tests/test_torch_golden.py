@@ -179,3 +179,49 @@ def test_linear_grads_match_torch(rng):
                                rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(grads["bias"]), tb.grad.numpy(),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_layer_norm_matches_torch(rng):
+    ff = FFModel(FFConfig())
+    x = ff.create_tensor((4, 6, 16), name="input")
+    ff.layer_norm(x, name="ln")
+    op = ff.ops[0]
+    xs = rng.randn(4, 6, 16).astype(np.float32)
+    scale = rng.randn(16).astype(np.float32)
+    bias = rng.randn(16).astype(np.float32)
+    (y,) = op.forward({"scale": jnp.asarray(scale),
+                       "bias": jnp.asarray(bias)},
+                      [jnp.asarray(xs)], _ctx())
+    ref = F.layer_norm(torch.from_numpy(xs), (16,),
+                       torch.from_numpy(scale), torch.from_numpy(bias))
+    np.testing.assert_allclose(np.asarray(y), ref.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_layer_norm_grads_match_torch(rng):
+    ff = FFModel(FFConfig())
+    x = ff.create_tensor((4, 16), name="input")
+    ff.layer_norm(x, name="ln")
+    op = ff.ops[0]
+    xs = rng.randn(4, 16).astype(np.float32)
+    scale = rng.randn(16).astype(np.float32)
+    bias = rng.randn(16).astype(np.float32)
+
+    def loss_fn(params, xv):
+        (y,) = op.forward(params, [xv], _ctx())
+        return jnp.sum(y * y)
+
+    params = {"scale": jnp.asarray(scale), "bias": jnp.asarray(bias)}
+    gp, gx = jax.grad(loss_fn, argnums=(0, 1))(params, jnp.asarray(xs))
+
+    xt = torch.from_numpy(xs).requires_grad_(True)
+    st = torch.from_numpy(scale).requires_grad_(True)
+    bt = torch.from_numpy(bias).requires_grad_(True)
+    out = F.layer_norm(xt, (16,), st, bt)
+    (out * out).sum().backward()
+    np.testing.assert_allclose(np.asarray(gx), xt.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gp["scale"]), st.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gp["bias"]), bt.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
