@@ -20,10 +20,12 @@ import numpy as np
 from ..parallel.sharding import batch_sharding
 
 
-def host_to_device(host, mesh) -> jax.Array:
+def host_to_device(host, mesh, dtype=None) -> jax.Array:
     """Host batch -> device array sharded over the mesh's data axis.
-    The single place batches land on devices (native and Python paths)."""
-    arr = jnp.asarray(host)
+    The single place batches land on devices (native and Python paths).
+    `dtype` casts IN the transfer (one materialization — a post-hoc
+    astype would move the wide dtype and buffer it twice)."""
+    arr = jnp.asarray(host, dtype=dtype)
     if mesh is not None:
         arr = jax.device_put(arr, batch_sharding(mesh, arr.ndim))
     return arr
@@ -86,12 +88,16 @@ class DataLoaderSet:
 
     def __init__(self, arrays: Dict[str, np.ndarray], batch_size: int,
                  mesh=None, shuffle: bool = True, seed: int = 0,
-                 use_native: Optional[bool] = None):
+                 use_native: Optional[bool] = None,
+                 dtypes: Optional[Dict] = None):
         n = {len(v) for v in arrays.values()}
         assert len(n) == 1, "all arrays must have equal sample counts"
         # one shared shuffled order: shuffle once here, not per-loader
         self._order_rng = np.random.RandomState(seed)
         self.mesh = mesh
+        # target device dtype per key (e.g. a bf16 model's declared input
+        # dtypes): cast happens IN the host->device transfer, once
+        self.dtypes = dict(dtypes or {})
         self.loaders = {
             k: SingleDataLoader(k, v, batch_size, mesh=mesh, shuffle=False)
             for k, v in arrays.items()
@@ -120,15 +126,35 @@ class DataLoaderSet:
             self._order_rng.shuffle(order)
         return order
 
-    def reset(self) -> None:
-        order = self._epoch_order()
+    def _set_order(self, order: np.ndarray) -> None:
         for l in self.loaders.values():
             l._order = order
             l._pos = 0
 
-    def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
+    def reset(self) -> None:
+        self._set_order(self._epoch_order())
+
+    def close(self) -> None:
+        """Release the native worker thread + double buffers (no-op on
+        the Python path). Safe to call more than once."""
         if self._native is not None:
-            self._native.start_epoch(self._epoch_order())
+            self._native.close()
+            self._native = None
+
+    def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
+        return self.iter_with_order(self._epoch_order())
+
+    def iter_with_order(self, order: np.ndarray
+                        ) -> Iterator[Dict[str, jax.Array]]:
+        """Iterate one epoch in an EXPLICIT sample order — lets a caller
+        that owns the shuffle stream (fit()'s checkpoint-replayable
+        permutations) still ride the native double-buffered prefetch."""
+        order = np.asarray(order)
+        n = next(iter(self.loaders.values())).num_samples
+        assert len(order) == n, (  # native path asserts the same
+            f"order has {len(order)} entries for {n} samples")
+        if self._native is not None:
+            self._native.start_epoch(order)
             while True:
                 batch = self._native.next_batch()
                 if batch is None:
@@ -136,12 +162,17 @@ class DataLoaderSet:
                 # explicit copy: jax may alias aligned host memory, and
                 # the worker reuses the double buffer after the next
                 # next_batch call
-                yield {k: host_to_device(np.array(v, copy=True), self.mesh)
+                yield {k: host_to_device(np.array(v, copy=True), self.mesh,
+                                         self.dtypes.get(k))
                        for k, v in batch.items()}
         else:
-            self.reset()
-            for _ in range(self.num_batches):
-                yield {k: l.next_batch() for k, l in self.loaders.items()}
+            self._set_order(order)
+            bs = self.batch_size
+            for i in range(self.num_batches):
+                sel = order[i * bs:(i + 1) * bs]
+                yield {k: host_to_device(l.data[sel], self.mesh,
+                                         self.dtypes.get(k))
+                       for k, l in self.loaders.items()}
 
 
 def synthetic_inputs(model, n_samples: int, seed: int = 0,

@@ -519,7 +519,8 @@ class FFModel:
             shuffle: bool = True, verbose: bool = True,
             checkpoint_dir: Optional[str] = None,
             checkpoint_every: int = 1,
-            steps_per_dispatch: int = 1):
+            steps_per_dispatch: int = 1,
+            prefetch: bool = False):
         """Keras-style fit over host numpy arrays (reference:
         base_model.py:195-255 + _train loop :347-424).
 
@@ -537,6 +538,7 @@ class FFModel:
         # when a wrapper drives one epoch at a time (keras frontend);
         # _fit_epochs_drawn counts permutations already consumed so a
         # checkpoint resume replays exactly the missing prefix
+        fit_loader = None  # local: bound to this call's x/y arrays
         if not hasattr(self, "_fit_rng"):
             self._fit_rng = np.random.RandomState(self.config.seed)
             self._fit_epochs_drawn = 0
@@ -578,11 +580,30 @@ class FFModel:
                 t0 = time.time()
                 spd = max(1, steps_per_dispatch)
 
-                def mk_batch(s):
-                    sel = idx[s * bs:(s + 1) * bs]
-                    batch = {k: x[k][sel] for k in names}
-                    batch["label"] = y[sel]
-                    return batch
+                if prefetch:
+                    # host row-gather on the native loader's background
+                    # thread (double-buffered, csrc/dataloader.cc) — the
+                    # prefetch analog of the reference's next_batch index
+                    # launches — driven by fit's OWN permutation so the
+                    # checkpoint-resume shuffle replay is unchanged
+                    if fit_loader is None:
+                        from .core.dataloader import DataLoaderSet
+                        declared = {t.name: t.dtype
+                                    for t in self.input_tensors}
+                        fit_loader = DataLoaderSet(
+                            {**{k: x[k] for k in names}, "label": y},
+                            bs, mesh=self.mesh, shuffle=False,
+                            dtypes=declared)
+                    it = fit_loader.iter_with_order(idx)
+
+                    def mk_batch(s):
+                        return next(it)
+                else:
+                    def mk_batch(s):
+                        sel = idx[s * bs:(s + 1) * bs]
+                        batch = {k: x[k][sel] for k in names}
+                        batch["label"] = y[sel]
+                        return batch
 
                 # full groups go through the scanned multi-step (one
                 # dispatch per group, trace-replay analog); the ragged
@@ -628,6 +649,8 @@ class FFModel:
             if ckptr is not None:  # commit in-flight saves even on
                 ckptr.wait_until_finished()  # Ctrl-C / mid-epoch errors
                 ckptr.close()
+            if fit_loader is not None:  # release the native prefetch
+                fit_loader.close()      # thread + double buffers
         return history
 
     def evaluate(self, x: Dict[str, np.ndarray], y: np.ndarray,

@@ -189,3 +189,24 @@ def test_fit_steps_per_dispatch():
                 verbose=False)
     assert len(h1) == 2
     assert h1[-1]["loss"] < h1[0]["loss"]
+
+
+def test_fit_prefetch_matches_direct():
+    """fit(prefetch=True) rides the (native, if available) double-
+    buffered loader but must reproduce the direct path's losses exactly
+    — same permutation stream, same batches, same updates."""
+    x, y = synthetic_classification()
+
+    def run(prefetch):
+        ff = make_mlp()
+        ff.compile(optimizer=SGDOptimizer(lr=0.1),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=["accuracy"])
+        return ff.fit({"input": x}, y, epochs=3, verbose=False,
+                      steps_per_dispatch=2, prefetch=prefetch)
+
+    ha, hb = run(False), run(True)
+    for ma, mb in zip(ha, hb):
+        np.testing.assert_allclose(ma["loss"], mb["loss"], rtol=1e-6)
+        np.testing.assert_allclose(ma.get("accuracy", 0),
+                                   mb.get("accuracy", 0), rtol=1e-6)
