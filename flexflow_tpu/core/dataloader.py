@@ -37,13 +37,14 @@ class SingleDataLoader:
 
     def __init__(self, name: str, data: np.ndarray, batch_size: int,
                  mesh=None, shuffle: bool = False, seed: int = 0,
-                 drop_last: bool = True):
+                 drop_last: bool = True, dtype=None):
         self.name = name
         self.data = np.asarray(data)
         self.batch_size = int(batch_size)
         self.mesh = mesh
         self.shuffle = shuffle
         self.drop_last = drop_last
+        self.dtype = dtype  # target device dtype; cast in the transfer
         self._rng = np.random.RandomState(seed)
         self._order = np.arange(len(self.data))
         self._pos = 0
@@ -73,7 +74,7 @@ class SingleDataLoader:
                 raise StopIteration
         sel = self._order[self._pos:self._pos + self.batch_size]
         self._pos += self.batch_size
-        return host_to_device(self.data[sel], self.mesh)
+        return host_to_device(self.data[sel], self.mesh, self.dtype)
 
 
 class DataLoaderSet:
@@ -99,7 +100,8 @@ class DataLoaderSet:
         # dtypes): cast happens IN the host->device transfer, once
         self.dtypes = dict(dtypes or {})
         self.loaders = {
-            k: SingleDataLoader(k, v, batch_size, mesh=mesh, shuffle=False)
+            k: SingleDataLoader(k, v, batch_size, mesh=mesh, shuffle=False,
+                                dtype=self.dtypes.get(k))
             for k, v in arrays.items()
         }
         self.shuffle = shuffle
@@ -166,13 +168,11 @@ class DataLoaderSet:
                                          self.dtypes.get(k))
                        for k, v in batch.items()}
         else:
+            # go through next_batch so each loader's cursor (_pos) stays
+            # truthful for anyone also reading self.loaders directly
             self._set_order(order)
-            bs = self.batch_size
-            for i in range(self.num_batches):
-                sel = order[i * bs:(i + 1) * bs]
-                yield {k: host_to_device(l.data[sel], self.mesh,
-                                         self.dtypes.get(k))
-                       for k, l in self.loaders.items()}
+            for _ in range(self.num_batches):
+                yield {k: l.next_batch() for k, l in self.loaders.items()}
 
 
 def synthetic_inputs(model, n_samples: int, seed: int = 0,

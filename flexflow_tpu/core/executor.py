@@ -359,13 +359,20 @@ class Executor:
         return self._eval_step
 
     # ---------------- data placement ----------------
+    @property
+    def declared_input_dtypes(self) -> Dict[str, Any]:
+        """Target device dtype per input name — THE dtype-resolution rule
+        for batches (shard_batch, shard_batch_stacked, and fit()'s
+        prefetch loader all share it so every path casts identically)."""
+        return {t.name: t.dtype for t in self.model.input_tensors}
+
     def shard_batch(self, batch: Dict[str, np.ndarray]):
         """Place a host batch on device(s), sharded over the data axis —
         the TPU analog of SingleDataLoader::next_batch's per-part copies
         (flexflow_dataloader.cc:649-740). Inputs are cast to their
         DECLARED tensor dtype (a bf16 model fed f32 numpy trains in bf16,
         like the reference loader honoring the region's type)."""
-        declared = {t.name: t.dtype for t in self.model.input_tensors}
+        declared = self.declared_input_dtypes
         out = {}
         for k, v in batch.items():
             want = declared.get(k)
@@ -389,7 +396,7 @@ class Executor:
         stacked device-side (never round-tripped through the host — a
         device->host pull per dispatch would dwarf the dispatch cost the
         multi-step path exists to amortize)."""
-        declared = {t.name: t.dtype for t in self.model.input_tensors}
+        declared = self.declared_input_dtypes
         keys = batches[0].keys()
         out = {}
         for k in keys:

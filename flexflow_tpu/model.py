@@ -588,12 +588,10 @@ class FFModel:
                     # checkpoint-resume shuffle replay is unchanged
                     if fit_loader is None:
                         from .core.dataloader import DataLoaderSet
-                        declared = {t.name: t.dtype
-                                    for t in self.input_tensors}
                         fit_loader = DataLoaderSet(
                             {**{k: x[k] for k in names}, "label": y},
                             bs, mesh=self.mesh, shuffle=False,
-                            dtypes=declared)
+                            dtypes=self.executor.declared_input_dtypes)
                     it = fit_loader.iter_with_order(idx)
 
                     def mk_batch(s):

@@ -118,3 +118,27 @@ def test_embedding_vocab_sharding(mesh_2d):
     ys = (xs.sum(axis=1) % 4).astype(np.int32)
     hist = ff.fit({"input": xs}, ys, epochs=2, verbose=False)
     assert np.isfinite(hist[-1]["loss"])
+
+
+def test_multi_step_dispatch_on_sharded_mesh(mesh8):
+    """train_batches (lax.scan over steps) composes with GSPMD: a DP
+    mesh run through the grouped dispatch must match the sequential
+    single-step stream on the same mesh."""
+    import jax
+
+    cfg = FFConfig()
+    cfg.batch_size = 64
+    x, y = data()
+    batches = [{"input": x[i * 64:(i + 1) * 64],
+                "label": y[i * 64:(i + 1) * 64]} for i in range(4)]
+
+    seq = build_mlp(cfg, mesh=mesh8)
+    want = [float(seq.train_batch(b)["loss"]) for b in batches]
+
+    grp = build_mlp(cfg, mesh=mesh8)
+    got = np.asarray(jax.device_get(grp.train_batches(batches)["loss"]),
+                     np.float64)
+    np.testing.assert_allclose(want, got, rtol=1e-5)
+    for k, v in seq.get_weights("dense").items():
+        np.testing.assert_allclose(
+            v, grp.get_weights("dense")[k], rtol=1e-4, atol=1e-6)
