@@ -241,7 +241,7 @@ def run_child(model: str, preset: str, steps: int) -> int:
         # compiles at two (single + scanned multi), same as every other
         # model — the multi-step warmup below still warms the device
         nbytes_basis = step_bytes(ff, batch_data)
-        log(f"single-step AOT compile + cost analysis in "
+        log(f"single-step cost probe ({nbytes_basis[1]}) in "
             f"{time.perf_counter() - t_c:.1f}s")
     else:
         m = ff.train_batch(batch_data)
@@ -293,7 +293,7 @@ def run_child(model: str, preset: str, steps: int) -> int:
         # switch is declared in the JSON (util_basis) and the byte count
         # is an approximate model (step_bytes docstring) — treat
         # vs_baseline for dlrm as roofline-relative, not MFU-relative.
-        nbytes, basis = nbytes_basis or step_bytes(ff, batch_data)
+        nbytes, basis = nbytes_basis
         hbm_util = nbytes / dt / detect_peak(PEAK_HBM_BW, 819e9)
         extra["hbm_util"] = round(hbm_util, 4)
         util = max(mfu, hbm_util)

@@ -168,11 +168,14 @@ class DataLoaderSet:
                                          self.dtypes.get(k))
                        for k, v in batch.items()}
         else:
-            # go through next_batch so each loader's cursor (_pos) stays
-            # truthful for anyone also reading self.loaders directly
-            self._set_order(order)
-            for _ in range(self.num_batches):
-                yield {k: l.next_batch() for k, l in self.loaders.items()}
+            # iterator-LOCAL slicing: the shared loaders' cursors are
+            # left untouched, so overlapping epoch iterators (or direct
+            # loader users) never see each other's position
+            bs = self.batch_size
+            for i in range(self.num_batches):
+                sel = order[i * bs:(i + 1) * bs]
+                yield {k: host_to_device(l.data[sel], self.mesh, l.dtype)
+                       for k, l in self.loaders.items()}
 
 
 def synthetic_inputs(model, n_samples: int, seed: int = 0,

@@ -73,6 +73,7 @@ class Executor:
         self.strategy = strategy or Strategy()
         self._train_step = None
         self._train_step_multi = None
+        self._train_step_accum = None
         self._eval_step = None
         self._sparse_ops_cache = None
         self._last_aux_losses = []
@@ -224,22 +225,23 @@ class Executor:
         return out
 
     # ---------------- step builders ----------------
-    def _step_body(self, state: TrainState, batch: Dict[str, jax.Array],
-                   rng) -> Tuple[TrainState, Dict[str, jax.Array]]:
-        """One optimizer step (pure; shared by the single-step and the
-        scanned multi-step compilations)."""
+    def _compute_grads(self, params, states, batch, rng):
+        """Gradients for one (micro)batch. For sparse tables the touched
+        rows are pre-gathered OUTSIDE the differentiated function
+        (forward consumes them via the "__rows__" override), so autodiff
+        returns row-gradients instead of a dense table.
+
+        -> (loss, logits, new_states, grads, sparse_idx) where `grads`
+        has {"__rows__": ...} entries for sparse ops."""
         from ..ops.embedding import DistributedEmbedding
         seq_length = self.config.iter_config.seq_length
         sparse_ops = self._sparse_table_ops()
-        diff_params = state.params
+        diff_params = params
         sparse_idx: Dict[str, jax.Array] = {}
         if sparse_ops:
-            # pre-gather the touched rows OUTSIDE the differentiated
-            # function; forward consumes them via the "__rows__" override
-            # and autodiff returns row-gradients instead of a dense table
-            diff_params = dict(state.params)
+            diff_params = dict(params)
             for name, op in sparse_ops.items():
-                table = state.params[name]["kernel"]
+                table = params[name]["kernel"]
                 if isinstance(op, DistributedEmbedding):
                     idx = jnp.stack([batch[t.name].astype(jnp.int32)
                                      for t in op.inputs])
@@ -253,7 +255,15 @@ class Executor:
         grad_fn = jax.value_and_grad(
             self._outputs_and_loss, argnums=0, has_aux=True)
         (loss, (logits, new_states)), grads = grad_fn(
-            diff_params, state.states, batch, True, rng, seq_length)
+            diff_params, states, batch, True, rng, seq_length)
+        return loss, logits, new_states, grads, sparse_idx
+
+    def _apply_update(self, state: TrainState, grads, sparse_idx,
+                      new_states) -> TrainState:
+        """Apply the optimizer to dense grads + scatter-apply sparse row
+        grads; returns the next TrainState (metrics are the caller's)."""
+        from ..ops.embedding import DistributedEmbedding
+        sparse_ops = self._sparse_table_ops()
         if sparse_ops:
             dense_params = {k: v for k, v in state.params.items()
                             if k not in sparse_ops}
@@ -294,13 +304,22 @@ class Executor:
         else:
             new_params, new_opt = self.optimizer.update(
                 state.params, grads, state.opt_state, state.step)
+        return TrainState(new_params, new_states, new_opt, state.step + 1)
+
+    def _step_body(self, state: TrainState, batch: Dict[str, jax.Array],
+                   rng) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        """One optimizer step (pure; shared by the single-step and the
+        scanned multi-step compilations)."""
+        loss, logits, new_states, grads, sparse_idx = self._compute_grads(
+            state.params, state.states, batch, rng)
+        new_state = self._apply_update(state, grads, sparse_idx,
+                                       new_states)
         metrics = {"loss": loss}
         if "label" in batch and self.metric_names:
             sparse = self.loss_name.startswith("sparse")
             metrics.update(M.compute_metrics(
                 self.metric_names, logits, batch["label"], sparse))
-        return TrainState(new_params, new_states, new_opt,
-                          state.step + 1), metrics
+        return new_state, metrics
 
     def build_train_step(self):
         jitted = jax.jit(self._step_body, donate_argnums=(0,))
@@ -323,6 +342,81 @@ class Executor:
             return jax.lax.scan(body, state, (batches, rngs))
 
         return jax.jit(train_multi, donate_argnums=(0,))
+
+    def build_train_step_accum(self):
+        """Gradient accumulation: scan K MICRObatches computing and
+        summing gradients, then apply ONE optimizer update with the mean
+        — the effective batch is K x microbatch without K x the
+        activation memory. No reference analog (FlexFlow scales batch by
+        adding GPUs, multi_gpu_tests.sh GPUS*64); on TPU this is the
+        standard single-chip route to large-batch parity. Sparse-table
+        row gradients are CONCATENATED across microbatches and applied
+        in one scatter, so the result is identical to a K x-sized batch
+        (duplicates across microbatches coalesce exactly like duplicates
+        within one). BN statistics advance per microbatch (each sees its
+        own microbatch moments, as torch/keras accumulation loops do)."""
+        sparse_ops = self._sparse_table_ops()
+
+        def train_accum(state: TrainState, batches, rngs):
+            k = jax.tree_util.tree_leaves(batches)[0].shape[0]
+            dense_zero = jax.tree_util.tree_map(
+                lambda w: jnp.zeros(w.shape, jnp.float32),
+                {n: p for n, p in state.params.items()
+                 if n not in sparse_ops})
+
+            def body(carry, xs):
+                states_c, gacc = carry
+                batch, rng = xs
+                loss, logits, new_states, grads, sidx = \
+                    self._compute_grads(state.params, states_c, batch,
+                                        rng)
+                dense_g = {n: grads[n] for n in gacc}
+                gacc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32),
+                    gacc, dense_g)
+                rows = {n: grads[n]["__rows__"] for n in sparse_ops}
+                metrics = {"loss": loss}
+                if "label" in batch and self.metric_names:
+                    sparse = self.loss_name.startswith("sparse")
+                    metrics.update(M.compute_metrics(
+                        self.metric_names, logits, batch["label"],
+                        sparse))
+                return (new_states, gacc), (rows, sidx, metrics)
+
+            (new_states, gsum), (rows_st, sidx_st, metrics) = \
+                jax.lax.scan(body, (state.states, dense_zero),
+                             (batches, rngs))
+            # mean over microbatches = the K x-batch loss gradient
+            gmean = jax.tree_util.tree_map(lambda g: g / k, gsum)
+            grads = dict(gmean)
+            sparse_idx = {}
+            for name, op in sparse_ops.items():
+                r = rows_st[name] / k          # (K, ...) row grads
+                i = sidx_st[name]              # (K, ...) indices
+                from ..ops.embedding import DistributedEmbedding
+                if isinstance(op, DistributedEmbedding):
+                    # (K, E, ...) -> (E, K*...): per-table concat
+                    r = jnp.moveaxis(r, 0, 1)
+                    i = jnp.moveaxis(i, 0, 1)
+                    ntab = r.shape[0]
+                    r = r.reshape(ntab, -1, r.shape[-1])
+                    i = i.reshape(ntab, -1)
+                else:
+                    r = r.reshape(-1, r.shape[-1])
+                    i = i.reshape(-1)
+                grads[name] = {"__rows__": r}
+                sparse_idx[name] = i
+            new_state = self._apply_update(state, grads, sparse_idx,
+                                           new_states)
+            # one optimizer step happened, whatever K was: fold the
+            # per-microbatch metrics like one K x batch (sums of
+            # sum-style metrics, mean loss)
+            metrics = {name: jnp.sum(v, axis=0)
+                       for name, v in metrics.items()}
+            metrics["loss"] = metrics["loss"] / k
+            return new_state, metrics
+
+        return jax.jit(train_accum, donate_argnums=(0,))
 
     def build_eval_step(self):
         cfg = self.config
@@ -351,6 +445,12 @@ class Executor:
         if self._train_step_multi is None:
             self._train_step_multi = self.build_train_step_multi()
         return self._train_step_multi
+
+    @property
+    def train_step_accum(self):
+        if self._train_step_accum is None:
+            self._train_step_accum = self.build_train_step_accum()
+        return self._train_step_accum
 
     @property
     def eval_step(self):

@@ -465,6 +465,28 @@ class FFModel:
             self.state, stacked, rngs)
         return metrics
 
+    def train_batch_accum(self, microbatches:
+                          Sequence[Dict[str, np.ndarray]]):
+        """ONE optimizer step over K microbatches (gradient
+        accumulation): gradients are computed per microbatch under
+        `lax.scan`, summed, and applied once — the large-batch result
+        without K x the activation memory. Sparse embedding rows
+        concatenate across microbatches into a single scatter update, so
+        the step equals a K x-sized batch exactly (BN stats advance per
+        microbatch). Returns one metrics dict (loss = mean; sum-style
+        metrics folded over the group)."""
+        k = len(microbatches)
+        if k == 0:
+            return {}
+        stacked = self.executor.shard_batch_stacked(list(microbatches))
+        rngs = jnp.stack([jax.random.fold_in(self._rng,
+                                             self._host_step + i)
+                          for i in range(k)])
+        self._host_step += k
+        self.state, metrics = self.executor.train_step_accum(
+            self.state, stacked, rngs)
+        return metrics
+
     def stage_batches(self, batches: Sequence[Dict[str, np.ndarray]]):
         """Pre-stage K batches as one stacked device-resident group for
         repeated `train_batches` calls. One host->device transfer total;

@@ -1,0 +1,90 @@
+"""Gradient accumulation: one optimizer step over K microbatches must
+equal one step over the concatenated K x batch (losses are batch means,
+so mean-of-means with equal sizes == big-batch mean; same for grads).
+No reference analog — FlexFlow grows batch by adding GPUs
+(multi_gpu_tests.sh GPUS*64); accumulation is the single-chip route."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, SGDOptimizer
+
+
+def _mlp(bs, optimizer):
+    cfg = FFConfig()
+    cfg.batch_size = bs
+    ff = FFModel(cfg)
+    x = ff.create_tensor((bs, 16), name="input")
+    t = ff.dense(x, 32, activation="relu")
+    ff.softmax(ff.dense(t, 4))
+    ff.compile(optimizer=optimizer,
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    return ff
+
+
+def _emb(bs, optimizer, sparse=True):
+    cfg = FFConfig()
+    cfg.batch_size = bs
+    cfg.sparse_embedding_updates = sparse
+    ff = FFModel(cfg)
+    idx = ff.create_tensor((bs, 2), dtype=np.int32, name="input")
+    t = ff.embedding(idx, 64, 8, aggr="sum")
+    ff.dense(t, 4)
+    ff.compile(optimizer=optimizer,
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    return ff
+
+
+@pytest.mark.parametrize("opt", [lambda: SGDOptimizer(lr=0.1),
+                                 lambda: AdamOptimizer(lr=0.01)])
+def test_accum_equals_big_batch(opt):
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 16).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.int32)
+
+    big = _mlp(32, opt())
+    mb = _mlp(8, opt())
+    w0 = big.get_weights("dense")
+    for name in ("dense", "dense_1"):
+        mb.set_weights(name, big.get_weights(name))
+
+    m_big = big.train_batch({"input": x, "label": y})
+    micro = [{"input": x[i * 8:(i + 1) * 8], "label": y[i * 8:(i + 1) * 8]}
+             for i in range(4)]
+    m_acc = mb.train_batch_accum(micro)
+
+    np.testing.assert_allclose(float(m_big["loss"]), float(m_acc["loss"]),
+                               rtol=1e-5)
+    assert int(m_acc["count"]) == 32  # folded over the group
+    for name in ("dense", "dense_1"):
+        wa, wb = big.get_weights(name), mb.get_weights(name)
+        for k in wa:
+            np.testing.assert_allclose(wa[k], wb[k], rtol=1e-4,
+                                       atol=1e-6)
+    # step counter advanced ONCE
+    assert int(mb.state.step) == 1
+
+
+def test_accum_sparse_rows_concatenate():
+    """Sparse tables: rows from different microbatches (with cross-
+    microbatch duplicate indices) must scatter like one big batch."""
+    rng = np.random.RandomState(1)
+    idx = rng.randint(0, 8, (32, 2)).astype(np.int32)  # heavy dupes
+    y = rng.randint(0, 4, 32).astype(np.int32)
+
+    big = _emb(32, SGDOptimizer(lr=0.1))
+    mb = _emb(8, SGDOptimizer(lr=0.1))
+    emb = next(op.name for op in big.ops if op.op_type == "embedding")
+    assert emb in big.executor._sparse_table_ops()
+    for op in big.ops:
+        if op.weight_specs():
+            mb.set_weights(op.name, big.get_weights(op.name))
+
+    big.train_batch({"input": idx, "label": y})
+    mb.train_batch_accum(
+        [{"input": idx[i * 8:(i + 1) * 8], "label": y[i * 8:(i + 1) * 8]}
+         for i in range(4)])
+    np.testing.assert_allclose(big.get_weights(emb)["kernel"],
+                               mb.get_weights(emb)["kernel"],
+                               rtol=1e-4, atol=1e-6)
