@@ -479,10 +479,13 @@ class FFModel:
         if k == 0:
             return {}
         stacked = self.executor.shard_batch_stacked(list(microbatches))
-        rngs = jnp.stack([jax.random.fold_in(self._rng,
-                                             self._host_step + i)
-                          for i in range(k)])
-        self._host_step += k
+        # ONE optimizer step -> _host_step advances by ONE (it mirrors
+        # state.step, which checkpoint resume resyncs from); the K
+        # microbatch keys are sub-keys of this step's key (double
+        # fold_in), so they never collide with other steps' streams
+        base = jax.random.fold_in(self._rng, self._host_step)
+        rngs = jnp.stack([jax.random.fold_in(base, i) for i in range(k)])
+        self._host_step += 1
         self.state, metrics = self.executor.train_step_accum(
             self.state, stacked, rngs)
         return metrics
@@ -542,7 +545,8 @@ class FFModel:
             checkpoint_dir: Optional[str] = None,
             checkpoint_every: int = 1,
             steps_per_dispatch: int = 1,
-            prefetch: bool = False):
+            prefetch: bool = False,
+            grad_accum_steps: int = 1):
         """Keras-style fit over host numpy arrays (reference:
         base_model.py:195-255 + _train loop :347-424).
 
@@ -550,7 +554,14 @@ class FFModel:
         lacks (SURVEY 5: no failure handling): the full TrainState is
         saved asynchronously every `checkpoint_every` epochs, and a
         re-run with the same directory resumes from the newest epoch —
-        kill the process at any point and simply run it again."""
+        kill the process at any point and simply run it again.
+
+        `grad_accum_steps=K` turns each group of K consecutive
+        microbatches into ONE optimizer step (train_batch_accum):
+        effective batch K*batch_size without the activation memory."""
+        assert not (grad_accum_steps > 1 and steps_per_dispatch > 1), (
+            "grad_accum_steps and steps_per_dispatch are both dispatch "
+            "groupings; use one or the other")
         bs = batch_size or self.config.batch_size
         ep = epochs or self.config.epochs
         names = list(x.keys())
@@ -626,15 +637,35 @@ class FFModel:
                         return batch
 
                 # full groups go through the scanned multi-step (one
-                # dispatch per group, trace-replay analog); the ragged
-                # tail uses the single-step path so only two program
-                # shapes ever compile
-                for s0 in range(0, steps - steps % spd, spd):
-                    ms = self.train_batches(
-                        [mk_batch(s) for s in range(s0, s0 + spd)])
-                    epoch_metrics.append(ms)
-                for s in range(steps - steps % spd, steps):
-                    epoch_metrics.append(self.train_batch(mk_batch(s)))
+                # dispatch per group, trace-replay analog) or the
+                # accumulation step (one UPDATE per group). Tails differ:
+                # for dispatch grouping the split is semantics-neutral so
+                # the tail takes single steps (only two program shapes
+                # compile); for ACCUMULATION the grouping IS the
+                # semantics, so the tail is accumulated as one smaller
+                # group rather than demoted to microbatch-sized updates.
+                # epoch_metrics entries: (metrics, loss_weight) where
+                # loss_weight = microbatches represented by the entry's
+                # (mean) loss; None = per-step stacked losses.
+                gas = max(1, grad_accum_steps)
+                group = gas if gas > 1 else spd
+                for s0 in range(0, steps - steps % group, group):
+                    mbs = [mk_batch(s) for s in range(s0, s0 + group)]
+                    if gas > 1:
+                        epoch_metrics.append(
+                            (self.train_batch_accum(mbs), len(mbs)))
+                    else:
+                        epoch_metrics.append(
+                            (self.train_batches(mbs), None))
+                tail = list(range(steps - steps % group, steps))
+                if tail and gas > 1:
+                    mbs = [mk_batch(s) for s in tail]
+                    epoch_metrics.append(
+                        (self.train_batch_accum(mbs), len(mbs)))
+                else:
+                    for s in tail:
+                        epoch_metrics.append(
+                            (self.train_batch(mk_batch(s)), 1))
                 # fold metrics on host (reference: UPDATE_METRICS future
                 # fold). One bulk device->host transfer for the whole
                 # epoch — per-scalar float(v) would issue steps*keys tiny
@@ -642,13 +673,24 @@ class FFModel:
                 # folds through futures too (model.cc:2084-2108).
                 epoch_metrics = jax.device_get(epoch_metrics)
                 agg = {}
-                for m in epoch_metrics:
+                loss_terms = 0
+                for m, w in epoch_metrics:
                     for k, v in m.items():
-                        # scalar (single-step) or (K,)-stacked (grouped)
-                        agg[k] = agg.get(k, 0.0) + float(np.sum(v))
+                        if k == "loss":
+                            # weight each entry's (mean) loss by the
+                            # microbatches it represents so the epoch
+                            # loss is the true per-microbatch mean
+                            if w is None:  # (K,) per-step losses
+                                agg[k] = agg.get(k, 0.0) + float(np.sum(v))
+                                loss_terms += int(np.size(v))
+                            else:
+                                agg[k] = agg.get(k, 0.0) + float(v) * w
+                                loss_terms += w
+                        else:
+                            agg[k] = agg.get(k, 0.0) + float(np.sum(v))
                 dt = time.time() - t0
                 out = {"epoch": epoch,
-                       "loss": agg.get("loss", 0.0) / max(1, steps),
+                       "loss": agg.get("loss", 0.0) / max(1, loss_terms),
                        "throughput": steps * bs / dt}
                 if "correct" in agg:
                     out["accuracy"] = agg["correct"] / agg["count"]

@@ -88,3 +88,78 @@ def test_accum_sparse_rows_concatenate():
     np.testing.assert_allclose(big.get_weights(emb)["kernel"],
                                mb.get_weights(emb)["kernel"],
                                rtol=1e-4, atol=1e-6)
+
+
+def test_fit_grad_accum_steps():
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 16).astype(np.float32)
+    w = rng.randn(16, 4).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    ff = _mlp(16, SGDOptimizer(lr=0.1))
+    h = ff.fit({"input": x}, y, epochs=10, verbose=False,
+               grad_accum_steps=4)
+    # 256/16 = 16 microbatches -> 4 optimizer steps per epoch
+    assert int(ff.state.step) == 10 * 4
+    assert h[-1]["loss"] < h[0]["loss"]
+    assert h[-1]["accuracy"] > 0.5
+
+
+def test_fit_rejects_both_groupings():
+    ff = _mlp(8, SGDOptimizer(lr=0.1))
+    with pytest.raises(AssertionError):
+        ff.fit({"input": np.zeros((16, 16), np.float32)},
+               np.zeros(16, np.int32), epochs=1, verbose=False,
+               grad_accum_steps=2, steps_per_dispatch=2)
+
+
+def test_fit_accum_tail_is_accumulated():
+    """steps % K != 0: the tail must be ONE smaller accumulation group,
+    not K demoted microbatch-sized updates (the grouping IS the
+    optimization semantics here, unlike steps_per_dispatch)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(80, 16).astype(np.float32)   # 5 microbatches of 16
+    y = rng.randint(0, 4, 80).astype(np.int32)
+    ff = _mlp(16, SGDOptimizer(lr=0.1))
+    ff.fit({"input": x}, y, epochs=1, verbose=False, grad_accum_steps=4)
+    # 5 microbatches -> 2 optimizer steps (group of 4 + tail group of 1)
+    assert int(ff.state.step) == 2
+
+
+def test_fit_accum_checkpoint_resume(tmp_path):
+    """Resume with grad_accum_steps: _host_step mirrors OPTIMIZER steps
+    (one per accum group), so the restored rng stream replays exactly.
+    Model includes dropout so rng divergence would show in the loss."""
+    from flexflow_tpu import FFConfig, FFModel
+
+    def build():
+        cfg = FFConfig()
+        cfg.batch_size = 16
+        ff = FFModel(cfg)
+        xx = ff.create_tensor((16, 16), name="input")
+        t = ff.dense(xx, 32, activation="relu")
+        t = ff.dropout(t, 0.2)
+        ff.softmax(ff.dense(t, 4))
+        ff.compile(optimizer=SGDOptimizer(lr=0.1),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=[])
+        return ff
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(128, 16).astype(np.float32)
+    y = rng.randint(0, 4, 128).astype(np.int32)
+    ck = str(tmp_path / "ck")
+
+    ref = build()
+    h_ref = ref.fit({"input": x}, y, epochs=4, verbose=False,
+                    grad_accum_steps=2)
+
+    a = build()
+    a.fit({"input": x}, y, epochs=2, verbose=False, grad_accum_steps=2,
+          checkpoint_dir=ck)
+    b = build()
+    h_b = b.fit({"input": x}, y, epochs=4, verbose=False,
+                grad_accum_steps=2, checkpoint_dir=ck)
+    assert h_b[-1]["loss"] == pytest.approx(h_ref[-1]["loss"], abs=1e-6)
+    np.testing.assert_allclose(ref.get_weights("dense")["kernel"],
+                               b.get_weights("dense")["kernel"],
+                               atol=1e-6)
