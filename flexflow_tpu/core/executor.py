@@ -75,6 +75,7 @@ class Executor:
         self._train_step_multi = None
         self._train_step_accum = None
         self._eval_step = None
+        self._eval_step_multi = None
         self._sparse_ops_cache = None
         self._last_aux_losses = []
         # fusion (reference apply_fusion, model.cc:1472): constrain
@@ -418,21 +419,34 @@ class Executor:
 
         return jax.jit(train_accum, donate_argnums=(0,))
 
+    def _eval_body(self, state: TrainState, batch: Dict[str, jax.Array]):
+        loss, (logits, _) = self._outputs_and_loss(
+            state.params, state.states, batch, False, None,
+            self.config.iter_config.seq_length)
+        metrics = {"loss": loss}
+        if "label" in batch and self.metric_names:
+            sparse = self.loss_name.startswith("sparse")
+            metrics.update(M.compute_metrics(
+                self.metric_names, logits, batch["label"], sparse))
+        return logits, metrics
+
     def build_eval_step(self):
-        cfg = self.config
+        return jax.jit(self._eval_body)
 
-        def eval_step(state: TrainState, batch: Dict[str, jax.Array]):
-            loss, (logits, _) = self._outputs_and_loss(
-                state.params, state.states, batch, False, None,
-                cfg.iter_config.seq_length)
-            metrics = {"loss": loss}
-            if "label" in batch and self.metric_names:
-                sparse = self.loss_name.startswith("sparse")
-                metrics.update(M.compute_metrics(
-                    self.metric_names, logits, batch["label"], sparse))
-            return logits, metrics
+    def build_eval_step_multi(self):
+        """K eval batches per dispatch (scan over the stacked step axis;
+        read-only twin of train_step_multi). Returns metrics stacked
+        (K,) — logits are dropped to keep the dispatch output small."""
 
-        return jax.jit(eval_step)
+        def eval_multi(state: TrainState, batches):
+            def body(_, batch):
+                _logits, metrics = self._eval_body(state, batch)
+                return (), metrics
+
+            _, metrics = jax.lax.scan(body, (), batches)
+            return metrics
+
+        return jax.jit(eval_multi)
 
     @property
     def train_step(self):
@@ -457,6 +471,12 @@ class Executor:
         if self._eval_step is None:
             self._eval_step = self.build_eval_step()
         return self._eval_step
+
+    @property
+    def eval_step_multi(self):
+        if self._eval_step_multi is None:
+            self._eval_step_multi = self.build_eval_step_multi()
+        return self._eval_step_multi
 
     # ---------------- data placement ----------------
     @property

@@ -716,24 +716,37 @@ class FFModel:
         return history
 
     def evaluate(self, x: Dict[str, np.ndarray], y: np.ndarray,
-                 batch_size: Optional[int] = None):
+                 batch_size: Optional[int] = None,
+                 steps_per_dispatch: int = 1):
         bs = batch_size or self.config.batch_size
         names = list(x.keys())
         n = len(y)
         steps = max(1, n // bs)
+        spd = max(1, steps_per_dispatch)
         step_metrics = []
-        for s in range(steps):
+
+        def mk_batch(s):
             sel = slice(s * bs, (s + 1) * bs)
             batch = {k: x[k][sel] for k in names}
             batch["label"] = y[sel]
-            sharded = self.executor.shard_batch(batch)
+            return batch
+
+        # grouped read-only dispatches (scan), single-step ragged tail
+        for s0 in range(0, steps - steps % spd, spd):
+            stacked = self.executor.shard_batch_stacked(
+                [mk_batch(s) for s in range(s0, s0 + spd)])
+            step_metrics.append(
+                self.executor.eval_step_multi(self.state, stacked))
+        for s in range(steps - steps % spd, steps):
+            sharded = self.executor.shard_batch(mk_batch(s))
             _, m = self.executor.eval_step(self.state, sharded)
             step_metrics.append(m)  # device scalars; convert once at end
         step_metrics = jax.device_get(step_metrics)  # one bulk transfer
         agg: Dict[str, float] = {}
         for m in step_metrics:
             for k, v in m.items():
-                agg[k] = agg.get(k, 0.0) + float(v)
+                # scalar (single-step) or (K,)-stacked (grouped)
+                agg[k] = agg.get(k, 0.0) + float(np.sum(v))
         out = {"loss": agg.get("loss", 0.0) / steps}
         if "correct" in agg:
             out["accuracy"] = agg["correct"] / agg["count"]

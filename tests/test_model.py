@@ -210,3 +210,16 @@ def test_fit_prefetch_matches_direct():
         np.testing.assert_allclose(ma["loss"], mb["loss"], rtol=1e-6)
         np.testing.assert_allclose(ma.get("accuracy", 0),
                                    mb.get("accuracy", 0), rtol=1e-6)
+
+
+def test_evaluate_steps_per_dispatch_matches():
+    ff = make_mlp()
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    x, y = synthetic_classification(n=320)
+    ff.fit({"input": x}, y, epochs=2, verbose=False)
+    a = ff.evaluate({"input": x}, y)
+    b = ff.evaluate({"input": x}, y, steps_per_dispatch=3)  # ragged tail
+    np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-6)
+    np.testing.assert_allclose(a["accuracy"], b["accuracy"], rtol=1e-6)
