@@ -22,7 +22,7 @@ BWD_FLOP_FACTOR = 2.0  # dX and dW GEMMs ≈ 2x fwd (reference bwd = 2 GEMMs)
 # saved logsumexp (flash custom-VJP) + 4 grad einsums ≈ 4x fwd
 BWD_FACTOR_BY_TYPE = {"multihead_attention": 4.0}
 MATMUL_OPS = {"linear", "conv2d", "batch_matmul", "multihead_attention",
-              "embedding", "lstm", "moe_ffn", "pipeline_blocks"}
+              "lstm", "moe_ffn", "pipeline_blocks"}
 
 
 @dataclasses.dataclass
@@ -119,6 +119,42 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
     bwd_comm = 0.0
     sync = 0.0
 
+    # Embedding ops never stream the whole table: forward gathers only
+    # the touched rows, and backward writes either the touched rows
+    # (executor sparse-update path, when the indices are graph inputs)
+    # or a dense table gradient (fallback). Price each accordingly —
+    # w_bytes in the generic formula would overprice forward by the
+    # vocab/batch ratio (10^3-10^5 for DLRM) and misrank strategies.
+    # The same traffic numbers feed the device-placement branch below,
+    # so placed and mesh-sharded candidates compete on equal pricing.
+    sync_bytes = w_bytes
+    sync_data_sharded = False  # dense grads are replicated across dp
+    fwd_bytes = bwd_bytes = act_bytes + in_bytes + w_bytes
+    if op.op_type in ("embedding", "distributed_embedding"):
+        rows_bytes = 4.0 * op.out_dim * sum(
+            t.num_elements for t in op.inputs)
+        cfg = op.model.config
+        input_uids = {t.uid for t in op.model.input_tensors}
+        # mirror the EXECUTOR's eligibility gate (executor.py
+        # _sparse_table_ops) — including the optimizer's sparse_mode and
+        # the lazy opt-in — so the search never prices a path the
+        # executor won't take; unknown optimizer (search before
+        # compile's assignment) prices dense, the conservative choice
+        opt = getattr(op.model, "optimizer", None)
+        mode = opt.sparse_mode() if opt is not None else None
+        sparse_updates = (
+            getattr(cfg, "sparse_embedding_updates", False)
+            and (mode == "exact" or (
+                mode == "lazy"
+                and getattr(cfg, "sparse_embedding_lazy", False)))
+            and all(t.uid in input_uids for t in op.inputs))
+        grad_bytes = rows_bytes if sparse_updates else w_bytes
+        fwd_bytes = act_bytes + in_bytes + rows_bytes
+        bwd_bytes = act_bytes + in_bytes + grad_bytes
+        sync_bytes = grad_bytes
+        sync_data_sharded = sparse_updates  # each replica syncs its rows
+        is_mm = False  # gather/scatter, never the MXU path
+
     # --- device-explicit placement (reference ParallelConfig.device_ids,
     # config.h:47-73; DLRM per-table strategies dlrm_strategy.cc:1-50):
     # the op runs whole on its device set — no sample/model sharding —
@@ -131,9 +167,12 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
     if devices:
         k = max(1, len(devices))
         n = max(1, int(mesh.size))
-        fwd = mm.compute_time(flops / k,
-                              (act_bytes + in_bytes + w_bytes) / k, is_mm)
-        bwd = BWD_FACTOR_BY_TYPE.get(op.op_type, BWD_FLOP_FACTOR) * fwd
+        fwd = mm.compute_time(flops / k, fwd_bytes / k, is_mm)
+        if op.op_type in ("embedding", "distributed_embedding"):
+            bwd = mm.compute_time(flops / k, bwd_bytes / k, is_mm)
+        else:
+            bwd = BWD_FACTOR_BY_TYPE.get(op.op_type,
+                                         BWD_FLOP_FACTOR) * fwd
         if n > k:
             fwd_comm = mm.all_gather(act_bytes, n)
             bwd_comm = mm.all_gather(act_bytes, n)
@@ -142,9 +181,11 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
         return OpCost(fwd=fwd, bwd=bwd, fwd_comm=fwd_comm,
                       bwd_comm=bwd_comm, sync=0.0, mem=mem)
 
-    fwd = mm.compute_time(flops / shards,
-                          (act_bytes + in_bytes + w_bytes) / shards, is_mm)
-    bwd = BWD_FACTOR_BY_TYPE.get(op.op_type, BWD_FLOP_FACTOR) * fwd
+    fwd = mm.compute_time(flops / shards, fwd_bytes / shards, is_mm)
+    if op.op_type in ("embedding", "distributed_embedding"):
+        bwd = mm.compute_time(flops / shards, bwd_bytes / shards, is_mm)
+    else:
+        bwd = BWD_FACTOR_BY_TYPE.get(op.op_type, BWD_FLOP_FACTOR) * fwd
 
     # --- TP (Megatron pattern): fwd all-reduce of the (data-sharded)
     # output when the contraction dim is sharded; bwd all-reduce of the
@@ -217,12 +258,15 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
     # --- DP gradient sync: all-reduce of each weight's grad over the
     # data axis (the reference's NCCL all-reduce / PS update+prefetch,
     # optimizer_kernel.cu:113-180)
-    if dp > 1 and w_bytes > 0:
+    if dp > 1 and sync_bytes > 0:
         # weights sharded over model/expert/pipe/vocab/table axes reduce
-        # per-device grad bytes proportionally
-        sync = mm.all_reduce(
-            w_bytes / max(1, eff_tp * ep * pp * vocab * table),
-            dp, _axis_name(strategy, "sample"))
+        # per-device grad bytes proportionally; sparse-updated embedding
+        # rows are additionally data-sharded (each replica contributes
+        # only its batch shard's rows)
+        payload = sync_bytes / max(1, eff_tp * ep * pp * vocab * table)
+        if sync_data_sharded:
+            payload /= dp
+        sync = mm.all_reduce(payload, dp, _axis_name(strategy, "sample"))
 
     # --- memory: weights (+ optimizer state) + activations per device
     w_per_dev = w_bytes / max(1, eff_tp * ep * pp * vocab * table)

@@ -102,15 +102,21 @@ def test_stacked_dlrm_trains_table_sharded():
 
 def test_cost_model_prefers_table_sharding():
     """Simulated: table sharding (concurrent vocab-complete lookups + an
-    all-gather) must beat vocab sharding (a psum per step) and full
-    replication for big tables."""
+    all-gather) must beat vocab sharding (a psum per step), and beat
+    replication when the replicated tables exceed HBM (the memory
+    penalty, simulator.cc:603-628 analog — which is WHY the reference
+    places DLRM tables per-device; with row-level traffic pricing,
+    replication of tables that FIT is legitimately free of collectives
+    and wins on speed)."""
     cfg = FFConfig()
     cfg.batch_size = 1024
     cfg.enable_parameter_parallel = True
     ff = FFModel(cfg)
     ins = [ff.create_tensor((1024, 1), dtype=jnp.int32, name=f"s{i}")
            for i in range(8)]
-    embs = ff.distributed_embedding(ins, 100_000, 64, name="tables")
+    # 8 x 10M x 64 f32 = 20GB replicated (+optimizer state) >> one
+    # chip's HBM; sharded over 8 devices it fits
+    embs = ff.distributed_embedding(ins, 10_000_000, 64, name="tables")
     t = ff.concat(embs, axis=1)
     t = ff.softmax(ff.dense(t, 4))
     mesh = make_mesh((1, 8), ("data", "model"))

@@ -149,6 +149,12 @@ def build_dlrm_for_search(vocab=100_000, batch=1024):
     # device-explicit candidates are opt-in (they execute as replication
     # under GSPMD; the executable form is distributed_embedding)
     cfg.enable_device_placement = True
+    # the placement economics being tested are the REFERENCE's: dense
+    # table-gradient updates (its scatter-add grad region + optimizer
+    # sweep). With the executor's sparse-update path the cost model
+    # prices embeddings at touched-row traffic and placement stops
+    # mattering — which is the correct answer, but not this scenario.
+    cfg.sparse_embedding_updates = False
     return build_dlrm(cfg, batch_size=batch,
                       embedding_vocab_sizes=(vocab,) * 8)
 
