@@ -133,6 +133,10 @@ def build(model: str, preset: str):
 
     rng = np.random.RandomState(0)
     cfg = FFConfig()
+    # conv compute-layout A/B knob (tools/tpu_session.sh sweeps it)
+    layout = os.environ.get("BENCH_CONV_LAYOUT")
+    if layout:
+        cfg.conv_layout = layout
     if model == "transformer":
         batch, seq, hidden, layers, ffd = {
             "full": (32, 512, 512, 6, 2048),

@@ -1,0 +1,40 @@
+#!/bin/bash
+# One-shot on-chip work queue: run whenever the TPU tunnel is up.
+# Usage: bash tools/tpu_session.sh [quick]
+#   quick = skip the preset sweeps, just refresh bench_all.json + tests.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+LOG=.scratch/tpu_session.log
+mkdir -p .scratch
+
+run_all() {
+  echo "=== tpu session $(date -u +%FT%TZ) ==="
+  if ! timeout 120 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu'; print('TPU:', d.device_kind)"; then
+      echo "TPU backend not reachable; aborting"
+      return 1
+  fi
+
+  echo "--- 1. on-chip test suite (tests_tpu/)"
+  timeout 1800 python -m pytest tests_tpu/ -q 2>&1 | tail -5 \
+      || echo "tests_tpu FAILED rc=$?"
+
+  echo "--- 2. full bench sweep -> bench_all.json"
+  BENCH_DEADLINE_S=2400 timeout 2600 python bench.py --all --steps 50 \
+      || echo "bench sweep FAILED rc=$?"
+
+  if [ "${1:-}" != "quick" ]; then
+    echo "--- 3. conv layout A/B (inception + alexnet)"
+    for m in inception alexnet; do
+      for layout in NCHW NHWC; do
+        echo "· $m $layout"
+        BENCH_CONV_LAYOUT=$layout timeout 600 python bench.py --child \
+          --model $m --preset full --steps 30 | tail -1 \
+          || echo "FAILED rc=$? ($m $layout)"
+      done
+    done
+  fi
+  echo "=== done $(date -u +%FT%TZ) ==="
+}
+
+run_all "${1:-}" 2>&1 | tee -a "$LOG"
+exit "${PIPESTATUS[0]}"
