@@ -1,0 +1,48 @@
+"""Functional CIFAR-10 CNN concatenating the outputs of TWO nested
+Models (reference: examples/python/keras/func_cifar10_cnn_concat_model.py;
+tests/multi_gpu_tests.sh): each branch is its own Model used as a layer,
+and their outputs concat into one classifier.
+
+  python examples/python/keras/func_cifar10_cnn_concat_model.py -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+
+
+def make_branch(kernel, name):
+    inp = keras.layers.Input((3, 32, 32))
+    t = keras.layers.Conv2D(32, (kernel, kernel), padding="same",
+                            activation="relu")(inp)
+    t = keras.layers.MaxPooling2D((2, 2))(t)
+    return keras.Model(inputs=inp, outputs=t, name=name)
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+
+    inp = keras.layers.Input((3, 32, 32))
+    a = make_branch(3, "branch3")(inp)
+    b = make_branch(5, "branch5")(inp)
+    t = keras.layers.Concatenate(axis=1)([a, b])
+    t = keras.layers.Flatten()(t)
+    t = keras.layers.Dense(128, activation="relu")(t)
+    out = keras.layers.Dense(10, activation="softmax")(t)
+    model = keras.Model(inputs=inp, outputs=out)
+    model.compile(optimizer=keras.SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, 256).astype(np.int32)
+    hist = model.fit(x, y, batch_size=32, epochs=epochs)
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

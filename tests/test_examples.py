@@ -75,6 +75,8 @@ def test_native_examples_run(script, args):
     "examples/python/keras/seq_mnist_cnn_net2net.py",
     "examples/python/keras/reshape.py",
     "examples/python/keras/candle_uno.py",
+    "examples/python/keras/func_cifar10_cnn_concat_model.py",
+    "examples/python/keras/func_cifar10_cnn_concat_seq_model.py",
 ])
 def test_keras_examples_run(script):
     out = run_example(script, "-e", "1")
