@@ -77,6 +77,7 @@ class Executor:
         self._eval_step = None
         self._eval_step_multi = None
         self._sparse_ops_cache = None
+        self._sparse_cache_key = None
         self._last_aux_losses = []
         # fusion (reference apply_fusion, model.cc:1472): constrain
         # sharding only at fused-group boundaries.
@@ -207,9 +208,27 @@ class Executor:
         scatter-add embedding backward + per-table update of
         src/ops/embedding.cu — the dense-gradient alternative writes the
         full (vocab, dim) table's worth of zeros + updates every step,
-        ruinous at DLRM scale."""
+        ruinous at DLRM scale.
+
+        Eligibility is keyed on the live sparse flags + optimizer; if
+        they change after steps were compiled, the stale compiled steps
+        are dropped so the next dispatch rebuilds with the new routing
+        (cost_model.py reads config live — keep the two in agreement)."""
+        # the optimizer OBJECT (not id(): a recycled address after gc
+        # could false-match) — default object __eq__ is identity and the
+        # strong ref pins it
+        key = (self.config.sparse_embedding_updates,
+               self.config.sparse_embedding_lazy,
+               self.optimizer,
+               self.optimizer.sparse_mode() if self.optimizer else None)
         if self._sparse_ops_cache is not None:
-            return self._sparse_ops_cache
+            if self._sparse_cache_key == key:
+                return self._sparse_ops_cache
+            # routing changed post-build: invalidate compiled steps that
+            # baked in the old sparse/dense split
+            self._train_step = None
+            self._train_step_multi = None
+            self._train_step_accum = None
         from ..ops.embedding import DistributedEmbedding, Embedding
         out: Dict[str, Op] = {}
         mode = (self.optimizer.sparse_mode() if self.optimizer else None)
@@ -223,6 +242,7 @@ class Executor:
                 if all(t.uid in input_uids for t in op.inputs):
                     out[op.name] = op
         self._sparse_ops_cache = out
+        self._sparse_cache_key = key
         return out
 
     # ---------------- step builders ----------------
@@ -450,18 +470,24 @@ class Executor:
 
     @property
     def train_step(self):
+        # consult the sparse routing FIRST: a post-build change to the
+        # sparse flags/optimizer invalidates the cached compiled step
+        # (see _sparse_table_ops), so the rebuild happens on dispatch
+        self._sparse_table_ops()
         if self._train_step is None:
             self._train_step = self.build_train_step()
         return self._train_step
 
     @property
     def train_step_multi(self):
+        self._sparse_table_ops()
         if self._train_step_multi is None:
             self._train_step_multi = self.build_train_step_multi()
         return self._train_step_multi
 
     @property
     def train_step_accum(self):
+        self._sparse_table_ops()
         if self._train_step_accum is None:
             self._train_step_accum = self.build_train_step_accum()
         return self._train_step_accum

@@ -559,9 +559,10 @@ class FFModel:
         `grad_accum_steps=K` turns each group of K consecutive
         microbatches into ONE optimizer step (train_batch_accum):
         effective batch K*batch_size without the activation memory."""
-        assert not (grad_accum_steps > 1 and steps_per_dispatch > 1), (
-            "grad_accum_steps and steps_per_dispatch are both dispatch "
-            "groupings; use one or the other")
+        if grad_accum_steps > 1 and steps_per_dispatch > 1:
+            raise ValueError(
+                "grad_accum_steps and steps_per_dispatch are both dispatch "
+                "groupings; use one or the other")
         bs = batch_size or self.config.batch_size
         ep = epochs or self.config.epochs
         names = list(x.keys())
@@ -649,15 +650,23 @@ class FFModel:
                 # (mean) loss; None = per-step stacked losses.
                 gas = max(1, grad_accum_steps)
                 group = gas if gas > 1 else spd
-                for s0 in range(0, steps - steps % group, group):
-                    mbs = [mk_batch(s) for s in range(s0, s0 + group)]
-                    if gas > 1:
+                if group == 1:
+                    # plain single-step path: no scan-of-1 wrapper, no
+                    # per-step np.stack — leaner default dispatch
+                    for s in range(steps):
                         epoch_metrics.append(
-                            (self.train_batch_accum(mbs), len(mbs)))
-                    else:
-                        epoch_metrics.append(
-                            (self.train_batches(mbs), None))
-                tail = list(range(steps - steps % group, steps))
+                            (self.train_batch(mk_batch(s)), 1))
+                    tail = []
+                else:
+                    for s0 in range(0, steps - steps % group, group):
+                        mbs = [mk_batch(s) for s in range(s0, s0 + group)]
+                        if gas > 1:
+                            epoch_metrics.append(
+                                (self.train_batch_accum(mbs), len(mbs)))
+                        else:
+                            epoch_metrics.append(
+                                (self.train_batches(mbs), None))
+                    tail = list(range(steps - steps % group, steps))
                 if tail and gas > 1:
                     mbs = [mk_batch(s) for s in tail]
                     epoch_metrics.append(

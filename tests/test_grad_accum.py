@@ -106,7 +106,7 @@ def test_fit_grad_accum_steps():
 
 def test_fit_rejects_both_groupings():
     ff = _mlp(8, SGDOptimizer(lr=0.1))
-    with pytest.raises(AssertionError):
+    with pytest.raises(ValueError):
         ff.fit({"input": np.zeros((16, 16), np.float32)},
                np.zeros(16, np.int32), epochs=1, verbose=False,
                grad_accum_steps=2, steps_per_dispatch=2)

@@ -179,3 +179,28 @@ def test_lazy_sparse_matches_dense_when_all_rows_touched(opt, distributed):
 def test_lazy_requires_opt_in():
     ff = _build_small_vocab(True, False, AdamOptimizer(lr=0.01))
     assert not ff.executor._sparse_table_ops()
+
+
+def test_sparse_flag_change_rebuilds_compiled_step():
+    """Mutating the sparse flags (or swapping the optimizer) AFTER the
+    first dispatch must drop the compiled steps and re-route: the
+    executor's routing cache is keyed on the live flags and consulted on
+    every dispatch, so it cannot diverge from cost_model.py's live
+    config reads (ADVICE r2)."""
+    ff = _build_embedding_model(True, SGDOptimizer(lr=0.05))
+    emb = next(o.name for o in ff.ops if "embedding" in o.op_type)
+    b = _batches(n=1)[0]
+    ff.train_batch(b)
+    assert emb in ff.executor._sparse_table_ops()
+    step_before = ff.executor._train_step
+    # flip the flag off: next dispatch must rebuild with dense routing
+    ff.config.sparse_embedding_updates = False
+    ff.train_batch(b)
+    assert emb not in ff.executor._sparse_table_ops()
+    assert ff.executor._train_step is not step_before
+    # and back on: rebuilds again, sparse routing restored
+    step_dense = ff.executor._train_step
+    ff.config.sparse_embedding_updates = True
+    ff.train_batch(b)
+    assert emb in ff.executor._sparse_table_ops()
+    assert ff.executor._train_step is not step_dense
