@@ -32,6 +32,7 @@ from .optimizers import Optimizer
 from ..parallel.pconfig import Strategy
 from ..parallel.sharding import (
     batch_sharding,
+    effective_op_strategy,
     op_output_sharding,
     spec_for_axes,
     weight_sharding,
@@ -79,6 +80,15 @@ class Executor:
         self._sparse_ops_cache = None
         self._sparse_cache_key = None
         self._last_aux_losses = []
+        # lower device-explicit placements (strategy device_ids) into
+        # the stacked-embedding slot layout BEFORE any weight_specs()
+        # read — the executable form of the reference's slice_task
+        # routing (mapper.cc:346-440); re-entrant across recompiles
+        from ..ops.embedding import DistributedEmbedding
+        for op in model.ops:
+            if isinstance(op, DistributedEmbedding):
+                s = self.strategy.for_op(op.name)
+                op.apply_placement(s.device_ids or None, mesh)
         # fusion (reference apply_fusion, model.cc:1472): constrain
         # sharding only at fused-group boundaries.
         self._sharding_boundary = None
@@ -111,7 +121,11 @@ class Executor:
                         arr = init_fn(key, spec.shape, spec.dtype)
                     if self.mesh is not None:
                         sh = weight_sharding(
-                            spec, self.strategy.for_op(op.name), self.mesh)
+                            spec,
+                            effective_op_strategy(
+                                op, self.strategy.for_op(op.name),
+                                self.mesh),
+                            self.mesh)
                         arr = jax.device_put(arr, sh)
                     op_params[wname] = arr
                 params[op.name] = op_params
@@ -264,8 +278,10 @@ class Executor:
             for name, op in sparse_ops.items():
                 table = params[name]["kernel"]
                 if isinstance(op, DistributedEmbedding):
-                    idx = jnp.stack([batch[t.name].astype(jnp.int32)
-                                     for t in op.inputs])
+                    # slot order (matches the kernel layout, incl.
+                    # device-placed permutations)
+                    idx = op.slot_ids([batch[t.name]
+                                       for t in op.inputs])
                     rows = jax.vmap(
                         lambda w, i: jnp.take(w, i, axis=0))(table, idx)
                 else:

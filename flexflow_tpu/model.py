@@ -378,15 +378,22 @@ class FFModel:
                 self.strategy.save(self.config.export_strategy_file)
 
         if self.strategy is not None:
+            # per-table device ids on distributed_embedding EXECUTE (the
+            # op lowers them to a device-ordered slot layout, see
+            # ops/embedding.py apply_placement); other placed ops still
+            # fall back to replication under GSPMD
+            ops_by_name = {op.name: op for op in self.ops}
             placed = [n for n, s in self.strategy.op_strategies.items()
-                      if s.device_ids]
+                      if s.device_ids
+                      and getattr(ops_by_name.get(n), "op_type", None)
+                      != "distributed_embedding"]
             if placed:
                 import warnings
                 warnings.warn(
                     f"strategy pins {placed} to explicit devices; GSPMD "
                     f"executes device-explicit placement as replication "
-                    f"— use distributed_embedding table sharding for an "
-                    f"executable equivalent")
+                    f"— use distributed_embedding per-table placement "
+                    f"for an executable equivalent")
 
         self.executor = Executor(self, optimizer, loss_type, metrics,
                                  mesh=self.mesh, strategy=self.strategy)

@@ -120,7 +120,18 @@ class DistributedEmbedding(Op):
 
     Inputs: E index tensors of shape (batch, bag); outputs: E tensors of
     shape (batch, dim) in the same order (drop-in for a list of
-    `Embedding` ops, models/dlrm.py)."""
+    `Embedding` ops, models/dlrm.py).
+
+    Device-EXPLICIT placement (reference ParallelConfig.device_ids,
+    executed by slice_task mapper.cc:346-440): `apply_placement` lowers
+    a per-table device-id tuple from the strategy into a SLOT layout —
+    tables are grouped by assigned device, padded to K tables per
+    device, and stacked as (n_dev*K, vocab, dim) whose slot axis shards
+    over the FULL mesh in device order, so slot block d literally lives
+    on mesh.devices.flat[d]. An arbitrary search-placed assignment
+    (scattered, skewed, or blocked) then EXECUTES under GSPMD instead of
+    falling back to replication; outputs are returned in original table
+    order via the inverse slot map."""
 
     op_type = "distributed_embedding"
 
@@ -146,6 +157,83 @@ class DistributedEmbedding(Op):
         self.attrs = {"num_tables": self.num_tables,
                       "num_entries": num_entries, "out_dim": out_dim,
                       "aggr": aggr}
+        # device-explicit placement state (set at executor build via
+        # apply_placement; None = plain table-axis stacking)
+        self.placement = None       # per-table device ids
+        self._slots = None          # slot -> table index (-1 = pad)
+        self._slot_of_table = None  # table -> slot
+        self.num_slots = self.num_tables
+
+    def apply_placement(self, device_ids, mesh=None) -> None:
+        """Lower per-table `device_ids` to the executable slot layout
+        (see class docstring), or reset to plain stacking when None.
+        Re-entrant: the executor calls this at every compile so a
+        strategy change relays out the weight. A length-1 tuple pins ALL
+        tables to that one device (the reference's whole-op pin)."""
+        if device_ids is not None and len(device_ids) == 1 \
+                and self.num_tables > 1:
+            device_ids = tuple(device_ids) * self.num_tables
+        if device_ids is None:
+            self.placement = None
+            self._slots = None
+            self._slot_of_table = None
+            self.num_slots = self.num_tables
+            return
+        if len(device_ids) != self.num_tables:
+            raise ValueError(
+                f"{self.name}: device_ids length {len(device_ids)} != "
+                f"num_tables {self.num_tables} (per-table placement "
+                f"needs one device id per table, or exactly one id to "
+                f"pin all tables)")
+        n_dev = int(mesh.size) if mesh is not None \
+            else max(int(d) for d in device_ids) + 1
+        ids = [int(d) for d in device_ids]
+        if any(d < 0 or d >= n_dev for d in ids):
+            raise ValueError(
+                f"{self.name}: device ids {ids} out of range for "
+                f"{n_dev} devices")
+        groups = [[] for _ in range(n_dev)]
+        for t, d in enumerate(ids):
+            groups[d].append(t)
+        k = max(1, max(len(g) for g in groups))
+        if n_dev * k >= 4 * self.num_tables:
+            # the slot layout pads every device to the LARGEST group, so
+            # a skewed assignment multiplies kernel memory (a (E,v,d)
+            # table becomes (n_dev*k,v,d)); the cost model prices this
+            # (search/cost_model.py pad factor) — surface it for
+            # hand-written strategies too
+            import warnings
+            warnings.warn(
+                f"{self.name}: placement {ids} pads {self.num_tables} "
+                f"tables to {n_dev * k} slots ({n_dev * k / self.num_tables:.1f}x "
+                f"kernel memory); balance tables across devices to "
+                f"avoid the padding")
+        slots = []
+        for g in groups:
+            slots += g + [-1] * (k - len(g))
+        self.placement = tuple(ids)
+        self._slots = tuple(slots)
+        self._slot_of_table = tuple(slots.index(t)
+                                    for t in range(self.num_tables))
+        self.num_slots = n_dev * k
+
+    def slot_ids(self, xs):
+        """Stack per-table index arrays into the (num_slots, batch, bag)
+        slot order the kernel is laid out in; pad slots read row 0 of
+        their (unused) pad table."""
+        if self._slots is None:
+            cols = xs
+        else:
+            zero = None
+            cols = []
+            for t in self._slots:
+                if t >= 0:
+                    cols.append(xs[t])
+                else:
+                    if zero is None:
+                        zero = jnp.zeros_like(xs[0])
+                    cols.append(zero)
+        return jnp.stack([c.astype(jnp.int32) for c in cols], axis=0)
 
     def output_shapes(self):
         bs = self.inputs[0].shape[0]
@@ -160,7 +248,7 @@ class DistributedEmbedding(Op):
     def weight_specs(self):
         return {
             "kernel": WeightSpec(
-                shape=(self.num_tables, self.num_entries, self.out_dim),
+                shape=(self.num_slots, self.num_entries, self.out_dim),
                 initializer=self.kernel_initializer,
                 axes=(TABLE, VOCAB, CHANNEL_OUT),
                 fan_in=self.num_entries, fan_out=self.out_dim,
@@ -169,20 +257,22 @@ class DistributedEmbedding(Op):
 
     def forward(self, params, xs, ctx: OpContext):
         if "__rows__" in params:
-            emb = params["__rows__"]  # (E, batch, bag, dim) pre-gathered
+            emb = params["__rows__"]  # (S, batch, bag, dim) pre-gathered
         else:
-            tables = params["kernel"]  # (E, vocab, dim)
-            ids = jnp.stack([x.astype(jnp.int32) for x in xs], axis=0)
-            # per-table gather, vmapped over the stacked axis: sharded on
-            # `table`, each device gathers only from its resident tables
-            # and GSPMD all-gathers the (E, batch, bag, dim) result
+            tables = params["kernel"]  # (S, vocab, dim), slot order
+            ids = self.slot_ids(xs)
+            # per-slot gather, vmapped over the stacked axis: sharded on
+            # `table` (or device-placed via slots), each device gathers
+            # only from its resident tables and GSPMD gathers the
+            # (S, batch, bag, dim) result
             emb = jax.vmap(lambda w, i: jnp.take(w, i, axis=0))(tables, ids)
         if self.aggr == AGGR_MODE_SUM:
             emb = jnp.sum(emb, axis=-2)
         elif self.aggr == AGGR_MODE_AVG:
             emb = jnp.mean(emb, axis=-2)
-        return [emb[e].astype(self.out_dtype)
-                for e in range(self.num_tables)]
+        order = (self._slot_of_table if self._slot_of_table is not None
+                 else range(self.num_tables))
+        return [emb[s].astype(self.out_dtype) for s in order]
 
     def output_axes(self):
         n = len(self.outputs[0].shape)  # 3-D when aggr == "none"

@@ -12,7 +12,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..op import Op, WeightSpec
+from ..op import TABLE, Op, WeightSpec
 from .pconfig import OpStrategy
 
 
@@ -60,6 +60,21 @@ def op_output_sharding(op: Op, strategy: OpStrategy, mesh: Mesh):
 def weight_sharding(spec: WeightSpec, strategy: OpStrategy, mesh: Mesh):
     pspec = spec_for_axes(spec.axes, strategy, mesh, spec.shape)
     return NamedSharding(mesh, pspec)
+
+
+def effective_op_strategy(op: Op, strategy: OpStrategy,
+                          mesh: Mesh) -> OpStrategy:
+    """Strategy view actually lowered for `op`. Device-placed stacked
+    embeddings (reference device_ids, executed via slice_task
+    mapper.cc:346-440): the slot-stacked `table` axis shards over the
+    FULL mesh in device order, so slot block d lives exactly on
+    mesh.devices.flat[d] — the strategy's explicit ids execute
+    literally rather than as replication."""
+    if mesh is not None and getattr(op, "placement", None):
+        am = {k: v for k, v in strategy.axis_map.items()}
+        am[TABLE] = tuple(mesh.axis_names)
+        return OpStrategy(am)
+    return strategy
 
 
 def batch_sharding(mesh: Mesh, ndim: int, data_axis: str = "data"):

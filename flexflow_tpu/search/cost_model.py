@@ -103,6 +103,10 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
     in_bytes = sum(t.size_bytes() for t in op.inputs)
     w_bytes = op.weight_bytes()
     is_mm = op.op_type in MATMUL_OPS
+    # conv has its own MEASURED MXU fraction (measure.py
+    # measure_conv_efficiency — the analog of the reference's per-shape
+    # conv algorithm measurement, conv_2d.cu:173-260)
+    kind = "conv" if op.op_type == "conv2d" else None
 
     dp = _axis_size(strategy, mesh, "sample")
     tp_axis = _axis_name(strategy, "channel_out")
@@ -165,11 +169,24 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
     # all devices, as the DLRM strategy does).
     devices = strategy.device_ids
     if devices:
-        k = max(1, len(devices))
+        # distinct devices = real concurrency (a per-table id tuple may
+        # assign several tables to one device; executed via the op's
+        # slot layout, ops/embedding.py apply_placement)
+        k = max(1, len(set(devices)))
+        # slot-layout pad factor: the executable lowering pads every
+        # device to the largest per-device group, so skewed assignments
+        # inflate the kernel — price it so search prefers balance
+        if (op.op_type == "distributed_embedding"
+                and len(devices) == getattr(op, "num_tables", -1)):
+            from collections import Counter
+            kmax = max(Counter(devices).values())
+            n_total = max(1, int(mesh.size))
+            w_bytes *= n_total * kmax / len(devices)
         n = max(1, int(mesh.size))
-        fwd = mm.compute_time(flops / k, fwd_bytes / k, is_mm)
+        fwd = mm.compute_time(flops / k, fwd_bytes / k, is_mm, kind=kind)
         if op.op_type in ("embedding", "distributed_embedding"):
-            bwd = mm.compute_time(flops / k, bwd_bytes / k, is_mm)
+            bwd = mm.compute_time(flops / k, bwd_bytes / k, is_mm,
+                                  kind=kind)
         else:
             bwd = BWD_FACTOR_BY_TYPE.get(op.op_type,
                                          BWD_FLOP_FACTOR) * fwd
@@ -181,9 +198,11 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
         return OpCost(fwd=fwd, bwd=bwd, fwd_comm=fwd_comm,
                       bwd_comm=bwd_comm, sync=0.0, mem=mem)
 
-    fwd = mm.compute_time(flops / shards, fwd_bytes / shards, is_mm)
+    fwd = mm.compute_time(flops / shards, fwd_bytes / shards, is_mm,
+                          kind=kind)
     if op.op_type in ("embedding", "distributed_embedding"):
-        bwd = mm.compute_time(flops / shards, bwd_bytes / shards, is_mm)
+        bwd = mm.compute_time(flops / shards, bwd_bytes / shards, is_mm,
+                              kind=kind)
     else:
         bwd = BWD_FACTOR_BY_TYPE.get(op.op_type, BWD_FLOP_FACTOR) * fwd
 

@@ -67,6 +67,18 @@ def candidate_maps(op, mesh, cfg, op_index: int = 0) -> List[Dict[str, str]]:
     if (getattr(cfg, "enable_device_placement", False)
             and op.op_type == "embedding" and n_dev > 1):
         cands.append({DEVICE_KEY: (op_index % n_dev,)})
+    if (getattr(cfg, "enable_device_placement", False)
+            and op.op_type == "distributed_embedding" and n_dev > 1):
+        # per-table explicit ids (the DLRM strategy-generator pattern,
+        # dlrm_strategy.cc:1-50) — EXECUTABLE via the op's slot layout:
+        # round-robin and blocked assignments
+        ntab = getattr(op, "num_tables", 1)
+        cands.append({DEVICE_KEY: tuple(t % n_dev
+                                        for t in range(ntab))})
+        if ntab >= n_dev:
+            cands.append({DEVICE_KEY: tuple(
+                min(t * n_dev // ntab, n_dev - 1)
+                for t in range(ntab))})
 
     if cfg.enable_sequence_parallel and "seq" in axes:
         if op.op_type in ("multihead_attention", "linear", "lstm",
