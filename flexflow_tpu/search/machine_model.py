@@ -26,7 +26,11 @@ class TPUMachineModel:
     spec: MachineSpec
     # achieved-fraction calibration knobs (overridable via measure.py)
     efficiency: Dict[str, float] = dataclasses.field(default_factory=lambda: {
-        "matmul": 0.55,      # MXU-bound ops (dense/conv/attention GEMMs)
+        "matmul": 0.55,      # MXU-bound ops (dense/attention GEMMs)
+        "conv": 0.45,        # conv MXU fraction (im2col/layout overheads
+        #                      put it below big-GEMM; MEASURED on device
+        #                      by measure.py, reference conv_2d.cu:173-260
+        #                      measures per-shape algorithms)
         "elementwise": 0.8,  # HBM-bound ops (fraction of peak HBM bw)
         "collective": 0.75,  # fraction of peak ICI bw
     })
@@ -35,10 +39,15 @@ class TPUMachineModel:
 
     # ---- compute ----
     def compute_time(self, flops: float, bytes_moved: float,
-                     is_matmul: bool = True) -> float:
-        """Roofline: max of MXU time and HBM time."""
-        t_flops = flops / (self.spec.peak_flops
-                           * self.efficiency["matmul"])
+                     is_matmul: bool = True,
+                     kind: Optional[str] = None) -> float:
+        """Roofline: max of MXU time and HBM time. `kind` selects a
+        measured per-family MXU efficiency ("conv" today); default is
+        the big-GEMM factor."""
+        eff = self.efficiency["matmul"]
+        if kind is not None:
+            eff = self.efficiency.get(kind, eff)
+        t_flops = flops / (self.spec.peak_flops * eff)
         t_mem = bytes_moved / (self.spec.hbm_bandwidth
                                * self.efficiency["elementwise"])
         return max(t_flops, t_mem)

@@ -52,6 +52,52 @@ def measure_matmul_efficiency(mm: TPUMachineModel, n: int = 8192,
     return min(1.0, achieved / mm.spec.peak_flops)
 
 
+def measure_conv_efficiency(mm: TPUMachineModel, repeats: int = 20
+                            ) -> float:
+    """Achieved MXU fraction for convolution — measured separately from
+    big GEMM because im2col/layout overheads put convs well below the
+    dense-matmul roofline, and ranking conv strategies by the GEMM
+    factor is a guess (VERDICT r2 #3; reference conv_2d.cu:173-260
+    auto-selects per-shape algorithms by measurement). Two Inception-
+    representative shapes (3x3 s1 mid-size, 1x1 channel-mixing),
+    NHWC/bf16 — the bench compute layout; returns the FLOP-weighted
+    achieved fraction."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    shapes = [
+        # (batch, h, w, cin, cout, k)
+        (64, 56, 56, 64, 128, 3),
+        (64, 28, 28, 256, 256, 1),
+    ]
+    dn = jax.lax.conv_dimension_numbers(
+        (1, 1, 1, 1), (1, 1, 1, 1), ("NHWC", "HWIO", "NHWC"))
+    total_flops = 0.0
+    total_time = 0.0
+    for (b, h, w, cin, cout, k) in shapes:
+        x = jnp.ones((b, h, w, cin), jnp.bfloat16)
+        kern = jnp.ones((k, k, cin, cout), jnp.bfloat16)
+
+        @partial(jax.jit)
+        def f(a, kr):
+            return jax.lax.conv_general_dilated(
+                a, kr, (1, 1), "SAME", dimension_numbers=dn,
+                preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+        y = f(x, kern)
+        _sync(y)
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            y = f(x, kern)
+        _sync(y)
+        total_time += (time.perf_counter() - t0) / repeats
+        total_flops += 2.0 * b * h * w * cout * cin * k * k
+    # back-to-back effective rate over the shape mix
+    achieved = total_flops / total_time
+    return min(1.0, achieved / mm.spec.peak_flops)
+
+
 def measure_elementwise_efficiency(mm: TPUMachineModel, n: int = 16384,
                                    repeats: int = 100) -> float:
     import jax
@@ -103,6 +149,7 @@ def calibrate(mm: TPUMachineModel, save_path: Optional[str] = None
     defeat re-measurement forever)."""
     try:
         mm.efficiency["matmul"] = max(0.05, measure_matmul_efficiency(mm))
+        mm.efficiency["conv"] = max(0.05, measure_conv_efficiency(mm))
         mm.efficiency["elementwise"] = max(
             0.05, measure_elementwise_efficiency(mm))
         mm.efficiency["step_overhead_s"] = measure_step_overhead()

@@ -344,3 +344,44 @@ def test_mesh_shape_search_wired_into_compile():
     m = ff.train_batch({"input": rng.randn(16, 64).astype(np.float32),
                         "label": rng.randint(0, 4, 16).astype(np.int32)})
     assert np.isfinite(float(m["loss"]))
+
+
+def test_conv_specific_efficiency_prices_conv_ops():
+    """Conv strategies are ranked by a conv-specific MEASURED factor,
+    not the big-GEMM guess (VERDICT r2 #3; reference conv_2d.cu:173-260
+    measures per-shape conv algorithms)."""
+    from flexflow_tpu.search.cost_model import op_cost
+    from flexflow_tpu.search.machine_model import default_machine_model
+    from flexflow_tpu.parallel.pconfig import OpStrategy
+    from flexflow_tpu import make_mesh
+
+    cfg = FFConfig()
+    cfg.batch_size = 64
+    ff = FFModel(cfg)
+    # channel-heavy shape so the op is MXU-bound (a 3-channel input conv
+    # is memory-bound and the MXU factor never shows in the roofline max)
+    x = ff.create_tensor((64, 64, 32, 32), name="input")
+    ff.conv2d(x, 128, 3, 3, 1, 1, 1, 1, name="c1")
+    conv = ff.ops[0]
+    mesh = make_mesh((8,), ("data",))
+    mm = default_machine_model(mesh)
+    mm.efficiency["conv"] = 0.45
+    base = op_cost(conv, OpStrategy({"sample": "data"}), mesh, mm).fwd
+    mm.efficiency["conv"] = 0.9  # doubling conv efficiency must show up
+    fast = op_cost(conv, OpStrategy({"sample": "data"}), mesh, mm).fwd
+    assert fast < base, (fast, base)
+    # and the matmul factor alone must NOT move conv cost
+    mm.efficiency["matmul"] = 0.05
+    still = op_cost(conv, OpStrategy({"sample": "data"}), mesh, mm).fwd
+    assert still == fast, (still, fast)
+
+
+def test_measure_conv_efficiency_smoke():
+    """The conv microbenchmark itself runs (CPU: value meaningless but
+    must be a sane fraction and not crash the calibration ladder)."""
+    from flexflow_tpu.search import measure
+    from flexflow_tpu.search.machine_model import default_machine_model
+
+    mm = default_machine_model(None)
+    eff = measure.measure_conv_efficiency(mm, repeats=1)
+    assert 0.0 < eff <= 1.0
