@@ -1,7 +1,8 @@
 """ONNX-frontend example (reference: examples/python/onnx/mnist_mlp.py
-— import an ONNX graph and train it). Import-gated: without the `onnx`
-package this prints a clear skip message and exits 0, matching the
-frontend's fail-loudly-only-when-used policy.
+— import an ONNX graph and train it). Runs with or without the `onnx`
+package: a real `.onnx` file is exported via torch and read back
+through the in-tree wire-format decoder (frontends/onnx_wire.py) when
+`onnx` is absent.
 
   python examples/python/onnx/mnist_mlp_onnx.py -e 1
 """
@@ -11,22 +12,18 @@ import sys
 import numpy as np
 
 from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
-from flexflow_tpu.frontends.onnx import HAS_ONNX
 
 
 def top_level_task():
-    if not HAS_ONNX:
-        print("onnx not installed; skipping (pip install onnx to run)")
-        return
     try:
         import torch
         import torch.nn as nn
     except ImportError:
-        print("onnx not installed with torch; this example exports the "
-              "test graph via torch.onnx (pip install torch to run)")
+        print("torch not installed; this example exports the test graph "
+              "via torch.onnx (pip install torch to run)")
         return
 
-    from flexflow_tpu.frontends.onnx import ONNXModel
+    from flexflow_tpu.frontends.onnx import ONNXModel, export_torch_onnx
 
     epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
         if "-e" in sys.argv else 1
@@ -36,15 +33,15 @@ def top_level_task():
                            nn.Linear(256, 10), nn.Softmax(dim=-1))
     import tempfile
     with tempfile.NamedTemporaryFile(suffix=".onnx") as f:
-        torch.onnx.export(module, torch.randn(bs, 784), f.name,
+        export_torch_onnx(module, torch.randn(bs, 784), f.name,
                           input_names=["input"])
         om = ONNXModel(f.name)
 
     cfg = FFConfig.from_args()
     cfg.batch_size = bs
     ff = FFModel(cfg)
-    inp = ff.create_tensor((bs, 784), name="input")
-    om.apply(ff, {"input": inp})
+    # input tensors straight from the graph's declared inputs
+    om.apply(ff, om.make_input_tensors(ff, batch_size=bs))
     ff.compile(optimizer=SGDOptimizer(lr=0.05),
                loss_type="sparse_categorical_crossentropy",
                metrics=["accuracy"])

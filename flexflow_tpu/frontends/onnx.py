@@ -6,11 +6,12 @@ AveragePool, BatchNormalization, Concat, Split, Flatten, Relu, Softmax,
 Reshape, Add/Sub/Mul, Dropout; onnx/model.py:74-340).
 
 The handler table operates on a neutral node form (`GraphNode`:
-op_type/input/output/name + plain-dict attrs), so it is fully
-executable without the `onnx` package: `ONNXModel.from_graph(nodes,
-initializers)` builds one directly (used by tests and any frontend
-that can produce the node list). Loading a real `.onnx` file/proto
-still requires `onnx` and is gated per-call.
+op_type/input/output/name + plain-dict attrs). Real `.onnx` files load
+with ZERO dependencies: when the `onnx` package is absent, the wire
+format is read by the in-tree protobuf decoder (`onnx_wire.py` —
+nodes, attributes, tensor initializers incl. raw_data).
+`ONNXModel.from_graph(nodes, initializers)` additionally accepts a
+pre-parsed node list from any producer.
 """
 
 from __future__ import annotations
@@ -61,23 +62,72 @@ def _proto_attrs(node) -> Dict:
             out[a.name] = a.f
         elif a.type == onnx.AttributeProto.STRING:
             out[a.name] = a.s.decode()
+        elif a.type == onnx.AttributeProto.TENSOR:
+            # Constant nodes carry their payload here; the wire decoder
+            # path decodes these too — keep both loaders equivalent
+            out[a.name] = numpy_helper.to_array(a.t)
     return out
+
+
+def export_torch_onnx(module, args, path, **kw) -> None:
+    """torch.onnx.export that works WITHOUT the `onnx` package: the
+    TorchScript exporter serializes the ModelProto in C++; only its
+    onnxscript post-processing step re-parses with `onnx`, and that
+    step is a no-op for plain nn modules — skip it when onnx is absent.
+    (Reference keras_exp/onnx flows assume onnx is installed; here the
+    zero-dep path keeps the frontend testable in the base image.)"""
+    import torch
+    if HAS_ONNX:
+        torch.onnx.export(module, args, path, dynamo=False, **kw)
+        return
+    try:
+        from torch.onnx._internal.torchscript_exporter import (
+            onnx_proto_utils,
+        )
+    except ImportError as e:  # pragma: no cover - torch layout changed
+        raise ImportError(
+            "torch.onnx internals moved; install the `onnx` package to "
+            "export") from e
+    orig = onnx_proto_utils._add_onnxscript_fn
+    onnx_proto_utils._add_onnxscript_fn = lambda b, c: b
+    try:
+        torch.onnx.export(module, args, path, dynamo=False, **kw)
+    finally:
+        onnx_proto_utils._add_onnxscript_fn = orig
 
 
 class ONNXModel:
     def __init__(self, path_or_model):
-        if not HAS_ONNX:
-            raise ImportError(
-                "the `onnx` package is required to load .onnx files; "
-                "pip install onnx (or build the graph with "
-                "ONNXModel.from_graph)")
-        model = (onnx.load(path_or_model)
-                 if isinstance(path_or_model, str) else path_or_model)
+        self.graph_inputs = []  # [(name, shape)] for non-initializer inputs
+        if HAS_ONNX and not isinstance(path_or_model, (str, bytes)):
+            model = path_or_model  # an onnx.ModelProto object
+        elif HAS_ONNX:
+            model = (onnx.load_model_from_string(path_or_model)
+                     if isinstance(path_or_model, bytes)
+                     else onnx.load(path_or_model))
+        else:
+            # no onnx package: read the wire format directly
+            from .onnx_wire import load_model
+            parsed = load_model(path_or_model)
+            g = parsed["graph"]
+            self.inits = dict(g["initializers"])
+            self.nodes = [GraphNode(n["op_type"], n["input"], n["output"],
+                                    n["name"], n["attrs"])
+                          for n in g["nodes"]]
+            self.graph_inputs = [(vi["name"], vi["shape"])
+                                 for vi in g["inputs"]
+                                 if vi["name"] not in self.inits]
+            return
         self.inits = {t.name: numpy_helper.to_array(t)
                       for t in model.graph.initializer}
         self.nodes = [GraphNode(n.op_type, list(n.input), list(n.output),
                                 n.name, _proto_attrs(n))
                       for n in model.graph.node]
+        self.graph_inputs = [
+            (vi.name,
+             [d.dim_value or d.dim_param
+              for d in vi.type.tensor_type.shape.dim])
+            for vi in model.graph.input if vi.name not in self.inits]
 
     @classmethod
     def from_graph(cls, nodes: Sequence[GraphNode],
@@ -86,7 +136,28 @@ class ONNXModel:
         self = cls.__new__(cls)
         self.inits = dict(initializers)
         self.nodes = list(nodes)
+        self.graph_inputs = []
         return self
+
+    def make_input_tensors(self, ffmodel, batch_size: int = None,
+                           dtype=None) -> Dict[str, "Tensor"]:
+        """Create framework input tensors from the graph's declared
+        (non-initializer) inputs — the dict `apply` consumes. Dim 0 is
+        replaced by `batch_size` when given; symbolic dims elsewhere
+        fail loudly (provide tensors by hand for dynamic graphs)."""
+        out = {}
+        for name, shape in self.graph_inputs:
+            shape = list(shape)
+            if batch_size is not None and shape:
+                shape[0] = batch_size
+            if any(not isinstance(d, int) or d <= 0 for d in shape):
+                raise ValueError(
+                    f"graph input {name!r} has non-static shape {shape}; "
+                    f"pass an explicit tensor to apply() instead")
+            kw = {} if dtype is None else {"dtype": dtype}
+            out[name] = ffmodel.create_tensor(tuple(shape), name=name,
+                                              **kw)
+        return out
 
     def apply(self, ffmodel, input_dict: Dict[str, "Tensor"]):
         """Emit the graph onto ffmodel; input_dict maps ONNX graph input
@@ -209,6 +280,16 @@ class ONNXModel:
                         "Div": "divide"}[node.op_type]
                 t = getattr(ffmodel, mode)(values[ins[0]], values[ins[1]],
                                            name=name)
+            elif node.op_type == "Constant":
+                # fold into the initializer map: downstream handlers
+                # (Reshape shape, Split sizes) read constants from there
+                val = a.get("value")
+                if val is None:
+                    raise NotImplementedError(
+                        f"Constant node {name} without a tensor `value` "
+                        f"attribute")
+                self.inits[node.output[0]] = np.asarray(val)
+                continue
             elif node.op_type == "Reshape":
                 shape = self.inits[ins[1]].tolist()
                 t = ffmodel.reshape(values[ins[0]], shape, name=name)

@@ -117,15 +117,16 @@ def test_torchfx_ff_file_roundtrip(tmp_path):
     assert out.shape == (2, 4)
 
 
-def test_onnx_file_load_gated():
-    """Loading a .onnx file still requires the onnx package; the handler
-    table itself is exercised without it in test_frontend_handlers.py."""
+def test_onnx_file_load_zero_dep():
+    """Loading a .onnx file needs NO onnx package (wire decoder,
+    test_onnx_wire.py); a missing path fails with the filesystem error,
+    not an import gate."""
     from flexflow_tpu.frontends import onnx as fonnx
     if not fonnx.HAS_ONNX:
-        with pytest.raises(ImportError):
+        with pytest.raises(FileNotFoundError):
             fonnx.ONNXModel("nonexistent.onnx")
-    else:  # pragma: no cover - image has no onnx
-        pass
+        with pytest.raises(ValueError):  # garbage bytes fail loudly
+            fonnx.ONNXModel(b"\x00\x01garbage\xff")
 
 
 def test_keras_nested_model_as_layer():
