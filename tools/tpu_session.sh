@@ -4,8 +4,10 @@
 #   quick = skip the preset sweeps, just refresh bench_all.json + tests.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
-LOG=.scratch/tpu_session.log
-mkdir -p .scratch
+# evidence discipline (EVIDENCE.md): every on-chip session transcript is
+# a committed artifact, not scratch
+LOG="evidence/tpu_session_$(date -u +%Y%m%dT%H%M%SZ).log"
+mkdir -p evidence
 
 run_all() {
   echo "=== tpu session $(date -u +%FT%TZ) ==="
@@ -32,6 +34,10 @@ run_all() {
           || echo "FAILED rc=$? ($m $layout)"
       done
     done
+    echo "--- 4. placement A/B (measured vs simulated, EVIDENCE.md row)"
+    timeout 900 python tools/placement_ab.py \
+      | tee evidence/placement_ab_tpu_$(date -u +%Y%m%d).json.txt \
+      || echo "placement A/B FAILED rc=$?"
   fi
   echo "=== done $(date -u +%FT%TZ) ==="
 }
