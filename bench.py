@@ -141,12 +141,22 @@ def build(model: str, preset: str):
     layout = os.environ.get("BENCH_CONV_LAYOUT")
     if layout:
         cfg.conv_layout = layout
+
+    def _b(default):
+        # BENCH_BATCH: sweep knob for per-chip batch (MFU is
+        # batch-sensitive on conv models; tools/tpu_session.sh A/Bs it).
+        # Child-mode only — main() strips it in ladder mode so the
+        # preset fallback keeps reducing batch on OOM/timeouts.
+        v = os.environ.get("BENCH_BATCH")
+        return int(v) if v else default
+
     if model == "transformer":
         batch, seq, hidden, layers, ffd = {
             "full": (32, 512, 512, 6, 2048),
             "small": (16, 256, 512, 4, 2048),
             "tiny": (8, 64, 128, 2, 256),
         }[preset]
+        batch = _b(batch)
         cfg.batch_size = batch
         ff = zoo.build_transformer(cfg, batch_size=batch, seq_len=seq,
                                    hidden=hidden, num_heads=8,
@@ -156,7 +166,7 @@ def build(model: str, preset: str):
             rng.randn(batch, seq, hidden), jnp.bfloat16),
             "label": jnp.asarray(rng.randint(0, 10, (batch,)), jnp.int32)}
     elif model == "alexnet":
-        batch = {"full": 256, "small": 128, "tiny": 16}[preset]
+        batch = _b({"full": 256, "small": 128, "tiny": 16}[preset])
         cfg.batch_size = batch
         # bf16 activations (weights f32): MXU-native mixed precision,
         # same mode the transformer config benches in
@@ -165,7 +175,7 @@ def build(model: str, preset: str):
             rng.randn(batch, 3, 32, 32), jnp.bfloat16),
             "label": jnp.asarray(rng.randint(0, 10, (batch,)), jnp.int32)}
     elif model == "inception":
-        batch = {"full": 32, "small": 16, "tiny": 4}[preset]
+        batch = _b({"full": 32, "small": 16, "tiny": 4}[preset])
         size = {"full": 299, "small": 299, "tiny": 75}[preset]
         cfg.batch_size = batch
         ff = zoo.build_inception_v3(cfg, batch_size=batch, image_size=size,
@@ -179,7 +189,7 @@ def build(model: str, preset: str):
         # because DLRM is bandwidth/latency-bound, not FLOPs-bound — at
         # batch 1024 even a perfect step is <0.1ms of HBM traffic and
         # every framework measures overhead, not hardware
-        batch = {"full": 8192, "small": 2048, "tiny": 64}[preset]
+        batch = _b({"full": 8192, "small": 2048, "tiny": 64}[preset])
         vocab = {"full": 1000000, "small": 100000, "tiny": 1000}[preset]
         ntab = {"full": 26, "small": 26, "tiny": 8}[preset]
         cfg.batch_size = batch
@@ -199,6 +209,7 @@ def build(model: str, preset: str):
         # reference nmt trains large global batches across GPUs too)
         batch, seq = {"full": (256, 40), "small": (64, 40),
                       "tiny": (8, 10)}[preset]
+        batch = _b(batch)
         cfg.batch_size = batch
         ff = zoo.build_nmt_lstm(cfg, batch_size=batch, seq_len=seq,
                                 dtype=jnp.bfloat16)
@@ -536,6 +547,14 @@ def main():
 
     if args.child:
         return run_child(args.model, args.preset, args.steps)
+
+    # ladder mode owns the preset fallback: a pinned sweep batch would
+    # defeat the full->small->tiny degradation (every rung would OOM the
+    # same way), so the knob is honored only under --child
+    if "BENCH_BATCH" in os.environ:
+        log(f"ignoring BENCH_BATCH={os.environ['BENCH_BATCH']} in "
+            f"ladder mode (use --child for batch sweeps)")
+        del os.environ["BENCH_BATCH"]
 
     deadline_at = time.perf_counter() + args.deadline
     if args.all:

@@ -75,7 +75,16 @@ class LSTM(Op):
               .reshape(b, t, 4 * h) + bias)
         xg = jnp.swapaxes(xg, 0, 1)  # (T, B, 4H) for scan
 
-        if self.use_pallas is True:
+        use_pallas = self.use_pallas
+        if use_pallas is None:
+            # session-level A/B knob (tools/tpu_session.sh): flip the
+            # undecided default from the environment without editing
+            # model code; read at trace time, so a recompile picks up a
+            # change
+            import os
+            use_pallas = os.environ.get(
+                "FLEXFLOW_TPU_LSTM_PALLAS", "") == "1"
+        if use_pallas:
             from ..kernels.lstm_scan import lstm_sequence
             ys = lstm_sequence(xg.astype(x.dtype), wh.astype(x.dtype),
                                jnp.zeros((b, h), x.dtype),

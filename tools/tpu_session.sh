@@ -38,6 +38,24 @@ run_all() {
     timeout 900 python tools/placement_ab.py \
       | tee evidence/placement_ab_tpu_$(date -u +%Y%m%d).json.txt \
       || echo "placement A/B FAILED rc=$?"
+    echo "--- 5. LSTM Pallas kernel A/B (nmt_lstm; decides use_pallas default)"
+    for v in 0 1; do
+      echo "· FLEXFLOW_TPU_LSTM_PALLAS=$v"
+      FLEXFLOW_TPU_LSTM_PALLAS=$v timeout 600 python bench.py --child \
+        --model nmt_lstm --preset full --steps 30 | tail -1 \
+        || echo "FAILED rc=$? (lstm pallas=$v)"
+    done
+    echo "--- 6. inception conv audit (layout A/B + tiling flags)"
+    timeout 1200 python tools/inception_audit.py \
+      | tee evidence/inception_audit_$(date -u +%Y%m%d).log \
+      || echo "inception audit FAILED rc=$?"
+    echo "--- 7. inception batch sweep (MFU is batch-sensitive on convs)"
+    for b in 48 64; do
+      echo "· inception batch=$b"
+      BENCH_BATCH=$b timeout 600 python bench.py --child \
+        --model inception --preset full --steps 30 | tail -1 \
+        || echo "FAILED rc=$? (inception batch=$b)"
+    done
   fi
   echo "=== done $(date -u +%FT%TZ) ==="
 }
