@@ -148,7 +148,15 @@ def build(model: str, preset: str):
         # Child-mode only — main() strips it in ladder mode so the
         # preset fallback keeps reducing batch on OOM/timeouts.
         v = os.environ.get("BENCH_BATCH")
-        return int(v) if v else default
+        if not v:
+            return default
+        try:
+            b = int(v)
+        except ValueError:
+            raise SystemExit(f"BENCH_BATCH={v!r} is not an integer")
+        if b <= 0:
+            raise SystemExit(f"BENCH_BATCH must be positive, got {b}")
+        return b
 
     if model == "transformer":
         batch, seq, hidden, layers, ffd = {

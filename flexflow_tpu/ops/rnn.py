@@ -79,8 +79,10 @@ class LSTM(Op):
         if use_pallas is None:
             # session-level A/B knob (tools/tpu_session.sh): flip the
             # undecided default from the environment without editing
-            # model code; read at trace time, so a recompile picks up a
-            # change
+            # model code. Read at TRACE time and baked into the compiled
+            # step — an already-compiled model will NOT pick up a later
+            # env change (jit cache keys don't include env); run each
+            # A/B arm in its own process, as the session script does.
             import os
             use_pallas = os.environ.get(
                 "FLEXFLOW_TPU_LSTM_PALLAS", "") == "1"

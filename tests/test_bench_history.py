@@ -1,0 +1,98 @@
+"""bench.py history/fallback logic (VERDICT r2 weak #1): the driver
+artifact must never lose committed TPU measurements to a dead tunnel.
+Pure-host tests — no backend, no subprocess ladder."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture()
+def bench(tmp_path, monkeypatch):
+    """Import bench.py fresh with bench_all.json redirected to a temp
+    copy (so merge tests can write without touching the repo)."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    committed = {
+        "transformer": {"metric": "transformer_x", "value": 964.87,
+                        "unit": "samples/s", "vs_baseline": 1.10,
+                        "extra": {"platform": "tpu", "mfu": 0.33,
+                                  "captured": "2026-07-29T20:43:26Z"}},
+        "dlrm": {"metric": "dlrm_x", "value": 100.0, "unit": "samples/s",
+                 "vs_baseline": 0.5,
+                 "extra": {"platform": "cpu"}},  # non-TPU: no history
+    }
+    p = tmp_path / "bench_all.json"
+    p.write_text(json.dumps(committed))
+    mod._bench_all_path = lambda: str(p)
+    return mod
+
+
+def fresh_tpu(v=2000.0):
+    return {"metric": "m", "value": v, "unit": "samples/s",
+            "vs_baseline": 2.0, "extra": {"platform": "tpu",
+                                          "captured": "now"}}
+
+
+def fresh_cpu():
+    return {"metric": "m_cpu_fallback", "value": 3.0, "unit": "samples/s",
+            "vs_baseline": 0.01,
+            "extra": {"platform": "cpu", "ms_per_step": 9.0,
+                      "captured": "now"}}
+
+
+def test_fresh_tpu_passes_through(bench):
+    res = fresh_tpu()
+    assert bench.finalize("transformer", res) is res
+
+
+def test_cpu_fallback_replaced_by_stale_history(bench):
+    out = bench.finalize("transformer", fresh_cpu())
+    assert out["value"] == 964.87
+    assert out["extra"]["stale"] is True
+    assert out["extra"]["captured"] == "2026-07-29T20:43:26Z"
+    assert out["extra"]["cpu_liveness"]["value"] == 3.0
+
+
+def test_total_failure_emits_history_with_null_liveness(bench):
+    out = bench.finalize("transformer", None)
+    assert out["value"] == 964.87
+    assert out["extra"]["cpu_liveness"] is None
+
+
+def test_no_tpu_history_keeps_cpu_fallback(bench):
+    res = fresh_cpu()
+    assert bench.finalize("dlrm", res) is res
+    assert bench.finalize("dlrm", None) is None
+
+
+def test_merge_never_overwrites_tpu_with_cpu(bench):
+    merged = bench.merge_bench_all(
+        {"transformer": fresh_cpu(), "dlrm": fresh_cpu()})
+    # committed TPU entry survives, stale-marked, liveness attached
+    assert merged["transformer"]["value"] == 964.87
+    assert merged["transformer"]["extra"]["stale"] is True
+    # no TPU history for dlrm: the fresh CPU number lands as-is
+    assert merged["dlrm"]["value"] == 3.0
+    on_disk = json.loads(open(bench._bench_all_path()).read())
+    assert on_disk["transformer"]["value"] == 964.87
+
+
+def test_merge_fresh_tpu_overwrites(bench):
+    merged = bench.merge_bench_all({"transformer": fresh_tpu(2000.0)})
+    assert merged["transformer"]["value"] == 2000.0
+    assert "stale" not in merged["transformer"]["extra"]
+
+
+def test_history_untouched_by_finalize_mutation(bench):
+    """finalize must deep-enough-copy: mutating its return value cannot
+    corrupt the cached committed entry the next caller reads."""
+    out = bench.finalize("transformer", None)
+    out["extra"]["cpu_liveness"] = {"value": 123}
+    out2 = bench.finalize("transformer", fresh_cpu())
+    assert out2["extra"]["cpu_liveness"]["value"] == 3.0
