@@ -95,3 +95,40 @@ def test_flash_unpadded_lanes_matches_xla(rng):
     ref = xla_attention(q, q, q, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_fused_qkv_under_remat_matches_no_remat():
+    """The fused self-attention QKV projection is decided at GRAPH level
+    (same tensor wired to q/k/v), so remat — which re-flattens the
+    duplicated runtime leaves into distinct tracers — must not change
+    the path or the numerics (review regression, r3)."""
+    import numpy as np
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+    def build(remat):
+        cfg = FFConfig()
+        cfg.batch_size = 4
+        cfg.remat = remat
+        ff = FFModel(cfg)
+        x = ff.create_tensor((4, 8, 32), name="input")
+        a = ff.multihead_attention(x, x, x, 32, 4, name="attn")
+        t = ff.add(a, x)
+        t = ff.reshape(t, (4, 8 * 32))
+        ff.softmax(ff.dense(t, 4))
+        ff.compile(optimizer=SGDOptimizer(lr=0.05),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=[])
+        return ff
+
+    ff1, ff2 = build(False), build(True)
+    attn = next(o for o in ff1.ops if o.op_type == "multihead_attention")
+    assert attn._fused_qkv
+    for name in ("attn", "dense"):
+        ff2.set_weights(name, ff1.get_weights(name))
+    rng = np.random.RandomState(0)
+    b = {"input": rng.randn(4, 8, 32).astype(np.float32),
+         "label": rng.randint(0, 4, 4).astype(np.int32)}
+    for _ in range(3):
+        l1 = float(ff1.train_batch(b)["loss"])
+        l2 = float(ff2.train_batch(b)["loss"])
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)

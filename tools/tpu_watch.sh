@@ -1,0 +1,23 @@
+#!/bin/bash
+# Detached TPU-uptime watcher: probe every 10 min; on the first
+# successful probe, run the full on-chip session (tools/tpu_session.sh)
+# and exit. Transcript: evidence/ (session) + .scratch/tpu_watch.log
+# (probe loop). Start with:
+#   nohup setsid bash tools/tpu_watch.sh > .scratch/tpu_watch.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p .scratch
+for i in $(seq 1 72); do  # up to 12h
+  echo "[watch $(date -u +%FT%TZ)] probe $i"
+  if timeout 90 env JAX_PLATFORMS=tpu python -c \
+      "import jax; d=jax.devices()[0]; assert d.platform=='tpu'; print('TPU', d.device_kind)"; then
+    echo "[watch $(date -u +%FT%TZ)] TPU UP — running full session"
+    bash tools/tpu_session.sh
+    echo "[watch $(date -u +%FT%TZ)] session done rc=$?"
+    touch .scratch/tpu_session_complete
+    exit 0
+  fi
+  sleep 600
+done
+echo "[watch $(date -u +%FT%TZ)] gave up after 12h"
+exit 1
