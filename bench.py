@@ -202,8 +202,15 @@ def build(model: str, preset: str):
         ntab = {"full": 26, "small": 26, "tiny": 8}[preset]
         cfg.batch_size = batch
         vocabs = (vocab,) * ntab
+        # BENCH_DLRM_STACKED=1: ONE vmapped gather over a (T, vocab,
+        # dim) kernel instead of 26 separate gathers — the executable
+        # placement form. Default stays the separate-table layout the
+        # committed sweep measured (CPU-tiny A/B favored separate;
+        # tools/tpu_session.sh decides at bench scale on chip).
+        stacked = os.environ.get("BENCH_DLRM_STACKED", "0") == "1"
         ff = zoo.build_dlrm(cfg, batch_size=batch,
-                            embedding_vocab_sizes=vocabs)
+                            embedding_vocab_sizes=vocabs,
+                            stacked_tables=stacked)
         data = {"dense_features": jnp.asarray(
             rng.randn(batch, 13), jnp.float32),
             "label": jnp.asarray(

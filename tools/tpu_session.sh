@@ -56,6 +56,13 @@ run_all() {
         --model inception --preset full --steps 30 | tail -1 \
         || echo "FAILED rc=$? (inception batch=$b)"
     done
+    echo "--- 8. DLRM stacked-vs-separate tables A/B"
+    for v in 0 1; do
+      echo "· BENCH_DLRM_STACKED=$v"
+      BENCH_DLRM_STACKED=$v timeout 600 python bench.py --child \
+        --model dlrm --preset full --steps 30 | tail -1 \
+        || echo "FAILED rc=$? (dlrm stacked=$v)"
+    done
   fi
   echo "=== done $(date -u +%FT%TZ) ==="
 }
