@@ -52,7 +52,13 @@ def save_checkpoint(path: str, state: TrainState,
 
 
 def restore_checkpoint(path: str, state: TrainState) -> TrainState:
-    """Restore into the structure (and shardings) of `state`."""
+    """Restore into the structure (and shardings) of `state`.
+
+    An INFERENCE-compiled model (opt_state == {}) restores a TRAINING
+    checkpoint by reading params/states/step only — the on-disk
+    optimizer slots are skipped, not structure-mismatched, so the
+    train -> checkpoint -> serve flow works (reference COMP_MODE
+    semantics; its nearest artifact was host weight import)."""
     import orbax.checkpoint as ocp
     ckptr = _checkpointer(False)
     target = {
@@ -61,9 +67,21 @@ def restore_checkpoint(path: str, state: TrainState) -> TrainState:
         "opt_state": state.opt_state,
         "step": state.step,
     }
-    restored = ckptr.restore(
-        os.path.abspath(path),
-        args=ocp.args.StandardRestore(target))
+    if not state.opt_state:
+        partial = {k: v for k, v in target.items() if k != "opt_state"}
+        # the PyTree handler reads the Standard layout and supports
+        # partial restore (skip the on-disk optimizer slots entirely)
+        pt = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+        restored = pt.restore(
+            os.path.abspath(path),
+            args=ocp.args.PyTreeRestore(item=partial,
+                                        partial_restore=True))
+        pt.close()
+        restored["opt_state"] = {}
+    else:
+        restored = ckptr.restore(
+            os.path.abspath(path),
+            args=ocp.args.StandardRestore(target))
     ckptr.close()
     return TrainState(restored["params"], restored["states"],
                       restored["opt_state"], restored["step"])

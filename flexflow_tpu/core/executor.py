@@ -63,10 +63,20 @@ jax.tree_util.register_pytree_node(
 class Executor:
     def __init__(self, model, optimizer: Optimizer, loss_fn, metric_names,
                  mesh: Optional[Mesh] = None,
-                 strategy: Optional[Strategy] = None):
+                 strategy: Optional[Strategy] = None,
+                 comp_mode: str = "training"):
         self.model = model
         self.config = model.config
         self.optimizer = optimizer
+        # reference COMP_MODE_INFERENCE (ffconst.h): no optimizer state
+        # is allocated and the train steps refuse to build — forward/
+        # evaluate only, at half the parameter memory of a training
+        # compile (no momentum/m/v slots)
+        if comp_mode not in ("training", "inference"):
+            raise ValueError(
+                f"comp_mode must be CompMode.TRAINING ('training') or "
+                f"CompMode.INFERENCE ('inference'), got {comp_mode!r}")
+        self.comp_mode = comp_mode
         self.loss_fn = L.resolve(loss_fn) if loss_fn is not None else None
         self.loss_name = loss_fn if isinstance(loss_fn, str) else "custom"
         self.metric_names = list(metric_names or [])
@@ -139,7 +149,9 @@ class Executor:
                             arr, NamedSharding(self.mesh, P()))
                     op_states[sname] = arr
                 states[op.name] = op_states
-        opt_state = self.optimizer.init_state(params) if self.optimizer else {}
+        opt_state = (self.optimizer.init_state(params)
+                     if self.optimizer and self.comp_mode != "inference"
+                     else {})
         step = jnp.zeros((), jnp.int32)
         return TrainState(params, states, opt_state, step)
 
@@ -484,8 +496,16 @@ class Executor:
 
         return jax.jit(eval_multi)
 
+    def _require_training(self):
+        if self.comp_mode == "inference":
+            raise RuntimeError(
+                "model was compiled with comp_mode=INFERENCE (no "
+                "optimizer state); recompile with comp_mode=TRAINING "
+                "to train")
+
     @property
     def train_step(self):
+        self._require_training()
         # consult the sparse routing FIRST: a post-build change to the
         # sparse flags/optimizer invalidates the cached compiled step
         # (see _sparse_table_ops), so the rebuild happens on dispatch
@@ -496,6 +516,7 @@ class Executor:
 
     @property
     def train_step_multi(self):
+        self._require_training()
         self._sparse_table_ops()
         if self._train_step_multi is None:
             self._train_step_multi = self.build_train_step_multi()
@@ -503,6 +524,7 @@ class Executor:
 
     @property
     def train_step_accum(self):
+        self._require_training()
         self._sparse_table_ops()
         if self._train_step_accum is None:
             self._train_step_accum = self.build_train_step_accum()

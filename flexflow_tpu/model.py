@@ -395,8 +395,10 @@ class FFModel:
                     f"— use distributed_embedding per-table placement "
                     f"for an executable equivalent")
 
+        self.comp_mode = comp_mode
         self.executor = Executor(self, optimizer, loss_type, metrics,
-                                 mesh=self.mesh, strategy=self.strategy)
+                                 mesh=self.mesh, strategy=self.strategy,
+                                 comp_mode=comp_mode)
         self.state = self.executor.init_state(self._next_rng())
         self._host_step = 0  # mirrors state.step for the train rng
         for op_name, ws in self.imported_weights.items():

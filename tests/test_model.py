@@ -223,3 +223,71 @@ def test_evaluate_steps_per_dispatch_matches():
     b = ff.evaluate({"input": x}, y, steps_per_dispatch=3)  # ragged tail
     np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-6)
     np.testing.assert_allclose(a["accuracy"], b["accuracy"], rtol=1e-6)
+
+
+def test_comp_mode_inference():
+    """compile(comp_mode=INFERENCE): no optimizer slots are allocated
+    (reference COMP_MODE_INFERENCE, ffconst.h), forward/evaluate work,
+    and training fails with a clear error instead of a silent step."""
+    from flexflow_tpu.config import CompMode
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 16), name="input")
+    t = ff.dense(x, 32, activation="relu")
+    ff.softmax(ff.dense(t, 4))
+    ff.compile(optimizer=AdamOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"], comp_mode=CompMode.INFERENCE)
+    assert ff.state.opt_state == {}  # no m/v slots
+    rng = np.random.RandomState(0)
+    b = {"input": rng.randn(8, 16).astype(np.float32),
+         "label": rng.randint(0, 4, 8).astype(np.int32)}
+    logits = ff.forward(b)
+    assert logits.shape == (8, 4)
+    m = ff.evaluate({"input": b["input"]}, b["label"])
+    assert "loss" in m
+    with pytest.raises(RuntimeError, match="INFERENCE"):
+        ff.train_batch(b)
+    # training compile of the same graph allocates the slots
+    ff.compile(optimizer=AdamOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    assert ff.state.opt_state
+    assert np.isfinite(float(ff.train_batch(b)["loss"]))
+    # typos must fail loudly, not silently compile for training
+    with pytest.raises(ValueError, match="comp_mode"):
+        ff.compile(optimizer=AdamOptimizer(lr=0.01),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=[], comp_mode="Inference")
+
+
+def test_inference_restores_training_checkpoint(tmp_path):
+    """train -> checkpoint -> inference-compile -> restore: the on-disk
+    optimizer slots are skipped (not structure-mismatched) and the
+    restored forward matches the training model's."""
+    from flexflow_tpu.config import CompMode
+    from flexflow_tpu.core.checkpoint import restore_model, save_model
+
+    def build(mode):
+        cfg = FFConfig()
+        cfg.batch_size = 8
+        ff = FFModel(cfg)
+        x = ff.create_tensor((8, 16), name="input")
+        ff.softmax(ff.dense(ff.dense(x, 32, activation="relu"), 4))
+        ff.compile(optimizer=AdamOptimizer(lr=0.01),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=[], comp_mode=mode)
+        return ff
+
+    rng = np.random.RandomState(0)
+    b = {"input": rng.randn(8, 16).astype(np.float32),
+         "label": rng.randint(0, 4, 8).astype(np.int32)}
+    ff = build(CompMode.TRAINING)
+    ff.train_batch(b)
+    save_model(ff, str(tmp_path / "ckpt"))
+    fi = build(CompMode.INFERENCE)
+    restore_model(fi, str(tmp_path / "ckpt"))
+    assert int(fi.state.step) == 1 and fi.state.opt_state == {}
+    np.testing.assert_allclose(np.asarray(fi.forward(b)),
+                               np.asarray(ff.forward(b)), rtol=1e-6)

@@ -199,3 +199,29 @@ def test_placed_strategy_file_roundtrip(tmp_path):
     mesh = make_mesh((2, 4), ("data", "model"))
     ff = build(mesh=mesh, strategy=loaded)
     assert float(ff.train_batch(batches(n=1)[0])["loss"]) > 0
+
+
+def test_placed_strategy_text_format_roundtrip(tmp_path):
+    """Reference text format (strategy.cc): a per-table placement
+    exports as a tpu_pin line with the literal id list and imports back
+    to an executable DEVICE_KEY strategy."""
+    from flexflow_tpu.parallel.strategy_io import (
+        load_strategies_from_file,
+        save_strategies_to_file,
+    )
+
+    ids = (3, 1, 4, 1, 5, 0, 2, 6)
+    mesh = make_mesh((8,), ("data",))
+    strat = Strategy(default=OpStrategy({"sample": "data"}))
+    strat.set("tables", OpStrategy({DEVICE_KEY: ids}))
+    ff = build(mesh=mesh, strategy=strat)
+    p = str(tmp_path / "strategy.txt")
+    save_strategies_to_file(ff, strat, mesh, p)
+    text = open(p).read()
+    assert "tpu_pin" in text and "3 1 4 1 5 0 2 6" in text
+    loaded = load_strategies_from_file(ff, mesh, p)
+    assert loaded.for_op("tables").device_ids == ids
+    ff2 = build(mesh=mesh, strategy=loaded)
+    op = next(o for o in ff2.ops if o.op_type == "distributed_embedding")
+    assert op.placement == ids
+    assert np.isfinite(float(ff2.train_batch(batches(n=1)[0])["loss"]))

@@ -8,6 +8,11 @@ cd "$(dirname "$0")/.."
 # a committed artifact, not scratch
 LOG="evidence/tpu_session_$(date -u +%Y%m%dT%H%M%SZ).log"
 mkdir -p evidence
+# persistent XLA compile cache: first compiles through the tunnel are
+# 20-40s each; re-runs of the same configs (A/B arms, repeat sessions)
+# hit the cache instead
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-.scratch/xla_cache}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 run_all() {
   echo "=== tpu session $(date -u +%FT%TZ) ==="
