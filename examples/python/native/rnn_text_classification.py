@@ -1,0 +1,40 @@
+"""RNN text classification (RNNTC) — one of the MLSys'19 paper's
+benchmark workloads (BASELINE.md speedup table: "RNNTC, RNNLM, NMT")
+that has no reference example script. Embedding -> stacked LSTM (last
+hidden state) -> dense classifier, on synthetic token sequences.
+
+  python examples/python/native/rnn_text_classification.py -b 32 -e 1
+"""
+
+import numpy as np
+
+from flexflow_tpu import AdamOptimizer, FFConfig, FFModel
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    vocab, seq_len, classes = 2000, 32, 4
+    bs = cfg.batch_size
+
+    ff = FFModel(cfg)
+    tokens = ff.create_tensor((bs, seq_len), dtype=np.int32, name="input")
+    t = ff.embedding(tokens, vocab, 128, aggr="none", name="embed")
+    t = ff.lstm(t, 128, return_sequences=True, name="lstm_0")
+    t = ff.lstm(t, 128, return_sequences=False, name="lstm_1")
+    t = ff.dense(t, 64, activation="relu", name="fc")
+    logits = ff.dense(t, classes, name="classifier")
+    ff.softmax(logits)
+    ff.compile(optimizer=AdamOptimizer(lr=cfg.learning_rate),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(cfg.seed)
+    n = 4 * bs
+    x = rng.randint(0, vocab, (n, seq_len)).astype(np.int32)
+    # separable synthetic labels: class = leading token bucket
+    y = (x[:, 0] * classes // vocab).astype(np.int32)
+    ff.fit({"input": x}, y, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -44,6 +44,8 @@ def run_example(script, *args, cpu_devices=2, timeout=240):
      ["-b", "8", "-e", "1"]),
     ("examples/python/native/bert_proxy_native.py", ["-b", "8", "-e", "1"]),
     ("examples/python/native/nmt_seq2seq.py", ["-b", "8", "-e", "1"]),
+    ("examples/python/native/rnn_text_classification.py",
+     ["-b", "8", "-e", "1"]),
     ("examples/python/native/cifar10_cnn_concat.py",
      ["-b", "8", "--samples", "32", "-e", "1"]),
 ])

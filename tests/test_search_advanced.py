@@ -220,11 +220,16 @@ def test_placed_strategy_roundtrips_via_reference_text(tmp_path):
                 s.for_op(op.name).device_ids, op.name
 
 
-def test_native_engine_parity_with_placement_candidates():
-    """The native engine mirrors the Python simulator task-for-task,
-    including per-device resources for placed candidates — random
-    assignments over the DLRM placement space must cost identically in
-    both engines (csrc/mcmc.cc simulate_assignment)."""
+def run_native_parity(ff, mesh, seed, rounds=6, require=None):
+    """Shared native-vs-Python engine parity harness: lower the
+    candidate space, draw `rounds` random assignments, assert identical
+    simulated cost through both engines.
+
+    `require=(predicate, label)`: at least one draw per run MUST
+    exercise a candidate matching the predicate — the matching index is
+    FORCED into every draw for some op that has one, so enumeration
+    reorders or RNG-consumption changes can never silently void the
+    coverage the test exists for."""
     from flexflow_tpu import native
     if not native.available():
         pytest.skip("native library unavailable")
@@ -232,18 +237,27 @@ def test_native_engine_parity_with_placement_candidates():
     from flexflow_tpu.search.mcmc import candidate_maps
     from flexflow_tpu.search.native_search import lower_to_arrays
 
-    ff = build_dlrm_for_search()
-    mesh = make_mesh((1, 8), ("data", "model"))
     sim = Simulator(ff, mesh)
     cands = {op.name: candidate_maps(op, mesh, ff.config, op_index=i)
              for i, op in enumerate(ff.ops)}
     table, edges, _, _, cand_lists = lower_to_arrays(
         ff, sim, cands, Strategy())
 
-    import numpy as np
-    rng = np.random.RandomState(7)
-    for _ in range(6):
-        assign = [rng.randint(len(l)) for l in cand_lists]
+    forced = None  # (op_index, [matching candidate indices])
+    if require is not None:
+        pred, label = require
+        for oi, lst in enumerate(cand_lists):
+            matches = [j for j, m in enumerate(lst) if pred(m)]
+            if matches:
+                forced = (oi, matches)
+                break
+        assert forced is not None, f"no candidate matches {label!r}"
+
+    rng = np.random.RandomState(seed)
+    for r in range(rounds):
+        assign = [rng.randint(len(lst)) for lst in cand_lists]
+        if forced is not None:
+            assign[forced[0]] = forced[1][r % len(forced[1])]
         strat = Strategy()
         for i, op in enumerate(ff.ops):
             strat.set(op.name, OpStrategy(dict(cand_lists[i][assign[i]])))
@@ -255,41 +269,25 @@ def test_native_engine_parity_with_placement_candidates():
         assert got == pytest.approx(want, rel=1e-9), assign
 
 
+def test_native_engine_parity_with_placement_candidates():
+    """The native engine mirrors the Python simulator task-for-task,
+    including per-device resources for placed candidates — random
+    assignments over the DLRM placement space must cost identically in
+    both engines (csrc/mcmc.cc simulate_assignment)."""
+    ff = build_dlrm_for_search()
+    mesh = make_mesh((1, 8), ("data", "model"))
+    run_native_parity(ff, mesh, seed=7,
+                      require=(lambda m: DEVICE_KEY in m, "placed"))
+
+
 def test_native_engine_parity_with_pipeline_expansion():
     """GPipe event-loop expansion parity: pipelined candidates must cost
     identically through the native and Python engines."""
-    from flexflow_tpu import native
-    if not native.available():
-        pytest.skip("native library unavailable")
-    from flexflow_tpu.native.wrappers import simulate_assignment
-    from flexflow_tpu.search.mcmc import candidate_maps
-    from flexflow_tpu.search.native_search import lower_to_arrays
-
     ff = build_pipe_model(num_layers=4, num_microbatches=4)
     mesh = make_mesh((2, 4), ("data", "pipe"))
-    sim = Simulator(ff, mesh)
-    cands = {op.name: candidate_maps(op, mesh, ff.config, op_index=i)
-             for i, op in enumerate(ff.ops)}
-    table, edges, _, _, cand_lists = lower_to_arrays(
-        ff, sim, cands, Strategy())
-
-    import numpy as np
-    rng = np.random.RandomState(3)
-    tried_pipe = False
-    for _ in range(8):
-        assign = [rng.randint(len(l)) for l in cand_lists]
-        strat = Strategy()
-        for i, op in enumerate(ff.ops):
-            m = dict(cand_lists[i][assign[i]])
-            tried_pipe = tried_pipe or m.get("layer") == "pipe"
-            strat.set(op.name, OpStrategy(m))
-        want = sim.simulate(strat)
-        got = simulate_assignment(table, edges, assign, sim.overlap,
-                                  sim.mm.spec.hbm_capacity,
-                                  sim.time_scale,
-                                  step_overhead=sim.step_overhead)
-        assert got == pytest.approx(want, rel=1e-9), assign
-    assert tried_pipe  # the space actually contained pipelined candidates
+    run_native_parity(ff, mesh, seed=3, rounds=8,
+                      require=(lambda m: m.get("layer") == "pipe",
+                               "pipelined"))
 
 
 # ----------------------------------------------------------- degree search
@@ -385,3 +383,23 @@ def test_measure_conv_efficiency_smoke():
     mm = default_machine_model(None)
     eff = measure.measure_conv_efficiency(mm, repeats=1)
     assert 0.0 < eff <= 1.0
+
+
+def test_native_engine_parity_with_per_table_placement():
+    """Per-TABLE device-id tuples (the executable DLRM placement form,
+    r3) must cost identically through the native and Python engines —
+    the tuple length (num_tables) differs from whole-op pins and from
+    n_devices, exercising the native placement arrays' general case."""
+    cfg = FFConfig()
+    cfg.batch_size = 1024
+    cfg.enable_parameter_parallel = True
+    cfg.enable_device_placement = True
+    cfg.sparse_embedding_updates = False
+    ff = build_dlrm(cfg, batch_size=1024,
+                    embedding_vocab_sizes=(100_000,) * 8,
+                    stacked_tables=True)
+    mesh = make_mesh((1, 8), ("data", "model"))
+    run_native_parity(
+        ff, mesh, seed=11,
+        require=(lambda m: DEVICE_KEY in m and len(m[DEVICE_KEY]) == 8,
+                 "per-table placement"))

@@ -21,13 +21,16 @@ run_all() {
       return 1
   fi
 
-  echo "--- 1. on-chip test suite (tests_tpu/)"
-  timeout 1800 python -m pytest tests_tpu/ -q 2>&1 | tail -5 \
-      || echo "tests_tpu FAILED rc=$?"
-
-  echo "--- 2. full bench sweep -> bench_all.json"
+  # bench sweep FIRST: if the tunnel window is short, the round's
+  # headline artifact (bench_all.json refresh, VERDICT #1) must land
+  # before anything else
+  echo "--- 1. full bench sweep -> bench_all.json"
   BENCH_DEADLINE_S=2400 timeout 2600 python bench.py --all --steps 50 \
       || echo "bench sweep FAILED rc=$?"
+
+  echo "--- 2. on-chip test suite (tests_tpu/)"
+  timeout 1800 python -m pytest tests_tpu/ -q 2>&1 | tail -5 \
+      || echo "tests_tpu FAILED rc=$?"
 
   if [ "${1:-}" != "quick" ]; then
     echo "--- 3. conv layout A/B (inception + alexnet)"
