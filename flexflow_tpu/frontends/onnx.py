@@ -98,7 +98,8 @@ def export_torch_onnx(module, args, path, **kw) -> None:
 
 class ONNXModel:
     def __init__(self, path_or_model):
-        self.graph_inputs = []  # [(name, shape)] for non-initializer inputs
+        # [(name, shape, np dtype)] for non-initializer graph inputs
+        self.graph_inputs = []
         if HAS_ONNX and not isinstance(path_or_model, (str, bytes)):
             model = path_or_model  # an onnx.ModelProto object
         elif HAS_ONNX:
@@ -114,19 +115,24 @@ class ONNXModel:
             self.nodes = [GraphNode(n["op_type"], n["input"], n["output"],
                                     n["name"], n["attrs"])
                           for n in g["nodes"]]
-            self.graph_inputs = [(vi["name"], vi["shape"])
-                                 for vi in g["inputs"]
-                                 if vi["name"] not in self.inits]
+            from .onnx_wire import TENSOR_DTYPES
+            self.graph_inputs = [
+                (vi["name"], vi["shape"],
+                 np.dtype(TENSOR_DTYPES.get(vi["elem_type"], np.float32)))
+                for vi in g["inputs"] if vi["name"] not in self.inits]
             return
         self.inits = {t.name: numpy_helper.to_array(t)
                       for t in model.graph.initializer}
         self.nodes = [GraphNode(n.op_type, list(n.input), list(n.output),
                                 n.name, _proto_attrs(n))
                       for n in model.graph.node]
+        from .onnx_wire import TENSOR_DTYPES
         self.graph_inputs = [
             (vi.name,
              [d.dim_value or d.dim_param
-              for d in vi.type.tensor_type.shape.dim])
+              for d in vi.type.tensor_type.shape.dim],
+             np.dtype(TENSOR_DTYPES.get(
+                 vi.type.tensor_type.elem_type, np.float32)))
             for vi in model.graph.input if vi.name not in self.inits]
 
     @classmethod
@@ -142,11 +148,13 @@ class ONNXModel:
     def make_input_tensors(self, ffmodel, batch_size: int = None,
                            dtype=None) -> Dict[str, "Tensor"]:
         """Create framework input tensors from the graph's declared
-        (non-initializer) inputs — the dict `apply` consumes. Dim 0 is
-        replaced by `batch_size` when given; symbolic dims elsewhere
-        fail loudly (provide tensors by hand for dynamic graphs)."""
+        (non-initializer) inputs — the dict `apply` consumes, with each
+        input's ONNX elem_type as its dtype (int64 ids build int
+        tensors, not f32). Dim 0 is replaced by `batch_size` when
+        given; symbolic dims elsewhere fail loudly (provide tensors by
+        hand for dynamic graphs). `dtype` overrides every input."""
         out = {}
-        for name, shape in self.graph_inputs:
+        for name, shape, in_dtype in self.graph_inputs:
             shape = list(shape)
             if batch_size is not None and shape:
                 shape[0] = batch_size
@@ -154,9 +162,17 @@ class ONNXModel:
                 raise ValueError(
                     f"graph input {name!r} has non-static shape {shape}; "
                     f"pass an explicit tensor to apply() instead")
-            kw = {} if dtype is None else {"dtype": dtype}
-            out[name] = ffmodel.create_tensor(tuple(shape), name=name,
-                                              **kw)
+            in_dtype = np.dtype(in_dtype)
+            # JAX (x64 disabled) holds 32-bit ints/floats; declare the
+            # dtype arrays will ACTUALLY have instead of letting the
+            # backend truncate with a warning (ids are int32 on device —
+            # embedding forward casts anyway)
+            narrow = {np.dtype(np.int64): np.dtype(np.int32),
+                      np.dtype(np.uint64): np.dtype(np.uint32),
+                      np.dtype(np.float64): np.dtype(np.float32)}
+            in_dtype = narrow.get(in_dtype, in_dtype)
+            out[name] = ffmodel.create_tensor(
+                tuple(shape), name=name, dtype=dtype or in_dtype)
         return out
 
     def apply(self, ffmodel, input_dict: Dict[str, "Tensor"]):
@@ -280,6 +296,29 @@ class ONNXModel:
                         "Div": "divide"}[node.op_type]
                 t = getattr(ffmodel, mode)(values[ins[0]], values[ins[1]],
                                            name=name)
+            elif node.op_type == "Gather":
+                # torch exports nn.Embedding as Gather(table, ids) on
+                # axis 0 — lower to the embedding op (aggr="none")
+                w = self.inits.get(ins[0])
+                if w is None or a.get("axis", 0) != 0 or w.ndim != 2:
+                    raise NotImplementedError(
+                        f"Gather node {name}: only axis-0 gathers from a "
+                        f"2-D initializer (embedding tables) are "
+                        f"supported")
+                t = ffmodel.embedding(values[ins[1]], w.shape[0],
+                                      w.shape[1], aggr="none", name=name)
+                pending_weights[name] = {"kernel": w}
+            elif node.op_type == "ReduceMean":
+                axes = a.get("axes")
+                if axes is None and len(ins) > 1:  # opset>=18: input 1
+                    axes = self.inits[ins[1]].tolist()
+                if axes is None or len(list(np.ravel(axes))) != 1:
+                    raise NotImplementedError(
+                        f"ReduceMean node {name}: exactly one axis is "
+                        f"supported, got {axes}")
+                t = ffmodel.reduce_mean(
+                    values[ins[0]], axis=int(np.ravel(axes)[0]),
+                    keepdims=bool(a.get("keepdims", 1)), name=name)
             elif node.op_type == "Constant":
                 # fold into the initializer map: downstream handlers
                 # (Reshape shape, Split sizes) read constants from there

@@ -40,6 +40,7 @@ from .ops import (
     Linear,
     MultiHeadAttention,
     Pool2D,
+    Reduce,
     Reshape,
     Reverse,
     Softmax,
@@ -157,6 +158,24 @@ class FFModel:
         from .ops import LayerNorm
         op = LayerNorm(self, name or self._fresh_name("layer_norm"),
                        [input], eps, elementwise_affine)
+        return self.add_op(op).output
+
+    def reduce_mean(self, input: Tensor, axis: int, keepdims: bool = False,
+                    name: Optional[str] = None) -> Tensor:
+        op = Reduce(self, name or self._fresh_name("reduce_mean"),
+                    [input], "mean", axis, keepdims)
+        return self.add_op(op).output
+
+    def reduce_sum(self, input: Tensor, axis: int, keepdims: bool = False,
+                   name: Optional[str] = None) -> Tensor:
+        op = Reduce(self, name or self._fresh_name("reduce_sum"),
+                    [input], "sum", axis, keepdims)
+        return self.add_op(op).output
+
+    def reduce_max(self, input: Tensor, axis: int, keepdims: bool = False,
+                   name: Optional[str] = None) -> Tensor:
+        op = Reduce(self, name or self._fresh_name("reduce_max"),
+                    [input], "max", axis, keepdims)
         return self.add_op(op).output
 
     def batch_matmul(self, a: Tensor, b: Tensor,

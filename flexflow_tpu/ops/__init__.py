@@ -7,7 +7,7 @@ kernels under flexflow_tpu/kernels/.
 
 from .linear import Linear
 from .conv import Conv2D, Pool2D, BatchNorm, Flat
-from .elementwise import ElementUnary, ElementBinary, Dropout, LayerNorm, Softmax
+from .elementwise import ElementUnary, ElementBinary, Dropout, LayerNorm, Reduce, Softmax
 from .tensor_ops import (
     Concat,
     Split,
@@ -32,6 +32,7 @@ __all__ = [
     "Flat",
     "ElementUnary",
     "ElementBinary",
+    "Reduce",
     "Dropout",
     "Softmax",
     "LayerNorm",

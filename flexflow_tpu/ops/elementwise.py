@@ -90,6 +90,58 @@ class ElementUnary(PassthroughAxesMixin, Op):
 
 
 @register_op
+class Reduce(Op):
+    """Axis reduction (mean/sum/max). No single reference analog — the
+    reference reaches reductions through pooling/softmax kernels; this
+    is the generic form frontends need (ONNX ReduceMean/Sum/Max, torch
+    .mean(dim)); lowers to one jnp reduction."""
+
+    op_type = "reduce"
+    _FNS = {"mean": jnp.mean, "sum": jnp.sum, "max": jnp.max}
+
+    def __init__(self, model, name, inputs, mode: str, axis: int,
+                 keepdims: bool = False):
+        super().__init__(model, name, inputs)
+        assert mode in self._FNS, f"unknown reduce mode {mode}"
+        rank = len(inputs[0].shape)
+        axis = axis if axis >= 0 else axis + rank
+        assert 0 < axis < rank, (
+            f"reduce axis {axis} out of range (the sample dim 0 cannot "
+            f"be reduced)")
+        self.mode = mode
+        self.axis = axis
+        self.keepdims = bool(keepdims)
+        self.attrs = {"mode": mode, "axis": axis, "keepdims": keepdims}
+
+    def output_shapes(self):
+        s = list(self.inputs[0].shape)
+        if self.keepdims:
+            s[self.axis] = 1
+        else:
+            s.pop(self.axis)
+        return [tuple(s)]
+
+    def forward(self, params, xs, ctx: OpContext):
+        (x,) = xs
+        return [self._FNS[self.mode](x, axis=self.axis,
+                                     keepdims=self.keepdims)]
+
+    def output_axes(self):
+        in_axes = list(_passthrough_axes(self.inputs[0].shape)[0])
+        if self.keepdims:
+            in_axes[self.axis] = None
+        else:
+            in_axes.pop(self.axis)
+        return [tuple(in_axes)]
+
+    def input_axes(self):
+        return [_passthrough_axes(self.inputs[0].shape)[0]]
+
+    def flops(self) -> float:
+        return float(self.inputs[0].num_elements)
+
+
+@register_op
 class ElementBinary(PassthroughAxesMixin, Op):
     op_type = "element_binary"
 

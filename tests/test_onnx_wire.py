@@ -214,3 +214,41 @@ def test_float_data_and_f16_int32_data_fields():
 def test_malformed_input_fails_loudly():
     with pytest.raises(ValueError):
         load_model(b"\x00\x01not a protobuf .onnx file\xff\xff")
+
+
+def test_make_input_tensors_carries_dtype(tmp_path):
+    """Graph inputs build with their declared ONNX elem_type: int64
+    token ids must not silently become f32 tensors."""
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(100, 16)
+            self.fc = nn.Linear(16, 4)
+
+        def forward(self, ids):
+            return self.fc(self.emb(ids).mean(dim=1))
+
+    torch.manual_seed(0)
+    m = M()
+    m.eval()
+    p = export(tmp_path, m, torch.randint(0, 100, (4, 7)))
+    om = ONNXModel(p)
+    assert len(om.graph_inputs) == 1
+    name, shape, dtype = om.graph_inputs[0]
+    assert shape == [4, 7] and np.dtype(dtype) == np.int64
+    cfg = FFConfig()
+    cfg.batch_size = 4
+    ff = FFModel(cfg)
+    tensors = om.make_input_tensors(ff)
+    # declared int64 narrows to the dtype device arrays actually have
+    assert np.dtype(tensors[name].dtype) == np.int32
+    # ...and the whole embedding graph imports (Gather -> embedding,
+    # ReduceMean -> reduce op) matching the torch forward exactly
+    out = om.apply(ff, tensors)
+    assert tuple(out.shape) == (4, 4)
+    ff.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
+    ids = np.random.RandomState(0).randint(0, 100, (4, 7)).astype(np.int64)
+    with torch.no_grad():
+        want = m(torch.from_numpy(ids)).numpy()
+    got = np.asarray(ff.forward({name: ids}))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -284,3 +284,41 @@ def test_dropout_train_eval(rng):
     assert 0.3 < frac < 0.7
     kept = y_train[y_train != 0]
     np.testing.assert_allclose(kept, 2.0, atol=1e-6)
+
+
+def test_reduce_op_modes():
+    """Generic axis reduction (ONNX ReduceMean/Sum/Max lowering)."""
+    import jax.numpy as jnp
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 5, 6).astype(np.float32)
+    for mode, ref in (("mean", x.mean(axis=1)), ("sum", x.sum(axis=1)),
+                      ("max", x.max(axis=1))):
+        cfg = FFConfig()
+        cfg.batch_size = 8
+        ff = FFModel(cfg)
+        t = ff.create_tensor((8, 5, 6), name="input")
+        out = getattr(ff, f"reduce_{mode}")(t, axis=1)
+        assert tuple(out.shape) == (8, 6)
+        ff.softmax(ff.dense(out, 4))
+        ff.compile(optimizer=SGDOptimizer(lr=0.05),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=[])
+        got = ff.executor.forward_values(
+            ff.state.params, ff.state.states,
+            {"input": jnp.asarray(x)}, False, None)[0]
+        red = next(o for o in ff.ops if o.op_type == "reduce")
+        np.testing.assert_allclose(
+            np.asarray(got[red.outputs[0].uid]), ref, rtol=1e-6)
+        # trains through the reduction (grad flows)
+        m = ff.train_batch({"input": x,
+                            "label": rng.randint(0, 4, 8).astype(np.int32)})
+        assert np.isfinite(float(m["loss"]))
+    # keepdims + negative axis
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    ff = FFModel(cfg)
+    t = ff.create_tensor((8, 5, 6), name="input")
+    out = ff.reduce_mean(t, axis=-1, keepdims=True)
+    assert tuple(out.shape) == (8, 5, 1)
