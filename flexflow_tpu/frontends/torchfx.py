@@ -139,7 +139,35 @@ def _function_desc(name, node, ins) -> _OpDesc:
             # cat takes a list as first arg
             ins = [_node_name(a) for a in node.args[0]]
         return _OpDesc(name, op, ins, **attrs)
+    if fn is torch.mean:
+        return _reduce_mean_desc(name, node, ins)
     raise NotImplementedError(f"unsupported torch function {fn}")
+
+
+def _reduce_mean_desc(name, node, ins) -> _OpDesc:
+    """x.mean(dim)/torch.mean(x, dim) with a single int dim -> the
+    generic reduce op. Everything the op cannot lower (full-tensor or
+    multi-dim means, the sample dim, a kwarg-passed input tensor)
+    raises HERE — trace time — per the frontend's contract."""
+    if not ins:
+        raise NotImplementedError(
+            f"mean at {name}: pass the tensor positionally "
+            f"(torch.mean(input=x, ...) hides it from the fx arg list)")
+    dim = node.kwargs.get("dim")
+    if dim is None and len(node.args) > 1:
+        dim = node.args[1]
+    if not isinstance(dim, int):
+        raise NotImplementedError(
+            f"mean at {name}: exactly one int dim is supported, "
+            f"got {dim!r}")
+    if dim == 0:
+        raise NotImplementedError(
+            f"mean at {name}: dim 0 is the sample dim and cannot be "
+            f"reduced")
+    keepdim = bool(node.kwargs.get("keepdim", False)
+                   or (len(node.args) > 2 and node.args[2]))
+    return _OpDesc(name, "reduce_mean", ins[:1], axis=dim,
+                   keepdims=int(keepdim))
 
 
 def _method_desc(name, node, ins) -> _OpDesc:
@@ -152,6 +180,8 @@ def _method_desc(name, node, ins) -> _OpDesc:
     if node.target == "transpose":
         return _OpDesc(name, "transpose", ins[:1], d0=node.args[1],
                        d1=node.args[2])
+    if node.target == "mean":
+        return _reduce_mean_desc(name, node, ins)
     raise NotImplementedError(f"unsupported torch method {node.target}")
 
 
@@ -243,6 +273,11 @@ class PyTorchModel:
                 values[d.name] = ffmodel.embedding(
                     values[d.inputs[0]], int(a["vocab"]), int(a["dim"]),
                     aggr="none", name=d.name)
+            elif d.op_type == "reduce_mean":
+                values[d.name] = ffmodel.reduce_mean(
+                    values[d.inputs[0]], axis=int(a["axis"]),
+                    keepdims=bool(int(a.get("keepdims", 0))),
+                    name=d.name)
             elif d.op_type == "reshape":
                 shape = [int(x) for x in str(a["shape"]).split(",")]
                 values[d.name] = ffmodel.reshape(values[d.inputs[0]],

@@ -102,12 +102,14 @@ class Reduce(Op):
     def __init__(self, model, name, inputs, mode: str, axis: int,
                  keepdims: bool = False):
         super().__init__(model, name, inputs)
-        assert mode in self._FNS, f"unknown reduce mode {mode}"
+        if mode not in self._FNS:
+            raise ValueError(f"unknown reduce mode {mode!r}")
         rank = len(inputs[0].shape)
         axis = axis if axis >= 0 else axis + rank
-        assert 0 < axis < rank, (
-            f"reduce axis {axis} out of range (the sample dim 0 cannot "
-            f"be reduced)")
+        if not 0 < axis < rank:
+            raise ValueError(
+                f"reduce axis {axis} out of range for rank {rank} "
+                f"(the sample dim 0 cannot be reduced)")
         self.mode = mode
         self.axis = axis
         self.keepdims = bool(keepdims)

@@ -225,3 +225,51 @@ def test_layer_norm_grads_match_torch(rng):
                                rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(np.asarray(gp["bias"]), bt.grad.numpy(),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_torchfx_embedding_mean_model():
+    """fx import of an embedding + .mean(dim) classifier, golden vs the
+    torch forward (the nn.Embedding path the ONNX importer also covers
+    via Gather/ReduceMean) — incl. the .ff text round trip."""
+    import torch
+    import torch.nn as nn
+
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.frontends.torchfx import PyTorchModel, export_ff
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(50, 16)
+            self.fc = nn.Linear(16, 4)
+
+        def forward(self, ids):
+            return self.fc(self.emb(ids).mean(dim=1))
+
+    torch.manual_seed(0)
+    m = M()
+    m.eval()
+
+    def run(ptm):
+        cfg = FFConfig()
+        cfg.batch_size = 4
+        ff = FFModel(cfg)
+        ids_t = ff.create_tensor((4, 7), dtype=np.int32, name="input")
+        (out,) = ptm.apply(ff, [ids_t])
+        assert tuple(out.shape) == (4, 4)
+        ff.compile(loss_type="sparse_categorical_crossentropy",
+                   metrics=[])
+        ptm.module = m  # .ff files carry no weights (reference same)
+        ptm.import_weights(ff)
+        ids = np.random.RandomState(0).randint(0, 50, (4, 7))
+        with torch.no_grad():
+            want = m(torch.from_numpy(ids)).numpy()
+        got = np.asarray(ff.forward({"input": ids.astype(np.int32)}))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    run(PyTorchModel(m))
+    # .ff text round trip (reference torch/model.py replay path)
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".ff", mode="w") as f:
+        export_ff(m, f.name)
+        run(PyTorchModel(f.name))
