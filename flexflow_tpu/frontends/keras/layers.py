@@ -174,6 +174,21 @@ class Flatten(Layer):
         return ff.flat(ins[0], name=self.name)
 
 
+class GlobalAveragePooling1D(Layer):
+    """(steps, features) -> (features,): mean over the steps axis — the
+    standard head after Embedding; lowers to the generic reduce op."""
+
+    def output_shape(self, in_shapes):
+        if len(in_shapes[0]) != 2:
+            raise ValueError(
+                f"GlobalAveragePooling1D expects (steps, features) "
+                f"inputs, got {in_shapes[0]}")
+        return (in_shapes[0][-1],)
+
+    def emit(self, ff, ins):
+        return ff.reduce_mean(ins[0], axis=1, name=self.name)
+
+
 class LayerNormalization(Layer):
     """Normalizes over the last axis (keras default axis=-1) ->
     FFModel.layer_norm. Fail-loudly policy (like the module's _same_pad/

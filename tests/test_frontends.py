@@ -212,3 +212,23 @@ def test_torchfx_layer_norm_roundtrip():
     got = np.asarray(ff.forward({"input": x}))
     want = mod(torch.from_numpy(x)).detach().numpy()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_embedding_gap1d_classifier():
+    """Embedding -> GlobalAveragePooling1D -> Dense: the standard keras
+    text-classifier head (GAP1D lowers to the generic reduce op)."""
+    m = keras.Sequential([
+        keras.layers.Embedding(100, 16, input_shape=(12,)),
+        keras.layers.GlobalAveragePooling1D(),
+        keras.layers.Dense(4, activation="softmax"),
+    ])
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 100, (256, 12)).astype(np.int32)
+    # every token informative: class = bucket of the mean token id —
+    # exactly the signal mean pooling preserves
+    y = np.clip(x.mean(axis=1) * 4 // 100, 0, 3).astype(np.int32)
+    m.fit(x, y, batch_size=32, epochs=10, verbose=False)
+    out = m.evaluate(x, y, batch_size=32)
+    assert out["accuracy"] > 0.5, out
