@@ -28,6 +28,9 @@ run_all() {
   BENCH_DEADLINE_S=2400 timeout 2600 python bench.py --all --steps 50 \
       || echo "bench sweep FAILED rc=$?"
 
+  echo "--- 1b. regenerate the README perf table from the fresh sweep"
+  python tools/perf_report.py --write || echo "perf report FAILED rc=$?"
+
   echo "--- 2. on-chip test suite (tests_tpu/)"
   timeout 1800 python -m pytest tests_tpu/ -q 2>&1 | tail -5 \
       || echo "tests_tpu FAILED rc=$?"
