@@ -17,14 +17,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..parallel.sharding import batch_sharding
+from ..parallel.sharding import batch_sharding, place_process_local
 
 
 def host_to_device(host, mesh, dtype=None) -> jax.Array:
     """Host batch -> device array sharded over the mesh's data axis.
     The single place batches land on devices (native and Python paths).
     `dtype` casts IN the transfer (one materialization — a post-hoc
-    astype would move the wide dtype and buffer it twice)."""
+    astype would move the wide dtype and buffer it twice). Multi-
+    controller SPMD: the host batch is this PROCESS's shard of the
+    global batch (place_process_local)."""
+    if mesh is not None and jax.process_count() > 1:
+        h = np.asarray(host, dtype=dtype)
+        return place_process_local(h, batch_sharding(mesh, h.ndim))
     arr = jnp.asarray(host, dtype=dtype)
     if mesh is not None:
         arr = jax.device_put(arr, batch_sharding(mesh, arr.ndim))

@@ -34,6 +34,8 @@ from ..parallel.sharding import (
     batch_sharding,
     effective_op_strategy,
     op_output_sharding,
+    place_global,
+    place_process_local,
     spec_for_axes,
     weight_sharding,
 )
@@ -136,17 +138,23 @@ class Executor:
                                 op, self.strategy.for_op(op.name),
                                 self.mesh),
                             self.mesh)
-                        arr = jax.device_put(arr, sh)
+                        arr = place_global(arr, sh)
                     op_params[wname] = arr
                 params[op.name] = op_params
             sspecs = op.state_specs()
             if sspecs:
                 op_states = {}
                 for sname, sspec in sspecs.items():
-                    arr = jnp.full(sspec.shape, sspec.init_value, sspec.dtype)
+                    # host-side init: placing from device via the
+                    # multi-process callback would round-trip device->
+                    # host->device for nothing
+                    arr = np.full(sspec.shape, sspec.init_value,
+                                  np.dtype(sspec.dtype))
                     if self.mesh is not None:
-                        arr = jax.device_put(
+                        arr = place_global(
                             arr, NamedSharding(self.mesh, P()))
+                    else:
+                        arr = jnp.asarray(arr)
                     op_states[sname] = arr
                 states[op.name] = op_states
         opt_state = (self.optimizer.init_state(params)
@@ -557,9 +565,33 @@ class Executor:
         DECLARED tensor dtype (a bf16 model fed f32 numpy trains in bf16,
         like the reference loader honoring the region's type)."""
         declared = self.declared_input_dtypes
+        multi = jax.process_count() > 1
         out = {}
         for k, v in batch.items():
             want = declared.get(k)
+            if self.mesh is not None and multi:
+                # multi-controller SPMD: each process holds ITS shard of
+                # the global batch (global batch = concat over
+                # processes); device_put cannot address remote devices —
+                # this is the make_array_from_process_local_data path
+                # SURVEY §7.7 prescribes for the loader
+                if isinstance(v, jax.Array) \
+                        and not v.is_fully_addressable:
+                    # already a global array (loader/caller placed it);
+                    # an eager cast is impossible here, so a declared-
+                    # dtype mismatch must fail, not silently train wide
+                    if want is not None and v.dtype != want:
+                        raise TypeError(
+                            f"input {k!r}: pre-placed global array has "
+                            f"dtype {v.dtype}, declared {want}; place "
+                            f"it with the declared dtype")
+                    out[k] = v
+                    continue
+                host = np.asarray(v, dtype=want) if want is not None \
+                    else np.asarray(v)
+                out[k] = place_process_local(
+                    host, batch_sharding(self.mesh, host.ndim))
+                continue
             # single-pass conversion: asarray+astype would materialize
             # the batch twice on device per step
             arr = jnp.asarray(v, dtype=want) if want is not None \
@@ -583,22 +615,41 @@ class Executor:
         declared = self.declared_input_dtypes
         keys = batches[0].keys()
         out = {}
+        multi = jax.process_count() > 1
+
+        def stacked_sharding(ndim):
+            # spec of one step-slice, shifted right past the step axis
+            sh = batch_sharding(self.mesh, ndim - 1)
+            spec = P(None, *sh.spec) if sh.spec else P()
+            return NamedSharding(self.mesh, spec)
+
         for k in keys:
             vals = [b[k] for b in batches]
             want = declared.get(k)
+            if multi and any(isinstance(v, jax.Array) for v in vals):
+                # eager stack/device_put cannot place onto the global
+                # mesh from one process; grouped dispatch over
+                # pre-placed device batches is a single-process feature
+                raise NotImplementedError(
+                    "steps_per_dispatch over device-resident batches is "
+                    "not supported in multi-process runs; pass host "
+                    "numpy batches (each process's shard)")
             if all(isinstance(v, jax.Array) for v in vals):
                 arr = jnp.stack([
                     v if want is None or v.dtype == want else v.astype(want)
                     for v in vals])
             else:
                 stacked = np.stack([np.asarray(v) for v in vals])
+                if self.mesh is not None and multi:
+                    host = stacked.astype(want) if want is not None \
+                        else stacked
+                    out[k] = place_process_local(
+                        host, stacked_sharding(host.ndim))
+                    continue
                 arr = jnp.asarray(stacked, dtype=want) if want is not None \
                     else jnp.asarray(stacked)
             if self.mesh is not None:
-                # spec of one step-slice, shifted right past the step axis
-                sh = batch_sharding(self.mesh, arr.ndim - 1)
-                spec = P(None, *sh.spec) if sh.spec else P()
-                out[k] = jax.device_put(arr, NamedSharding(self.mesh, spec))
+                out[k] = jax.device_put(arr, stacked_sharding(arr.ndim))
             else:
                 out[k] = arr
         return out

@@ -49,6 +49,7 @@ from .ops import (
     Transpose,
 )
 from .parallel.mesh import default_mesh, make_mesh
+from .parallel.sharding import place_global
 from .parallel.pconfig import OpStrategy, Strategy
 from .tensor import Tensor
 
@@ -812,7 +813,7 @@ class FFModel:
             # stage the whole array on the default device — an OOM for
             # weights that are sharded precisely because they don't fit)
             host = np.asarray(v, dtype=np.dtype(cur[k].dtype))
-            cur[k] = jax.device_put(host, cur[k].sharding)
+            cur[k] = place_global(host, cur[k].sharding)
 
     def set_states(self, op_name: str, states: Dict[str, np.ndarray]):
         """Host set of non-trainable op state (e.g. BN running stats) —
@@ -822,7 +823,7 @@ class FFModel:
         for k, v in states.items():
             assert cur[k].shape == v.shape, (op_name, k, cur[k].shape, v.shape)
             host = np.asarray(v, dtype=np.dtype(cur[k].dtype))
-            cur[k] = jax.device_put(host, cur[k].sharding)
+            cur[k] = place_global(host, cur[k].sharding)
 
     def summary(self) -> str:
         lines = [f"{'op':30s} {'type':20s} {'output':24s} {'params':>12s}"]

@@ -82,3 +82,35 @@ def batch_sharding(mesh: Mesh, ndim: int, data_axis: str = "data"):
     if data_axis not in mesh.shape:
         return NamedSharding(mesh, P())
     return NamedSharding(mesh, P(data_axis, *([None] * (ndim - 1))))
+
+
+def place_global(arr, sharding):
+    """Place a host-computed GLOBAL array under `sharding`. Single
+    process: plain device_put. Multi-controller SPMD: device_put cannot
+    address remote devices, so each process contributes its addressable
+    shards from the (identically computed on every host — deterministic
+    init/imports) global array via make_array_from_callback."""
+    import numpy as np
+    if jax.process_count() <= 1:
+        return jax.device_put(arr, sharding)
+    host = np.asarray(arr)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx])
+
+
+def place_process_local(host, sharding):
+    """Place per-process batch data: each process holds ITS shard of
+    the global batch (global = concat over processes in process order).
+    Single process this is just device_put."""
+    if jax.process_count() <= 1:
+        return jax.device_put(host, sharding)
+    if sharding.is_fully_replicated:
+        # replicated placement would install each process's DIFFERENT
+        # local batch as "the same" global array — XLA assumes
+        # replicated operands are identical across processes, so this
+        # is silent data corruption, not a supported layout
+        raise NotImplementedError(
+            "multi-process batch placement needs a 'data' mesh axis to "
+            "split the global batch; a replicated batch would combine "
+            "different per-process data silently")
+    return jax.make_array_from_process_local_data(sharding, host)

@@ -1,0 +1,136 @@
+"""REAL multi-controller SPMD: two OS processes, one global mesh.
+
+The reference never tests multi-node without a cluster (SURVEY §4:
+"distributed coverage is single-node multi-GPU"). Here the launcher's
+jax.distributed bootstrap (python -m flexflow_tpu --coordinator ...,
+the mpirun-analog of python/flexflow.py) runs two CPU processes with 2
+local devices each; a DP model trains over the 4-device global mesh
+with each process feeding ITS shard of the global batch, and the loss
+must match a single-process run on the concatenated batch exactly.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRAIN = """
+import sys
+import numpy as np
+import jax
+import jax.numpy as jnp
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer, make_mesh
+
+pid = jax.process_index()
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4 and jax.local_device_count() == 2
+
+cfg = FFConfig()
+cfg.batch_size = 16  # GLOBAL batch
+ff = FFModel(cfg, mesh=make_mesh((4,), ("data",)))
+x = ff.create_tensor((16, 32), name="input")
+ff.softmax(ff.dense(ff.dense(x, 64, activation="relu", name="d1"), 4,
+                    name="d2"))
+ff.compile(optimizer=SGDOptimizer(lr=0.1),
+           loss_type="sparse_categorical_crossentropy", metrics=[])
+
+rng = np.random.RandomState(0)  # same stream on both processes
+xg = rng.randn(16, 32).astype(np.float32)
+yg = rng.randint(0, 4, 16).astype(np.int32)
+lo, hi = pid * 8, (pid + 1) * 8  # this process's shard of the batch
+for step in range(3):
+    m = ff.train_batch({"input": xg[lo:hi], "label": yg[lo:hi]})
+    print(f"RESULT proc={pid} step={step} loss={float(m['loss']):.8f}",
+          flush=True)
+
+# grouped dispatch (scan of 2 steps) through the multi-process stacked
+# placement path
+ms = ff.train_batches([
+    {"input": xg[lo:hi], "label": yg[lo:hi]},
+    {"input": xg[lo:hi], "label": yg[lo:hi]},
+])
+print(f"RESULT proc={pid} step=group loss={float(ms['loss'][-1]):.8f}",
+      flush=True)
+
+# fit() epoch: each process feeds its local dataset half
+h = ff.fit({"input": xg[lo:hi]}, yg[lo:hi], epochs=1, verbose=False,
+           batch_size=8)
+print(f"RESULT proc={pid} step=fit loss={h[-1]['loss']:.8f}", flush=True)
+"""
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_two_process_dp_matches_single_process(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(TRAIN)
+    port = free_port()
+    procs = []
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    for pid in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "flexflow_tpu",
+             "--cpu-devices", "2",
+             "--coordinator", f"localhost:{port}",
+             "--num-processes", "2", "--process-id", str(pid),
+             str(script)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+        assert p.returncode == 0, out[-3000:]
+
+    losses = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT"):
+                parts = dict(kv.split("=") for kv in line.split()[1:])
+                losses.setdefault(int(parts["proc"]), []).append(
+                    float(parts["loss"]))
+    # 3 single steps + grouped dispatch + fit epoch
+    assert len(losses[0]) == len(losses[1]) == 5, outs
+    # the jitted step is GLOBAL: both controllers must see the same
+    # losses across every path (single, grouped, fit)
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-7)
+    losses = {p: v[:3] for p, v in losses.items()}  # single-proc ref
+
+    # single-process run on the full batch reproduces it exactly
+    import jax
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer, make_mesh
+
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    ff = FFModel(cfg, mesh=make_mesh((4,), ("data",)))
+    x = ff.create_tensor((16, 32), name="input")
+    ff.softmax(ff.dense(ff.dense(x, 64, activation="relu", name="d1"),
+                        4, name="d2"))
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    rng = np.random.RandomState(0)
+    xg = rng.randn(16, 32).astype(np.float32)
+    yg = rng.randint(0, 4, 16).astype(np.int32)
+    ref = [float(ff.train_batch({"input": xg, "label": yg})["loss"])
+           for _ in range(3)]
+    np.testing.assert_allclose(losses[0], ref, rtol=1e-5)
