@@ -225,3 +225,50 @@ def test_placed_strategy_text_format_roundtrip(tmp_path):
     op = next(o for o in ff2.ops if o.op_type == "distributed_embedding")
     assert op.placement == ids
     assert np.isfinite(float(ff2.train_batch(batches(n=1)[0])["loss"]))
+
+
+def test_dlrm_strategy_generator(tmp_path):
+    """tools/gen_dlrm_strategy.py (the reference dlrm_strategy.py/
+    gen_strategy.sh analog): generated files load into executable
+    placements in both formats."""
+    import subprocess
+    import sys
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools", "gen_dlrm_strategy.py")
+    out_json = str(tmp_path / "s.json")
+    r = subprocess.run(
+        [sys.executable, tool, "--tables", "8", "--devices", "4",
+         "--scheme", "blocked", "--op-name", "tables",
+         "--out", out_json],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    loaded = Strategy.load(out_json)
+    assert loaded.for_op("tables").device_ids == (0, 0, 1, 1, 2, 2, 3, 3)
+    mesh = make_mesh((4,), ("data",))
+    ff = build(mesh=mesh, strategy=loaded)
+    op = next(o for o in ff.ops if o.op_type == "distributed_embedding")
+    assert op.placement == (0, 0, 1, 1, 2, 2, 3, 3)
+    assert np.isfinite(float(ff.train_batch(batches(n=1)[0])["loss"]))
+
+    # text format: the tpu_pin line parses back to the same placement
+    out_txt = str(tmp_path / "s.txt")
+    r = subprocess.run(
+        [sys.executable, tool, "--tables", "8", "--devices", "4",
+         "--scheme", "blocked", "--op-name", "tables",
+         "--format", "text", "--out", out_txt],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    from flexflow_tpu.parallel.strategy_io import (
+        load_strategies_from_file,
+    )
+    loaded_txt = load_strategies_from_file(ff, mesh, out_txt)
+    assert loaded_txt.for_op("tables").device_ids \
+        == (0, 0, 1, 1, 2, 2, 3, 3)
+
+    # invalid device counts fail loudly, never emit negative ids
+    r = subprocess.run(
+        [sys.executable, tool, "--devices", "0"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode != 0 and ">= 1" in r.stdout + r.stderr

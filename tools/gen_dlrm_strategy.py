@@ -1,0 +1,75 @@
+"""DLRM strategy generator — the reference ships a C++/py generator
+that emits per-GPU embedding placements as strategy files
+(examples/cpp/DLRM/strategies/{dlrm_strategy.cc,dlrm_strategy.py,
+gen_strategy.sh}); this is the TPU-native analog, emitting the SAME
+placements in both supported formats. Unlike the reference's, the
+output executes here without a custom mapper: per-table device ids
+lower to the slot layout (ops/embedding.py apply_placement).
+
+  python tools/gen_dlrm_strategy.py --tables 26 --devices 8 \
+      --scheme round_robin --out dlrm_strategy.json
+  # --format text emits the reference text format (strategy.cc)
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def assignment(tables: int, devices: int, scheme: str):
+    if tables < 1 or devices < 1:
+        raise SystemExit(
+            f"--tables and --devices must be >= 1, got {tables}/{devices}")
+    if scheme == "round_robin":
+        return tuple(t % devices for t in range(tables))
+    if scheme == "blocked":
+        return tuple(min(t * devices // tables, devices - 1)
+                     for t in range(tables))
+    if scheme == "one_device":
+        return (0,) * tables
+    raise SystemExit(f"unknown scheme {scheme!r}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tables", type=int, default=26)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--scheme", default="round_robin",
+                    choices=["round_robin", "blocked", "one_device"])
+    ap.add_argument("--op-name", default="emb_tables",
+                    help="distributed_embedding op name "
+                         "(build_dlrm(stacked_tables=True) uses "
+                         "'emb_tables')")
+    ap.add_argument("--format", default="json", choices=["json", "text"])
+    ap.add_argument("--out", default="dlrm_strategy.json")
+    args = ap.parse_args()
+
+    from flexflow_tpu.parallel.pconfig import (
+        DEVICE_KEY,
+        OpStrategy,
+        Strategy,
+    )
+
+    ids = assignment(args.tables, args.devices, args.scheme)
+    strat = Strategy(default=OpStrategy({"sample": "data"}))
+    strat.set(args.op_name, OpStrategy({DEVICE_KEY: ids}))
+
+    if args.format == "json":
+        strat.save(args.out)
+    else:
+        # reference text format needs the op graph for output dims; a
+        # single tpu_pin line is enough for the import path
+        # (strategy_io.load_strategies_from_file keys on op name)
+        with open(args.out, "w") as f:
+            f.write("1\n")
+            f.write(f"{args.op_name} tpu_pin 1 1 "
+                    + " ".join(str(i) for i in ids) + "\n")
+    print(f"{args.out}: {args.op_name} <- {args.scheme} over "
+          f"{args.devices} devices: {ids}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
