@@ -46,17 +46,18 @@ def main():
     ap.add_argument("--out", default="dlrm_strategy.json")
     args = ap.parse_args()
 
-    from flexflow_tpu.parallel.pconfig import (
-        DEVICE_KEY,
-        OpStrategy,
-        Strategy,
-    )
-
+    # validate + compute BEFORE the heavyweight jax import so bad
+    # arguments fail instantly
     ids = assignment(args.tables, args.devices, args.scheme)
-    strat = Strategy(default=OpStrategy({"sample": "data"}))
-    strat.set(args.op_name, OpStrategy({DEVICE_KEY: ids}))
 
     if args.format == "json":
+        from flexflow_tpu.parallel.pconfig import (
+            DEVICE_KEY,
+            OpStrategy,
+            Strategy,
+        )
+        strat = Strategy(default=OpStrategy({"sample": "data"}))
+        strat.set(args.op_name, OpStrategy({DEVICE_KEY: ids}))
         strat.save(args.out)
     else:
         # reference text format needs the op graph for output dims; a
