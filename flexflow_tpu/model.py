@@ -801,7 +801,23 @@ class FFModel:
 
     # ---------------- weight access (reference Parameter::get/set) ------
     def get_weights(self, op_name: str) -> Dict[str, np.ndarray]:
-        return {k: np.asarray(v) for k, v in self.state.params[op_name].items()}
+        """Host copy of an op's weights (reference Parameter::get_weights,
+        model.cu:439-452). Under multi-controller SPMD a weight sharded
+        across processes is all-gathered — a COLLECTIVE, so call from
+        every process (the normal SPMD discipline)."""
+        out = {}
+        for k, v in self.state.params[op_name].items():
+            if isinstance(v, jax.Array) and not v.is_fully_addressable \
+                    and not v.is_fully_replicated:
+                # genuinely cross-process-sharded: only a collective can
+                # materialize it (replicated weights fetch locally —
+                # no communication, callable from one process alone)
+                from jax.experimental import multihost_utils
+                out[k] = np.asarray(
+                    multihost_utils.process_allgather(v, tiled=True))
+            else:
+                out[k] = np.asarray(v)
+        return out
 
     def set_weights(self, op_name: str, weights: Dict[str, np.ndarray]):
         cur = self.state.params[op_name]

@@ -193,6 +193,12 @@ if ckpt:
     # the PLACED tables are the feature under test: their restored
     # bytes must match too, not just the dense head's
     before_tab = shard_sum(ff.state.params["tables"]["kernel"])
+    # get_weights all-gathers cross-process-sharded weights (collective:
+    # both controllers call it together); full-table sum must agree
+    full_tab = ff.get_weights("tables")["kernel"]
+    assert full_tab.shape[0] == 8  # every slot, incl. remote ones
+    print(f"RESULT proc={pid} step=gather loss={full_tab.sum():.8f}",
+          flush=True)
     # fresh model, same graph/strategy, restore into it
     cfg2 = FFConfig()
     cfg2.batch_size = 16
@@ -259,5 +265,6 @@ def test_two_process_placed_embedding_and_checkpoint(tmp_path):
                 parts = dict(kv.split("=") for kv in line.split()[1:])
                 losses.setdefault(int(parts["proc"]), []).append(
                     float(parts["loss"]))
-    assert len(losses[0]) == len(losses[1]) == 3, outs
+    # 2 steps + full-table gather fingerprint + resumed step
+    assert len(losses[0]) == len(losses[1]) == 4, outs
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-7)
