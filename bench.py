@@ -539,8 +539,15 @@ def merge_bench_all(results):
     """Write bench_all.json without letting a dead tunnel erase history:
     per model, a fresh TPU result overwrites; a CPU fallback/None keeps
     the existing TPU entry (stale-marked) and records the fallback under
-    extra.cpu_liveness via finalize()."""
-    merged = {m: finalize(m, r) for m, r in results.items()}
+    extra.cpu_liveness via finalize(). Committed entries for models NOT
+    in this sweep survive untouched (history is merged into, never
+    rebuilt from scratch)."""
+    try:
+        with open(_bench_all_path()) as f:
+            merged = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        merged = {}
+    merged.update({m: finalize(m, r) for m, r in results.items()})
     with open(_bench_all_path(), "w") as f:
         json.dump(merged, f, indent=2)
     return merged

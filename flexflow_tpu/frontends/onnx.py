@@ -69,6 +69,21 @@ def _proto_attrs(node) -> Dict:
     return out
 
 
+def _input_dtype(name: str, elem_type: int) -> np.dtype:
+    """Graph-input elem_type -> numpy dtype. 0 (unset) defaults to f32;
+    a SET-but-unsupported type (bfloat16/float8/...) fails loudly like
+    initializer decoding does — a silent f32 input would train wrong."""
+    from .onnx_wire import TENSOR_DTYPES
+    if elem_type == 0:
+        return np.dtype(np.float32)
+    if elem_type not in TENSOR_DTYPES:
+        raise NotImplementedError(
+            f"graph input {name!r}: elem_type {elem_type} is "
+            f"unsupported (bfloat16/float8 inputs need explicit "
+            f"tensors passed to apply())")
+    return np.dtype(TENSOR_DTYPES[elem_type])
+
+
 def export_torch_onnx(module, args, path, **kw) -> None:
     """torch.onnx.export that works WITHOUT the `onnx` package: the
     TorchScript exporter serializes the ModelProto in C++; only its
@@ -115,10 +130,9 @@ class ONNXModel:
             self.nodes = [GraphNode(n["op_type"], n["input"], n["output"],
                                     n["name"], n["attrs"])
                           for n in g["nodes"]]
-            from .onnx_wire import TENSOR_DTYPES
             self.graph_inputs = [
                 (vi["name"], vi["shape"],
-                 np.dtype(TENSOR_DTYPES.get(vi["elem_type"], np.float32)))
+                 _input_dtype(vi["name"], vi["elem_type"]))
                 for vi in g["inputs"] if vi["name"] not in self.inits]
             return
         self.inits = {t.name: numpy_helper.to_array(t)
@@ -126,13 +140,11 @@ class ONNXModel:
         self.nodes = [GraphNode(n.op_type, list(n.input), list(n.output),
                                 n.name, _proto_attrs(n))
                       for n in model.graph.node]
-        from .onnx_wire import TENSOR_DTYPES
         self.graph_inputs = [
             (vi.name,
              [d.dim_value or d.dim_param
               for d in vi.type.tensor_type.shape.dim],
-             np.dtype(TENSOR_DTYPES.get(
-                 vi.type.tensor_type.elem_type, np.float32)))
+             _input_dtype(vi.name, vi.type.tensor_type.elem_type))
             for vi in model.graph.input if vi.name not in self.inits]
 
     @classmethod

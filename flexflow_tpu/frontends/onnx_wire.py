@@ -81,9 +81,12 @@ def _f32(v: int) -> float:
     return struct.unpack("<f", v.to_bytes(4, "little"))[0]
 
 
-def _packed_or_scalar(acc: list, wt, val, fmt=None):
+def _packed_or_scalar(acc: list, wt, val, fmt=None, unsigned=False):
     """Repeated scalar field: packed (wire 2) or one-per-entry; `fmt`
-    set for fixed-width (float/double) elements, varints otherwise."""
+    set for fixed-width (float/double) elements, varints otherwise.
+    `unsigned` skips the two's-complement reinterpretation (uint64_data
+    values >= 2^63 are NOT negative int64s)."""
+    conv = (lambda v: v) if unsigned else _signed
     if wt == 2:
         if fmt:  # fixed-width packed
             acc.extend(x[0] for x in struct.iter_unpack(fmt, val))
@@ -91,12 +94,12 @@ def _packed_or_scalar(acc: list, wt, val, fmt=None):
             pos = 0
             while pos < len(val):
                 v, pos = _varint(val, pos)
-                acc.append(_signed(v))
+                acc.append(conv(v))
     elif fmt:
         acc.append(struct.unpack(fmt, val.to_bytes(
             8 if fmt[1] in "dq" else 4, "little"))[0])
     else:
-        acc.append(_signed(val))
+        acc.append(conv(val))
 
 
 # --- ONNX messages -----------------------------------------------------
@@ -140,7 +143,7 @@ def parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
         elif fno == 10:
             _packed_or_scalar(f64, wt, val, "<d")
         elif fno == 11:
-            _packed_or_scalar(u64, wt, val)
+            _packed_or_scalar(u64, wt, val, unsigned=True)
         elif fno == 6:
             raise NotImplementedError(
                 f"ONNX string tensors are unsupported ({name!r})")

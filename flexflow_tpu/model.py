@@ -415,10 +415,12 @@ class FFModel:
                     f"— use distributed_embedding per-table placement "
                     f"for an executable equivalent")
 
-        self.comp_mode = comp_mode
+        # Executor validates comp_mode; assign OURS only after it
+        # succeeds so a rejected compile leaves the previous mode live
         self.executor = Executor(self, optimizer, loss_type, metrics,
                                  mesh=self.mesh, strategy=self.strategy,
                                  comp_mode=comp_mode)
+        self.comp_mode = comp_mode
         self.state = self.executor.init_state(self._next_rng())
         self._host_step = 0  # mirrors state.step for the train rng
         for op_name, ws in self.imported_weights.items():
@@ -805,6 +807,7 @@ class FFModel:
         model.cu:439-452). Under multi-controller SPMD a weight sharded
         across processes is all-gathered — a COLLECTIVE, so call from
         every process (the normal SPMD discipline)."""
+        op = next((o for o in self.ops if o.name == op_name), None)
         out = {}
         for k, v in self.state.params[op_name].items():
             if isinstance(v, jax.Array) and not v.is_fully_addressable \
@@ -817,11 +820,28 @@ class FFModel:
                     multihost_utils.process_allgather(v, tiled=True))
             else:
                 out[k] = np.asarray(v)
+            if k == "kernel" and hasattr(op, "to_table_order"):
+                # placed stacked embeddings expose TABLE order (pads
+                # dropped) — a balanced placement permutes slots, and a
+                # raw slot-order copy into another layout would install
+                # the wrong rows with no shape error
+                out[k] = op.to_table_order(out[k])
         return out
 
     def set_weights(self, op_name: str, weights: Dict[str, np.ndarray]):
         cur = self.state.params[op_name]
+        op = next((o for o in self.ops if o.name == op_name), None)
         for k, v in weights.items():
+            if (k == "kernel" and hasattr(op, "from_table_order")
+                    and getattr(op, "placement", None)
+                    and v.shape[0] == op.num_tables
+                    and tuple(v.shape[1:]) == tuple(cur[k].shape[1:])):
+                # TABLE-ordered kernel (the get_weights form): scatter
+                # into the placed slot layout, pads untouched
+                v = op.from_table_order(
+                    v, np.asarray(cur[k], dtype=np.dtype(cur[k].dtype))
+                    if cur[k].is_fully_addressable
+                    else np.zeros(cur[k].shape, np.dtype(cur[k].dtype)))
             assert cur[k].shape == v.shape, (op_name, k, cur[k].shape, v.shape)
             # convert on HOST, then device_put with the parameter's
             # sharding: only each device's shard transfers, and the

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..op import (
     CHANNEL_OUT,
@@ -216,6 +217,24 @@ class DistributedEmbedding(Op):
         self._slot_of_table = tuple(slots.index(t)
                                     for t in range(self.num_tables))
         self.num_slots = n_dev * k
+
+    def to_table_order(self, kernel):
+        """(num_slots, vocab, dim) slot-layout kernel -> (num_tables,
+        vocab, dim) in TABLE order (pads dropped) — the user-facing
+        layout get_weights returns regardless of placement."""
+        if self._slot_of_table is None:
+            return kernel
+        return kernel[list(self._slot_of_table)]
+
+    def from_table_order(self, kernel_tables, current):
+        """Inverse of to_table_order: scatter a table-ordered kernel
+        into the slot layout (pad slots keep `current`'s values)."""
+        if self._slot_of_table is None:
+            return kernel_tables
+        out = np.array(current, copy=True)
+        for t, s in enumerate(self._slot_of_table):
+            out[s] = kernel_tables[t]
+        return out
 
     def slot_ids(self, xs):
         """Stack per-table index arrays into the (num_slots, batch, bag)

@@ -129,6 +129,25 @@ class Strategy:
                 f"{len(self.op_strategies)} op overrides)")
 
 
+def placement_assignment(tables: int, devices: int, scheme: str) -> tuple:
+    """Per-table device assignment schemes — the single source the MCMC
+    candidates (search/mcmc.py) and the strategy generator
+    (tools/gen_dlrm_strategy.py) both draw from, so the generator's
+    output always lies inside the search space (reference
+    dlrm_strategy.py emits what its search consumed, likewise)."""
+    if tables < 1 or devices < 1:
+        raise ValueError(
+            f"tables and devices must be >= 1, got {tables}/{devices}")
+    if scheme == "round_robin":
+        return tuple(t % devices for t in range(tables))
+    if scheme == "blocked":
+        return tuple(min(t * devices // tables, devices - 1)
+                     for t in range(tables))
+    if scheme == "one_device":
+        return (0,) * tables
+    raise ValueError(f"unknown placement scheme {scheme!r}")
+
+
 DATA_PARALLEL = Strategy()
 
 

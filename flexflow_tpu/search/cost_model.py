@@ -167,17 +167,33 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
     # replica exists, so there is no gradient sync. Memory is averaged
     # over the mesh (exact when equal-size placed ops round-robin over
     # all devices, as the DLRM strategy does).
+    if op.op_type == "distributed_embedding":
+        # normalize to the UNPADDED (num_tables) basis: weight_specs
+        # reflects num_slots once a placement was applied to the live
+        # op, and pricing a new candidate from the padded bytes would
+        # double-count (the placement A/B's simulate-after-compile
+        # pattern hit exactly this)
+        slots = max(1, getattr(op, "num_slots", 1))
+        ntab = max(1, getattr(op, "num_tables", 1))
+        w_bytes = w_bytes * ntab / slots
     devices = strategy.device_ids
     if devices:
+        # a length-1 id is the whole-op pin shorthand the executor
+        # expands to every table (ops/embedding.py apply_placement) —
+        # price what will actually run
+        ntab = getattr(op, "num_tables", None)
+        if (op.op_type == "distributed_embedding" and ntab
+                and len(devices) == 1):
+            devices = tuple(devices) * ntab
         # distinct devices = real concurrency (a per-table id tuple may
         # assign several tables to one device; executed via the op's
-        # slot layout, ops/embedding.py apply_placement)
+        # slot layout)
         k = max(1, len(set(devices)))
         # slot-layout pad factor: the executable lowering pads every
         # device to the largest per-device group, so skewed assignments
         # inflate the kernel — price it so search prefers balance
         if (op.op_type == "distributed_embedding"
-                and len(devices) == getattr(op, "num_tables", -1)):
+                and len(devices) == ntab):
             from collections import Counter
             kmax = max(Counter(devices).values())
             n_total = max(1, int(mesh.size))

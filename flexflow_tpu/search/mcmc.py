@@ -71,14 +71,15 @@ def candidate_maps(op, mesh, cfg, op_index: int = 0) -> List[Dict[str, str]]:
             and op.op_type == "distributed_embedding" and n_dev > 1):
         # per-table explicit ids (the DLRM strategy-generator pattern,
         # dlrm_strategy.cc:1-50) — EXECUTABLE via the op's slot layout:
-        # round-robin and blocked assignments
+        # round-robin and blocked assignments (shared with
+        # tools/gen_dlrm_strategy.py via placement_assignment)
+        from ..parallel.pconfig import placement_assignment
         ntab = getattr(op, "num_tables", 1)
-        cands.append({DEVICE_KEY: tuple(t % n_dev
-                                        for t in range(ntab))})
+        cands.append({DEVICE_KEY: placement_assignment(
+            ntab, n_dev, "round_robin")})
         if ntab >= n_dev:
-            cands.append({DEVICE_KEY: tuple(
-                min(t * n_dev // ntab, n_dev - 1)
-                for t in range(ntab))})
+            cands.append({DEVICE_KEY: placement_assignment(
+                ntab, n_dev, "blocked")})
 
     if cfg.enable_sequence_parallel and "seq" in axes:
         if op.op_type in ("multihead_attention", "linear", "lstm",

@@ -62,13 +62,11 @@ def batches(n=3, tables=TABLES, seed=0):
 
 
 def place_weights(ff_placed, kern_table_order, dense):
-    """Lay a (tables, vocab, dim) table-ordered kernel into the placed
-    model's slot order (pad slots keep their init values)."""
+    """get/set_weights speak TABLE order regardless of placement (the
+    slot permutation is internal), so a copy from an unplaced model is
+    just set_weights."""
     op = next(o for o in ff_placed.ops if o.op_type == "distributed_embedding")
-    cur = np.asarray(ff_placed.get_weights("tables")["kernel"]).copy()
-    for t, s in enumerate(op._slot_of_table):
-        cur[s] = kern_table_order[t]
-    ff_placed.set_weights("tables", {"kernel": cur})
+    ff_placed.set_weights("tables", {"kernel": kern_table_order})
     ff_placed.set_weights("dense", dense)
     return op
 
@@ -103,10 +101,11 @@ def test_placed_matches_unplaced(ids, sparse):
         lp = float(ff.train_batch(b)["loss"])
         lr = float(ref.train_batch(b)["loss"])
         np.testing.assert_allclose(lp, lr, rtol=1e-5)
+    # get_weights returns TABLE order for placed ops too: direct compare
     got = np.asarray(ff.get_weights("tables")["kernel"])
     want = np.asarray(ref.get_weights("tables")["kernel"])
-    for t, s in enumerate(op._slot_of_table):
-        np.testing.assert_allclose(got[s], want[t], rtol=1e-4, atol=1e-6)
+    assert got.shape == want.shape == (TABLES, VOCAB, DIM)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
 
 
 def test_placed_weight_residency():
@@ -272,3 +271,32 @@ def test_dlrm_strategy_generator(tmp_path):
         [sys.executable, tool, "--devices", "0"],
         capture_output=True, text=True, timeout=60)
     assert r.returncode != 0 and ">= 1" in r.stdout + r.stderr
+
+
+def test_simulator_pricing_stable_after_placement_applied():
+    """Pricing a candidate must not depend on whether the LIVE op
+    already carries an applied placement (weight_specs then reflects
+    the padded slot count): simulate-after-compile — the placement_ab
+    pattern — must cost identically to simulate-before-compile, and
+    the whole-op pin shorthand (one id) must price like its expanded
+    per-table form."""
+    from flexflow_tpu.search.simulator import Simulator
+
+    mesh = make_mesh((8,), ("data",))
+    ids = (0,) * TABLES  # maximal padding: 8 tables -> 64 slots
+    strat = Strategy(default=OpStrategy({"sample": "data"}))
+    strat.set("tables", OpStrategy({DEVICE_KEY: ids}))
+
+    ff1 = build()  # never compiled with a placement
+    t_before = Simulator(ff1, mesh).simulate(strat)
+    ff2 = build(mesh=mesh, strategy=strat)  # placement APPLIED
+    op = next(o for o in ff2.ops if o.op_type == "distributed_embedding")
+    assert op.num_slots == 8 * TABLES
+    t_after = Simulator(ff2, mesh).simulate(strat)
+    assert t_before == pytest.approx(t_after, rel=1e-9)
+
+    # one-id shorthand == expanded per-table pin
+    strat_short = Strategy(default=OpStrategy({"sample": "data"}))
+    strat_short.set("tables", OpStrategy({DEVICE_KEY: (0,)}))
+    t_short = Simulator(ff1, mesh).simulate(strat_short)
+    assert t_short == pytest.approx(t_before, rel=1e-9)

@@ -19,17 +19,17 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def assignment(tables: int, devices: int, scheme: str):
+    # cheap validation BEFORE the heavyweight import; the formulas
+    # themselves live in parallel/pconfig.placement_assignment so the
+    # generator and the MCMC candidate space can never diverge
     if tables < 1 or devices < 1:
         raise SystemExit(
             f"--tables and --devices must be >= 1, got {tables}/{devices}")
-    if scheme == "round_robin":
-        return tuple(t % devices for t in range(tables))
-    if scheme == "blocked":
-        return tuple(min(t * devices // tables, devices - 1)
-                     for t in range(tables))
-    if scheme == "one_device":
-        return (0,) * tables
-    raise SystemExit(f"unknown scheme {scheme!r}")
+    from flexflow_tpu.parallel.pconfig import placement_assignment
+    try:
+        return placement_assignment(tables, devices, scheme)
+    except ValueError as e:
+        raise SystemExit(str(e))
 
 
 def main():
