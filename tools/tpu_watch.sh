@@ -15,6 +15,10 @@ for i in $(seq 1 72); do  # up to 12h
     bash tools/tpu_session.sh
     echo "[watch $(date -u +%FT%TZ)] session done rc=$?"
     touch .scratch/tpu_session_complete
+    # secure the artifacts even if the interactive session has ended:
+    # evidence transcripts + refreshed sweep + regenerated README table
+    git add evidence/ bench_all.json README.md 2>/dev/null
+    git diff --cached --quiet || git commit -m "On-chip session: refreshed bench sweep + evidence transcripts"
     exit 0
   fi
   sleep 600
