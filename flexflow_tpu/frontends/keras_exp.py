@@ -124,6 +124,18 @@ def _map_layer_weights(ltype, layer, w, op):
     elif ltype == "Embedding":
         # tf embeddings (vocab, dim) == framework kernel (vocab, dim)
         take("kernel", w[0])
+    elif ltype == "LayerNormalization":
+        cfgd = layer.get_config()
+        if not (cfgd.get("scale", True) and cfgd.get("center", True)):
+            # scale=False would positionally map beta into gamma —
+            # silent numeric divergence, same guard as BN below
+            raise NotImplementedError(
+                "keras_exp: LayerNormalization with scale=False or "
+                "center=False changes get_weights() order")
+        # tf [gamma, beta] == framework [scale, bias]
+        take("scale", w[0])
+        if len(w) > 1:
+            take("bias", w[1])
     elif ltype == "BatchNormalization":
         cfgd = layer.get_config()
         if not (cfgd.get("scale", True) and cfgd.get("center", True)):
@@ -215,8 +227,31 @@ def _emit_layer(ff, layer, ltype, ins):
             t = ff.add(t, extra, name=f"{layer.name}_add{j + 2}")
         return t
     if ltype == "Embedding":
+        if cfgd.get("mask_zero"):
+            # tf propagates the mask (e.g. masked-mean pooling); a
+            # plain lookup would silently pool over padding
+            raise NotImplementedError(
+                "keras_exp: Embedding(mask_zero=True) masking is not "
+                "propagated")
         return ff.embedding(ins[0], cfgd["input_dim"], cfgd["output_dim"],
-                            name=layer.name)
+                            aggr="none", name=layer.name)
+    if ltype == "GlobalAveragePooling1D":
+        if cfgd.get("keepdims") or \
+                cfgd.get("data_format", "channels_last") != "channels_last":
+            raise NotImplementedError(
+                f"keras_exp: GlobalAveragePooling1D keepdims/"
+                f"channels_first configs are unsupported "
+                f"({ {k: cfgd.get(k) for k in ('keepdims', 'data_format')} })")
+        return ff.reduce_mean(ins[0], axis=1, name=layer.name)
+    if ltype == "LayerNormalization":
+        axis = cfgd.get("axis", -1)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        if list(axes) not in ([-1], [len(layer.input.shape) - 1]):
+            raise NotImplementedError(
+                f"keras_exp: LayerNormalization axis={axis}; only "
+                f"last-dim normalization is supported")
+        return ff.layer_norm(ins[0], eps=cfgd.get("epsilon", 1e-3),
+                             name=layer.name)
     raise NotImplementedError(f"keras_exp: unsupported layer {ltype}")
 
 

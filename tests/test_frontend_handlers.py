@@ -346,3 +346,33 @@ def test_onnx_layer_norm_handler():
     var = xv.var(-1, keepdims=True)
     want = np.maximum((xv - mu) / np.sqrt(var + 1e-5) * scale + bias, 0)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@needs_tf
+def test_keras_exp_real_tf_embedding_gap_layernorm_matches_predict():
+    """Real tf.keras text-classifier head: Embedding (sequence output,
+    tf semantics) -> GlobalAveragePooling1D -> LayerNormalization ->
+    Dense, imported with weights and matching tf's forward."""
+    tfk = tf.keras
+    inp = tfk.Input((10,), dtype="int32")
+    t = tfk.layers.Embedding(50, 8, name="emb")(inp)
+    t = tfk.layers.GlobalAveragePooling1D(name="gap")(t)
+    t = tfk.layers.LayerNormalization(name="ln")(t)
+    out = tfk.layers.Dense(4, name="head")(t)
+    tf_model = tfk.Model(inp, out)
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    ff = from_tf_keras(tf_model, config=cfg, batch_size=8)
+    ff.softmax(ff.ops[-1].outputs[0])
+    ff.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50, (8, 10)).astype(np.int32)
+    want = tf_model.predict(ids, verbose=0)
+    logits = ff.ops[-2].outputs[0]
+    values, _ = ff.executor.forward_values(
+        ff.state.params, ff.state.states,
+        {ff.input_tensors[0].name: ids}, False, None)
+    np.testing.assert_allclose(np.asarray(values[logits.uid]), want,
+                               atol=1e-4)
