@@ -70,6 +70,7 @@ def test_native_examples_run(script, args):
     "examples/python/keras/func_cifar10_cnn_nested.py",
     "examples/python/keras/seq_mnist_cnn_nested.py",
     "examples/python/keras/func_mnist_mlp_concat2.py",
+    "examples/python/keras/seq_text_classification.py",
     "examples/python/keras/func_cifar10_cnn_net2net.py",
     "examples/python/keras/func_mnist_cnn.py",
     "examples/python/keras/func_cifar10_cnn.py",
