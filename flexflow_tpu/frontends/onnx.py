@@ -320,17 +320,19 @@ class ONNXModel:
                 t = ffmodel.embedding(values[ins[1]], w.shape[0],
                                       w.shape[1], aggr="none", name=name)
                 pending_weights[name] = {"kernel": w}
-            elif node.op_type == "ReduceMean":
+            elif node.op_type in ("ReduceMean", "ReduceSum", "ReduceMax"):
                 axes = a.get("axes")
                 if axes is None and len(ins) > 1:  # opset>=18: input 1
                     axes = self.inits[ins[1]].tolist()
                 if axes is None or len(list(np.ravel(axes))) != 1:
                     raise NotImplementedError(
-                        f"ReduceMean node {name}: exactly one axis is "
-                        f"supported, got {axes}")
-                t = ffmodel.reduce_mean(
-                    values[ins[0]], axis=int(np.ravel(axes)[0]),
-                    keepdims=bool(a.get("keepdims", 1)), name=name)
+                        f"{node.op_type} node {name}: exactly one axis "
+                        f"is supported, got {axes}")
+                fn = {"ReduceMean": ffmodel.reduce_mean,
+                      "ReduceSum": ffmodel.reduce_sum,
+                      "ReduceMax": ffmodel.reduce_max}[node.op_type]
+                t = fn(values[ins[0]], axis=int(np.ravel(axes)[0]),
+                       keepdims=bool(a.get("keepdims", 1)), name=name)
             elif node.op_type == "Constant":
                 # fold into the initializer map: downstream handlers
                 # (Reshape shape, Split sizes) read constants from there

@@ -66,6 +66,10 @@ class MultiHeadAttention(Op):
         # remat
         self._fused_qkv = (q is k and k is v
                            and self.q_in == self.k_in == self.v_in)
+        # cross-attention (seq2seq decoders): K and V read the SAME
+        # encoder output — fuse their projections into one 2x-wide GEMM
+        self._fused_kv = (not self._fused_qkv and k is v
+                          and self.k_in == self.v_in)
         self.kernel_initializer = kernel_initializer
         self.attrs = {"embed_dim": embed_dim, "num_heads": num_heads,
                       "dropout": dropout, "use_bias": use_bias,
@@ -122,10 +126,17 @@ class MultiHeadAttention(Op):
         else:
             q = jnp.einsum("bse,ehd->bshd", q_in,
                            params["wq"].astype(q_in.dtype))
-            k = jnp.einsum("bse,ehd->bshd", k_in,
-                           params["wk"].astype(k_in.dtype))
-            v = jnp.einsum("bse,ehd->bshd", v_in,
-                           params["wv"].astype(v_in.dtype))
+            if self._fused_kv:
+                # one 2x-wide GEMM over the shared encoder output
+                w = jnp.stack([params["wk"], params["wv"]],
+                              axis=1).astype(k_in.dtype)  # (E, 2, H, D)
+                kv = jnp.einsum("bse,exhd->xbshd", k_in, w)
+                k, v = kv[0], kv[1]
+            else:
+                k = jnp.einsum("bse,ehd->bshd", k_in,
+                               params["wk"].astype(k_in.dtype))
+                v = jnp.einsum("bse,ehd->bshd", v_in,
+                               params["wv"].astype(v_in.dtype))
         if self.add_bias_kv:
             b = k.shape[0]
             bk = jnp.broadcast_to(params["bias_k"].astype(k.dtype),

@@ -132,3 +132,46 @@ def test_fused_qkv_under_remat_matches_no_remat():
         l1 = float(ff1.train_batch(b)["loss"])
         l2 = float(ff2.train_batch(b)["loss"])
         np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_fused_kv_cross_attention_matches_separate():
+    """Cross-attention with k is v (seq2seq decoder over encoder
+    output) uses the fused 2x-wide KV projection; numerics must equal
+    a graph where k and v are distinct tensors with identical values."""
+    import numpy as np
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+    def build(share_kv):
+        cfg = FFConfig()
+        cfg.batch_size = 4
+        ff = FFModel(cfg)
+        q = ff.create_tensor((4, 6, 32), name="q")
+        kv = ff.create_tensor((4, 9, 32), name="kv")
+        if share_kv:
+            a = ff.multihead_attention(q, kv, kv, 32, 4, name="xattn")
+        else:
+            kv2 = ff.create_tensor((4, 9, 32), name="kv2")
+            a = ff.multihead_attention(q, kv, kv2, 32, 4, name="xattn")
+        t = ff.reshape(a, (4, 6 * 32))
+        ff.softmax(ff.dense(t, 4, name="head"))
+        ff.compile(optimizer=SGDOptimizer(lr=0.05),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=[])
+        return ff
+
+    ff1, ff2 = build(True), build(False)
+    attn1 = next(o for o in ff1.ops if o.op_type == "multihead_attention")
+    attn2 = next(o for o in ff2.ops if o.op_type == "multihead_attention")
+    assert attn1._fused_kv and not attn1._fused_qkv
+    assert not attn2._fused_kv
+    for name in ("xattn", "head"):
+        ff2.set_weights(name, ff1.get_weights(name))
+    rng = np.random.RandomState(0)
+    qv = rng.randn(4, 6, 32).astype(np.float32)
+    kvv = rng.randn(4, 9, 32).astype(np.float32)
+    y = rng.randint(0, 4, 4).astype(np.int32)
+    for _ in range(3):
+        l1 = float(ff1.train_batch({"q": qv, "kv": kvv, "label": y})["loss"])
+        l2 = float(ff2.train_batch({"q": qv, "kv": kvv, "kv2": kvv,
+                                    "label": y})["loss"])
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
