@@ -520,6 +520,9 @@ def finalize(model, res):
     hist = dict(hist)
     hist["extra"] = dict(hist.get("extra", {}))
     hist["extra"]["stale"] = True
+    # staleness must survive parsers that ignore `extra`: surface it at
+    # top level too
+    hist["stale"] = True
     if res:
         hist["extra"]["cpu_liveness"] = {
             "value": res.get("value"),

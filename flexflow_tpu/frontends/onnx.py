@@ -323,7 +323,13 @@ class ONNXModel:
             elif node.op_type in ("ReduceMean", "ReduceSum", "ReduceMax"):
                 axes = a.get("axes")
                 if axes is None and len(ins) > 1:  # opset>=18: input 1
-                    axes = self.inits[ins[1]].tolist()
+                    ax_init = self.inits.get(ins[1])
+                    if ax_init is None:
+                        raise NotImplementedError(
+                            f"{node.op_type} node {name}: axes must be a "
+                            f"constant (initializer/Constant); dynamically "
+                            f"computed axes are unsupported")
+                    axes = ax_init.tolist()
                 if axes is None or len(list(np.ravel(axes))) != 1:
                     raise NotImplementedError(
                         f"{node.op_type} node {name}: exactly one axis "

@@ -193,6 +193,10 @@ def parse_attribute(buf: bytes):
             s = val
         elif fno == 5:
             t = parse_tensor(val)[1]
+        elif fno == 6:
+            raise NotImplementedError(
+                f"ONNX attribute {name!r}: GRAPH attributes (If/Loop "
+                f"subgraphs) are unsupported")
         elif fno == 7:
             _packed_or_scalar(floats, wt, val, "<f")
         elif fno == 8:
@@ -209,6 +213,10 @@ def parse_attribute(buf: bytes):
                8: [x.decode() for x in strings]}
     if atype in by_type:
         return name, by_type[atype]
+    if atype:  # set but outside the supported set (GRAPH(S)=5/10, etc.)
+        raise NotImplementedError(
+            f"ONNX attribute {name!r}: AttributeProto.type {atype} "
+            f"unsupported")
     for v in (i, f, t):
         if v is not None:
             return name, v

@@ -160,6 +160,12 @@ def _reduce_mean_desc(name, node, ins) -> _OpDesc:
         raise NotImplementedError(
             f"mean at {name}: exactly one int dim is supported, "
             f"got {dim!r}")
+    if dim < 0:
+        # normalize against the traced rank when fx shape metadata is
+        # available, so .mean(-rank) is rejected here, not deep in Reduce
+        tm = getattr(node.args[0], "meta", {}).get("tensor_meta")
+        if tm is not None:
+            dim += len(tm.shape)
     if dim == 0:
         raise NotImplementedError(
             f"mean at {name}: dim 0 is the sample dim and cannot be "
@@ -274,6 +280,8 @@ class PyTorchModel:
                     values[d.inputs[0]], int(a["vocab"]), int(a["dim"]),
                     aggr="none", name=d.name)
             elif d.op_type == "reduce_mean":
+                # Reduce.__init__ normalizes negative axes and rejects
+                # the sample dim — pass the raw axis through
                 values[d.name] = ffmodel.reduce_mean(
                     values[d.inputs[0]], axis=int(a["axis"]),
                     keepdims=bool(int(a.get("keepdims", 0))),

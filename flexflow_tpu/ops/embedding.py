@@ -174,6 +174,15 @@ class DistributedEmbedding(Op):
         if device_ids is not None and len(device_ids) == 1 \
                 and self.num_tables > 1:
             device_ids = tuple(device_ids) * self.num_tables
+        if device_ids is not None and mesh is None:
+            # meshless compile: a device-explicit placement cannot
+            # execute, and building the padded slot layout anyway would
+            # only multiply kernel memory — reset to plain stacking
+            import warnings
+            warnings.warn(
+                f"{self.name}: device-explicit placement {device_ids} "
+                f"ignored — no mesh to place on (meshless compile)")
+            device_ids = None
         if device_ids is None:
             self.placement = None
             self._slots = None
@@ -186,8 +195,7 @@ class DistributedEmbedding(Op):
                 f"num_tables {self.num_tables} (per-table placement "
                 f"needs one device id per table, or exactly one id to "
                 f"pin all tables)")
-        n_dev = int(mesh.size) if mesh is not None \
-            else max(int(d) for d in device_ids) + 1
+        n_dev = int(mesh.size)
         ids = [int(d) for d in device_ids]
         if any(d < 0 or d >= n_dev for d in ids):
             raise ValueError(

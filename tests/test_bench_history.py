@@ -55,6 +55,8 @@ def test_cpu_fallback_replaced_by_stale_history(bench):
     out = bench.finalize("transformer", fresh_cpu())
     assert out["value"] == 964.87
     assert out["extra"]["stale"] is True
+    # ADVICE r3: parsers that ignore `extra` must still see staleness
+    assert out["stale"] is True
     assert out["extra"]["captured"] == "2026-07-29T20:43:26Z"
     assert out["extra"]["cpu_liveness"]["value"] == 3.0
 
@@ -87,6 +89,7 @@ def test_merge_fresh_tpu_overwrites(bench):
     merged = bench.merge_bench_all({"transformer": fresh_tpu(2000.0)})
     assert merged["transformer"]["value"] == 2000.0
     assert "stale" not in merged["transformer"]["extra"]
+    assert "stale" not in merged["transformer"]
 
 
 def test_history_untouched_by_finalize_mutation(bench):

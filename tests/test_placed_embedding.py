@@ -148,6 +148,27 @@ def test_skewed_placement_pads():
                                    rtol=1e-5)
 
 
+def test_meshless_placement_warns_and_resets():
+    """A placed strategy on a meshless compile cannot execute: it must
+    warn and fall back to plain stacking, NOT build the padded slot
+    layout (ADVICE r3: high device ids would silently multiply kernel
+    memory with zero benefit)."""
+    strat = Strategy(default=OpStrategy({}))
+    strat.set("tables", OpStrategy({DEVICE_KEY: (7, 0, 7, 0, 7, 0, 7, 0)}))
+    with pytest.warns(UserWarning, match="no mesh"):
+        ff = build(mesh=None, strategy=strat)
+    op = next(o for o in ff.ops if o.op_type == "distributed_embedding")
+    assert op.placement is None
+    assert op.num_slots == TABLES  # plain stacking, no padding
+    ref = build()
+    kern = np.asarray(ref.get_weights("tables")["kernel"])
+    place_weights(ff, kern, ref.get_weights("dense"))
+    for b in batches(1):
+        np.testing.assert_allclose(float(ff.train_batch(b)["loss"]),
+                                   float(ref.train_batch(b)["loss"]),
+                                   rtol=1e-5)
+
+
 def test_adam_sparse_placed():
     """Lazy/exact-mode interplay: Adam (dense fallback) still matches."""
     mesh = make_mesh((4,), ("data",))
