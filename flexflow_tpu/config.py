@@ -113,6 +113,14 @@ class FFConfig:
     # simulator.cc:508-556); written by the first simulate() of a search.
     taskgraph_file: Optional[str] = None
 
+    # generalized pipeline parallelism (core/staged.py): auto-cut the op
+    # graph into this many flops-balanced stages over a matching mesh
+    # axis. 0 = off. Strategy device pins trigger staged execution
+    # independently of this knob.
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 4
+    pipeline_schedule: str = "gpipe"
+
     # fusion (reference: --fusion flag, model.cc:1472)
     perform_fusion: bool = False
 
@@ -169,6 +177,10 @@ class FFConfig:
             raise ValueError(
                 f"conv_layout must be 'NCHW' or 'NHWC', got "
                 f"{self.conv_layout!r}")
+        if self.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pipeline_schedule must be 'gpipe' or '1f1b', got "
+                f"{self.pipeline_schedule!r}")
 
     @classmethod
     def from_args(cls, argv: Optional[Sequence[str]] = None) -> "FFConfig":
@@ -199,6 +211,9 @@ class FFConfig:
         "--taskgraph": ("taskgraph_file", str),
         "--seed": ("seed", int),
         "--conv-layout": ("conv_layout", str),
+        "--pipeline-stages": ("pipeline_stages", int),
+        "--pipeline-microbatches": ("pipeline_microbatches", int),
+        "--pipeline-schedule": ("pipeline_schedule", str),
     }
     _BOOL_FLAGS = {
         "--profiling": "profiling",

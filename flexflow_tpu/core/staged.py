@@ -1,0 +1,181 @@
+"""StagedExecutor: pipelined execution of arbitrary op graphs.
+
+The executable lowering of whole-op device placement (reference
+FFMapper::slice_task routing ops to ParallelConfig.device_ids,
+/root/reference/src/mapper/mapper.cc:346-440) and of pipeline
+parallelism over non-uniform graphs (SURVEY §7 hard part (c)). The op
+graph is cut into S stages (from strategy pins or flops-balanced
+auto-cut); parameters flat-pack into per-stage rows sharded over the
+mesh `pipe` axis (real per-device weight residency); forward runs the
+GPipe microbatch schedule (parallel/graph_pipeline.py).
+
+Inherits every step builder from Executor — only parameter layout
+(init_state), the loss-bearing forward (_outputs_and_loss), and the
+weight-access hooks change. Elementwise optimizers (SGD/Adam) update
+the packed rows directly, so optimizer state is stage-resident too.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import initializers as I
+from .executor import Executor, _stable_hash
+from ..parallel.graph_pipeline import (
+    PackSpec,
+    StagePlan,
+    build_stage_plan,
+    make_pack_spec,
+    pack_params,
+    pipeline_1f1b_grads,
+    pipeline_logits,
+    read_op_weights,
+    write_op_weights,
+)
+
+PACKED = "__stages__"
+
+
+class StagedExecutor(Executor):
+    def __init__(self, model, optimizer, loss_fn, metric_names,
+                 mesh: Mesh, strategy, comp_mode: str,
+                 stage_of: Dict[str, int], pipe_axis: str,
+                 num_microbatches: int, schedule: str = "gpipe"):
+        if mesh is None or pipe_axis not in mesh.shape:
+            raise ValueError(
+                f"staged execution needs a mesh axis to pipeline over; "
+                f"got axis {pipe_axis!r} in {mesh}")
+        self.plan: StagePlan = build_stage_plan(model, stage_of)
+        if mesh.shape[pipe_axis] != self.plan.num_stages:
+            raise ValueError(
+                f"stage count {self.plan.num_stages} != mesh axis "
+                f"{pipe_axis!r} size {mesh.shape[pipe_axis]}")
+        self.pack: PackSpec = make_pack_spec(self.plan)
+        self.pipe_axis = pipe_axis
+        self.num_microbatches = int(num_microbatches)
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
+        self.schedule = schedule
+        super().__init__(model, optimizer, loss_fn, metric_names,
+                         mesh=mesh, strategy=strategy,
+                         comp_mode=comp_mode)
+
+    # The sparse-embedding fast path gathers rows outside the
+    # differentiated region — incompatible with packed stage rows.
+    # Dense gradients through the pipeline are always correct.
+    def _sparse_table_ops(self) -> Dict:
+        self._sparse_ops_cache = {}
+        return {}
+
+    # ---------------- state ----------------
+    def init_state(self, rng):
+        by_op: Dict[str, Dict[str, np.ndarray]] = {}
+        for op in self.model.ops:
+            wspecs = op.weight_specs()
+            if not wspecs:
+                continue
+            op_params = {}
+            for wname, spec in wspecs.items():
+                key = jax.random.fold_in(
+                    jax.random.fold_in(rng, _stable_hash(op.name)),
+                    _stable_hash(wname))
+                init_fn = spec.custom_init or I.resolve(spec.initializer)
+                if spec.fan_in is not None or spec.fan_out is not None:
+                    arr = init_fn(key, spec.shape, spec.dtype,
+                                  fan_in=spec.fan_in, fan_out=spec.fan_out)
+                else:
+                    arr = init_fn(key, spec.shape, spec.dtype)
+                op_params[wname] = np.asarray(arr)
+            by_op[op.name] = op_params
+        packed_host = pack_params(self.pack, by_op)
+        packed = {dt: self._place_packed(a)
+                  for dt, a in packed_host.items()}
+        params = {PACKED: packed}
+        opt_state = (self.optimizer.init_state(params)
+                     if self.optimizer and self.comp_mode != "inference"
+                     else {})
+        # optimizer slots mirror the packed rows — place them with the
+        # same per-stage sharding so optimizer state is stage-resident
+        opt_state = jax.tree_util.tree_map(
+            lambda a: self._place_packed(np.asarray(a)), opt_state)
+        from .executor import TrainState
+        return TrainState(params, {}, opt_state,
+                          jnp.zeros((), jnp.int32))
+
+    def _packed_sharding(self):
+        return NamedSharding(self.mesh, P(self.pipe_axis, None))
+
+    def _place_packed(self, host):
+        from ..parallel.sharding import place_global
+        return place_global(np.asarray(host), self._packed_sharding())
+
+    # ---------------- gradients ----------------
+    def _compute_grads(self, params, states, batch, rng):
+        """1F1B computes gradients explicitly inside the pipelined tick
+        loop (per-stage vjp recompute, cotangents riding the reverse
+        ring); GPipe differentiates the forward schedule (base class).
+        Same returned contract either way."""
+        if self.schedule != "1f1b":
+            return super()._compute_grads(params, states, batch, rng)
+        inputs = {t.name: batch[t.name]
+                  for t in self.model.input_tensors}
+        label = batch.get("label")
+        logits, aux, packed_grads = pipeline_1f1b_grads(
+            self.plan, self.pack, params[PACKED], inputs, label,
+            self.loss_fn, rng, self.mesh, self.pipe_axis,
+            self._data_axis(), self.num_microbatches, self.model,
+            seq_length=self.config.iter_config.seq_length)
+        loss = jnp.asarray(0.0, jnp.float32)
+        if self.loss_fn is not None and label is not None:
+            loss = self.loss_fn(logits, label)
+        loss = loss + aux
+        return loss, logits, dict(states), {PACKED: packed_grads}, {}
+
+    # ---------------- forward/loss ----------------
+    def _outputs_and_loss(self, params, states, batch, training, rng,
+                          seq_length):
+        inputs = {t.name: batch[t.name] for t in self.model.input_tensors}
+        logits, aux = pipeline_logits(
+            self.plan, self.pack, params[PACKED], inputs, rng,
+            self.mesh, self.pipe_axis, self._data_axis(),
+            self.num_microbatches, self.model, training=training,
+            seq_length=seq_length, schedule="gpipe")
+        loss = jnp.asarray(0.0, jnp.float32)
+        if self.loss_fn is not None and "label" in batch:
+            loss = self.loss_fn(logits, batch["label"])
+        loss = loss + aux
+        return loss, (logits, dict(states))
+
+    def _data_axis(self) -> Optional[str]:
+        return "data" if "data" in self.mesh.shape else None
+
+    # ---------------- weight access hooks (model.get/set_weights) ----
+    def get_op_weights(self, state, op_name: str):
+        host = {dt: np.asarray(jax.device_get(a))
+                for dt, a in state.params[PACKED].items()}
+        out = read_op_weights(self.pack, host, op_name)
+        if not out:
+            raise KeyError(f"op {op_name!r} has no weights")
+        return out
+
+    def set_op_weights(self, state, op_name: str, weights) -> None:
+        host = {dt: np.asarray(jax.device_get(a))
+                for dt, a in state.params[PACKED].items()}
+        new_host = write_op_weights(self.pack, host, op_name, weights)
+        state.params[PACKED] = {dt: self._place_packed(a)
+                                for dt, a in new_host.items()}
+
+    def get_op_opt_slots(self, state, op_name: str):
+        """Per-op view of optimizer slots (packed layout mirrors
+        params)."""
+        out = {}
+        for slot, tree in state.opt_state.items():
+            host = {dt: np.asarray(jax.device_get(a))
+                    for dt, a in tree[PACKED].items()}
+            out[slot] = read_op_weights(self.pack, host, op_name)
+        return out

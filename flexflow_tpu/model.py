@@ -397,29 +397,87 @@ class FFModel:
             if self.config.export_strategy_file:
                 self.strategy.save(self.config.export_strategy_file)
 
-        if self.strategy is not None:
-            # per-table device ids on distributed_embedding EXECUTE (the
-            # op lowers them to a device-ordered slot layout, see
-            # ops/embedding.py apply_placement); other placed ops still
-            # fall back to replication under GSPMD
-            ops_by_name = {op.name: op for op in self.ops}
-            placed = [n for n, s in self.strategy.op_strategies.items()
-                      if s.device_ids
-                      and getattr(ops_by_name.get(n), "op_type", None)
-                      != "distributed_embedding"]
-            if placed:
+        # device-explicit placement lowering. Per-table ids on
+        # distributed_embedding execute via the slot layout
+        # (ops/embedding.py apply_placement). Whole-op pins on other ops
+        # execute as PIPELINE STAGES: stage order = device-id order,
+        # microbatches stream over the mesh pipe axis
+        # (core/staged.py; the executable analog of slice_task routing,
+        # mapper.cc:346-440). Pins that cannot form a forward pipeline
+        # (or lack a matching mesh axis) fall back to replication with
+        # a warning.
+        stage_of = None
+        pipe_axis = None
+        if self.strategy is not None and self.mesh is not None:
+            from .parallel.graph_pipeline import (
+                assignment_from_pins, build_stage_plan, pick_pipe_axis)
+            try:
+                stage_of = assignment_from_pins(self, self.strategy)
+                if stage_of is not None:
+                    build_stage_plan(self, stage_of)  # viability check
+            except (ValueError, NotImplementedError) as e:
                 import warnings
                 warnings.warn(
-                    f"strategy pins {placed} to explicit devices; GSPMD "
-                    f"executes device-explicit placement as replication "
-                    f"— use distributed_embedding per-table placement "
-                    f"for an executable equivalent")
+                    f"strategy pins ops to explicit devices but the "
+                    f"placement cannot execute as a pipeline "
+                    f"({e}); falling back to replication")
+                stage_of = None
+            if stage_of is not None:
+                n_stages = max(stage_of.values()) + 1
+                if n_stages < 2:
+                    stage_of = None  # all on one device: plain SPMD
+                else:
+                    pipe_axis = pick_pipe_axis(self.mesh, n_stages)
+                    if pipe_axis is None:
+                        import warnings
+                        warnings.warn(
+                            f"strategy pins ops across {n_stages} "
+                            f"devices but the mesh {self.mesh.shape} "
+                            f"has no non-data axis of that size to "
+                            f"pipeline over; executing as replication")
+                        stage_of = None
+        if stage_of is None and self.config.pipeline_stages > 1:
+            from .parallel.graph_pipeline import (
+                balanced_stages, pick_pipe_axis)
+            stage_of = balanced_stages(self, self.config.pipeline_stages)
+            n_stages = max(stage_of.values()) + 1  # clamped to op count
+            pipe_axis = (pick_pipe_axis(self.mesh, n_stages)
+                         if self.mesh is not None else None)
+            if pipe_axis is None:
+                raise ValueError(
+                    f"pipeline_stages={self.config.pipeline_stages} "
+                    f"(=> {n_stages} stages for this graph) needs a "
+                    f"mesh axis of that size to pipeline over (mesh: "
+                    f"{self.mesh.shape if self.mesh else None})")
+        if (stage_of is None and self.strategy is not None
+                and self.mesh is None):
+            # meshless compile: pins cannot execute at all — surface it
+            # (the mesh path warns through the lowering above)
+            pinned = [op.name for op in self.ops
+                      if self.strategy.for_op(op.name).device_ids
+                      and op.op_type != "distributed_embedding"]
+            if pinned:
+                import warnings
+                warnings.warn(
+                    f"strategy pins {pinned} to explicit devices but "
+                    f"there is no mesh; placement is ignored "
+                    f"(replicated single-device execution)")
 
         # Executor validates comp_mode; assign OURS only after it
         # succeeds so a rejected compile leaves the previous mode live
-        self.executor = Executor(self, optimizer, loss_type, metrics,
-                                 mesh=self.mesh, strategy=self.strategy,
-                                 comp_mode=comp_mode)
+        if stage_of is not None and pipe_axis is not None:
+            from .core.staged import StagedExecutor
+            self.executor = StagedExecutor(
+                self, optimizer, loss_type, metrics, mesh=self.mesh,
+                strategy=self.strategy, comp_mode=comp_mode,
+                stage_of=stage_of, pipe_axis=pipe_axis,
+                num_microbatches=self.config.pipeline_microbatches,
+                schedule=self.config.pipeline_schedule)
+        else:
+            self.executor = Executor(
+                self, optimizer, loss_type, metrics,
+                mesh=self.mesh, strategy=self.strategy,
+                comp_mode=comp_mode)
         self.comp_mode = comp_mode
         self.state = self.executor.init_state(self._next_rng())
         self._host_step = 0  # mirrors state.step for the train rng
@@ -807,6 +865,10 @@ class FFModel:
         model.cu:439-452). Under multi-controller SPMD a weight sharded
         across processes is all-gathered — a COLLECTIVE, so call from
         every process (the normal SPMD discipline)."""
+        if hasattr(self.executor, "get_op_weights"):
+            # staged (pipelined) executor: weights live flat-packed in
+            # per-stage rows; the hook unpacks the op's view
+            return self.executor.get_op_weights(self.state, op_name)
         op = next((o for o in self.ops if o.name == op_name), None)
         out = {}
         for k, v in self.state.params[op_name].items():
@@ -829,6 +891,9 @@ class FFModel:
         return out
 
     def set_weights(self, op_name: str, weights: Dict[str, np.ndarray]):
+        if hasattr(self.executor, "set_op_weights"):
+            self.executor.set_op_weights(self.state, op_name, weights)
+            return
         cur = self.state.params[op_name]
         op = next((o for o in self.ops if o.name == op_name), None)
         for k, v in weights.items():

@@ -1,0 +1,882 @@
+"""Generalized pipeline parallelism over ARBITRARY op graphs.
+
+Reference FlexFlow executes per-op device placement by routing each op's
+index-task points to its `ParallelConfig.device_ids`
+(/root/reference/src/mapper/mapper.cc:346-440); concurrency between ops
+placed on different devices comes from Legion's dataflow asynchrony.
+XLA's SPMD model has no per-op device routing — every device runs one
+program — so the TPU-native execution of "layer L on device d" is a
+PIPELINE: stages are contiguous groups of ops, the mesh `pipe` axis
+assigns one stage per device coordinate, and microbatches stream
+through the ring (shard_map + lax.switch on the stage index +
+lax.ppermute hops). This file is that lowering:
+
+  * ``StagePlan``     — partition of the op graph into S stages, with
+    the boundary (cut) tensors each inter-stage hop must carry.
+    Built either from a strategy's explicit whole-op device pins
+    (`assignment_from_pins`, the executable form of the reference's
+    propagate-placed strategies model.cc:1807-1903) or by flops-balanced
+    auto-cut (`balanced_stages`, the analog of SURVEY §7 hard part (c):
+    searching stage boundaries).
+  * ``PackSpec``      — per-stage parameter flat-packing: every stage's
+    weights flatten into one (S, L) row per dtype, sharded over the
+    pipe axis, so each device PHYSICALLY holds only its stage's
+    parameters (and its optimizer state rows) — true weight residency,
+    not replication. Elementwise optimizers (SGD/Adam) apply to packed
+    rows unchanged.
+  * ``pipeline_logits`` — the schedule. GPipe semantics: M microbatches,
+    M + S - 1 ticks, bubble fraction (S-1)/(M+S-1); backward runs as the
+    autodiff transpose of the same schedule (reverse pipeline).
+    `schedule="1f1b"` interleaves each stage's backward with remaining
+    forwards via a two-wire (activation + cotangent) steady state,
+    cutting peak per-stage activation storage from M to S microbatches.
+
+Heterogeneous stages are expressed as `lax.switch` branches on
+`lax.axis_index(pipe)`: XLA compiles every stage body once, each device
+executes its own branch — the one-program answer to Legion's per-device
+task variants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..op import Op, OpContext
+
+
+# --------------------------------------------------------------------------
+# stage planning
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class StagePlan:
+    """Partition of a model's op graph into pipeline stages.
+
+    stages[s]    ops of stage s, in topological order
+    stage_of     op name -> stage index
+    cuts[i]      tensors crossing the boundary between stages <= i and
+                 stages > i (each must ride hop i of the wire)
+    """
+
+    stages: List[List[Op]]
+    stage_of: Dict[str, int]
+    cuts: List[List]  # List[List[Tensor]]
+
+    @property
+    def num_stages(self) -> int:
+        return len(self.stages)
+
+
+def _check_supported(model, stage_of: Dict[str, int]) -> None:
+    for op in model.ops:
+        if op.state_specs():
+            raise NotImplementedError(
+                f"graph pipeline: op {op.name!r} ({op.op_type}) carries "
+                f"functional state (e.g. BatchNorm running stats); "
+                f"stateful ops are not supported under pipelined "
+                f"execution")
+        if op.name not in stage_of:
+            raise ValueError(f"op {op.name!r} has no stage assignment")
+
+
+def build_stage_plan(model, stage_of: Dict[str, int]) -> StagePlan:
+    """Materialize a StagePlan from an op->stage map. Validates that
+    data flows forward (producer stage <= consumer stage) and computes
+    the cut tensors every hop must carry."""
+    _check_supported(model, stage_of)
+    S = max(stage_of.values()) + 1
+    producer = {}
+    for op in model.ops:
+        for t in op.outputs:
+            producer[t.uid] = op.name
+    input_uids = {t.uid for t in model.input_tensors}
+    for op in model.ops:
+        for t in op.inputs:
+            if t.uid in input_uids:
+                continue  # graph inputs are microbatch-fed to every stage
+            ps = stage_of[producer[t.uid]]
+            if ps > stage_of[op.name]:
+                raise ValueError(
+                    f"stage assignment sends tensor {t.uid} backward: "
+                    f"producer {producer[t.uid]!r} is stage {ps}, "
+                    f"consumer {op.name!r} is stage "
+                    f"{stage_of[op.name]} — pipeline hops only go "
+                    f"forward")
+    stages: List[List[Op]] = [[] for _ in range(S)]
+    for op in model.ops:  # model.ops is topological order
+        stages[stage_of[op.name]].append(op)
+
+    # last consumer stage per tensor; the model output is virtually
+    # consumed at the last stage (it must arrive there to be emitted)
+    last_use: Dict[int, int] = {}
+    for op in model.ops:
+        for t in op.inputs:
+            if t.uid in input_uids:
+                continue
+            last_use[t.uid] = max(last_use.get(t.uid, 0),
+                                  stage_of[op.name])
+    final_uid = model.final_tensor.uid
+    last_use[final_uid] = S - 1
+
+    cuts: List[List] = []
+    by_uid = {}
+    for op in model.ops:
+        for t in op.outputs:
+            by_uid[t.uid] = t
+    for i in range(S - 1):
+        cut = [by_uid[uid] for uid, last in sorted(last_use.items())
+               if stage_of[producer[uid]] <= i < last]
+        cuts.append(cut)
+    return StagePlan(stages=stages, stage_of=dict(stage_of), cuts=cuts)
+
+
+def balanced_stages(model, num_stages: int) -> Dict[str, int]:
+    """Flops-balanced contiguous auto-cut: partition the topological op
+    order into `num_stages` segments minimizing the max per-stage flops
+    (linear-partition DP). The searchable analog of the reference's
+    hand-chosen per-layer placements."""
+    ops = model.ops
+    n = len(ops)
+    S = min(num_stages, n)
+    costs = [max(float(op.flops()), 1.0) for op in ops]
+    prefix = np.concatenate([[0.0], np.cumsum(costs)])
+
+    def seg(i, j):  # cost of ops[i:j]
+        return prefix[j] - prefix[i]
+
+    INF = float("inf")
+    # dp[k][j] = best max-stage-cost splitting ops[:j] into k stages
+    dp = [[INF] * (n + 1) for _ in range(S + 1)]
+    cut = [[0] * (n + 1) for _ in range(S + 1)]
+    dp[0][0] = 0.0
+    for k in range(1, S + 1):
+        for j in range(k, n + 1):
+            for i in range(k - 1, j):
+                c = max(dp[k - 1][i], seg(i, j))
+                if c < dp[k][j]:
+                    dp[k][j] = c
+                    cut[k][j] = i
+    bounds = [n]
+    j = n
+    for k in range(S, 0, -1):
+        j = cut[k][j]
+        bounds.append(j)
+    bounds.reverse()  # [0, c1, ..., n]
+    stage_of = {}
+    for s in range(S):
+        for op in ops[bounds[s]:bounds[s + 1]]:
+            stage_of[op.name] = s
+    return stage_of
+
+
+def assignment_from_pins(model, strategy) -> Optional[Dict[str, int]]:
+    """Derive a stage assignment from a strategy's whole-op device pins
+    (length-1 `__devices__` tuples on non-embedding ops) — the
+    executable lowering of reference propagate-placed strategies
+    (model.cc:1807-1903). Stage order = device-id order. Unpinned ops
+    inherit the latest stage among their producers. Returns None when no
+    such pins exist; raises if the pins cannot form a forward pipeline
+    (caller falls back to replication with the compile warning)."""
+    pins = {}
+    for op in model.ops:
+        s = strategy.for_op(op.name)
+        ids = s.device_ids
+        if ids is None or op.op_type == "distributed_embedding":
+            continue
+        if len(set(ids)) != 1:
+            raise ValueError(
+                f"op {op.name!r}: multi-device pin {ids} has no "
+                f"executable lowering (whole-op pins = one device id; "
+                f"use axis_map sharding for intra-op splits)")
+        pins[op.name] = int(ids[0])
+    if not pins:
+        return None
+    order = sorted(set(pins.values()))
+    rank = {d: i for i, d in enumerate(order)}
+    producer = {}
+    for op in model.ops:
+        for t in op.outputs:
+            producer[t.uid] = op.name
+    input_uids = {t.uid for t in model.input_tensors}
+    stage_of: Dict[str, int] = {}
+    for op in model.ops:
+        inherited = 0
+        for t in op.inputs:
+            if t.uid not in input_uids:
+                inherited = max(inherited, stage_of[producer[t.uid]])
+        stage_of[op.name] = (rank[pins[op.name]] if op.name in pins
+                             else inherited)
+    return stage_of
+
+
+def pick_pipe_axis(mesh: Mesh, num_stages: int) -> Optional[str]:
+    """Mesh axis to pipeline over: prefer an axis literally named
+    'pipe'/'layer' of the right size, else any non-'data' axis whose
+    size equals the stage count."""
+    if mesh is None:
+        return None
+    for name in ("pipe", "layer"):
+        if mesh.shape.get(name) == num_stages:
+            return name
+    for name, size in mesh.shape.items():
+        if name != "data" and size == num_stages:
+            return name
+    return None
+
+
+# --------------------------------------------------------------------------
+# parameter flat-packing
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Segment:
+    stage: int
+    dtype: str
+    offset: int
+    size: int
+    shape: Tuple[int, ...]
+
+
+@dataclasses.dataclass
+class PackSpec:
+    """Layout of per-stage flat-packed parameters.
+
+    Packed form: {dtype_str: (S, L_dtype)} — row s holds stage s's
+    weights (flattened, concatenated, zero-padded to the longest
+    stage). Sharded P(pipe, None): each device holds exactly its
+    stage's row, so weights (and elementwise-optimizer state, which
+    mirrors the packed tree) physically reside on their pinned device.
+    """
+
+    segments: Dict[Tuple[str, str], _Segment]  # (op, weight) -> segment
+    lengths: Dict[str, int]                    # dtype -> L
+    num_stages: int
+
+    def row_layout(self, stage: int) -> List[Tuple[str, str, _Segment]]:
+        return [(op, w, seg) for (op, w), seg in self.segments.items()
+                if seg.stage == stage]
+
+
+def make_pack_spec(plan: StagePlan) -> PackSpec:
+    segments: Dict[Tuple[str, str], _Segment] = {}
+    lengths: Dict[str, int] = {}
+    for s, ops in enumerate(plan.stages):
+        offsets: Dict[str, int] = {}
+        for op in ops:
+            for wname, spec in op.weight_specs().items():
+                dt = np.dtype(spec.dtype).name
+                size = int(np.prod(spec.shape)) if spec.shape else 1
+                off = offsets.get(dt, 0)
+                segments[(op.name, wname)] = _Segment(
+                    stage=s, dtype=dt, offset=off, size=size,
+                    shape=tuple(spec.shape))
+                offsets[dt] = off + size
+        for dt, end in offsets.items():
+            lengths[dt] = max(lengths.get(dt, 0), end)
+    if not lengths:  # weightless graph: keep one dummy lane so the
+        lengths["float32"] = 1  # packed tree / optimizer state is non-empty
+    return PackSpec(segments=segments, lengths=lengths,
+                    num_stages=plan.num_stages)
+
+
+def pack_params(spec: PackSpec, params_by_op: Dict[str, Dict[str, np.ndarray]]):
+    """Host-side: {op: {w: array}} -> {dtype: (S, L) ndarray}."""
+    packed = {dt: np.zeros((spec.num_stages, L), dtype=dt)
+              for dt, L in spec.lengths.items()}
+    for (opn, wn), seg in spec.segments.items():
+        arr = np.asarray(params_by_op[opn][wn]).reshape(-1)
+        packed[seg.dtype][seg.stage, seg.offset:seg.offset + seg.size] = arr
+    return packed
+
+
+def unpack_stage(spec: PackSpec, packed_row: Dict[str, jax.Array],
+                 stage: int) -> Dict[str, Dict[str, jax.Array]]:
+    """Trace-time: slice one stage's weights out of its packed row
+    ({dtype: (L,)}). `stage` is static (each switch branch closes over
+    its own)."""
+    out: Dict[str, Dict[str, jax.Array]] = {}
+    for opn, wn, seg in spec.row_layout(stage):
+        flat = lax.dynamic_slice_in_dim(packed_row[seg.dtype],
+                                        seg.offset, seg.size)
+        out.setdefault(opn, {})[wn] = flat.reshape(seg.shape)
+    return out
+
+
+def read_op_weights(spec: PackSpec, packed, op_name: str):
+    """Host-side view of one op's weights out of the packed arrays."""
+    out = {}
+    for (opn, wn), seg in spec.segments.items():
+        if opn != op_name:
+            continue
+        row = np.asarray(packed[seg.dtype][seg.stage])
+        out[wn] = row[seg.offset:seg.offset + seg.size].reshape(seg.shape)
+    return out
+
+
+def write_op_weights(spec: PackSpec, packed, op_name: str,
+                     weights: Dict[str, np.ndarray]):
+    """Return a new packed dict with `op_name`'s weights replaced."""
+    host = {dt: np.asarray(a).copy() for dt, a in packed.items()}
+    for wn, arr in weights.items():
+        seg = spec.segments.get((op_name, wn))
+        if seg is None:
+            raise KeyError(
+                f"{op_name!r} has no weight {wn!r} in the stage packing")
+        a = np.asarray(arr)
+        if tuple(a.shape) != seg.shape:
+            raise ValueError(
+                f"{op_name}.{wn}: shape {a.shape} != declared {seg.shape}")
+        host[seg.dtype][seg.stage,
+                        seg.offset:seg.offset + seg.size] = \
+            a.astype(host[seg.dtype].dtype, copy=False).reshape(-1)
+    return host
+
+
+# --------------------------------------------------------------------------
+# wire (inter-stage hop buffer)
+# --------------------------------------------------------------------------
+
+def _wire_layouts(plan: StagePlan):
+    """Per-cut flat layout and per-dtype max hop width. The wire is one
+    {dtype: (W,)} buffer: every device sends/receives the same shapes
+    (SPMD), each interprets its own cut's layout."""
+    layouts = []
+    widths: Dict[str, int] = {}
+    for cut in plan.cuts:
+        lay = []
+        offsets: Dict[str, int] = {}
+        for t in cut:
+            dt = np.dtype(t.dtype).name
+            size = int(np.prod(t.shape[1:]))  # per-sample; dim0 = batch
+            off = offsets.get(dt, 0)
+            lay.append((t.uid, dt, off, size, tuple(t.shape[1:])))
+            offsets[dt] = off + size
+        for dt, end in offsets.items():
+            widths[dt] = max(widths.get(dt, 0), end)
+        layouts.append(lay)
+    if not widths:
+        widths["float32"] = 1
+    return layouts, widths
+
+
+# --------------------------------------------------------------------------
+# the pipelined forward
+# --------------------------------------------------------------------------
+
+def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
+                       widths, mb_local: int, *, training: bool,
+                       seq_length: int):
+    """Shared stage body for both schedules: unpack weights + incoming
+    wire, run the stage's ops, emit (wire_out, final, aux). Pure
+    compute — collectives stay at the tick level (SPMD-uniform across
+    switch branches)."""
+    S = plan.num_stages
+    final_t = model.final_tensor
+    name_of_input = {t.name: t.uid for t in model.input_tensors}
+
+    def run_stage(s: int, row: Dict[str, jax.Array],
+                  wire_in: Dict[str, jax.Array],
+                  mb_in: Dict[str, jax.Array], mb_rng):
+        values: Dict[int, jax.Array] = {}
+        for name, v in mb_in.items():
+            values[name_of_input[name]] = v
+        if s > 0:
+            for uid, dt, off, size, shape in layouts[s - 1]:
+                flat = lax.dynamic_slice_in_dim(
+                    wire_in[dt], off * mb_local, size * mb_local)
+                values[uid] = flat.reshape((mb_local,) + shape)
+        params_s = unpack_stage(pack, row, s)
+        aux = jnp.float32(0.0)
+        for i, op in enumerate(plan.stages[s]):
+            ctx = OpContext(
+                training=training,
+                rng=(jax.random.fold_in(mb_rng, i)
+                     if mb_rng is not None else None),
+                seq_length=seq_length,
+                mesh=None, op_strategy=None)
+            xs = [values[t.uid] for t in op.inputs]
+            ys = op.forward(params_s.get(op.name, {}), xs, ctx)
+            for t, y in zip(op.outputs, ys):
+                values[t.uid] = y
+            if ctx.aux_loss is not None:
+                aux = aux + ctx.aux_loss
+        wire_out = {dt: jnp.zeros((w * mb_local,), dtype=dt)
+                    for dt, w in widths.items()}
+        if s < S - 1:
+            for uid, dt, off, size, shape in layouts[s]:
+                wire_out[dt] = lax.dynamic_update_slice_in_dim(
+                    wire_out[dt],
+                    values[uid].reshape(-1).astype(wire_out[dt].dtype),
+                    off * mb_local, axis=0)
+        if s == S - 1:
+            final = values[final_t.uid]
+        else:
+            final = jnp.zeros((mb_local,) + tuple(final_t.shape[1:]),
+                              dtype=final_t.dtype)
+        return wire_out, final, aux
+
+    return run_stage
+
+
+def _data_split(mesh: Mesh, data_axis: Optional[str], mb: int):
+    """(data_ax or None, n_data, mb_local): microbatches shard over the
+    data axis inside each stage when divisible, else replicate."""
+    data_ax = data_axis if (data_axis and data_axis in mesh.shape) else None
+    ndata = mesh.shape[data_ax] if data_ax else 1
+    if mb % ndata != 0:
+        data_ax, ndata = None, 1
+    return data_ax, ndata, mb // ndata
+
+
+def pipeline_logits(plan: StagePlan, pack: PackSpec, packed,
+                    inputs: Dict[str, jax.Array], rng, mesh: Mesh,
+                    pipe_axis: str, data_axis: Optional[str],
+                    num_microbatches: int, model, *, training: bool,
+                    seq_length: int = -1, schedule: str = "gpipe"):
+    """Run the staged graph pipelined over `pipe_axis`; returns
+    (logits (B, ...), aux_loss scalar).
+
+    GPipe schedule, M microbatches over S stages: tick t has stage s
+    computing microbatch t - s; activations hop via ppermute. Backward
+    is the autodiff transpose (a reverse pipeline). Bubble fraction
+    (S-1)/(M+S-1) forward, same again backward — `simulate_step_scaling`
+    predicts step-time scaling, tests hold measurements against it.
+    The 1F1B schedule lives in `pipeline_1f1b_grads` (it computes
+    gradients directly instead of relying on the autodiff transpose).
+    """
+    S = plan.num_stages
+    M = int(num_microbatches)
+    if schedule != "gpipe":
+        raise ValueError(
+            f"pipeline_logits runs the gpipe schedule; use "
+            f"pipeline_1f1b_grads for 1F1B (got {schedule!r})")
+    final_t = model.final_tensor
+    B = next(iter(inputs.values())).shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    layouts, widths = _wire_layouts(plan)
+
+    # (B, ...) -> (M, mb, ...)
+    inputs_mb = {k: v.reshape((M, mb) + v.shape[1:])
+                 for k, v in inputs.items()}
+
+    data_ax, ndata, mb_local = _data_split(mesh, data_axis, mb)
+    run_stage = _make_stage_runner(
+        plan, pack, model, layouts, widths, mb_local,
+        training=training, seq_length=seq_length)
+
+    def local_fn(packed_local, inputs_local, rng_op):
+        # packed_local: {dt: (1, L)}; inputs_local: {name: (M, mb_l, ...)}
+        idx = lax.axis_index(pipe_axis)
+        row = {dt: a[0] for dt, a in packed_local.items()}
+        branches = [functools.partial(run_stage, s) for s in range(S)]
+
+        def tick(carry, t):
+            wire, outputs, aux_acc = carry
+            mb_idx = jnp.clip(t - idx, 0, M - 1)
+            mb_in = {k: lax.dynamic_index_in_dim(v, mb_idx,
+                                                 keepdims=False)
+                     for k, v in inputs_local.items()}
+            mb_rng = (jax.random.fold_in(rng_op, mb_idx)
+                      if rng_op is not None else None)
+            wire_out, final, aux = lax.switch(
+                idx, branches, row, wire, mb_in, mb_rng)
+            valid = jnp.logical_and(t - idx >= 0, t - idx < M)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            wire_nxt = {dt: lax.ppermute(a, pipe_axis, perm)
+                        for dt, a in wire_out.items()}
+            done_idx = t - (S - 1)
+            write = jnp.logical_and(idx == S - 1, done_idx >= 0)
+            safe = jnp.clip(done_idx, 0, M - 1)
+            cur = lax.dynamic_index_in_dim(outputs, safe, keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(write, final, cur), safe, 0)
+            return (wire_nxt, outputs, aux_acc), None
+
+        wire0 = {dt: jnp.zeros((w * mb_local,), dtype=dt)
+                 for dt, w in widths.items()}
+        outputs0 = jnp.zeros(
+            (M, mb_local) + tuple(final_t.shape[1:]),
+            dtype=final_t.dtype)
+        (_, outputs, aux_acc), _ = lax.scan(
+            tick, (wire0, outputs0, jnp.float32(0.0)),
+            jnp.arange(M + S - 1))
+        outputs = lax.psum(
+            jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs)),
+            pipe_axis)
+        # aux: mean over (microbatches x data shards). Averaging over
+        # the data axis too keeps the P() aux output genuinely uniform —
+        # each data shard sees different samples, and a per-shard value
+        # declared replicated is undefined under check_vma=False
+        aux_total = lax.psum(
+            aux_acc, (pipe_axis,) if data_ax is None
+            else (pipe_axis, data_ax)) / (M * ndata)
+        return outputs, aux_total
+
+    packed_spec = {dt: P(pipe_axis, None) for dt in packed}
+    in_spec = {k: P(None, data_ax, *([None] * (v.ndim - 2)))
+               for k, v in inputs_mb.items()}
+    out_spec = P(None, data_ax,
+                 *([None] * (len(final_t.shape) - 1)))
+
+    out, aux = shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(packed_spec, in_spec, P()),
+        out_specs=(out_spec, P()),
+        check_vma=False)(packed, inputs_mb, rng)
+    return out.reshape((B,) + tuple(final_t.shape[1:])), aux
+
+
+# --------------------------------------------------------------------------
+# 1F1B schedule
+# --------------------------------------------------------------------------
+
+IDLE, FWD, BWD = 0, 1, 2
+
+
+def one_f_one_b_schedule(S: int, M: int):
+    """Host-side PipeDream-flush (non-interleaved 1F1B) schedule.
+
+    One unit of work (a microbatch forward OR backward) per stage per
+    tick. Stage s warms up with at most S - s in-flight forwards, then
+    alternates one-forward-one-backward; backward has priority when both
+    are ready (this is what bounds live activations at min(S - s, M)
+    instead of GPipe's M). Returns (kind (T, S), mbi (T, S)) int arrays:
+    kind[t, s] in {IDLE, FWD, BWD}, mbi the microbatch index.
+
+    Dependencies honored: fwd(s, m) needs fwd(s-1, m)'s activation
+    (arrives one tick after it ran); bwd(s, m) needs bwd(s+1, m)'s
+    cotangent (same delay); bwd(S-1, m) follows fwd(S-1, m).
+    """
+    fwd_done = [[-1] * M for _ in range(S)]   # tick fwd(s,m) ran
+    bwd_done = [[-1] * M for _ in range(S)]
+    next_f = [0] * S
+    next_b = [0] * S
+    kind_rows: List[List[int]] = []
+    mbi_rows: List[List[int]] = []
+    t = 0
+    while any(nb < M for nb in next_b):
+        krow, mrow = [], []
+        for s in range(S):
+            f_m, b_m = next_f[s], next_b[s]
+            can_f = f_m < M and (
+                s == 0 or (fwd_done[s - 1][f_m] not in (-1,)
+                           and fwd_done[s - 1][f_m] < t))
+            can_b = b_m < M and (
+                (s == S - 1 and fwd_done[s][b_m] not in (-1,)
+                 and fwd_done[s][b_m] < t)
+                or (s < S - 1 and bwd_done[s + 1][b_m] not in (-1,)
+                    and bwd_done[s + 1][b_m] < t))
+            # backward first (memory bound); forward gated by window
+            in_flight = next_f[s] - next_b[s]
+            if can_b:
+                krow.append(BWD)
+                mrow.append(b_m)
+                bwd_done[s][b_m] = t
+                next_b[s] += 1
+            elif can_f and in_flight < max(1, S - s):
+                krow.append(FWD)
+                mrow.append(f_m)
+                fwd_done[s][f_m] = t
+                next_f[s] += 1
+            else:
+                krow.append(IDLE)
+                mrow.append(-1)
+        kind_rows.append(krow)
+        mbi_rows.append(mrow)
+        t += 1
+        if t > 4 * (M + S) + 8:  # schedule generator must terminate
+            raise AssertionError("1F1B schedule did not converge")
+    kind = np.asarray(kind_rows, np.int32)
+    mbi = np.asarray(mbi_rows, np.int32)
+    # ring-buffer safety: while fwd(s,m)'s saved input is live
+    # (until bwd(s,m)), no other live microbatch may share m % D
+    D = min(S, M)
+    for s in range(S):
+        for m in range(M):
+            for m2 in range(m + 1, M):
+                if m2 % D != m % D:
+                    continue
+                # live intervals [fwd, bwd] must not overlap
+                if fwd_done[s][m2] <= bwd_done[s][m]:
+                    raise AssertionError(
+                        f"1F1B slot conflict at stage {s}: {m} vs {m2}")
+    return kind, mbi
+
+
+def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
+                        inputs: Dict[str, jax.Array],
+                        label, loss_fn, rng, mesh: Mesh,
+                        pipe_axis: str, data_axis: Optional[str],
+                        num_microbatches: int, model, *,
+                        seq_length: int = -1):
+    """One-forward-one-backward pipelined TRAINING step: returns
+    (logits (B, ...), aux scalar, grads {dtype: (S, L)}).
+
+    Unlike the GPipe path (autodiff transpose of the forward schedule),
+    this computes gradients EXPLICITLY inside the tick loop: each
+    stage's backward recomputes its forward from the saved input
+    activation via `jax.vjp` (remat-1F1B) as soon as the downstream
+    cotangent arrives, so peak live activations per stage drop from M
+    microbatches to min(S - s, M). Two wires ride the ring each tick:
+    activations forward (ppermute i->i+1), cotangents backward
+    (ppermute i->i-1). Ring buffers of depth min(S, M) hold arrived
+    activations/cotangents between their arrival tick and use tick.
+    """
+    S = plan.num_stages
+    M = int(num_microbatches)
+    final_t = model.final_tensor
+    B = next(iter(inputs.values())).shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    layouts, widths = _wire_layouts(plan)
+    for dt in widths:
+        if not np.issubdtype(np.dtype(dt), np.floating):
+            raise NotImplementedError(
+                f"1F1B: non-float tensor (dtype {dt}) crosses a stage "
+                f"boundary; cotangent wires need float dtypes — use "
+                f"the gpipe schedule")
+
+    inputs_mb = {k: v.reshape((M, mb) + v.shape[1:])
+                 for k, v in inputs.items()}
+    label_mb = (label.reshape((M, mb) + label.shape[1:])
+                if label is not None else None)
+
+    data_ax, ndata, mb_local = _data_split(mesh, data_axis, mb)
+    run_stage = _make_stage_runner(
+        plan, pack, model, layouts, widths, mb_local,
+        training=True, seq_length=seq_length)
+
+    kind, mbi = one_f_one_b_schedule(S, M)
+    T = kind.shape[0]
+    D = min(S, M)
+    # arrival tables: what lands on each wire at each tick (-1 = none).
+    # stage s-1 running fwd(m) at t-1 puts act(m) on s's fwd wire at t;
+    # stage s+1 running bwd(m) at t-1 puts ct(m) on s's bwd wire at t.
+    arr_f = np.full((T, S), -1, np.int32)
+    arr_b = np.full((T, S), -1, np.int32)
+    for t in range(1, T):
+        for s in range(S):
+            if s > 0 and kind[t - 1, s - 1] == FWD:
+                arr_f[t, s] = mbi[t - 1, s - 1]
+            if s < S - 1 and kind[t - 1, s + 1] == BWD:
+                arr_b[t, s] = mbi[t - 1, s + 1]
+    # branch index per (tick, stage): 0 idle, 1+s fwd, 1+S+s bwd
+    bidx = np.where(kind == IDLE, 0,
+                    np.where(kind == FWD, 1 + np.arange(S)[None, :],
+                             1 + S + np.arange(S)[None, :]))
+
+    kind_a = jnp.asarray(kind)
+    mbi_a = jnp.asarray(mbi)
+    arr_f_a = jnp.asarray(arr_f)
+    arr_b_a = jnp.asarray(arr_b)
+    bidx_a = jnp.asarray(bidx.astype(np.int32))
+
+    # objective scaling (matches the GPipe/autodiff path): the reported
+    # loss is mean over the GLOBAL batch; each (stage, data-shard)
+    # device's per-microbatch loss contributes 1/(M * ndata); aux
+    # contributes 1/M per device (psum'd over pipe only)
+    loss_scale = 1.0 / (M * ndata)
+    # aux averages over data shards too (the GPipe path psums aux over
+    # (pipe, data) and divides by M*ndata — grads must match)
+    aux_scale = 1.0 / (M * ndata)
+
+    def local_fn(packed_local, inputs_local, label_local, rng_op):
+        idx = lax.axis_index(pipe_axis)
+        row = {dt: a[0] for dt, a in packed_local.items()}
+
+        def mb_inputs_at(m):
+            return {k: lax.dynamic_index_in_dim(v, m, keepdims=False)
+                    for k, v in inputs_local.items()}
+
+        def fwd_branch(s, row, act_buf, ct_buf, wire_f, wire_b, m,
+                       mb_rng, gacc):
+            mb_in = mb_inputs_at(m)
+            wire_in = {dt: lax.dynamic_index_in_dim(
+                act_buf[dt], m % D, keepdims=False) for dt in act_buf}
+            wire_out, final, aux = run_stage(s, row, wire_in, mb_in,
+                                             mb_rng)
+            return wire_out, _zero_wire(), final, gacc, aux
+
+        def bwd_branch(s, row, act_buf, ct_buf, wire_f, wire_b, m,
+                       mb_rng, gacc):
+            mb_in = mb_inputs_at(m)
+            wire_in = {dt: lax.dynamic_index_in_dim(
+                act_buf[dt], m % D, keepdims=False) for dt in act_buf}
+            if s == S - 1:
+                def objective(r, w):
+                    _wire_o, final, aux = run_stage(s, r, w, mb_in,
+                                                    mb_rng)
+                    obj = aux_scale * aux
+                    if loss_fn is not None and label_local is not None:
+                        lbl = lax.dynamic_index_in_dim(
+                            label_local, m, keepdims=False)
+                        obj = obj + loss_scale * loss_fn(final, lbl)
+                    return obj
+                _obj, pull = jax.vjp(objective, row, wire_in)
+                d_row, d_wire = pull(jnp.float32(1.0))
+            else:
+                def emit(r, w):
+                    wire_o, _final, aux = run_stage(s, r, w, mb_in,
+                                                    mb_rng)
+                    return wire_o, aux
+                _out, pull = jax.vjp(emit, row, wire_in)
+                ct_wire = {dt: lax.dynamic_index_in_dim(
+                    ct_buf[dt], m % D, keepdims=False) for dt in ct_buf}
+                d_row, d_wire = pull((ct_wire,
+                                      jnp.float32(aux_scale)))
+            gacc = {dt: gacc[dt] + d_row[dt].astype(gacc[dt].dtype)
+                    for dt in gacc}
+            final0 = jnp.zeros((mb_local,) + tuple(final_t.shape[1:]),
+                               dtype=final_t.dtype)
+            return _zero_wire(), d_wire, final0, gacc, jnp.float32(0.0)
+
+        def idle_branch(row, act_buf, ct_buf, wire_f, wire_b, m,
+                        mb_rng, gacc):
+            final0 = jnp.zeros((mb_local,) + tuple(final_t.shape[1:]),
+                               dtype=final_t.dtype)
+            return (_zero_wire(), _zero_wire(), final0, gacc,
+                    jnp.float32(0.0))
+
+        def _zero_wire():
+            return {dt: jnp.zeros((w * mb_local,), dtype=dt)
+                    for dt, w in widths.items()}
+
+        branches = ([idle_branch]
+                    + [functools.partial(fwd_branch, s)
+                       for s in range(S)]
+                    + [functools.partial(bwd_branch, s)
+                       for s in range(S)])
+
+        def tick(carry, t):
+            act_buf, ct_buf, wire_f, wire_b, gacc, outputs, aux_acc = \
+                carry
+            # deposit arrivals into the ring buffers
+            af = arr_f_a[t, idx]
+            ab = arr_b_a[t, idx]
+            act_buf = _deposit(act_buf, wire_f, af)
+            ct_buf = _deposit(ct_buf, wire_b, ab)
+
+            m = mbi_a[t, idx]
+            safe_m = jnp.clip(m, 0, M - 1)
+            mb_rng = (jax.random.fold_in(rng_op, safe_m)
+                      if rng_op is not None else None)
+            b = bidx_a[t, idx]
+            wire_f_out, wire_b_out, final, gacc, aux = lax.switch(
+                b, branches, row, act_buf, ct_buf, wire_f, wire_b,
+                safe_m, mb_rng, gacc)
+
+            # every 1F1B fwd tick is real work (idle replaces the
+            # GPipe warmup garbage), so fwd-tick aux sums are exact
+            aux_acc = aux_acc + aux
+            k = kind_a[t, idx]
+            is_last_fwd = jnp.logical_and(k == FWD, idx == S - 1)
+            outputs = _write_mb(outputs, final, safe_m, is_last_fwd)
+
+            fperm = [(i, (i + 1) % S) for i in range(S)]
+            bperm = [(i, (i - 1) % S) for i in range(S)]
+            wire_f = {dt: lax.ppermute(a, pipe_axis, fperm)
+                      for dt, a in wire_f_out.items()}
+            wire_b = {dt: lax.ppermute(a, pipe_axis, bperm)
+                      for dt, a in wire_b_out.items()}
+            return (act_buf, ct_buf, wire_f, wire_b, gacc, outputs,
+                    aux_acc), None
+
+        def _deposit(buf, wire, m_arrived):
+            ok = m_arrived >= 0
+            safe = jnp.clip(m_arrived, 0, M - 1) % D
+            out = {}
+            for dt, a in buf.items():
+                cur = lax.dynamic_index_in_dim(a, safe, keepdims=False)
+                upd = jnp.where(ok, wire[dt], cur)
+                out[dt] = lax.dynamic_update_index_in_dim(a, upd, safe,
+                                                          0)
+            return out
+
+        def _write_mb(outputs, final, m, flag):
+            cur = lax.dynamic_index_in_dim(outputs, m, keepdims=False)
+            upd = jnp.where(flag, final, cur)
+            return lax.dynamic_update_index_in_dim(outputs, upd, m, 0)
+
+        zw = {dt: jnp.zeros((w * mb_local,), dtype=dt)
+              for dt, w in widths.items()}
+        act_buf0 = {dt: jnp.zeros((D,) + a.shape, a.dtype)
+                    for dt, a in zw.items()}
+        ct_buf0 = {dt: jnp.zeros_like(a) for dt, a in act_buf0.items()}
+        gacc0 = {dt: jnp.zeros((L,), dtype=packed_local[dt].dtype)
+                 for dt, L in pack.lengths.items()}
+        outputs0 = jnp.zeros((M, mb_local) + tuple(final_t.shape[1:]),
+                             dtype=final_t.dtype)
+        (_, _, _, _, gacc, outputs, aux_acc), _ = lax.scan(
+            tick, (act_buf0, ct_buf0, zw, dict(zw), gacc0, outputs0,
+                   jnp.float32(0.0)),
+            jnp.arange(T))
+        outputs = lax.psum(
+            jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs)),
+            pipe_axis)
+        aux_total = lax.psum(
+            aux_acc, (pipe_axis,) if data_ax is None
+            else (pipe_axis, data_ax)) / (M * ndata)
+        # weight grads: each device owns its stage row; replicas across
+        # the data axis hold partial sums -> reduce there
+        if data_ax is not None:
+            gacc = {dt: lax.psum(a, data_ax) for dt, a in gacc.items()}
+        grads = {dt: a[None, :] for dt, a in gacc.items()}
+        return outputs, aux_total, grads
+
+    packed_spec = {dt: P(pipe_axis, None) for dt in packed}
+    in_spec = {k: P(None, data_ax, *([None] * (v.ndim - 2)))
+               for k, v in inputs_mb.items()}
+    lbl_spec = (P(None, data_ax,
+                  *([None] * (label_mb.ndim - 2)))
+                if label_mb is not None else P())
+    out_spec = P(None, data_ax, *([None] * (len(final_t.shape) - 1)))
+    grad_spec = {dt: P(pipe_axis, None) for dt in packed}
+
+    outputs, aux, grads = shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(packed_spec, in_spec, lbl_spec, P()),
+        out_specs=(out_spec, P(), grad_spec),
+        check_vma=False)(packed, inputs_mb, label_mb, rng)
+    logits = outputs.reshape((B,) + tuple(final_t.shape[1:]))
+    return logits, aux, grads
+
+
+# --------------------------------------------------------------------------
+# analytics
+# --------------------------------------------------------------------------
+
+def bubble_fraction(num_stages: int, num_microbatches: int) -> float:
+    """GPipe bubble: idle fraction of each device's timeline."""
+    S, M = num_stages, num_microbatches
+    return (S - 1) / (M + S - 1)
+
+
+def simulate_step_scaling(num_stages: int, m_a: int, m_b: int) -> float:
+    """Predicted step-time ratio time(M=m_a)/time(M=m_b) at fixed global
+    batch: per-microbatch work scales 1/M, ticks = M + S - 1, so step
+    time ∝ (M + S - 1)/M. The measurable form of the bubble model (the
+    sim-vs-measured agreement tests hold CPU-mesh timings against it)."""
+    S = num_stages
+    return ((m_a + S - 1) / m_a) / ((m_b + S - 1) / m_b)
+
+
+def peak_microbatches(num_stages: int, num_microbatches: int,
+                      schedule: str) -> int:
+    """Peak in-flight microbatches whose activations a stage must hold:
+    GPipe stores all M before backward drains; 1F1B caps at S."""
+    if schedule == "1f1b":
+        return min(num_stages, num_microbatches)
+    return num_microbatches

@@ -29,12 +29,29 @@ MATMUL_OPS = {"linear", "conv2d", "batch_matmul", "multihead_attention",
 class PipelineCost:
     """Per-stage costs for event-loop expansion of a pipelined op
     (reference simulator.cc:330-629 expands every task; our Python
-    simulator expands pipeline units into (microbatch, stage) tasks)."""
+    simulator expands pipeline units into (microbatch, stage) tasks).
+
+    Uniform stages (pipeline_blocks) use the scalar fields; graph-level
+    staged strategies (heterogeneous stages, core/staged.py) fill the
+    per-stage/per-cut lists instead."""
     stages: int
     microbatches: int
     fwd_stage: float    # compute seconds of ONE (microbatch, stage) tick
     bwd_stage: float
     hop: float          # ppermute seconds per inter-stage activation hop
+    fwd_stages: Optional[list] = None   # per-stage overrides
+    bwd_stages: Optional[list] = None
+    hops: Optional[list] = None         # per-cut overrides (len S-1)
+
+    def fwd_at(self, k: int) -> float:
+        return self.fwd_stages[k] if self.fwd_stages else self.fwd_stage
+
+    def bwd_at(self, k: int) -> float:
+        return self.bwd_stages[k] if self.bwd_stages else self.bwd_stage
+
+    def hop_at(self, k: int) -> float:
+        """Hop cost of the cut feeding stage k (k >= 1)."""
+        return self.hops[k - 1] if self.hops else self.hop
 
 
 @dataclasses.dataclass
@@ -310,3 +327,55 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
 
     return OpCost(fwd=fwd, bwd=bwd, fwd_comm=fwd_comm, bwd_comm=bwd_comm,
                   sync=sync, mem=mem, pipeline=pipeline)
+
+
+def staged_pipeline_cost(model, mesh, mm: TPUMachineModel,
+                         stage_of: Dict[str, int], microbatches: int,
+                         schedule: str = "gpipe",
+                         optimizer_state_mult: float = 3.0):
+    """Price a graph-level staged strategy (core/staged.py): the whole
+    model runs as one pipeline whose per-stage tick costs are the sum of
+    that stage's ops at microbatch granularity; hops carry the cut
+    tensors. Returns (PipelineCost, per_stage_sync, total_mem).
+
+    Mirrors what executes: no intra-stage sharding except the data axis
+    over microbatch samples; per-stage weight grads all-reduce over data
+    replicas; activation stash scales with the schedule's peak
+    (M for GPipe, min(S - s, M) for 1F1B — the 1F1B memory story)."""
+    from ..parallel.graph_pipeline import build_stage_plan
+    plan = build_stage_plan(model, stage_of)
+    S = plan.num_stages
+    M = max(1, int(microbatches))
+    ndata = mesh.shape.get("data", 1)
+    local = OpStrategy({"sample": "data"})  # data split only
+    fwd_stages, bwd_stages, syncs, mems = [], [], [], []
+    for s, ops in enumerate(plan.stages):
+        f = b = sync_bytes = w_bytes = act_bytes = 0.0
+        for op in ops:
+            c = op_cost(op, local, mesh, mm,
+                        optimizer_state_mult=optimizer_state_mult)
+            f += c.fwd / M
+            b += c.bwd / M
+            w = op.weight_bytes()
+            sync_bytes += w
+            w_bytes += w
+            act_bytes += sum(t.size_bytes() for t in op.outputs) / ndata
+        fwd_stages.append(f)
+        bwd_stages.append(b)
+        syncs.append(mm.all_reduce(sync_bytes, ndata, "data")
+                     if ndata > 1 and sync_bytes > 0 else 0.0)
+        peak = M if schedule != "1f1b" else min(S - s, M)
+        mems.append(w_bytes * (1.0 + optimizer_state_mult)
+                    + act_bytes / M * max(1, peak) * 2)
+    hops = []
+    for cut in plan.cuts:
+        cut_bytes = sum(t.size_bytes() for t in cut) / M / ndata
+        hops.append(mm.ppermute(cut_bytes, "pipe"))
+    pc = PipelineCost(
+        stages=S, microbatches=M,
+        fwd_stage=sum(fwd_stages) / S, bwd_stage=sum(bwd_stages) / S,
+        hop=(sum(hops) / len(hops)) if hops else 0.0,
+        fwd_stages=fwd_stages, bwd_stages=bwd_stages, hops=hops)
+    # stage rows ride separate devices: per-device memory is the worst
+    # stage (the packed rows pad to the largest stage)
+    return pc, syncs, max(mems) if mems else 0.0

@@ -109,6 +109,40 @@ def candidate_maps(op, mesh, cfg, op_index: int = 0) -> List[Dict[str, str]]:
     return out
 
 
+def staged_strategies(model, mesh, cfg) -> List[Strategy]:
+    """Whole-graph pipeline candidates: flops-balanced stage cuts
+    expressed as per-op whole-device pins (the executable graph-PP form,
+    core/staged.py) — one candidate per viable non-data mesh-axis size.
+    These are GLOBAL moves (a single op's pin is useless alone; the
+    reference's propagate move spread placements the same way,
+    model.cc:1807-1903)."""
+    if not getattr(cfg, "enable_pipeline_parallel", False):
+        return []
+    from ..parallel.graph_pipeline import (
+        balanced_stages, build_stage_plan, pick_pipe_axis)
+    out: List[Strategy] = []
+    sizes = sorted({size for name, size in mesh.shape.items()
+                    if name != "data" and size > 1})
+    for S in sizes:
+        if pick_pipe_axis(mesh, S) is None or len(model.ops) < 2:
+            continue
+        stage_of = balanced_stages(model, S)
+        if max(stage_of.values()) < 1:
+            continue
+        try:
+            build_stage_plan(model, stage_of)  # stateful ops etc.
+        except (ValueError, NotImplementedError):
+            continue
+        s = Strategy(default=OpStrategy({"sample": "data"}
+                                        if "data" in mesh.shape else {}))
+        for op in model.ops:
+            if op.op_type == "distributed_embedding":
+                continue  # table placement has its own executable form
+            s.set(op.name, OpStrategy({DEVICE_KEY: (stage_of[op.name],)}))
+        out.append(s)
+    return out
+
+
 def _divisor_splits(n: int, num_axes: int):
     """All tuples (d0..dk) with product n, each di >= 1."""
     if num_axes == 1:
@@ -143,7 +177,8 @@ def enumerate_mesh_shapes(n_devices: int, model, cfg
         axes.append("seq")
     if cfg.enable_expert_parallel and "moe_ffn" in op_types:
         axes.append("expert")
-    if cfg.enable_pipeline_parallel and "pipeline_blocks" in op_types:
+    if cfg.enable_pipeline_parallel and (
+            "pipeline_blocks" in op_types or len(model.ops) >= 2):
         axes.append("pipe")
     shapes = []
     seen = set()
@@ -253,6 +288,15 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
             raise ValueError("native search does not support "
                              "perform_fusion; use the Python engine")
         use_native = False
+    # graph-PP staged candidates are global moves priced by the Python
+    # simulator's staged expansion — route to the Python engine
+    staged = staged_strategies(model, mesh, cfg)
+    if staged:
+        if use_native is True:
+            raise ValueError("native search does not support graph-"
+                             "pipeline candidates; use the Python "
+                             "engine")
+        use_native = False
     if use_native is not False:
         from .native_search import optimize_native
         found = optimize_native(model, sim, cands, budget, alpha, seed,
@@ -270,6 +314,12 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
     cur_cost = sim.simulate(current)
     best, best_cost = current.copy(), cur_cost
 
+    # staged candidates compete even when no per-op axis choice exists
+    for s in staged:
+        c = sim.simulate(s)
+        if c < best_cost:
+            best, best_cost = s.copy(), c
+
     searchable = [op for op in model.ops if len(cands[op.name]) > 1]
     if not searchable:
         return finish(best)
@@ -280,6 +330,22 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
             current, cur_cost = best.copy(), best_cost
 
         nxt = current.copy()
+        # global staged-pipeline move: jump to (or mutate microbatching
+        # of) a whole-graph stage cut — per-op moves cannot assemble a
+        # viable pipeline one pin at a time
+        if staged and rng.random() < 0.1:
+            nxt = rng.choice(staged).copy()
+            nxt_cost = sim.simulate(nxt)
+            delta = nxt_cost - cur_cost
+            if delta <= 0 or rng.random() < math.exp(
+                    -delta / max(1e-12, alpha * cur_cost)):
+                current, cur_cost = nxt, nxt_cost
+                if cur_cost < best_cost:
+                    best, best_cost = current.copy(), cur_cost
+                    if verbose:
+                        print(f"[search] iter {it}: staged pipeline "
+                              f"{best_cost*1e3:.3f} ms/step")
+            continue
         # propagation move is opt-in (reference --enable-propagation,
         # model.cc:2374), fired with prob 0.25 like model.cc:1807-1903
         if cfg.enable_propagation and rng.random() < 0.25 and edges:

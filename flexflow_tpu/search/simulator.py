@@ -223,9 +223,77 @@ class Simulator:
         step_time, penalty = self._simulate_raw(strategy, dot_path)
         return step_time * self.time_scale + penalty + self.step_overhead
 
+    def _staged_assignment(self, strategy: Strategy):
+        """op->stage map when this strategy executes as a graph
+        pipeline (mirrors model.compile's lowering decision: whole-op
+        pins on non-embedding ops, or config.pipeline_stages), else
+        None."""
+        from ..parallel.graph_pipeline import (
+            assignment_from_pins, balanced_stages, build_stage_plan,
+            pick_pipe_axis)
+
+        def viable(stage_of):
+            if stage_of is None or max(stage_of.values()) < 1:
+                return None
+            if pick_pipe_axis(self.mesh,
+                              max(stage_of.values()) + 1) is None:
+                return None  # compile would warn + replicate
+            try:
+                build_stage_plan(self.model, stage_of)
+            except (ValueError, NotImplementedError):
+                return None
+            return stage_of
+
+        stage_of = None
+        try:
+            stage_of = viable(assignment_from_pins(self.model, strategy))
+        except (ValueError, NotImplementedError):
+            stage_of = None  # compile warns and falls through, as here
+        if stage_of is None \
+                and getattr(self.model.config, "pipeline_stages", 0) > 1:
+            stage_of = viable(balanced_stages(
+                self.model, self.model.config.pipeline_stages))
+        return stage_of
+
+    def _simulate_staged(self, strategy: Strategy, stage_of,
+                         dot_path: Optional[str] = None):
+        """Event-loop makespan of a graph-level staged strategy: one
+        pipeline covering the whole model, per-stage tick costs from the
+        cost model (staged_pipeline_cost), per-stage grad sync, memory
+        from the schedule's activation peak."""
+        from .cost_model import staged_pipeline_cost
+        cfg = self.model.config
+        key = (tuple(sorted(stage_of.items())),
+               getattr(cfg, "pipeline_microbatches", 4),
+               getattr(cfg, "pipeline_schedule", "gpipe"))
+        cache = getattr(self, "_staged_cost_cache", None)
+        if cache is None:
+            cache = self._staged_cost_cache = {}
+        if key in cache:  # the annealing loop revisits candidates
+            pc, syncs, mem = cache[key]
+        else:
+            pc, syncs, mem = cache[key] = staged_pipeline_cost(
+                self.model, self.mesh, self.mm, stage_of, key[1],
+                schedule=key[2])
+        g = TaskGraph()
+        exits: Dict[str, List] = {}
+        fwd_join = self._expand_pipeline_fwd(g, "net", pc, [], exits)
+        bwd_join = self._expand_pipeline_bwd(g, "net", pc, [fwd_join],
+                                             exits["net"])
+        for k, s in enumerate(syncs):
+            if s > 0:
+                g.add(f"net:sync.s{k}", s, "comm", [bwd_join])
+        step_time = g.simulate()
+        if dot_path:
+            g.export_dot(dot_path)
+        return step_time, self.mm.memory_penalty(mem)
+
     def _simulate_raw(self, strategy: Strategy,
                       dot_path: Optional[str] = None):
         """Returns (unscaled step seconds, memory penalty seconds)."""
+        stage_of = self._staged_assignment(strategy)
+        if stage_of is not None:
+            return self._simulate_staged(strategy, stage_of, dot_path)
         g = TaskGraph()
         fwd_tasks: Dict[str, SimTask] = {}
 
@@ -333,13 +401,14 @@ class Simulator:
             for k in range(S):
                 deps = list(ext_deps) if k == 0 else []
                 if prev is not None:
-                    if pc.hop > 0:
-                        h = g.add(f"{u}:f{m}.hop{k}", pc.hop, "comm",
+                    hop = pc.hop_at(k)
+                    if hop > 0:
+                        h = g.add(f"{u}:f{m}.hop{k}", hop, "comm",
                                   [prev])
                         deps.append(h)
                     else:
                         deps.append(prev)
-                prev = g.add(f"{u}:f{m}.s{k}", pc.fwd_stage,
+                prev = g.add(f"{u}:f{m}.s{k}", pc.fwd_at(k),
                              ("stage", u, k), deps)
                 row.append(prev)
             rows.append(row)
@@ -360,13 +429,14 @@ class Simulator:
                 deps = list(ext_deps) if k == S - 1 else []
                 deps.append(fwd_rows[m][k])
                 if prev is not None:
-                    if pc.hop > 0:
-                        h = g.add(f"{u}:b{m}.hop{k}", pc.hop, "comm",
+                    hop = pc.hop_at(k + 1)
+                    if hop > 0:
+                        h = g.add(f"{u}:b{m}.hop{k}", hop, "comm",
                                   [prev])
                         deps.append(h)
                     else:
                         deps.append(prev)
-                prev = g.add(f"{u}:b{m}.s{k}", pc.bwd_stage,
+                prev = g.add(f"{u}:b{m}.s{k}", pc.bwd_at(k),
                              ("stage", u, k), deps)
             exits.append(prev)
         return g.add(f"{u}:bwd_join", 0.0, ("join", u, "b"), exits)
