@@ -113,6 +113,14 @@ class FFConfig:
     # simulator.cc:508-556); written by the first simulate() of a search.
     taskgraph_file: Optional[str] = None
 
+    # MoE dispatch path: "auto" uses dense GShard masks (MXU-friendly,
+    # clean EP all-to-alls) until the mask would exceed
+    # ops/moe.py DENSE_MASK_ELEMENT_LIMIT elements, then switches to
+    # sorted-scatter routing (argsort by expert; no (S, E, C) mask —
+    # the scalable form for large expert counts). "dense"/"sorted"
+    # force a path.
+    moe_dispatch: str = "auto"
+
     # generalized pipeline parallelism (core/staged.py): auto-cut the op
     # graph into this many flops-balanced stages over a matching mesh
     # axis. 0 = off. Strategy device pins trigger staged execution
@@ -181,6 +189,10 @@ class FFConfig:
             raise ValueError(
                 f"pipeline_schedule must be 'gpipe' or '1f1b', got "
                 f"{self.pipeline_schedule!r}")
+        if self.moe_dispatch not in ("auto", "dense", "sorted"):
+            raise ValueError(
+                f"moe_dispatch must be 'auto', 'dense' or 'sorted', "
+                f"got {self.moe_dispatch!r}")
 
     @classmethod
     def from_args(cls, argv: Optional[Sequence[str]] = None) -> "FFConfig":
@@ -211,6 +223,7 @@ class FFConfig:
         "--taskgraph": ("taskgraph_file", str),
         "--seed": ("seed", int),
         "--conv-layout": ("conv_layout", str),
+        "--moe-dispatch": ("moe_dispatch", str),
         "--pipeline-stages": ("pipeline_stages", int),
         "--pipeline-microbatches": ("pipeline_microbatches", int),
         "--pipeline-schedule": ("pipeline_schedule", str),

@@ -50,12 +50,11 @@ class StagedExecutor(Executor):
             raise ValueError(
                 f"staged execution needs a mesh axis to pipeline over; "
                 f"got axis {pipe_axis!r} in {mesh}")
-        self.plan: StagePlan = build_stage_plan(model, stage_of)
-        if mesh.shape[pipe_axis] != self.plan.num_stages:
+        n_stages = max(stage_of.values()) + 1
+        if mesh.shape[pipe_axis] != n_stages:
             raise ValueError(
-                f"stage count {self.plan.num_stages} != mesh axis "
+                f"stage count {n_stages} != mesh axis "
                 f"{pipe_axis!r} size {mesh.shape[pipe_axis]}")
-        self.pack: PackSpec = make_pack_spec(self.plan)
         self.pipe_axis = pipe_axis
         self.num_microbatches = int(num_microbatches)
         if schedule not in ("gpipe", "1f1b"):
@@ -64,6 +63,23 @@ class StagedExecutor(Executor):
         super().__init__(model, optimizer, loss_fn, metric_names,
                          mesh=mesh, strategy=strategy,
                          comp_mode=comp_mode)
+        # stages run ops with ctx.mesh=None, so a per-table embedding
+        # placement (which super().__init__ may have lowered into the
+        # padded slot layout, mutating weight_specs) cannot execute —
+        # reset BEFORE freezing the pack layout, or the packing would
+        # record pre-/post-placement shapes inconsistently
+        from ..ops.embedding import DistributedEmbedding
+        for op in model.ops:
+            if isinstance(op, DistributedEmbedding) \
+                    and op.placement is not None:
+                import warnings
+                warnings.warn(
+                    f"{op.name}: per-table device placement is ignored "
+                    f"under staged (pipelined) execution; tables run "
+                    f"plainly stacked inside their stage")
+                op.apply_placement(None, None)
+        self.plan: StagePlan = build_stage_plan(model, stage_of)
+        self.pack: PackSpec = make_pack_spec(self.plan)
 
     # The sparse-embedding fast path gathers rows outside the
     # differentiated region — incompatible with packed stage rows.

@@ -425,7 +425,13 @@ class FFModel:
             if stage_of is not None:
                 n_stages = max(stage_of.values()) + 1
                 if n_stages < 2:
-                    stage_of = None  # all on one device: plain SPMD
+                    import warnings
+                    warnings.warn(
+                        "strategy pins every op to one device; a "
+                        "single-stage placement has no pipelined "
+                        "lowering — executing as plain (replicated) "
+                        "SPMD")
+                    stage_of = None
                 else:
                     pipe_axis = pick_pipe_axis(self.mesh, n_stages)
                     if pipe_axis is None:

@@ -40,6 +40,83 @@ def dispatch_mask(assign: jax.Array, n_experts: int, capacity: int):
     return onehot[:, :, None] * pos[:, None, :] * keep[:, None, None]
 
 
+# Above this many mask elements (S * E * C floats) the dense dispatch
+# mask is pure HBM waste; the sorted-scatter path does the same routing
+# in O(S log S + S * D). Override with FFConfig.moe_dispatch.
+DENSE_MASK_ELEMENT_LIMIT = 1 << 22
+
+
+def dispatch_indices(assign: jax.Array, n_experts: int, capacity: int):
+    """Sorted-scatter routing: the same (rank-within-expert, capacity
+    drop) semantics as `dispatch_mask` without materializing the
+    (S, E, C) mask — the scalable path for large expert counts
+    (VERDICT r3 #8; capacity semantics preserved from
+    /root/reference/src/ops/group_by.cc:1-381).
+
+    assign: (batch, k) int. Returns (pos (S,), keep (S,)) where
+    pos = expert * capacity + rank indexes a flat (E*C, ...) buffer and
+    keep masks slots that exceeded their expert's capacity. Ranks count
+    earlier slots (original slot order) routed to the same expert —
+    jnp.argsort is stable, so this matches the dense mask bit-for-bit.
+    """
+    flat = assign.reshape(-1).astype(jnp.int32)  # (S,)
+    s = flat.shape[0]
+    order = jnp.argsort(flat)  # stable: preserves slot order per expert
+    sorted_e = flat[order]
+    idx = jnp.arange(s, dtype=jnp.int32)
+    # index of each sorted run's first element, broadcast via cummax
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_e[1:] != sorted_e[:-1]])
+    run_start = jax.lax.cummax(jnp.where(boundary, idx, 0))
+    rank_sorted = idx - run_start
+    rank = jnp.zeros((s,), jnp.int32).at[order].set(rank_sorted)
+    # out-of-range expert ids (e.g. -1 padding) silently contribute
+    # nothing in the dense path (one_hot zeroes them) — match that
+    # here, and DON'T let a negative pos wrap (jnp advanced indexing
+    # normalizes negatives before mode="drop" can reject them)
+    keep = (rank < capacity) & (flat >= 0) & (flat < n_experts)
+    # dropped slots park out of range: scatters use mode="drop",
+    # gathers mode="fill" — no valid position is ever clobbered
+    pos = jnp.where(keep, flat * capacity + rank,
+                    n_experts * capacity)
+    return pos, keep
+
+
+def sorted_dispatch(xrep: jax.Array, pos: jax.Array, keep: jax.Array,
+                    n_experts: int, capacity: int):
+    """Scatter slot-major tokens (S, D) into (E, C, D) expert buffers.
+    Kept positions are unique by construction, so the add is a write."""
+    d = xrep.shape[-1]
+    masked = jnp.where(keep[:, None], xrep, jnp.zeros_like(xrep))
+    buf = jnp.zeros((n_experts * capacity, d), xrep.dtype)
+    buf = buf.at[pos].add(masked, mode="drop")
+    return buf.reshape(n_experts, capacity, d)
+
+
+def sorted_combine(out_e: jax.Array, pos: jax.Array, keep: jax.Array):
+    """Gather expert outputs (E, C, O) back to slot-major (S, O);
+    dropped slots read zeros (same as the dense mask contraction)."""
+    flat = out_e.reshape(-1, out_e.shape[-1])
+    gathered = flat.at[pos].get(mode="fill", fill_value=0)
+    return jnp.where(keep[:, None], gathered, jnp.zeros_like(gathered))
+
+
+def use_sorted_dispatch(model, n_slots: int, n_experts: int,
+                        capacity: int, expert_sharded: bool) -> bool:
+    """Dispatch-path policy. "auto": dense masks feed the MXU and lower
+    to clean all-to-alls when the expert axis is mesh-sharded (EP), so
+    keep them unless the mask itself would be huge; sorted-scatter
+    takes over above DENSE_MASK_ELEMENT_LIMIT elements."""
+    mode = getattr(getattr(model, "config", None), "moe_dispatch", "auto")
+    if mode == "dense":
+        return False
+    if mode == "sorted":
+        return True
+    if expert_sharded:
+        return False  # einsum -> all-to-all is the EP-friendly lowering
+    return n_slots * n_experts * capacity > DENSE_MASK_ELEMENT_LIMIT
+
+
 @register_op
 class GroupBy(Op):
     """inputs: (data (B, D), assign (B, k)); outputs: n tensors (cap, D)."""
@@ -67,11 +144,17 @@ class GroupBy(Op):
 
     def forward(self, params, xs, ctx: OpContext):
         data, assign = xs
-        mask = dispatch_mask(assign, self.n, self.capacity)  # (S, n, cap)
-        xrep = jnp.repeat(data, self.k, axis=0)  # (S, D), slot-major like mask
-        expert_in = jnp.einsum("snc,sd->ncd", mask,
-                               xrep.astype(jnp.float32))
-        expert_in = expert_in.astype(data.dtype)
+        xrep = jnp.repeat(data, self.k, axis=0)  # (S, D), slot-major
+        if use_sorted_dispatch(self.model, xrep.shape[0], self.n,
+                               self.capacity, expert_sharded=False):
+            pos, keep = dispatch_indices(assign, self.n, self.capacity)
+            expert_in = sorted_dispatch(xrep, pos, keep, self.n,
+                                        self.capacity)
+        else:
+            mask = dispatch_mask(assign, self.n, self.capacity)
+            expert_in = jnp.einsum("snc,sd->ncd", mask,
+                                   xrep.astype(jnp.float32))
+            expert_in = expert_in.astype(data.dtype)
         return [expert_in[i] for i in range(self.n)]
 
     def output_axes(self):

@@ -84,6 +84,10 @@ def _check_supported(model, stage_of: Dict[str, int]) -> None:
                 f"functional state (e.g. BatchNorm running stats); "
                 f"stateful ops are not supported under pipelined "
                 f"execution")
+        if op.op_type == "pipeline_blocks":
+            raise NotImplementedError(
+                f"graph pipeline: {op.name!r} is itself a pipeline "
+                f"meta-op; nesting pipelines is not supported")
         if op.name not in stage_of:
             raise ValueError(f"op {op.name!r} has no stage assignment")
 
@@ -132,9 +136,23 @@ def build_stage_plan(model, stage_of: Dict[str, int]) -> StagePlan:
     for op in model.ops:
         for t in op.outputs:
             by_uid[t.uid] = t
+    batch = model.input_tensors[0].shape[0] if model.input_tensors \
+        else None
     for i in range(S - 1):
         cut = [by_uid[uid] for uid, last in sorted(last_use.items())
                if stage_of[producer[uid]] <= i < last]
+        for t in cut:
+            # the wire microbatches dim 0: a tensor whose dim 0 is NOT
+            # the batch (e.g. GroupBy's (capacity, D) expert buffers)
+            # would be silently reinterpreted sample-wise
+            if batch is not None and (not t.shape
+                                      or t.shape[0] != batch):
+                raise NotImplementedError(
+                    f"graph pipeline: tensor {t.uid} "
+                    f"(shape {t.shape}, producer "
+                    f"{producer[t.uid]!r}) crosses the stage-"
+                    f"{i}/{i + 1} boundary but its dim 0 is not the "
+                    f"batch dim ({batch}); cut elsewhere")
         cuts.append(cut)
     return StagePlan(stages=stages, stage_of=dict(stage_of), cuts=cuts)
 

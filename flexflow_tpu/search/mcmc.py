@@ -118,6 +118,10 @@ def staged_strategies(model, mesh, cfg) -> List[Strategy]:
     model.cc:1807-1903)."""
     if not getattr(cfg, "enable_pipeline_parallel", False):
         return []
+    if any(op.op_type == "pipeline_blocks" for op in model.ops):
+        # the uniform-stack meta-op already owns the pipe axis (and
+        # the native engine prices it); don't nest graph-level stages
+        return []
     from ..parallel.graph_pipeline import (
         balanced_stages, build_stage_plan, pick_pipe_axis)
     out: List[Strategy] = []
@@ -289,14 +293,20 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
                              "perform_fusion; use the Python engine")
         use_native = False
     # graph-PP staged candidates are global moves priced by the Python
-    # simulator's staged expansion — route to the Python engine
+    # simulator's staged expansion — route to the Python engine; an
+    # explicit native request keeps the native engine and simply
+    # forgoes the staged candidates
     staged = staged_strategies(model, mesh, cfg)
     if staged:
         if use_native is True:
-            raise ValueError("native search does not support graph-"
-                             "pipeline candidates; use the Python "
-                             "engine")
-        use_native = False
+            import warnings
+            warnings.warn(
+                "native search engine does not price graph-pipeline "
+                "candidates; searching without them (drop "
+                "use_native=True to include staged pipelining)")
+            staged = []
+        else:
+            use_native = False
     if use_native is not False:
         from .native_search import optimize_native
         found = optimize_native(model, sim, cands, budget, alpha, seed,

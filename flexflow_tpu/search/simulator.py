@@ -251,8 +251,15 @@ class Simulator:
             stage_of = None  # compile warns and falls through, as here
         if stage_of is None \
                 and getattr(self.model.config, "pipeline_stages", 0) > 1:
-            stage_of = viable(balanced_stages(
-                self.model, self.model.config.pipeline_stages))
+            # strategy-independent: the O(S*n^2) partition DP and plan
+            # viability check run once, not per annealing candidate
+            S_req = self.model.config.pipeline_stages
+            cache = getattr(self, "_balanced_cache", None)
+            if cache is None:
+                cache = self._balanced_cache = {}
+            if S_req not in cache:
+                cache[S_req] = viable(balanced_stages(self.model, S_req))
+            stage_of = cache[S_req]
         return stage_of
 
     def _simulate_staged(self, strategy: Strategy, stage_of,
