@@ -379,7 +379,8 @@ class FFModel:
         self.optimizer = optimizer
 
         if self.strategy is None and self.config.import_strategy_file:
-            self.strategy = Strategy.load(self.config.import_strategy_file)
+            self.strategy = self._load_strategy_file(
+                self.config.import_strategy_file)
 
         if self.config.search_budget > 0:
             if self.config.search_mesh_shapes:
@@ -491,6 +492,22 @@ class FFModel:
             self.set_weights(op_name, ws)
         for op_name, ss in self.imported_states.items():
             self.set_states(op_name, ss)
+
+    def _load_strategy_file(self, path: str) -> Strategy:
+        """--import-strategy dispatch: our JSON format, the reference's
+        FFProtoBuf .pb artifacts, or strategy.cc's text stream."""
+        from .parallel.strategy_io import load_reference_strategy_file
+        if not path.endswith(".pb"):
+            try:
+                return Strategy.load(path)
+            except (ValueError, UnicodeDecodeError):
+                pass  # not our JSON: try the reference text format
+        if self.mesh is None:
+            raise ValueError(
+                f"importing the reference strategy format from {path!r} "
+                f"needs a mesh (splits/device ids resolve against mesh "
+                f"axes); pass mesh= or use the native JSON format")
+        return load_reference_strategy_file(self, self.mesh, path)
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
