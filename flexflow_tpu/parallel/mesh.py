@@ -58,6 +58,16 @@ class MachineSpec:
     # dcn_bandwidth/chips_per_host (the reference's EnhancedMachineModel
     # models the same shared-NIC congestion, machine_model.cc:172+)
     chips_per_host: int = 4
+    # physical ICI torus factorization of the slice, e.g. (4, 4, 4) for
+    # a 64-chip v5p cube or (16, 16) for a v5e pod; () = flat/unknown
+    # (every mesh axis priced as a single ring). A mesh axis laid out
+    # over k torus dims runs its collective phases over k link sets
+    # concurrently (the analog of reference get_comm_path routing over
+    # the physical hierarchy, machine_model.cc:695).
+    ici_torus_dims: tuple = ()
+    # wraparound links present (torus vs line): halves worst-case hop
+    # distance and doubles bisection
+    ici_wraparound: bool = True
 
     @staticmethod
     def v5e(num_chips: int = 1) -> "MachineSpec":

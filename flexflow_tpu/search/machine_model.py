@@ -36,6 +36,22 @@ class TPUMachineModel:
     })
     # mesh axes that ride DCN instead of ICI (multi-host `data` axis)
     dcn_axes: tuple = ()
+    # mesh axis -> tuple of physical torus dims it spans (from
+    # assign_axis_topology); {} = flat (one ring per axis). A k-dim
+    # axis runs ring phases over k link sets concurrently, and
+    # all-to-all is bisection-bound by its LARGEST dim — the TPU form
+    # of the reference's physical comm paths (machine_model.cc:695).
+    axis_topology: Dict[str, tuple] = dataclasses.field(
+        default_factory=dict)
+
+    def _phys(self, axis: Optional[str], axis_size: int):
+        """(k concurrent link sets, largest physical dim) for an axis.
+        DCN axes are switched, not tori — always flat."""
+        dims = (self.axis_topology.get(axis)
+                if axis and axis not in self.dcn_axes else None)
+        if not dims:
+            return 1, axis_size
+        return len(dims), max(dims)
 
     # ---- compute ----
     def compute_time(self, flops: float, bytes_moved: float,
@@ -64,21 +80,38 @@ class TPUMachineModel:
         return (self.spec.ici_bandwidth * self.efficiency["collective"],
                 self.spec.ici_latency)
 
+    def _ring_bw_mult(self, axis: Optional[str], k: int) -> float:
+        """Bandwidth multiplier for ring collectives: k concurrent link
+        sets on a torus; a line (no wraparound) cannot close the ring,
+        so the bidirectional algorithm degrades to ~half the torus
+        bandwidth (ICI only — DCN is switched)."""
+        if axis is not None and axis in self.dcn_axes:
+            return 1.0
+        wrap = 1.0 if self.spec.ici_wraparound else 0.5
+        return k * wrap
+
     def all_reduce(self, nbytes: float, axis_size: int,
                    axis: Optional[str] = None) -> float:
         if axis_size <= 1:
             return 0.0
         bw, lat = self._bw_lat(axis)
-        return 2.0 * (axis_size - 1) / axis_size * nbytes / bw \
-            + 2 * (axis_size - 1) * lat
+        k, dmax = self._phys(axis, axis_size)
+        # k-dim torus: per-dim ring phases run over disjoint link sets
+        # concurrently -> k x bandwidth; latency chain follows the
+        # LONGEST dim's ring (other dims' hops overlap it)
+        mult = self._ring_bw_mult(axis, k)
+        return 2.0 * (axis_size - 1) / axis_size * nbytes / (bw * mult) \
+            + 2 * (dmax - 1) * lat
 
     def all_gather(self, nbytes_out: float, axis_size: int,
                    axis: Optional[str] = None) -> float:
         if axis_size <= 1:
             return 0.0
         bw, lat = self._bw_lat(axis)
-        return (axis_size - 1) / axis_size * nbytes_out / bw \
-            + (axis_size - 1) * lat
+        k, dmax = self._phys(axis, axis_size)
+        mult = self._ring_bw_mult(axis, k)
+        return (axis_size - 1) / axis_size * nbytes_out / (bw * mult) \
+            + (dmax - 1) * lat
 
     reduce_scatter = all_gather  # same ring cost
 
@@ -87,9 +120,23 @@ class TPUMachineModel:
         if axis_size <= 1:
             return 0.0
         bw, lat = self._bw_lat(axis)
-        # each device exchanges (n-1)/n of its local bytes
-        return (axis_size - 1) / axis_size * nbytes_local / bw \
-            + (axis_size - 1) * lat
+        k, dmax = self._phys(axis, axis_size)
+        # bisection-bound: total V_local*n/4 bytes cross the worst cut;
+        # a torus cut perpendicular to the largest dim has 2*n/dmax
+        # (wraparound) link pairs -> T = V_local * dmax / (8 * bw) per
+        # direction-pair; a line (no wraparound) halves the cut. The
+        # old (n-1)/n ring formula underpriced large-n all-to-alls by
+        # ~n/4 (EP dispatch misranking).
+        wrap = 2.0 if self.spec.ici_wraparound else 1.0
+        if axis is not None and axis in self.dcn_axes:
+            # DCN is switched, not a torus: the NIC serializes the
+            # (n-1)/n exchange — keep the flat formula
+            return (axis_size - 1) / axis_size * nbytes_local / bw \
+                + (axis_size - 1) * lat
+        # worst-case hop distance: dmax/2 around a torus ring, dmax
+        # end-to-end on a line
+        hops = dmax / 2 if self.spec.ici_wraparound else dmax
+        return nbytes_local * dmax / (4.0 * wrap * bw) + hops * lat
 
     def ppermute(self, nbytes: float, axis: Optional[str] = None) -> float:
         bw, lat = self._bw_lat(axis)
@@ -113,6 +160,37 @@ class TPUMachineModel:
             self.efficiency.update(json.load(f))
 
 
+def assign_axis_topology(mesh, torus_dims: tuple,
+                         dcn_axes: tuple = ()) -> Dict[str, tuple]:
+    """Lay mesh axes out over the physical torus factorization, in mesh
+    axis order (the standard TPU layout: contiguous torus dims per mesh
+    axis). Each axis consumes whole torus dims while their product
+    divides the axis size; an axis that cannot be covered exactly (or
+    once dims run out) falls back to a single ring. DCN-resident axes
+    span hosts, not ICI links — they consume no torus dims. Mirrors
+    what jax.experimental.mesh_utils.create_device_mesh arranges
+    physically."""
+    out: Dict[str, tuple] = {}
+    if mesh is None or not torus_dims:
+        return out
+    remaining = list(torus_dims)
+    for name, size in mesh.shape.items():
+        if name in dcn_axes:
+            continue
+        got: list = []
+        prod = 1
+        while remaining and prod < size and size % (
+                prod * remaining[0]) == 0:
+            prod *= remaining[0]
+            got.append(remaining.pop(0))
+        if prod == size and got:
+            out[name] = tuple(got)
+        else:
+            # not exactly coverable: restore and price as one ring
+            remaining = got + remaining
+    return out
+
+
 def default_machine_model(mesh=None, spec: Optional[MachineSpec] = None,
                           machine_file: Optional[str] = None
                           ) -> TPUMachineModel:
@@ -132,10 +210,11 @@ def default_machine_model(mesh=None, spec: Optional[MachineSpec] = None,
         except Exception:
             pass
     file_keys = set()
+    file_data: Dict = {}
     if machine_file:
         with open(machine_file) as f:
-            data = json.load(f)
-        for k, v in data.items():
+            file_data = json.load(f)
+        for k, v in file_data.items():
             if hasattr(spec, k):
                 setattr(spec, k, v)
                 file_keys.add(k)
@@ -152,4 +231,27 @@ def default_machine_model(mesh=None, spec: Optional[MachineSpec] = None,
                     spec.chips_per_host = max(1, jax.local_device_count())
         except Exception:
             pass
-    return TPUMachineModel(spec=spec, dcn_axes=dcn_axes)
+    # physical-torus layout: machine file may pin it per axis
+    # ({"axis_topology": {"data": [4, 4], "model": [4]}}), else derive
+    # from spec.ici_torus_dims ({"ici_torus_dims": [4, 4, 4]})
+    axis_topology: Dict[str, tuple] = {}
+    if "axis_topology" in file_data:
+        axis_topology = {k: tuple(v)
+                         for k, v in file_data["axis_topology"].items()}
+        if mesh is not None:
+            import math
+            import warnings
+            for name, dims in list(axis_topology.items()):
+                size = mesh.shape.get(name)
+                if size is not None and math.prod(dims) != size:
+                    warnings.warn(
+                        f"machine file axis_topology[{name!r}]={dims} "
+                        f"does not factor the mesh axis size {size}; "
+                        f"ignoring the pin (flat-ring pricing)")
+                    del axis_topology[name]
+    if not axis_topology:
+        axis_topology = assign_axis_topology(
+            mesh, tuple(getattr(spec, "ici_torus_dims", ()) or ()),
+            dcn_axes)
+    return TPUMachineModel(spec=spec, dcn_axes=dcn_axes,
+                           axis_topology=axis_topology)

@@ -1,0 +1,139 @@
+"""Torus-aware collective pricing (VERDICT r3 #9).
+
+The reference's EnhancedMachineModel routes each comm through the
+physical hierarchy (get_comm_path, machine_model.cc:695). The TPU
+analog: a mesh axis laid out over k physical ICI torus dims runs its
+ring phases over k disjoint link sets concurrently (k x bandwidth), and
+all-to-all is bisection-bound by the axis's largest physical dim —
+instead of pricing every axis as one flat ring.
+"""
+
+import json
+
+import pytest
+
+from flexflow_tpu import make_mesh
+from flexflow_tpu.parallel.mesh import MachineSpec
+from flexflow_tpu.search.machine_model import (
+    TPUMachineModel,
+    assign_axis_topology,
+    default_machine_model,
+)
+
+MB = 1 << 20
+
+
+def model_with(topology, **spec_kw):
+    return TPUMachineModel(spec=MachineSpec(**spec_kw),
+                           axis_topology=topology)
+
+
+def test_assign_axis_topology_layout():
+    mesh = make_mesh((4, 2), ("data", "model"))
+    # 16-chip 2D slice presented as (4, 2, 2): data covers (4),
+    # model covers (2) — leftover dims unused
+    topo = assign_axis_topology(mesh, (4, 2, 2))
+    assert topo == {"data": (4,), "model": (2,)}
+
+
+def test_assign_axis_topology_multi_dim_axis():
+    mesh = make_mesh((8,), ("data",))
+    topo = assign_axis_topology(mesh, (4, 2))
+    assert topo == {"data": (4, 2)}  # axis spans BOTH torus dims
+
+
+def test_assign_axis_topology_uncoverable_falls_back():
+    mesh = make_mesh((3, 2), ("data", "model"))
+    topo = assign_axis_topology(mesh, (4, 2))
+    assert "data" not in topo  # 3 does not divide into (4, 2)
+    # 4 was restored, so model=2 still cannot consume it exactly? 4%2:
+    # remaining[0]=4, size=2: 2 % 4 != 0 -> stays a flat ring
+    assert "model" not in topo
+
+
+def test_multi_dim_axis_speeds_up_all_reduce():
+    flat = model_with({})
+    torus = model_with({"x": (8, 8)})
+    t_flat = flat.all_reduce(64 * MB, 64, "x")
+    t_torus = torus.all_reduce(64 * MB, 64, "x")
+    # two concurrent link sets: ~2x faster (latency term differs too)
+    assert t_torus < 0.6 * t_flat
+    # all-gather likewise
+    assert torus.all_gather(64 * MB, 64, "x") < \
+        0.6 * flat.all_gather(64 * MB, 64, "x")
+
+
+def test_all_to_all_is_bisection_bound():
+    flat = model_with({})
+    torus = model_with({"e": (8, 8)})
+    t_flat = flat.all_to_all(8 * MB, 64, "e")
+    t_torus = torus.all_to_all(8 * MB, 64, "e")
+    # worst cut of an 8x8 torus is 8x wider than a 64-ring's
+    assert t_torus < t_flat / 4
+    # and the flat 64-way all-to-all must cost MORE than a flat
+    # 64-way all-gather of the same payload (the old ring formula
+    # priced them equal, underpricing EP dispatch ~n/4)
+    assert t_flat > flat.all_gather(8 * MB, 64, "e")
+
+
+def test_line_topology_doubles_all_to_all():
+    wrap = model_with({"e": (8,)})
+    line = TPUMachineModel(spec=MachineSpec(ici_wraparound=False),
+                           axis_topology={"e": (8,)})
+    assert line.all_to_all(MB, 8, "e") > 1.5 * wrap.all_to_all(MB, 8, "e")
+
+
+def test_machine_file_axis_topology_override(tmp_path):
+    mesh = make_mesh((4, 2), ("data", "model"))
+    p = tmp_path / "machine.json"
+    p.write_text(json.dumps({"axis_topology": {"data": [2, 2]},
+                             "ici_latency": 2e-6}))
+    mm = default_machine_model(mesh, machine_file=str(p))
+    assert mm.axis_topology == {"data": (2, 2)}
+    assert mm.spec.ici_latency == 2e-6
+
+
+def test_machine_file_torus_dims_derivation(tmp_path):
+    mesh = make_mesh((4, 2), ("data", "model"))
+    p = tmp_path / "machine.json"
+    p.write_text(json.dumps({"ici_torus_dims": [2, 2, 2]}))
+    mm = default_machine_model(mesh, machine_file=str(p))
+    assert mm.axis_topology == {"data": (2, 2), "model": (2,)}
+
+
+def test_dcn_axis_keeps_flat_pricing():
+    mm = TPUMachineModel(spec=MachineSpec(), dcn_axes=("data",),
+                         axis_topology={"data": (4, 4)})
+    # DCN is switched, not a torus: the multiplier must not apply
+    flat_dcn = TPUMachineModel(spec=MachineSpec(), dcn_axes=("data",))
+    assert mm.all_reduce(MB, 16, "data") == \
+        flat_dcn.all_reduce(MB, 16, "data")
+    assert mm.all_to_all(MB, 16, "data") == \
+        flat_dcn.all_to_all(MB, 16, "data")
+
+
+def test_line_topology_slows_ring_collectives():
+    torus = model_with({"x": (8,)})
+    line = TPUMachineModel(spec=MachineSpec(ici_wraparound=False),
+                           axis_topology={"x": (8,)})
+    big = 256 * MB  # bandwidth-dominated
+    assert line.all_reduce(big, 8, "x") > 1.5 * torus.all_reduce(
+        big, 8, "x")
+
+
+def test_dcn_axis_consumes_no_torus_dims():
+    mesh = make_mesh((4, 2), ("data", "model"))
+    topo = assign_axis_topology(mesh, (2, 2), dcn_axes=("data",))
+    # 'data' spans hosts: the (2, 2) dims go to 'model'... which is
+    # size 2 -> consumes (2,); 'data' gets nothing
+    assert "data" not in topo
+    assert topo["model"] == (2,)
+
+
+def test_bad_axis_topology_pin_warns_and_drops(tmp_path):
+    mesh = make_mesh((4, 2), ("data", "model"))
+    p = tmp_path / "machine.json"
+    p.write_text(json.dumps({"axis_topology": {"model": [2, 2]}}))
+    with pytest.warns(UserWarning, match="does not factor"):
+        mm = default_machine_model(mesh, machine_file=str(p))
+    assert "model" not in mm.axis_topology
