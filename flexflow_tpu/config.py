@@ -109,6 +109,13 @@ class FFConfig:
     # they are for strategy-space exploration/export tooling.
     enable_device_placement: bool = False
     machine_model_file: Optional[str] = None
+    # ground the cost model per-op: the top-N ops by analytic time get
+    # their fwd/bwd timed as isolated jitted kernels at the strategy's
+    # sub-shape (search/op_measure.py — the analog of the reference
+    # measuring every op's real kernels at search time, model.cu:20-62).
+    # 0 = analytic-only (default: measuring pays a jit compile per
+    # distinct op shape on first use; cached per machine thereafter).
+    measure_top_ops: int = 0
     # DOT export of the simulated task graph (reference --taskgraph,
     # simulator.cc:508-556); written by the first simulate() of a search.
     taskgraph_file: Optional[str] = None
@@ -223,6 +230,7 @@ class FFConfig:
         "--taskgraph": ("taskgraph_file", str),
         "--seed": ("seed", int),
         "--conv-layout": ("conv_layout", str),
+        "--measure-ops": ("measure_top_ops", int),
         "--moe-dispatch": ("moe_dispatch", str),
         "--pipeline-stages": ("pipeline_stages", int),
         "--pipeline-microbatches": ("pipeline_microbatches", int),

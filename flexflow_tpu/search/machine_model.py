@@ -231,27 +231,29 @@ def default_machine_model(mesh=None, spec: Optional[MachineSpec] = None,
                     spec.chips_per_host = max(1, jax.local_device_count())
         except Exception:
             pass
-    # physical-torus layout: machine file may pin it per axis
-    # ({"axis_topology": {"data": [4, 4], "model": [4]}}), else derive
-    # from spec.ici_torus_dims ({"ici_torus_dims": [4, 4, 4]})
-    axis_topology: Dict[str, tuple] = {}
+    # physical-torus layout: machine-file per-axis pins
+    # ({"axis_topology": {"data": [4, 4]}}) fully govern the axes they
+    # mention — a pin dropped as invalid leaves THAT axis flat-ring, as
+    # warned; axes the file does not mention derive from
+    # spec.ici_torus_dims ({"ici_torus_dims": [4, 4, 4]}) when set
+    pins: Dict[str, tuple] = {}
+    pinned_axes: tuple = ()
     if "axis_topology" in file_data:
-        axis_topology = {k: tuple(v)
-                         for k, v in file_data["axis_topology"].items()}
-        if mesh is not None:
-            import math
-            import warnings
-            for name, dims in list(axis_topology.items()):
-                size = mesh.shape.get(name)
-                if size is not None and math.prod(dims) != size:
-                    warnings.warn(
-                        f"machine file axis_topology[{name!r}]={dims} "
-                        f"does not factor the mesh axis size {size}; "
-                        f"ignoring the pin (flat-ring pricing)")
-                    del axis_topology[name]
-    if not axis_topology:
-        axis_topology = assign_axis_topology(
-            mesh, tuple(getattr(spec, "ici_torus_dims", ()) or ()),
-            dcn_axes)
+        raw = {k: tuple(v) for k, v in file_data["axis_topology"].items()}
+        pinned_axes = tuple(raw)  # dropped pins stay excluded (= flat)
+        import math
+        import warnings
+        for name, dims in raw.items():
+            size = mesh.shape.get(name) if mesh is not None else None
+            if size is not None and math.prod(dims) != size:
+                warnings.warn(
+                    f"machine file axis_topology[{name!r}]={dims} "
+                    f"does not factor the mesh axis size {size}; "
+                    f"ignoring the pin (flat-ring pricing)")
+            else:
+                pins[name] = dims
+    derived = assign_axis_topology(
+        mesh, tuple(getattr(spec, "ici_torus_dims", ()) or ()),
+        dcn_axes + pinned_axes)
     return TPUMachineModel(spec=spec, dcn_axes=dcn_axes,
-                           axis_topology=axis_topology)
+                           axis_topology={**derived, **pins})

@@ -52,8 +52,11 @@ def lower_to_arrays(model, sim: Simulator, cands: Dict[str, list],
     for i, op in enumerate(ops):
         for j, m in enumerate(cand_lists[i]):
             s = OpStrategy(dict(m))
-            table.set(i, j, op_cost(op, s, sim.mesh, sim.mm),
-                      devices=s.device_ids)
+            # measured grounding (measure_top_ops) applies to the
+            # native table too — both engines rank on the same numbers
+            c = sim.measured_adjust(op, s,
+                                    op_cost(op, s, sim.mesh, sim.mm))
+            table.set(i, j, c, devices=s.device_ids)
 
     _, op_pairs = op_edges(model)
     edges: List[Tuple[int, int]] = [

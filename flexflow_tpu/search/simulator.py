@@ -141,6 +141,8 @@ class Simulator:
         # fused-unit partition + edges per strategy signature (fusion
         # groups depend only on each op's axis map)
         self._unit_cache: Dict[tuple, tuple] = {}
+        # per-op measured grounding (FFConfig.measure_top_ops)
+        self._measured_set: set = self._choose_measured_ops()
 
     def calibrate_end_to_end(self, strategy: Strategy,
                              measured_step_seconds: float) -> float:
@@ -169,13 +171,62 @@ class Simulator:
 
     def _op_cost(self, op, strategy: Strategy) -> OpCost:
         """Per-(op, op-strategy) cost with caching (the analog of the
-        reference's hash-keyed measurement cache, simulator.cc:301-321)."""
+        reference's hash-keyed measurement cache, simulator.cc:301-321).
+        With FFConfig.measure_top_ops > 0, the top-N ops by analytic
+        time get their fwd/bwd REPLACED by isolated-op jit measurements
+        at the strategy's data-sharded sub-shape (op_measure.py — the
+        reference's measure_operator_cost, model.cu:20-62); residual
+        non-sample shardings still divide analytically."""
         s = strategy.for_op(op.name)
         key = (op.name, tuple(sorted(
             (k, str(v)) for k, v in s.axis_map.items())))
         if key not in self._cache:
-            self._cache[key] = op_cost(op, s, self.mesh, self.mm)
+            c = op_cost(op, s, self.mesh, self.mm)
+            self._cache[key] = self.measured_adjust(op, s, c)
         return self._cache[key]
+
+    def measured_adjust(self, op, s, c: OpCost) -> OpCost:
+        """Replace analytic fwd/bwd with measured seconds for grounded
+        ops (measure_top_ops). Measurement happens at the sample-sharded
+        sub-shape WHEN the sample axis genuinely divides; every other
+        sharding axis divides the measured time analytically. Pipelined
+        meta-ops and device-pinned ops keep their analytic expansion.
+        Shared by the Python cache and the native engine's cost table
+        (native_search.py) so both rank on the same grounded numbers."""
+        if op.name not in self._measured_set or s.device_ids \
+                or c.pipeline is not None:
+            return c
+        from .cost_model import compute_shards
+        from .op_measure import measure_op
+        from ..parallel.pconfig import OpStrategy
+        shards_total = compute_shards(op, s, self.mesh)
+        s_nosample = OpStrategy({k: v for k, v in s.axis_map.items()
+                                 if k != "sample"})
+        resid = max(1, compute_shards(op, s_nosample, self.mesh))
+        sample_div = max(1, shards_total // resid)
+        m = measure_op(op, sample_shard=sample_div)
+        if m is None:
+            return c
+        return dataclasses.replace(c, fwd=m["fwd"] / resid,
+                                   bwd=m["bwd"] / resid)
+
+    def _choose_measured_ops(self) -> set:
+        """Top-N ops by analytic (fwd+bwd) time under the seed (DP)
+        strategy — measuring everything would pay a jit compile per op
+        for ops that never matter. Pipeline meta-ops are excluded: one
+        timing of the whole stack would be the giant compile this cap
+        exists to avoid, and it would drop the bubble factor."""
+        n = int(getattr(self.model.config, "measure_top_ops", 0) or 0)
+        if n <= 0:
+            return set()
+        seed = Strategy()
+        eligible = [op for op in self.model.ops
+                    if op.op_type != "pipeline_blocks"]
+        ranked = sorted(
+            eligible,
+            key=lambda op: -(lambda c: c.fwd + c.bwd)(
+                op_cost(op, seed.for_op(op.name), self.mesh, self.mm)))
+        return {op.name for op in ranked[:n]}
 
     def _units_for(self, strategy: Strategy):
         """(groups, unit_deps, unit_consumers) for this strategy's fusion

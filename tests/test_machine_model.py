@@ -137,3 +137,23 @@ def test_bad_axis_topology_pin_warns_and_drops(tmp_path):
     with pytest.warns(UserWarning, match="does not factor"):
         mm = default_machine_model(mesh, machine_file=str(p))
     assert "model" not in mm.axis_topology
+
+
+def test_pin_plus_torus_dims_mixed_semantics(tmp_path):
+    """A file pin governs its axis; unmentioned axes derive from
+    ici_torus_dims; an INVALID pin leaves its axis flat-ring even when
+    torus dims could cover it (the warning promises flat pricing)."""
+    mesh = make_mesh((4, 2), ("data", "model"))
+    p = tmp_path / "machine.json"
+    p.write_text(json.dumps({"axis_topology": {"data": [2, 2]},
+                             "ici_torus_dims": [2, 2, 2]}))
+    mm = default_machine_model(mesh, machine_file=str(p))
+    assert mm.axis_topology["data"] == (2, 2)   # the pin
+    assert mm.axis_topology["model"] == (2,)    # derived
+    p2 = tmp_path / "machine2.json"
+    p2.write_text(json.dumps({"axis_topology": {"model": [2, 2]},
+                              "ici_torus_dims": [2, 2, 2]}))
+    with pytest.warns(UserWarning, match="does not factor"):
+        mm2 = default_machine_model(mesh, machine_file=str(p2))
+    assert "model" not in mm2.axis_topology     # dropped pin stays flat
+    assert mm2.axis_topology["data"] == (2, 2)  # others still derive

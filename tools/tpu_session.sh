@@ -74,6 +74,10 @@ run_all() {
         --model dlrm --preset full --steps 30 | tail -1 \
         || echo "FAILED rc=$? (dlrm stacked=$v)"
     done
+    echo "--- 9. sim-vs-real validation, all five models (VERDICT r3 #6)"
+    SIM_VALIDATION_PLATFORM=tpu timeout 1800 \
+      python tools/sim_validation.py \
+      || echo "sim validation FAILED rc=$?"
   fi
   echo "=== done $(date -u +%FT%TZ) ==="
 }
