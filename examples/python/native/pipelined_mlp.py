@@ -1,0 +1,63 @@
+"""Graph pipelining: train a plain-layer MLP split into pipeline
+stages by whole-op device pins (the executable form of the reference's
+per-op device placement, mapper.cc:346-440 — here stages stream
+microbatches over a mesh `pipe` axis, core/staged.py).
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m flexflow_tpu examples/python/native/pipelined_mlp.py \
+      -b 64 -e 2 --pipeline-schedule 1f1b
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer, make_mesh
+from flexflow_tpu.parallel.pconfig import DEVICE_KEY, OpStrategy, Strategy
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    import jax
+    n = len(jax.devices())
+    if n < 2:
+        print("needs >= 2 devices (set "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+        return
+    mesh = make_mesh((n // 2, 2), ("data", "pipe"))
+
+    # stage 0 = the wide trunk, stage 1 = the head (pins; unpinned ops
+    # inherit their producers' stage)
+    strat = Strategy(default=OpStrategy({}))
+    strat.set("fc1", OpStrategy({DEVICE_KEY: (0,)}))
+    strat.set("fc3", OpStrategy({DEVICE_KEY: (1,)}))
+
+    ff = FFModel(cfg, mesh=mesh, strategy=strat)
+    x = ff.create_tensor((cfg.batch_size, 784), name="input")
+    t = ff.dense(x, 512, activation="relu", name="fc1")
+    t = ff.dense(t, 512, activation="relu", name="fc2")
+    t = ff.dense(t, 10, name="fc3")
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"], mesh=mesh, strategy=strat)
+
+    from flexflow_tpu.core.staged import StagedExecutor
+    assert isinstance(ff.executor, StagedExecutor), (
+        "pins did not lower to pipeline stages")
+    print(f"stages: {[[o.name for o in s] for s in ff.executor.plan.stages]}"
+          f"  schedule: {ff.executor.schedule}")
+
+    rng = np.random.RandomState(cfg.seed)
+    xs = rng.randn(1024, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    ys = np.argmax(xs @ w, axis=1).astype(np.int32)
+    hist = ff.fit({"input": xs}, ys, epochs=cfg.epochs)
+    acc = hist[-1]["accuracy"]
+    print(f"final accuracy: {acc:.3f}")
+    if "--accuracy" in sys.argv:
+        assert acc > 0.3, f"model failed to learn ({acc:.3f})"
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -252,8 +252,15 @@ def default_machine_model(mesh=None, spec: Optional[MachineSpec] = None,
                     f"ignoring the pin (flat-ring pricing)")
             else:
                 pins[name] = dims
-    derived = assign_axis_topology(
-        mesh, tuple(getattr(spec, "ici_torus_dims", ()) or ()),
-        dcn_axes + pinned_axes)
+    # pins occupy physical dims: remove them (by multiset) from the
+    # pool before deriving the unmentioned axes, or two mesh axes could
+    # be priced on the same physical ICI dimension
+    pool = list(getattr(spec, "ici_torus_dims", ()) or ())
+    for dims in pins.values():
+        for d in dims:
+            if d in pool:
+                pool.remove(d)
+    derived = assign_axis_topology(mesh, tuple(pool),
+                                   dcn_axes + pinned_axes)
     return TPUMachineModel(spec=spec, dcn_axes=dcn_axes,
                            axis_topology={**derived, **pins})

@@ -174,12 +174,19 @@ def calibrate(mm: TPUMachineModel, save_path: Optional[str] = None
 _CAL_MEMO: dict = {}
 
 
-def calibration_cache_path(device_kind: str) -> str:
+def cache_file(prefix: str, device_kind: str) -> str:
+    """Per-machine measurement cache path (shared by the calibration
+    and per-op cost caches so the root/sanitization policy lives
+    once)."""
     root = os.environ.get("FLEXFLOW_TPU_CACHE",
                           os.path.join(os.path.expanduser("~"), ".cache",
                                        "flexflow_tpu"))
     safe = device_kind.lower().replace(" ", "_")
-    return os.path.join(root, f"calibration_{safe}.json")
+    return os.path.join(root, f"{prefix}_{safe}.json")
+
+
+def calibration_cache_path(device_kind: str) -> str:
+    return cache_file("calibration", device_kind)
 
 
 def calibrated_machine_model(mesh=None, machine_file: Optional[str] = None,

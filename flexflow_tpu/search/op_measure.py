@@ -35,11 +35,8 @@ _DISK_LOADED: set = set()
 
 
 def _cache_path(device_kind: str) -> str:
-    root = os.environ.get("FLEXFLOW_TPU_CACHE",
-                          os.path.join(os.path.expanduser("~"), ".cache",
-                                       "flexflow_tpu"))
-    safe = device_kind.lower().replace(" ", "_")
-    return os.path.join(root, f"op_costs_{safe}.json")
+    from .measure import cache_file
+    return cache_file("op_costs", device_kind)
 
 
 def _load_disk(device_kind: str) -> None:
@@ -58,8 +55,11 @@ def _persist(device_kind: str) -> None:
     path = _cache_path(device_kind)
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        # None = a FAILED measurement: in-process only, never persisted
+        # (a cached failure would silently defeat re-measurement
+        # forever — same policy as measure.py's calibrate())
         data = {sig: v for (kind, sig), v in _MEMO.items()
-                if kind == device_kind}
+                if kind == device_kind and v is not None}
         with open(path, "w") as f:
             json.dump(data, f)
     except OSError:

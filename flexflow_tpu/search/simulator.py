@@ -211,22 +211,30 @@ class Simulator:
                                    bwd=m["bwd"] / resid)
 
     def _choose_measured_ops(self) -> set:
-        """Top-N ops by analytic (fwd+bwd) time under the seed (DP)
-        strategy — measuring everything would pay a jit compile per op
-        for ops that never matter. Pipeline meta-ops are excluded: one
-        timing of the whole stack would be the giant compile this cap
-        exists to avoid, and it would drop the bubble factor."""
+        """Ops covered by the top-N measurement SIGNATURES (shape
+        classes) by aggregate analytic time. The cost cap is jit
+        compiles, and measure_op memoizes per signature — so N
+        signatures can ground far more than N ops (Inception's ~100
+        convs share a handful of shapes; capping op count left most of
+        the model analytic). Pipeline meta-ops are excluded: one timing
+        of the whole stack would be the giant compile this cap exists
+        to avoid, and it would drop the bubble factor."""
         n = int(getattr(self.model.config, "measure_top_ops", 0) or 0)
         if n <= 0:
             return set()
+        from .op_measure import op_signature
         seed = Strategy()
-        eligible = [op for op in self.model.ops
-                    if op.op_type != "pipeline_blocks"]
-        ranked = sorted(
-            eligible,
-            key=lambda op: -(lambda c: c.fwd + c.bwd)(
-                op_cost(op, seed.for_op(op.name), self.mesh, self.mm)))
-        return {op.name for op in ranked[:n]}
+        by_sig: Dict[str, list] = {}
+        sig_time: Dict[str, float] = {}
+        for op in self.model.ops:
+            if op.op_type == "pipeline_blocks":
+                continue
+            c = op_cost(op, seed.for_op(op.name), self.mesh, self.mm)
+            sig = op_signature(op, 1)
+            by_sig.setdefault(sig, []).append(op.name)
+            sig_time[sig] = sig_time.get(sig, 0.0) + c.fwd + c.bwd
+        top = sorted(sig_time, key=sig_time.get, reverse=True)[:n]
+        return {name for sig in top for name in by_sig[sig]}
 
     def _units_for(self, strategy: Strategy):
         """(groups, unit_deps, unit_consumers) for this strategy's fusion

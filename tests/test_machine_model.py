@@ -157,3 +157,16 @@ def test_pin_plus_torus_dims_mixed_semantics(tmp_path):
         mm2 = default_machine_model(mesh, machine_file=str(p2))
     assert "model" not in mm2.axis_topology     # dropped pin stays flat
     assert mm2.axis_topology["data"] == (2, 2)  # others still derive
+
+
+def test_pins_consume_torus_dims_from_pool(tmp_path):
+    """A pinned axis's physical dims leave the derivation pool — two
+    mesh axes must never price on the same ICI dimension."""
+    mesh = make_mesh((4, 2), ("data", "model"))
+    p = tmp_path / "machine.json"
+    p.write_text(json.dumps({"axis_topology": {"data": [4]},
+                             "ici_torus_dims": [4, 2, 2]}))
+    mm = default_machine_model(mesh, machine_file=str(p))
+    assert mm.axis_topology["data"] == (4,)
+    # model must get one of the remaining 2s, not the consumed 4
+    assert mm.axis_topology["model"] == (2,)

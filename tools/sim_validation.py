@@ -74,7 +74,11 @@ def main():
         if quick and name == "inception":
             continue  # ~5 min XLA CPU compile
         entry = {}
-        for mode, n in (("analytic", 0), ("measured", 8)):
+        # N caps measurement signatures (shape classes). Inception has
+        # ~90 DISTINCT conv shapes — it needs a deeper sweep where the
+        # other models saturate at a handful
+        deep = 48 if name == "inception" else 8
+        for mode, n in (("analytic", 0), ("measured", deep)):
             try:
                 entry[mode] = one(name, builder, kw, batch, n)
                 print(f"{name:12s} {mode:9s} "
