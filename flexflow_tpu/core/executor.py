@@ -160,8 +160,17 @@ class Executor:
         opt_state = (self.optimizer.init_state(params)
                      if self.optimizer and self.comp_mode != "inference"
                      else {})
-        step = jnp.zeros((), jnp.int32)
-        return TrainState(params, states, opt_state, step)
+        return TrainState(params, states, opt_state, self._init_step())
+
+    def _init_step(self):
+        """Step counter, committed to the mesh (replicated) when one
+        exists: a checkpoint restore otherwise brings it back committed
+        to ONE device, and jit rejects the mixed device assignment
+        against mesh-sharded params."""
+        if self.mesh is None:
+            return jnp.zeros((), jnp.int32)
+        return place_global(np.zeros((), np.int32),
+                            NamedSharding(self.mesh, P()))
 
     # ---------------- forward ----------------
     def forward_values(self, params, states, inputs: Dict[str, jax.Array],

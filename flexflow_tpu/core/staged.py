@@ -120,8 +120,7 @@ class StagedExecutor(Executor):
         opt_state = jax.tree_util.tree_map(
             lambda a: self._place_packed(np.asarray(a)), opt_state)
         from .executor import TrainState
-        return TrainState(params, {}, opt_state,
-                          jnp.zeros((), jnp.int32))
+        return TrainState(params, {}, opt_state, self._init_step())
 
     def _packed_sharding(self):
         return NamedSharding(self.mesh, P(self.pipe_axis, None))
