@@ -1,6 +1,16 @@
 """PipelineBlocks: a stack of identical sub-graphs with first-class
 pipeline parallelism.
 
+Schedule note: this meta-op runs GPipe (forward schedule + autodiff
+transpose). True 1F1B cannot live inside an op that is differentiated
+as part of a larger graph — interleaving a stage's backward with later
+forwards requires the downstream cotangent DURING the forward pass,
+which only exists when the pipeline owns the whole training step. That
+form is provided by the graph-level staged executor
+(core/staged.py + parallel/graph_pipeline.pipeline_1f1b_grads):
+build the stack from plain per-layer ops and pin/auto-cut stages with
+--pipeline-schedule 1f1b.
+
 Builder: ``ff.pipeline_blocks(x, block_builder, num_layers)`` where
 ``block_builder(sub_model, t) -> t_out`` constructs one shape-preserving
 block using the normal layer API on a sub-FFModel. Weights of every block

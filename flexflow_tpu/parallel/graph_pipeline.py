@@ -392,11 +392,15 @@ def _wire_layouts(plan: StagePlan):
 
 def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
                        widths, mb_local: int, *, training: bool,
-                       seq_length: int):
+                       seq_length: int, remat: bool = False):
     """Shared stage body for both schedules: unpack weights + incoming
     wire, run the stage's ops, emit (wire_out, final, aux). Pure
     compute — collectives stay at the tick level (SPMD-uniform across
-    switch branches)."""
+    switch branches). `remat=True` wraps each stage tick in
+    jax.checkpoint so the GPipe backward recomputes stage activations
+    from the saved tick inputs instead of storing every intermediate —
+    most of 1F1B's activation savings without the interleaved schedule
+    (the 1F1B path recomputes inherently and must NOT also remat)."""
     S = plan.num_stages
     final_t = model.final_tensor
     name_of_input = {t.name: t.uid for t in model.input_tensors}
@@ -404,6 +408,18 @@ def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
     def run_stage(s: int, row: Dict[str, jax.Array],
                   wire_in: Dict[str, jax.Array],
                   mb_in: Dict[str, jax.Array], mb_rng):
+        if remat and training and mb_rng is not None:
+            # prevent_cse=False: the CSE-prevention barriers exist for
+            # remat OUTSIDE scans; inside the tick lax.scan they only
+            # block fusion (per the jax.checkpoint docs)
+            return jax.checkpoint(functools.partial(_stage_core, s),
+                                  prevent_cse=False)(
+                row, wire_in, mb_in, mb_rng)
+        return _stage_core(s, row, wire_in, mb_in, mb_rng)
+
+    def _stage_core(s: int, row: Dict[str, jax.Array],
+                    wire_in: Dict[str, jax.Array],
+                    mb_in: Dict[str, jax.Array], mb_rng):
         values: Dict[int, jax.Array] = {}
         for name, v in mb_in.items():
             values[name_of_input[name]] = v
@@ -491,7 +507,8 @@ def pipeline_logits(plan: StagePlan, pack: PackSpec, packed,
     data_ax, ndata, mb_local = _data_split(mesh, data_axis, mb)
     run_stage = _make_stage_runner(
         plan, pack, model, layouts, widths, mb_local,
-        training=training, seq_length=seq_length)
+        training=training, seq_length=seq_length,
+        remat=bool(getattr(model.config, "remat", False)))
 
     def local_fn(packed_local, inputs_local, rng_op):
         # packed_local: {dt: (1, L)}; inputs_local: {name: (M, mb_l, ...)}

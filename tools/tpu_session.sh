@@ -78,6 +78,10 @@ run_all() {
     SIM_VALIDATION_PLATFORM=tpu timeout 1800 \
       python tools/sim_validation.py \
       || echo "sim validation FAILED rc=$?"
+    echo "--- 10. per-shape conv table (inception MFU diagnosis)"
+    CONV_TABLE_PLATFORM=tpu timeout 1800 \
+      python tools/conv_shape_table.py \
+      || echo "conv table FAILED rc=$?"
   fi
   echo "=== done $(date -u +%FT%TZ) ==="
 }
