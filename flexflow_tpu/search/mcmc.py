@@ -292,27 +292,30 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
             raise ValueError("native search does not support "
                              "perform_fusion; use the Python engine")
         use_native = False
-    # graph-PP staged candidates are global moves priced by the Python
-    # simulator's staged expansion — route to the Python engine; an
-    # explicit native request keeps the native engine and simply
-    # forgoes the staged candidates
+    # graph-PP staged candidates: a staged strategy's simulated cost is
+    # INDEPENDENT of the per-op assignment (the whole graph runs as one
+    # pipeline), so the native engine needn't anneal through them — run
+    # the native search over the per-op space and compare the winner
+    # against each staged candidate afterward (priced by the Python
+    # staged expansion). Equivalent outcome to the Python loop's global
+    # staged moves, native speed retained.
     staged = staged_strategies(model, mesh, cfg)
-    if staged:
-        if use_native is True:
-            import warnings
-            warnings.warn(
-                "native search engine does not price graph-pipeline "
-                "candidates; searching without them (drop "
-                "use_native=True to include staged pipelining)")
-            staged = []
-        else:
-            use_native = False
     if use_native is not False:
         from .native_search import optimize_native
         found = optimize_native(model, sim, cands, budget, alpha, seed,
                                 verbose=verbose)
         if found is not None:
-            return finish(found)
+            best = found
+            if staged:  # compare only when candidates exist: the
+                best_cost = sim.simulate(found)  # extra sim is theirs
+                for st in staged:
+                    c = sim.simulate(st)
+                    if c < best_cost:
+                        best, best_cost = st, c
+                        if verbose:
+                            print(f"[search] staged pipeline wins: "
+                                  f"{best_cost*1e3:.3f} ms/step")
+            return finish(best)
         assert use_native is not True, "native search requested but " \
             "the native library is unavailable"
     _, edges = op_edges(model)

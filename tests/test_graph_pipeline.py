@@ -575,3 +575,19 @@ def test_remat_under_gpipe_matches():
         np.testing.assert_allclose(float(ff.train_batch(b)["loss"]),
                                    float(ref.train_batch(b)["loss"]),
                                    rtol=1e-5)
+
+
+def test_native_engine_compares_staged_candidates():
+    """use_native=True works with pipeline candidates: the native
+    anneal runs the per-op space and the staged pipeline wins the
+    final comparison when cheaper (staged cost is independent of the
+    per-op assignment, so post-comparison == annealing through it)."""
+    from flexflow_tpu import native
+    if not native.available():
+        pytest.skip("native library unavailable")
+    from flexflow_tpu.search.mcmc import optimize
+    ff = build_deep()
+    mesh = make_mesh((2,), ("pipe",))
+    best = optimize(ff, budget=40, mesh=mesh, seed=1, use_native=True)
+    pins = [best.for_op(f"fc{i}").device_ids for i in range(8)]
+    assert any(p is not None for p in pins), pins

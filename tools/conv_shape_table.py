@@ -3,11 +3,13 @@ conv signature in the conv-heavy bench models (VERDICT r3 #2 — the
 analog of the reference's per-shape cuDNN algorithm selection,
 /root/reference/src/ops/conv_2d.cu:173-260).
 
-For each distinct Conv2D signature in Inception-v3 and AlexNet at
-bench batch sizes: the measured isolated-kernel fwd+bwd time
-(search/op_measure.py — the same memoized measurements --measure-ops
-uses, so running this tool WARMS the per-machine cache every
-subsequent search hits), the analytic roofline prediction, and the
+For each distinct Conv2D signature in Inception-v3 and AlexNet at the
+EXACT bench configs (reusing bench.build, so the shapes cannot drift
+from what bench.py measures): the measured isolated-kernel fwd+bwd
+time (search/op_measure.py — the same memoized measurements
+--measure-ops reads, so this run warms the per-machine cache for
+unsharded/single-chip searches; data-sharded candidates measure at
+their own sub-shape), the analytic roofline prediction, and the
 implied achieved MXU fraction. Sorted by measured time: the top rows
 are where Inception's MFU lives, and a row whose achieved fraction is
 far below the calibrated conv efficiency is a specific shape worth a
@@ -28,8 +30,6 @@ jax.config.update("jax_platforms",
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from flexflow_tpu import FFConfig  # noqa: E402
-from flexflow_tpu import models as zoo  # noqa: E402
 from flexflow_tpu.search.machine_model import default_machine_model  # noqa: E402
 from flexflow_tpu.search.measure import calibrated_machine_model  # noqa: E402
 from flexflow_tpu.search.op_measure import measure_op, op_signature  # noqa: E402
@@ -77,17 +77,10 @@ def main():
     out = {"platform": platform,
            "conv_efficiency_factor": mm.efficiency.get("conv"),
            "models": {}}
-    import jax.numpy as jnp
-    # EXACTLY the bench configs (bench.py full preset): bf16
-    # activations, bench batch sizes — the signatures measured here are
-    # the ones --measure-ops looks up for the bench models, so this
-    # run warms that cache for real
-    for name, builder, kw, bs in (
-            ("inception", zoo.build_inception_v3,
-             {"dtype": jnp.bfloat16, "image_size": 299}, 32),
-            ("alexnet", zoo.build_alexnet,
-             {"dtype": jnp.bfloat16}, 256)):
-        model = builder(FFConfig(batch_size=bs), **kw)
+    import bench  # the SAME configs the bench measures — no drift
+    # (honors BENCH_BATCH / BENCH_CONV_LAYOUT session knobs too)
+    for name in ("inception", "alexnet"):
+        model, _data = bench.build(name, "full")
         rows = conv_rows(model, mm, repeats)
         out["models"][name] = rows
         print(f"[{name}] {len(rows)} distinct conv shapes")
