@@ -77,7 +77,10 @@ class CostTable:
             devices: Optional[Sequence[int]] = None) -> None:
         i = int(self.offsets[op]) + cand
         self.fwd[i] = cost.fwd
-        self.bwd[i] = cost.bwd
+        # the native task graph has no separate update task: fold the
+        # optimizer-update sweep into bwd, exactly as the Python
+        # simulator serializes it onto the device after backward
+        self.bwd[i] = cost.bwd + getattr(cost, "update", 0.0)
         self.fwd_comm[i] = cost.fwd_comm
         self.bwd_comm[i] = cost.bwd_comm
         self.sync[i] = cost.sync

@@ -233,6 +233,33 @@ def assignment_from_pins(model, strategy) -> Optional[Dict[str, int]]:
                 inherited = max(inherited, stage_of[producer[t.uid]])
         stage_of[op.name] = (rank[pins[op.name]] if op.name in pins
                              else inherited)
+    # pipelining is only meaningful for SEQUENTIAL placements: each
+    # consecutive stage pair must be bridged by a real data edge
+    # (producer in stage i feeding a consumer in stage i+1). Pins on
+    # parallel SIBLING branches (e.g. DLRM's independent per-table
+    # embeddings round-robined over devices) express concurrency, not
+    # a pipeline — serializing them into stages would slow them down;
+    # they fall back to the simulator's per-device concurrency pricing
+    # (and, for embeddings, the distributed_embedding slot layout is
+    # the executable form).
+    S = max(stage_of.values()) + 1
+    if S > 1:
+        bridged = [False] * (S - 1)
+        for op in model.ops:
+            dst = stage_of[op.name]
+            for t in op.inputs:
+                if t.uid in input_uids:
+                    continue
+                src = stage_of[producer[t.uid]]
+                if src == dst - 1:
+                    bridged[src] = True
+        if not all(bridged):
+            gap = bridged.index(False)
+            raise ValueError(
+                f"pins do not form a sequential pipeline: no tensor "
+                f"flows from stage {gap} to stage {gap + 1} (the "
+                f"pinned ops are parallel siblings — placement there "
+                f"means concurrency, not pipelining)")
     return stage_of
 
 

@@ -62,6 +62,12 @@ class OpCost:
     bwd_comm: float
     sync: float         # gradient sync (DP all-reduce) seconds
     mem: float          # bytes resident per device (weights+opt+acts)
+    # optimizer-update sweep seconds (HBM-bound; the reference's update
+    # tasks carry run_time=0, simulator.cc:420 — priced here beyond
+    # parity). Kept separate from bwd so measured grounding replaces
+    # kernel time without losing the update term; task builders add
+    # bwd + update.
+    update: float = 0.0
     # set for pipeline_blocks ops with layer->pipe mapped; fwd/bwd then
     # hold the closed-form GPipe makespan (used by the native engine's
     # one-task-per-op lowering) while the Python simulator replaces them
@@ -78,6 +84,7 @@ class OpCost:
                       fwd_comm=self.fwd_comm + other.fwd_comm,
                       bwd_comm=self.bwd_comm + other.bwd_comm,
                       sync=self.sync + other.sync, mem=self.mem + other.mem,
+                      update=self.update + other.update,
                       pipeline=self.pipeline or other.pipeline)
 
 
@@ -175,6 +182,9 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
         sync_bytes = grad_bytes
         sync_data_sharded = sparse_updates  # each replica syncs its rows
         is_mm = False  # gather/scatter, never the MXU path
+        emb_sparse_updates = sparse_updates
+    else:
+        emb_sparse_updates = False
 
     # --- device-explicit placement (reference ParallelConfig.device_ids,
     # config.h:47-73; DLRM per-table strategies dlrm_strategy.cc:1-50):
@@ -228,8 +238,15 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
             bwd_comm = mm.all_gather(act_bytes, n)
         mem = (w_bytes * (1.0 + optimizer_state_mult) + act_bytes * 2) \
             * k / n
+        # dense updates sweep the (NORMALIZED) table bytes — sync_bytes
+        # was captured before the padded-slot normalization above and
+        # would overprice a live placed op by slots/ntab
+        upd_basis = sync_bytes if emb_sparse_updates else w_bytes
+        upd = (upd_basis * (2.0 + 2.0 * optimizer_state_mult) / k
+               / (mm.spec.hbm_bandwidth * mm.efficiency["elementwise"])
+               if w_bytes > 0 else 0.0)
         return OpCost(fwd=fwd, bwd=bwd, fwd_comm=fwd_comm,
-                      bwd_comm=bwd_comm, sync=0.0, mem=mem)
+                      bwd_comm=bwd_comm, sync=0.0, mem=mem, update=upd)
 
     fwd = mm.compute_time(flops / shards, fwd_bytes / shards, is_mm,
                           kind=kind)
@@ -291,11 +308,28 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
     # (M + pp - 1)/M. fwd/bwd carry the closed-form makespan (native
     # engine's one-task-per-op view); `pipeline` carries the per-stage
     # tick costs so the Python simulator can run the real schedule.
+    # optimizer-update sweep (see the `update` computation below) —
+    # needed early here so pipelined ops fold it into their per-stage
+    # ticks (the Python simulator prices expanded pipelines from
+    # PipelineCost, never from OpCost.update)
+    def update_sweep(divisor: float) -> float:
+        if w_bytes <= 0:
+            return 0.0
+        upd_bytes = sync_bytes * (2.0 + 2.0 * optimizer_state_mult)
+        per_dev = upd_bytes / max(1.0, divisor)
+        if sync_data_sharded:
+            per_dev /= max(1, dp)
+        return per_dev / (mm.spec.hbm_bandwidth
+                          * mm.efficiency["elementwise"])
+
     pipeline = None
     if pp > 1 and op.op_type == "pipeline_blocks":
         M = op.num_microbatches
+        upd = update_sweep(eff_tp * ep * pp * vocab * table)
         fwd_stage = fwd / (pp * M)
-        bwd_stage = bwd / (pp * M)
+        # each stage's weights update once per step; amortized over the
+        # M bwd ticks so BOTH engines and the expanded schedule carry it
+        bwd_stage = bwd / (pp * M) + upd / M
         mb_bytes = in_bytes / max(1, dp) / M
         hop = mm.ppermute(mb_bytes, pp_ax)
         pipeline = PipelineCost(stages=pp, microbatches=M,
@@ -303,7 +337,7 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
                                 hop=hop)
         bubble = (M + pp - 1) / (M * pp)
         fwd *= bubble
-        bwd *= bubble
+        bwd = bwd * bubble + upd  # closed form (native engine view)
         fwd_comm += (M + pp - 1) * hop
         bwd_comm += (M + pp - 1) * hop
 
@@ -325,8 +359,21 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
     act_per_dev = act_bytes / shards
     mem = w_per_dev * (1.0 + optimizer_state_mult) + act_per_dev * 2
 
+    # --- optimizer update: the reference's update tasks carry
+    # run_time=0 ("assume update takes no time", simulator.cc:420) —
+    # but the elementwise sweep reads grads+weights+slots and writes
+    # weights+slots, HBM-bound and significant for table-heavy models.
+    # Priced beyond reference parity; sparse-updated embeddings sweep
+    # only their touched rows (grad_bytes above). Serialized onto the
+    # device after backward (folded into bwd so BOTH search engines
+    # price it identically with no task-graph/ABI change).
+    # pipelined ops already folded the sweep into their stage ticks /
+    # closed-form bwd above — a nonzero field would double-count
+    update = (0.0 if pipeline is not None
+              else update_sweep(eff_tp * ep * pp * vocab * table))
+
     return OpCost(fwd=fwd, bwd=bwd, fwd_comm=fwd_comm, bwd_comm=bwd_comm,
-                  sync=sync, mem=mem, pipeline=pipeline)
+                  sync=sync, mem=mem, update=update, pipeline=pipeline)
 
 
 def staged_pipeline_cost(model, mesh, mm: TPUMachineModel,
@@ -355,7 +402,10 @@ def staged_pipeline_cost(model, mesh, mm: TPUMachineModel,
             c = op_cost(op, local, mesh, mm,
                         optimizer_state_mult=optimizer_state_mult)
             f += c.fwd / M
-            b += c.bwd / M
+            # the update sweep runs once per STEP, not per microbatch —
+            # amortize it over the M bwd ticks like the Python executor
+            # applies one optimizer step per dispatch
+            b += (c.bwd + c.update) / M
             w = op.weight_bytes()
             sync_bytes += w
             w_bytes += w

@@ -435,7 +435,8 @@ class Simulator:
                 if c.bwd_comm > 0:
                     comm = g.add(f"{u}:bwd_comm", c.bwd_comm, "comm", deps)
                     deps = deps + [comm]
-                bwd_tasks[u] = g.add(f"{u}:bwd", c.bwd, res_for(u), deps)
+                bwd_tasks[u] = g.add(f"{u}:bwd", c.bwd + c.update,
+                                     res_for(u), deps)
             if c.sync > 0:
                 # grad all-reduce may overlap the rest of backward
                 # (reference overlap flag, simulator.cc:393-497)

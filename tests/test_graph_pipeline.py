@@ -591,3 +591,30 @@ def test_native_engine_compares_staged_candidates():
     best = optimize(ff, budget=40, mesh=mesh, seed=1, use_native=True)
     pins = [best.for_op(f"fc{i}").device_ids for i in range(8)]
     assert any(p is not None for p in pins), pins
+
+
+def test_sibling_pins_do_not_pipeline():
+    """Pins on parallel branches (DLRM-style round-robin embeddings)
+    express CONCURRENCY; lowering them to pipeline stages would
+    serialize independent work. They must fall back (with the
+    replication warning) instead."""
+    import jax.numpy as jnp
+    mesh = make_mesh((4,), ("pipe",))
+    s = Strategy(default=OpStrategy({}))
+    cfg = FFConfig(batch_size=8)
+    ff = FFModel(cfg, mesh=mesh, strategy=s)
+    ins = [ff.create_tensor((8, 2), dtype=jnp.int32, name=f"s{i}")
+           for i in range(4)]
+    embs = [ff.embedding(x, 64, 8, aggr="sum", name=f"e{i}")
+            for i, x in enumerate(ins)]
+    t = ff.concat(embs, axis=1)
+    ff.softmax(ff.dense(t, 4, name="head"))
+    for i in range(4):
+        s.set(f"e{i}", OpStrategy({DEVICE_KEY: (i,)}))
+    with pytest.warns(UserWarning, match="parallel siblings"):
+        ff.compile(loss_type="sparse_categorical_crossentropy",
+                   metrics=[], mesh=mesh, strategy=s)
+    assert not isinstance(ff.executor, StagedExecutor)
+    # and the simulator prices them as concurrent placed ops, not stages
+    from flexflow_tpu.search.simulator import Simulator
+    assert Simulator(ff, mesh)._staged_assignment(s) is None

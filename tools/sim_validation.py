@@ -95,8 +95,14 @@ def main():
            "note": ("CPU: analytic TPU-roofline error is expected; the "
                     "table demonstrates measured grounding collapsing "
                     "it. TPU leg via tools/tpu_session.sh.")}
+    suffix = ""
+    if quick:
+        # a quick run covers four of the five families — it must not
+        # silently shrink the committed five-model table
+        out["note"] += " QUICK RUN: inception skipped."
+        suffix = "_quick"
     path = os.path.join(os.path.dirname(__file__), "..", "evidence",
-                        f"sim_validation_{platform}.json")
+                        f"sim_validation_{platform}{suffix}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(f"wrote {os.path.normpath(path)}")
