@@ -61,7 +61,11 @@ def test_dispatch_ab_on_chip(e, n_tokens, hidden):
     # op's real capacity (these timings exist to recalibrate
     # DENSE_MASK_ELEMENT_LIMIT — don't re-derive it by hand)
     from flexflow_tpu.ops.moe import use_sorted_dispatch
-    auto = use_sorted_dispatch(moe_op.model, n_tokens * moe_op.k, e,
+
+    class _AutoHolder:  # the loop's last model has moe_dispatch FORCED;
+        config = FFConfig()  # the label must reflect the auto policy
+
+    auto = use_sorted_dispatch(_AutoHolder(), n_tokens * moe_op.k, e,
                                moe_op.capacity, expert_sharded=False)
     print(f"\n[moe-dispatch A/B] E={e} tokens={n_tokens} "
           f"cap={moe_op.capacity}: "
