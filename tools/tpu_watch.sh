@@ -1,5 +1,5 @@
 #!/bin/bash
-# Detached TPU-uptime watcher: probe every 10 min; on the first
+# Detached TPU-uptime watcher: probe every ~2.5 min; on the first
 # successful probe, run the full on-chip session (tools/tpu_session.sh)
 # and exit. Transcript: evidence/ (session) + .scratch/tpu_watch.log
 # (probe loop). Start with:
@@ -7,7 +7,7 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p .scratch
-for i in $(seq 1 72); do  # up to 12h
+for i in $(seq 1 288); do  # up to 12h at the fast cadence
   echo "[watch $(date -u +%FT%TZ)] probe $i"
   if timeout 90 env JAX_PLATFORMS=tpu python -c \
       "import jax; d=jax.devices()[0]; assert d.platform=='tpu'; print('TPU', d.device_kind)"; then
@@ -21,7 +21,7 @@ for i in $(seq 1 72); do  # up to 12h
     git diff --cached --quiet || git commit -m "On-chip session: refreshed bench sweep + evidence transcripts"
     exit 0
   fi
-  sleep 600
+  sleep 150
 done
 echo "[watch $(date -u +%FT%TZ)] gave up after 12h"
 exit 1
