@@ -36,7 +36,18 @@ run_all() {
       || echo "tests_tpu FAILED rc=$?"
 
   if [ "${1:-}" != "quick" ]; then
-    echo "--- 3. conv layout A/B (inception + alexnet)"
+    # round-4 evidence first: if the tunnel window is short, the
+    # VERDICT-requested artifacts (five-model sim validation + the
+    # per-shape conv table) must land before the preset sweeps
+    echo "--- 3. sim-vs-real validation, all five models (VERDICT r3 #6)"
+    SIM_VALIDATION_PLATFORM=tpu timeout 1800 \
+      python tools/sim_validation.py \
+      || echo "sim validation FAILED rc=$?"
+    echo "--- 4. per-shape conv table (inception MFU diagnosis)"
+    CONV_TABLE_PLATFORM=tpu timeout 1800 \
+      python tools/conv_shape_table.py \
+      || echo "conv table FAILED rc=$?"
+    echo "--- 5. conv layout A/B (inception + alexnet)"
     for m in inception alexnet; do
       for layout in NCHW NHWC; do
         echo "· $m $layout"
@@ -45,43 +56,35 @@ run_all() {
           || echo "FAILED rc=$? ($m $layout)"
       done
     done
-    echo "--- 4. placement A/B (measured vs simulated, EVIDENCE.md row)"
+    echo "--- 6. placement A/B (measured vs simulated, EVIDENCE.md row)"
     timeout 900 python tools/placement_ab.py \
       | tee evidence/placement_ab_tpu_$(date -u +%Y%m%d).json.txt \
       || echo "placement A/B FAILED rc=$?"
-    echo "--- 5. LSTM Pallas kernel A/B (nmt_lstm; decides use_pallas default)"
+    echo "--- 7. LSTM Pallas kernel A/B (nmt_lstm; decides use_pallas default)"
     for v in 0 1; do
       echo "· FLEXFLOW_TPU_LSTM_PALLAS=$v"
       FLEXFLOW_TPU_LSTM_PALLAS=$v timeout 600 python bench.py --child \
         --model nmt_lstm --preset full --steps 30 | tail -1 \
         || echo "FAILED rc=$? (lstm pallas=$v)"
     done
-    echo "--- 6. inception conv audit (layout A/B + tiling flags)"
+    echo "--- 8. inception conv audit (layout A/B + tiling flags)"
     timeout 1200 python tools/inception_audit.py \
       | tee evidence/inception_audit_$(date -u +%Y%m%d).log \
       || echo "inception audit FAILED rc=$?"
-    echo "--- 7. inception batch sweep (MFU is batch-sensitive on convs)"
+    echo "--- 9. inception batch sweep (MFU is batch-sensitive on convs)"
     for b in 48 64; do
       echo "· inception batch=$b"
       BENCH_BATCH=$b timeout 600 python bench.py --child \
         --model inception --preset full --steps 30 | tail -1 \
         || echo "FAILED rc=$? (inception batch=$b)"
     done
-    echo "--- 8. DLRM stacked-vs-separate tables A/B"
+    echo "--- 10. DLRM stacked-vs-separate tables A/B"
     for v in 0 1; do
       echo "· BENCH_DLRM_STACKED=$v"
       BENCH_DLRM_STACKED=$v timeout 600 python bench.py --child \
         --model dlrm --preset full --steps 30 | tail -1 \
         || echo "FAILED rc=$? (dlrm stacked=$v)"
     done
-    echo "--- 9. sim-vs-real validation, all five models (VERDICT r3 #6)"
-    SIM_VALIDATION_PLATFORM=tpu timeout 1800 \
-      python tools/sim_validation.py \
-      || echo "sim validation FAILED rc=$?"
-    echo "--- 10. per-shape conv table (inception MFU diagnosis)"
-    CONV_TABLE_PLATFORM=tpu timeout 1800 \
-      python tools/conv_shape_table.py \
-      || echo "conv table FAILED rc=$?"
   fi
   echo "=== done $(date -u +%FT%TZ) ==="
 }
