@@ -135,6 +135,11 @@ class FFConfig:
     pipeline_stages: int = 0
     pipeline_microbatches: int = 4
     pipeline_schedule: str = "gpipe"
+    # interleaved (virtual-stage) 1F1B: each pipe device hosts this
+    # many round-robin stage chunks (Megatron interleaving), dividing
+    # the warmup/drain bubble by up to v. Requires
+    # pipeline_schedule="1f1b" with auto-cut stages; 1 = off.
+    pipeline_virtual_stages: int = 1
 
     # fusion (reference: --fusion flag, model.cc:1472)
     perform_fusion: bool = False
@@ -200,6 +205,16 @@ class FFConfig:
             raise ValueError(
                 f"moe_dispatch must be 'auto', 'dense' or 'sorted', "
                 f"got {self.moe_dispatch!r}")
+        if self.pipeline_virtual_stages < 1:
+            raise ValueError(
+                f"pipeline_virtual_stages must be >= 1, got "
+                f"{self.pipeline_virtual_stages}")
+        if self.pipeline_virtual_stages > 1 \
+                and self.pipeline_schedule != "1f1b":
+            raise ValueError(
+                "pipeline_virtual_stages > 1 requires "
+                "pipeline_schedule='1f1b' (interleaving lives in the "
+                "explicit-gradient schedule)")
 
     @classmethod
     def from_args(cls, argv: Optional[Sequence[str]] = None) -> "FFConfig":
@@ -235,6 +250,7 @@ class FFConfig:
         "--pipeline-stages": ("pipeline_stages", int),
         "--pipeline-microbatches": ("pipeline_microbatches", int),
         "--pipeline-schedule": ("pipeline_schedule", str),
+        "--pipeline-virtual-stages": ("pipeline_virtual_stages", int),
     }
     _BOOL_FLAGS = {
         "--profiling": "profiling",

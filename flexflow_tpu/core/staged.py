@@ -51,10 +51,16 @@ class StagedExecutor(Executor):
                 f"staged execution needs a mesh axis to pipeline over; "
                 f"got axis {pipe_axis!r} in {mesh}")
         n_stages = max(stage_of.values()) + 1
-        if mesh.shape[pipe_axis] != n_stages:
+        n_dev = int(mesh.shape[pipe_axis])
+        if n_stages % n_dev != 0:
             raise ValueError(
-                f"stage count {n_stages} != mesh axis "
-                f"{pipe_axis!r} size {mesh.shape[pipe_axis]}")
+                f"stage count {n_stages} does not divide over the "
+                f"{pipe_axis!r} axis size {n_dev}")
+        self.virtual_stages = n_stages // n_dev
+        if self.virtual_stages > 1 and schedule != "1f1b":
+            raise ValueError(
+                f"{n_stages} stages over {n_dev} devices = interleaved "
+                f"execution, which requires the 1f1b schedule")
         self.pipe_axis = pipe_axis
         self.num_microbatches = int(num_microbatches)
         if schedule not in ("gpipe", "1f1b"):
@@ -79,7 +85,8 @@ class StagedExecutor(Executor):
                     f"plainly stacked inside their stage")
                 op.apply_placement(None, None)
         self.plan: StagePlan = build_stage_plan(model, stage_of)
-        self.pack: PackSpec = make_pack_spec(self.plan)
+        self.pack: PackSpec = make_pack_spec(
+            self.plan, n_dev=int(mesh.shape[pipe_axis]))
 
     # The sparse-embedding fast path gathers rows outside the
     # differentiated region — incompatible with packed stage rows.
@@ -154,6 +161,11 @@ class StagedExecutor(Executor):
     # ---------------- forward/loss ----------------
     def _outputs_and_loss(self, params, states, batch, training, rng,
                           seq_length):
+        if self.virtual_stages > 1:
+            raise NotImplementedError(
+                "forward/evaluate under an interleaved (virtual-stage) "
+                "pipeline is not implemented; training works (the 1F1B "
+                "gradient schedule), eval needs virtual_stages=1")
         inputs = {t.name: batch[t.name] for t in self.model.input_tensors}
         logits, aux = pipeline_logits(
             self.plan, self.pack, params[PACKED], inputs, rng,

@@ -409,6 +409,7 @@ class FFModel:
         # a warning.
         stage_of = None
         pipe_axis = None
+        vstages_applied = False
         if self.strategy is not None and self.mesh is not None:
             from .parallel.graph_pipeline import (
                 assignment_from_pins, build_stage_plan, pick_pipe_axis)
@@ -446,16 +447,28 @@ class FFModel:
         if stage_of is None and self.config.pipeline_stages > 1:
             from .parallel.graph_pipeline import (
                 balanced_stages, pick_pipe_axis)
-            stage_of = balanced_stages(self, self.config.pipeline_stages)
+            # interleaving: v round-robin stage chunks per pipe device
+            # (Megatron virtual stages; executes under 1f1b)
+            vstages = max(1, self.config.pipeline_virtual_stages)
+            vstages_applied = True
+            stage_of = balanced_stages(
+                self, self.config.pipeline_stages * vstages)
             n_stages = max(stage_of.values()) + 1  # clamped to op count
-            pipe_axis = (pick_pipe_axis(self.mesh, n_stages)
+            if n_stages % vstages != 0:
+                raise ValueError(
+                    f"pipeline_virtual_stages={vstages} needs "
+                    f"{self.config.pipeline_stages * vstages} stages "
+                    f"but this graph only supports {n_stages} (too few "
+                    f"ops); lower the stage or virtual-stage count")
+            pipe_axis = (pick_pipe_axis(self.mesh, n_stages // vstages)
                          if self.mesh is not None else None)
             if pipe_axis is None:
                 raise ValueError(
                     f"pipeline_stages={self.config.pipeline_stages} "
                     f"(=> {n_stages} stages for this graph) needs a "
-                    f"mesh axis of that size to pipeline over (mesh: "
-                    f"{self.mesh.shape if self.mesh else None})")
+                    f"mesh axis of size "
+                    f"{max(1, n_stages // vstages)} to pipeline over "
+                    f"(mesh: {self.mesh.shape if self.mesh else None})")
         if (stage_of is None and self.strategy is not None
                 and self.mesh is None):
             # meshless compile: pins cannot execute at all — surface it
@@ -469,6 +482,15 @@ class FFModel:
                     f"strategy pins {pinned} to explicit devices but "
                     f"there is no mesh; placement is ignored "
                     f"(replicated single-device execution)")
+
+        if self.config.pipeline_virtual_stages > 1 \
+                and not vstages_applied:
+            import warnings
+            warnings.warn(
+                "pipeline_virtual_stages > 1 only applies to auto-cut "
+                "pipelines (--pipeline-stages); this compile's stages "
+                "come from pins or no pipeline at all — interleaving "
+                "was NOT applied")
 
         # Executor validates comp_mode; assign OURS only after it
         # succeeds so a rejected compile leaves the previous mode live

@@ -618,3 +618,111 @@ def test_sibling_pins_do_not_pipeline():
     # and the simulator prices them as concurrent placed ops, not stages
     from flexflow_tpu.search.simulator import Simulator
     assert Simulator(ff, mesh)._staged_assignment(s) is None
+
+
+# ------------------------------------------- interleaved (virtual) 1F1B
+def build_deep_mlp(mesh=None, cfg=None):
+    cfg = cfg or FFConfig(batch_size=BS)
+    ff = FFModel(cfg, mesh=mesh)
+    x = ff.create_tensor((BS, 32), name="input")
+    t = x
+    for i in range(8):
+        t = ff.dense(t, 32, activation="relu", name=f"fc{i}")
+    t = ff.dense(t, 10, name="head")
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=[], mesh=mesh)
+    return ff
+
+
+def cfg_interleaved(v, m=8, stages=2):
+    cfg = FFConfig(batch_size=BS)
+    cfg.pipeline_stages = stages
+    cfg.pipeline_schedule = "1f1b"
+    cfg.pipeline_microbatches = m
+    cfg.pipeline_virtual_stages = v
+    return cfg
+
+
+DEEP = tuple(f"fc{i}" for i in range(8)) + ("head",)
+
+
+@pytest.mark.parametrize("v", [2, 4])
+def test_interleaved_matches_reference(v):
+    mesh = make_mesh((2,), ("pipe",))
+    ref = build_deep_mlp()
+    ff = build_deep_mlp(mesh=mesh, cfg=cfg_interleaved(v))
+    assert ff.executor.virtual_stages == v
+    assert ff.executor.plan.num_stages == 2 * v
+    copy_weights(ff, ref, DEEP)
+    for b in batches(3):
+        np.testing.assert_allclose(float(ff.train_batch(b)["loss"]),
+                                   float(ref.train_batch(b)["loss"]),
+                                   rtol=1e-5)
+    for n in DEEP:  # device-major packed rows round-trip
+        np.testing.assert_allclose(ff.get_weights(n)["kernel"],
+                                   ref.get_weights(n)["kernel"],
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_interleaved_dp_pp_mesh():
+    mesh = make_mesh((2, 2), ("data", "pipe"))
+    ref = build_deep_mlp()
+    ff = build_deep_mlp(mesh=mesh, cfg=cfg_interleaved(2))
+    copy_weights(ff, ref, DEEP)
+    for b in batches(2):
+        np.testing.assert_allclose(float(ff.train_batch(b)["loss"]),
+                                   float(ref.train_batch(b)["loss"]),
+                                   rtol=1e-5)
+
+
+def test_interleaved_packed_residency():
+    """Device-major rows: device d owns rows [d*v, (d+1)*v) = its
+    round-robin stages {d, d+D, ...}."""
+    mesh = make_mesh((2,), ("pipe",))
+    ff = build_deep_mlp(mesh=mesh, cfg=cfg_interleaved(2))
+    packed = ff.state.params["__stages__"]["float32"]
+    assert packed.shape[0] == 4  # v * n_dev rows
+    for shard in packed.addressable_shards:
+        assert shard.data.shape[0] == 2  # v rows per device
+
+
+def test_interleaved_schedule_reduces_bubble():
+    """The wave-policy interleaved schedule must beat plain 1F1B's
+    bubble at v=4 across representative (devices, microbatches)."""
+    from flexflow_tpu.parallel.graph_pipeline import (
+        interleaved_schedule, schedule_bubble)
+    for D, M in [(2, 8), (4, 8), (4, 16), (8, 32)]:
+        b1 = schedule_bubble(interleaved_schedule(D, 1, M)[0])
+        b4 = schedule_bubble(interleaved_schedule(D, 4, M)[0])
+        assert b4 < b1, (D, M, b1, b4)
+
+
+def test_interleaved_requires_1f1b():
+    cfg = FFConfig(batch_size=BS)
+    cfg.pipeline_virtual_stages = 2
+    with pytest.raises(ValueError, match="1f1b"):
+        cfg.validate()
+
+
+def test_interleaved_eval_unsupported():
+    mesh = make_mesh((2,), ("pipe",))
+    ff = build_deep_mlp(mesh=mesh, cfg=cfg_interleaved(2))
+    b = batches(1)[0]
+    with pytest.raises(NotImplementedError, match="interleaved"):
+        ff.evaluate({"input": b["input"]}, b["label"])
+
+
+def test_virtual_stages_warn_when_unused():
+    """--pipeline-virtual-stages outside the auto-cut path must warn,
+    not silently run non-interleaved."""
+    mesh = make_mesh((2,), ("pipe",))
+    cfg = FFConfig(batch_size=BS)
+    cfg.pipeline_schedule = "1f1b"
+    cfg.pipeline_virtual_stages = 2  # but stages come from PINS
+    with pytest.warns(UserWarning, match="NOT applied"):
+        ff = build_mlp(mesh=mesh, cfg=cfg,
+                       strategy=pin({"fc1": 0, "fc2": 0, "fc3": 1,
+                                     "fc4": 1}))
+    assert ff.executor.virtual_stages == 1
