@@ -108,8 +108,26 @@ def wall_clock(schedule):
     return rows
 
 
+def interleaved_bubbles():
+    """Schedule-level bubble fractions: plain 1F1B (v=1) vs the
+    interleaved wave schedule at v in {2, 4} (round 4's
+    --pipeline-virtual-stages)."""
+    from flexflow_tpu.parallel.graph_pipeline import (
+        interleaved_schedule, schedule_bubble)
+    rows = []
+    for D, M in [(2, 8), (4, 8), (4, 16), (8, 32)]:
+        row = {"devices": D, "microbatches": M}
+        for v in (1, 2, 4):
+            kind, _m, _s, depth = interleaved_schedule(D, v, M)
+            row[f"bubble_v{v}"] = round(schedule_bubble(kind), 4)
+            row[f"depth_v{v}"] = depth
+        rows.append(row)
+    return rows
+
+
 def main():
     out = {"stages": STAGES, "nproc": os.cpu_count(),
+           "interleaved_schedule_bubbles": interleaved_bubbles(),
            "sim_vs_analytic": sim_vs_analytic(),
            "wall_clock_caveat": (
                "1 physical core: devices serialize; wall-clock = total "
