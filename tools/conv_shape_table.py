@@ -16,7 +16,7 @@ far below the calibrated conv efficiency is a specific shape worth a
 layout/padding fix or a Pallas kernel.
 
 Writes evidence/conv_shape_table_<platform>.json. On-chip run = step
-10 of tools/tpu_session.sh (CONV_TABLE_PLATFORM=tpu).
+4 of tools/tpu_session.sh (CONV_TABLE_PLATFORM=tpu).
 """
 
 import json

@@ -14,7 +14,7 @@ does not describe the executing hardware, so ANALYTIC error is
 expected to be large — what this table demonstrates on CPU is that
 per-op MEASURED grounding collapses the error (the mechanism VERDICT
 asks for: grounding beats family factors wherever family factors are
-wrong). The TPU leg (tools/tpu_session.sh) produces the on-chip table
+wrong). The TPU leg (tools/tpu_session.sh step 3) produces the on-chip table
 against BASELINE.md's <30% envelope.
 
 Run: python tools/sim_validation.py [--quick]
