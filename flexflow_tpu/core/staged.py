@@ -34,6 +34,7 @@ from ..parallel.graph_pipeline import (
     pack_params,
     pipeline_1f1b_grads,
     pipeline_logits,
+    pipeline_logits_interleaved,
     read_op_weights,
     write_op_weights,
 )
@@ -161,17 +162,22 @@ class StagedExecutor(Executor):
     # ---------------- forward/loss ----------------
     def _outputs_and_loss(self, params, states, batch, training, rng,
                           seq_length):
-        if self.virtual_stages > 1:
-            raise NotImplementedError(
-                "forward/evaluate under an interleaved (virtual-stage) "
-                "pipeline is not implemented; training works (the 1F1B "
-                "gradient schedule), eval needs virtual_stages=1")
         inputs = {t.name: batch[t.name] for t in self.model.input_tensors}
-        logits, aux = pipeline_logits(
-            self.plan, self.pack, params[PACKED], inputs, rng,
-            self.mesh, self.pipe_axis, self._data_axis(),
-            self.num_microbatches, self.model, training=training,
-            seq_length=seq_length, schedule="gpipe")
+        if self.virtual_stages > 1:
+            # forward-only interleaved schedule: same round-robin
+            # stage->device layout + device-major packed rows the 1F1B
+            # training path uses
+            logits, aux = pipeline_logits_interleaved(
+                self.plan, self.pack, params[PACKED], inputs, rng,
+                self.mesh, self.pipe_axis, self._data_axis(),
+                self.num_microbatches, self.model, training=training,
+                seq_length=seq_length)
+        else:
+            logits, aux = pipeline_logits(
+                self.plan, self.pack, params[PACKED], inputs, rng,
+                self.mesh, self.pipe_axis, self._data_axis(),
+                self.num_microbatches, self.model, training=training,
+                seq_length=seq_length, schedule="gpipe")
         loss = jnp.asarray(0.0, jnp.float32)
         if self.loss_fn is not None and "label" in batch:
             loss = self.loss_fn(logits, batch["label"])

@@ -635,6 +635,85 @@ def pipeline_logits(plan: StagePlan, pack: PackSpec, packed,
 IDLE, FWD, BWD = 0, 1, 2
 
 
+def _ring_depth(fwd_done, consume_done, S: int, M: int, start: int,
+                what: str) -> int:
+    """Smallest safe activation ring-buffer depth for a generated
+    schedule. The hazard is the ARRIVAL tick: act(m2) lands in stage
+    s's buffer one tick after fwd(s-1, m2) runs (not when fwd(s, m2)
+    runs), so slot m2 % depth must not be overwritten before the
+    consumer has used act(m) — consumption is bwd(s, m) for training
+    schedules, fwd(s, m) for forward-only ones."""
+    def conflict_free(dep: int) -> bool:
+        for s in range(1, S):  # stage 0 takes no wire arrivals
+            for m in range(M):
+                for m2 in range(m + 1, M):
+                    if m2 % dep != m % dep:
+                        continue
+                    if fwd_done[s - 1][m2] + 1 <= consume_done[s][m]:
+                        return False
+        return True
+
+    depth = max(1, start)
+    while depth < M and not conflict_free(depth):
+        depth += 1
+    if not conflict_free(depth):
+        raise AssertionError(
+            f"{what} has no conflict-free ring depth <= {M}")
+    return depth
+
+
+def _arrival_tables(kind, mbi, sidx, n_dev: int, S: int):
+    """Per-(tick, device) wire-arrival tables (-1 mb = nothing
+    arrived): stage s running fwd(m) at t-1 puts act(m) on stage s+1's
+    device ((s+1) % n_dev — a +1 ring neighbor by the round-robin
+    layout) at tick t, landing in that stage's chunk ((s+1) // n_dev)
+    buffer; bwd cotangents mirror on the -1 ring. Forward-only
+    schedules simply leave the bwd tables empty."""
+    T = kind.shape[0]
+    arr_f = np.full((T, n_dev), -1, np.int32)
+    arrc_f = np.zeros((T, n_dev), np.int32)
+    arr_b = np.full((T, n_dev), -1, np.int32)
+    arrc_b = np.zeros((T, n_dev), np.int32)
+    for t in range(1, T):
+        for d in range(n_dev):
+            s = int(sidx[t - 1, d])
+            if kind[t - 1, d] == FWD and s < S - 1:
+                rd = (s + 1) % n_dev
+                arr_f[t, rd] = mbi[t - 1, d]
+                arrc_f[t, rd] = (s + 1) // n_dev
+            elif kind[t - 1, d] == BWD and s > 0:
+                rd = (s - 1) % n_dev
+                arr_b[t, rd] = mbi[t - 1, d]
+                arrc_b[t, rd] = (s - 1) // n_dev
+    return arr_f, arrc_f, arr_b, arrc_b
+
+
+def _ring_io(widths, mb_local: int, depth: int, v: int, M: int):
+    """(zero_wire, slot, deposit) helpers shared by the interleaved
+    training and forward-only tick loops: the uniform wire buffer, the
+    flat (chunk, microbatch) ring-buffer slot, and the arrival deposit
+    keyed by the static tables."""
+    def zero_wire():
+        return {dt: jnp.zeros((w * mb_local,), dtype=dt)
+                for dt, w in widths.items()}
+
+    def slot(chunk, m):
+        return chunk * depth + m % depth
+
+    def deposit(buf, wire, m_arrived, chunk_arrived):
+        ok = m_arrived >= 0
+        sl = jnp.clip(chunk_arrived, 0, v - 1) * depth \
+            + jnp.clip(m_arrived, 0, M - 1) % depth
+        out = {}
+        for dt, a in buf.items():
+            cur = lax.dynamic_index_in_dim(a, sl, keepdims=False)
+            upd = jnp.where(ok, wire[dt], cur)
+            out[dt] = lax.dynamic_update_index_in_dim(a, upd, sl, 0)
+        return out
+
+    return zero_wire, slot, deposit
+
+
 def one_f_one_b_schedule(S: int, M: int):
     """Plain (non-interleaved) 1F1B: the v=1 case of
     `interleaved_schedule`, kept as the historical entry point —
@@ -717,11 +796,8 @@ def interleaved_schedule(n_dev: int, v: int, M: int):
         if t > 4 * v * (M + S) + 8:
             raise AssertionError("interleaved schedule did not converge")
     # ring-buffer depth: start at the max in-flight forwards any stage
-    # holds, then grow until slot-reuse is provably safe. The hazard is
-    # the ARRIVAL tick: act(m2) lands in stage s's buffer one tick
-    # after fwd(s-1, m2) runs (not when fwd(s, m2) runs), so slot
-    # m2 % depth must not be overwritten before bwd(s, m) has consumed
-    # act(m) — check arrival <= bwd_done, not execution <= bwd_done.
+    # holds, then grow until slot-reuse is provably safe (_ring_depth;
+    # consumption = the bwd tick)
     inflight = [0] * S
     peak = [0] * S
     for krow, srow in zip(kind_rows, sidx_rows):
@@ -731,29 +807,12 @@ def interleaved_schedule(n_dev: int, v: int, M: int):
                 peak[s] = max(peak[s], inflight[s])
             elif k == BWD:
                 inflight[s] -= 1
-    depth = max(1, max(peak))
-
-    def conflict_free(dep: int) -> bool:
-        for s in range(1, S):  # stage 0 takes no wire arrivals
-            for m in range(M):
-                for m2 in range(m + 1, M):
-                    if m2 % dep != m % dep:
-                        continue
-                    arrival2 = fwd_done[s - 1][m2] + 1
-                    if arrival2 <= bwd_done[s][m]:
-                        return False
-        return True
-
-    while depth < M and not conflict_free(depth):
-        depth += 1
-    if not conflict_free(depth):
-        raise AssertionError(
-            f"interleaved schedule has no conflict-free ring depth "
-            f"<= {M} (D={n_dev}, v={v}, M={M})")
-    import numpy as _np
-    return (_np.asarray(kind_rows, _np.int32),
-            _np.asarray(mbi_rows, _np.int32),
-            _np.asarray(sidx_rows, _np.int32), depth)
+    depth = _ring_depth(
+        fwd_done, bwd_done, S, M, start=max(peak),
+        what=f"interleaved schedule (D={n_dev}, v={v}, M={M})")
+    return (np.asarray(kind_rows, np.int32),
+            np.asarray(mbi_rows, np.int32),
+            np.asarray(sidx_rows, np.int32), depth)
 
 
 def schedule_bubble(kind) -> float:
@@ -816,26 +875,8 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
             f"{pipe_axis!r} axis")
     kind, mbi, sidx, depth = interleaved_schedule(n_dev, v, M)
     T = kind.shape[0]
-    # arrival tables keyed by DEVICE (-1 mb = nothing arrived): stage s
-    # running fwd(m) at t-1 puts act(m) on stage s+1's device
-    # ((s+1) % n_dev — a +1 ring neighbor by the round-robin layout) at
-    # tick t, landing in that stage's chunk ((s+1) // n_dev) buffer;
-    # bwd cotangents mirror on the -1 ring.
-    arr_f = np.full((T, n_dev), -1, np.int32)
-    arrc_f = np.zeros((T, n_dev), np.int32)
-    arr_b = np.full((T, n_dev), -1, np.int32)
-    arrc_b = np.zeros((T, n_dev), np.int32)
-    for t in range(1, T):
-        for d in range(n_dev):
-            s = int(sidx[t - 1, d])
-            if kind[t - 1, d] == FWD and s < S - 1:
-                rd = (s + 1) % n_dev
-                arr_f[t, rd] = mbi[t - 1, d]
-                arrc_f[t, rd] = (s + 1) // n_dev
-            elif kind[t - 1, d] == BWD and s > 0:
-                rd = (s - 1) % n_dev
-                arr_b[t, rd] = mbi[t - 1, d]
-                arrc_b[t, rd] = (s - 1) // n_dev
+    arr_f, arrc_f, arr_b, arrc_b = _arrival_tables(
+        kind, mbi, sidx, n_dev, S)
     # branch index per (tick, device): 0 idle, 1+s fwd(s), 1+S+s bwd(s)
     bidx = np.where(kind == IDLE, 0,
                     np.where(kind == FWD, 1 + sidx, 1 + S + sidx))
@@ -858,6 +899,8 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
     # (pipe, data) and divides by M*ndata — grads must match)
     aux_scale = 1.0 / (M * ndata)
 
+    _zero_wire, slot, _deposit = _ring_io(widths, mb_local, depth, v, M)
+
     def local_fn(packed_local, inputs_local, label_local, rng_op):
         idx = lax.axis_index(pipe_axis)
         # packed_local: {dt: (v, L)} — this device's chunk rows in
@@ -868,9 +911,6 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
         def mb_inputs_at(m):
             return {k: lax.dynamic_index_in_dim(v_, m, keepdims=False)
                     for k, v_ in inputs_local.items()}
-
-        def slot(chunk, m):  # flat ring-buffer slot for (chunk, mb)
-            return chunk * depth + m % depth
 
         def fwd_branch(s, rows, act_buf, ct_buf, wire_f, wire_b, m,
                        mb_rng, gacc):
@@ -928,10 +968,6 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
             return (_zero_wire(), _zero_wire(), final0, gacc,
                     jnp.float32(0.0))
 
-        def _zero_wire():
-            return {dt: jnp.zeros((w * mb_local,), dtype=dt)
-                    for dt, w in widths.items()}
-
         branches = ([idle_branch]
                     + [functools.partial(fwd_branch, s)
                        for s in range(S)]
@@ -973,24 +1009,12 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
             return (act_buf, ct_buf, wire_f, wire_b, gacc, outputs,
                     aux_acc), None
 
-        def _deposit(buf, wire, m_arrived, chunk_arrived):
-            ok = m_arrived >= 0
-            sl = jnp.clip(chunk_arrived, 0, v - 1) * depth \
-                + jnp.clip(m_arrived, 0, M - 1) % depth
-            out = {}
-            for dt, a in buf.items():
-                cur = lax.dynamic_index_in_dim(a, sl, keepdims=False)
-                upd = jnp.where(ok, wire[dt], cur)
-                out[dt] = lax.dynamic_update_index_in_dim(a, upd, sl, 0)
-            return out
-
         def _write_mb(outputs, final, m, flag):
             cur = lax.dynamic_index_in_dim(outputs, m, keepdims=False)
             upd = jnp.where(flag, final, cur)
             return lax.dynamic_update_index_in_dim(outputs, upd, m, 0)
 
-        zw = {dt: jnp.zeros((w * mb_local,), dtype=dt)
-              for dt, w in widths.items()}
+        zw = _zero_wire()
         act_buf0 = {dt: jnp.zeros((v * depth,) + a.shape, a.dtype)
                     for dt, a in zw.items()}
         ct_buf0 = {dt: jnp.zeros_like(a) for dt, a in act_buf0.items()}
@@ -1032,6 +1056,180 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
         check_vma=False)(packed, inputs_mb, label_mb, rng)
     logits = outputs.reshape((B,) + tuple(final_t.shape[1:]))
     return logits, aux, grads
+
+
+def interleaved_forward_schedule(n_dev: int, v: int, M: int):
+    """Forward-only interleaved schedule (eval/predict under virtual
+    stages): same wave policy as `interleaved_schedule` minus the
+    backward units and the in-flight memory cap — eval stores no
+    activations for a backward, so microbatches stream as fast as the
+    ring delivers them. Returns (kind (T, D), mbi, sidx, depth) with
+    the same conventions (kind is FWD or IDLE only).
+    """
+    D, S = n_dev, v * n_dev
+    fwd_done = [[-1] * M for _ in range(S)]
+    next_f = [0] * S
+    kind_rows, mbi_rows, sidx_rows = [], [], []
+    t = 0
+    while any(nf < M for nf in next_f):
+        krow = [IDLE] * D
+        mrow = [-1] * D
+        srow = [-1] * D
+        for d in range(D):
+            stages = [d + c * D for c in range(v)]
+            cand = []
+            for s in stages:
+                m = next_f[s]
+                if m >= M:
+                    continue
+                if s == 0 or 0 <= fwd_done[s - 1][m] < t:
+                    cand.append((m // D, s // D, m, s))
+            if cand:
+                _, _, m, s = min(cand)
+                krow[d], mrow[d], srow[d] = FWD, m, s
+                fwd_done[s][m] = t
+                next_f[s] += 1
+        kind_rows.append(krow)
+        mbi_rows.append(mrow)
+        sidx_rows.append(srow)
+        t += 1
+        if t > 4 * v * (M + S) + 8:
+            raise AssertionError(
+                "interleaved forward schedule did not converge")
+    # forward-only consumption is the fwd tick itself
+    depth = _ring_depth(
+        fwd_done, fwd_done, S, M, start=1,
+        what=f"forward schedule (D={n_dev}, v={v}, M={M})")
+    return (np.asarray(kind_rows, np.int32),
+            np.asarray(mbi_rows, np.int32),
+            np.asarray(sidx_rows, np.int32), depth)
+
+
+def pipeline_logits_interleaved(plan: StagePlan, pack: PackSpec, packed,
+                                inputs: Dict[str, jax.Array], rng,
+                                mesh: Mesh, pipe_axis: str,
+                                data_axis: Optional[str],
+                                num_microbatches: int, model, *,
+                                training: bool, seq_length: int = -1):
+    """Forward-only pipelined run under an interleaved (virtual-stage)
+    layout: S = v * n_dev stages, stage s on device s % n_dev, packed
+    rows in device-major order (PackSpec.row_of). The eval/predict
+    counterpart of `pipeline_1f1b_grads` — same tick machinery (static
+    schedule tables, lax.switch branch per stage, activation ring
+    buffers, +1-ring ppermute) without the backward wire. Returns
+    (logits (B, ...), aux scalar)."""
+    S = plan.num_stages
+    M = int(num_microbatches)
+    final_t = model.final_tensor
+    B = next(iter(inputs.values())).shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    layouts, widths = _wire_layouts(plan)
+
+    inputs_mb = {k: v_.reshape((M, mb) + v_.shape[1:])
+                 for k, v_ in inputs.items()}
+    data_ax, ndata, mb_local = _data_split(mesh, data_axis, mb)
+    run_stage = _make_stage_runner(
+        plan, pack, model, layouts, widths, mb_local,
+        training=training, seq_length=seq_length)
+
+    n_dev = int(mesh.shape[pipe_axis])
+    v = S // n_dev
+    if S != v * n_dev:
+        raise ValueError(
+            f"{S} stages do not divide over the {n_dev}-device "
+            f"{pipe_axis!r} axis")
+    kind, mbi, sidx, depth = interleaved_forward_schedule(n_dev, v, M)
+    T = kind.shape[0]
+    arr_f, arrc_f, _arr_b, _arrc_b = _arrival_tables(
+        kind, mbi, sidx, n_dev, S)
+    bidx = np.where(kind == IDLE, 0, 1 + sidx)
+
+    kind_a = jnp.asarray(kind)
+    mbi_a = jnp.asarray(mbi)
+    sidx_a = jnp.asarray(sidx)
+    arr_f_a = jnp.asarray(arr_f)
+    arrc_f_a = jnp.asarray(arrc_f)
+    bidx_a = jnp.asarray(bidx.astype(np.int32))
+
+    _zero_wire, slot, _deposit = _ring_io(widths, mb_local, depth, v, M)
+
+    def local_fn(packed_local, inputs_local, rng_op):
+        idx = lax.axis_index(pipe_axis)
+        rows = packed_local  # {dt: (v, L)} device-major chunk rows
+
+        def fwd_branch(s, rows, act_buf, m, mb_rng):
+            c = s // n_dev
+            row = {dt: a[c] for dt, a in rows.items()}
+            mb_in = {k: lax.dynamic_index_in_dim(v_, m, keepdims=False)
+                     for k, v_ in inputs_local.items()}
+            wire_in = {dt: lax.dynamic_index_in_dim(
+                act_buf[dt], slot(c, m), keepdims=False)
+                for dt in act_buf}
+            wire_out, final, aux = run_stage(s, row, wire_in, mb_in,
+                                             mb_rng)
+            return wire_out, final, aux
+
+        def idle_branch(rows, act_buf, m, mb_rng):
+            final0 = jnp.zeros((mb_local,) + tuple(final_t.shape[1:]),
+                               dtype=final_t.dtype)
+            return _zero_wire(), final0, jnp.float32(0.0)
+
+        branches = [idle_branch] + [functools.partial(fwd_branch, s)
+                                    for s in range(S)]
+
+        def tick(carry, t):
+            act_buf, wire_f, outputs, aux_acc = carry
+            act_buf = _deposit(act_buf, wire_f, arr_f_a[t, idx],
+                               arrc_f_a[t, idx])
+            m = mbi_a[t, idx]
+            safe_m = jnp.clip(m, 0, M - 1)
+            mb_rng = (jax.random.fold_in(rng_op, safe_m)
+                      if rng_op is not None else None)
+            wire_out, final, aux = lax.switch(
+                bidx_a[t, idx], branches, rows, act_buf, safe_m, mb_rng)
+            aux_acc = aux_acc + aux  # every fwd tick is real work
+            is_last = jnp.logical_and(kind_a[t, idx] == FWD,
+                                      sidx_a[t, idx] == S - 1)
+            cur = lax.dynamic_index_in_dim(outputs, safe_m,
+                                           keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(is_last, final, cur), safe_m, 0)
+            fperm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+            wire_f = {dt: lax.ppermute(a, pipe_axis, fperm)
+                      for dt, a in wire_out.items()}
+            return (act_buf, wire_f, outputs, aux_acc), None
+
+        zw = _zero_wire()
+        act_buf0 = {dt: jnp.zeros((v * depth,) + a.shape, a.dtype)
+                    for dt, a in zw.items()}
+        outputs0 = jnp.zeros((M, mb_local) + tuple(final_t.shape[1:]),
+                             dtype=final_t.dtype)
+        (_, _, outputs, aux_acc), _ = lax.scan(
+            tick, (act_buf0, zw, outputs0, jnp.float32(0.0)),
+            jnp.arange(T))
+        # stage S-1 = v*n_dev - 1 lives on device n_dev - 1
+        outputs = lax.psum(
+            jnp.where(idx == n_dev - 1, outputs,
+                      jnp.zeros_like(outputs)),
+            pipe_axis)
+        aux_total = lax.psum(
+            aux_acc, (pipe_axis,) if data_ax is None
+            else (pipe_axis, data_ax)) / (M * ndata)
+        return outputs, aux_total
+
+    packed_spec = {dt: P(pipe_axis, None) for dt in packed}
+    in_spec = {k: P(None, data_ax, *([None] * (v_.ndim - 2)))
+               for k, v_ in inputs_mb.items()}
+    out_spec = P(None, data_ax, *([None] * (len(final_t.shape) - 1)))
+
+    out, aux = shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(packed_spec, in_spec, P()),
+        out_specs=(out_spec, P()),
+        check_vma=False)(packed, inputs_mb, rng)
+    return out.reshape((B,) + tuple(final_t.shape[1:])), aux
 
 
 # --------------------------------------------------------------------------

@@ -379,7 +379,8 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
 def staged_pipeline_cost(model, mesh, mm: TPUMachineModel,
                          stage_of: Dict[str, int], microbatches: int,
                          schedule: str = "gpipe",
-                         optimizer_state_mult: float = 3.0):
+                         optimizer_state_mult: float = 3.0,
+                         n_dev: Optional[int] = None):
     """Price a graph-level staged strategy (core/staged.py): the whole
     model runs as one pipeline whose per-stage tick costs are the sum of
     that stage's ops at microbatch granularity; hops carry the cut
@@ -426,6 +427,14 @@ def staged_pipeline_cost(model, mesh, mm: TPUMachineModel,
         fwd_stage=sum(fwd_stages) / S, bwd_stage=sum(bwd_stages) / S,
         hop=(sum(hops) / len(hops)) if hops else 0.0,
         fwd_stages=fwd_stages, bwd_stages=bwd_stages, hops=hops)
-    # stage rows ride separate devices: per-device memory is the worst
-    # stage (the packed rows pad to the largest stage)
-    return pc, syncs, max(mems) if mems else 0.0
+    # per-device memory: one stage per device normally; under an
+    # interleaved layout (n_dev < S, passed by the caller who knows the
+    # compile lowering) device d owns the round-robin stage set
+    # {d, d+n_dev, ...} and holds ALL their rows
+    if n_dev is None:
+        n_dev = S
+    if mems and S > n_dev > 0 and S % n_dev == 0:
+        mem_total = max(sum(mems[d::n_dev]) for d in range(n_dev))
+    else:
+        mem_total = max(mems) if mems else 0.0
+    return pc, syncs, mem_total

@@ -16,12 +16,21 @@ Memory over HBM capacity adds the reference's 1ms/MB penalty
 from __future__ import annotations
 
 import dataclasses
+import functools
 import heapq
 from typing import Dict, List, Optional
 
 from ..parallel.pconfig import Strategy
 from .cost_model import OpCost, op_cost
 from .machine_model import TPUMachineModel, default_machine_model
+
+
+@functools.lru_cache(maxsize=256)
+def _schedule_tables(n_dev: int, v: int, M: int):
+    """Memoized 1F1B/interleaved schedule tables (pure function of the
+    triple; the annealing loop reprices thousands of candidates)."""
+    from ..parallel.graph_pipeline import interleaved_schedule
+    return interleaved_schedule(n_dev, v, M)
 
 
 @dataclasses.dataclass
@@ -291,11 +300,17 @@ class Simulator:
             assignment_from_pins, balanced_stages, build_stage_plan,
             pick_pipe_axis)
 
-        def viable(stage_of):
+        def viable(stage_of, vstages=1):
             if stage_of is None or max(stage_of.values()) < 1:
                 return None
+            n_stages = max(stage_of.values()) + 1
+            # interleaved auto-cut: the pipe axis carries
+            # n_stages / vstages devices (compile's lowering,
+            # model.py pipeline_virtual_stages)
+            if vstages > 1 and n_stages % vstages != 0:
+                return None
             if pick_pipe_axis(self.mesh,
-                              max(stage_of.values()) + 1) is None:
+                              n_stages // max(1, vstages)) is None:
                 return None  # compile would warn + replicate
             try:
                 build_stage_plan(self.model, stage_of)
@@ -304,6 +319,11 @@ class Simulator:
             return stage_of
 
         stage_of = None
+        # provenance for pricing: pins execute one stage per device
+        # (v=1); the auto-cut path interleaves v stages per device.
+        # _price_1f1b_ticks and staged_pipeline_cost must see the SAME
+        # layout compile runs, not re-guess it from axis sizes.
+        self._staged_vstages = 1
         try:
             stage_of = viable(assignment_from_pins(self.model, strategy))
         except (ValueError, NotImplementedError):
@@ -311,14 +331,21 @@ class Simulator:
         if stage_of is None \
                 and getattr(self.model.config, "pipeline_stages", 0) > 1:
             # strategy-independent: the O(S*n^2) partition DP and plan
-            # viability check run once, not per annealing candidate
-            S_req = self.model.config.pipeline_stages
+            # viability check run once, not per annealing candidate.
+            # Mirror compile: auto-cut produces pipeline_stages * v
+            # stages laid round-robin over pipeline_stages devices
+            v = max(1, getattr(self.model.config,
+                               "pipeline_virtual_stages", 1))
+            S_req = self.model.config.pipeline_stages * v
             cache = getattr(self, "_balanced_cache", None)
             if cache is None:
                 cache = self._balanced_cache = {}
             if S_req not in cache:
-                cache[S_req] = viable(balanced_stages(self.model, S_req))
+                cache[S_req] = viable(
+                    balanced_stages(self.model, S_req), vstages=v)
             stage_of = cache[S_req]
+            if stage_of is not None:
+                self._staged_vstages = v
         return stage_of
 
     def _simulate_staged(self, strategy: Strategy, stage_of,
@@ -329,9 +356,12 @@ class Simulator:
         from the schedule's activation peak."""
         from .cost_model import staged_pipeline_cost
         cfg = self.model.config
+        vstages = max(1, getattr(self, "_staged_vstages", 1))
+        n_stages = max(stage_of.values()) + 1
         key = (tuple(sorted(stage_of.items())),
                getattr(cfg, "pipeline_microbatches", 4),
-               getattr(cfg, "pipeline_schedule", "gpipe"))
+               getattr(cfg, "pipeline_schedule", "gpipe"),
+               vstages)
         cache = getattr(self, "_staged_cost_cache", None)
         if cache is None:
             cache = self._staged_cost_cache = {}
@@ -340,7 +370,13 @@ class Simulator:
         else:
             pc, syncs, mem = cache[key] = staged_pipeline_cost(
                 self.model, self.mesh, self.mm, stage_of, key[1],
-                schedule=key[2])
+                schedule=key[2],
+                n_dev=(n_stages // vstages
+                       if n_stages % vstages == 0 else None))
+        tick_step = (self._price_1f1b_ticks(pc, syncs)
+                     if key[2] == "1f1b" else None)
+        if tick_step is not None and not dot_path:
+            return tick_step, self.mm.memory_penalty(mem)
         g = TaskGraph()
         exits: Dict[str, List] = {}
         fwd_join = self._expand_pipeline_fwd(g, "net", pc, [], exits)
@@ -352,7 +388,42 @@ class Simulator:
         step_time = g.simulate()
         if dot_path:
             g.export_dot(dot_path)
+        if tick_step is not None:  # DOT exported; price stays tick-based
+            step_time = tick_step
         return step_time, self.mm.memory_penalty(mem)
+
+    def _price_1f1b_ticks(self, pc, syncs):
+        """Price a 1F1B (incl. interleaved v > 1) staged strategy from
+        the ACTUAL schedule tables the executor runs
+        (parallel/graph_pipeline.interleaved_schedule). The executed
+        program is a tick-lockstep lax.scan — every device runs one
+        switch branch per tick, then both wire ppermutes — so tick t
+        costs max over devices of the unit worked that tick, plus the
+        two uniform-width wire hops; the bubble falls out of the IDLE
+        entries. Returns None when the stage count does not divide the
+        pipe axis (the executor would have rejected it too)."""
+        import numpy as np
+        S, M = pc.stages, pc.microbatches
+        # _staged_assignment recorded which lowering produced this
+        # stage_of (pins: one stage per device; auto-cut: v stages per
+        # device) — price exactly that layout, never re-guess from axis
+        # sizes (a same-size unrelated axis must not flip the schedule)
+        v = max(1, getattr(self, "_staged_vstages", 1))
+        if S % v != 0:
+            return None
+        n_dev = S // v
+        kind, _mbi, sidx, _depth = _schedule_tables(n_dev, v, M)
+        fwd = np.asarray([pc.fwd_at(k) for k in range(S)])
+        bwd = np.asarray([pc.bwd_at(k) for k in range(S)])
+        from ..parallel.graph_pipeline import BWD, FWD
+        sidx_c = np.clip(sidx, 0, S - 1)
+        cost = np.where(kind == FWD, fwd[sidx_c],
+                        np.where(kind == BWD, bwd[sidx_c], 0.0))
+        # two wires (activations +1 ring, cotangents -1 ring) ppermute
+        # every tick at the max cut width (the wire pads to it)
+        hop = 2.0 * (max(pc.hops) if pc.hops else pc.hop)
+        ticks = float(cost.max(axis=1).sum()) + kind.shape[0] * hop
+        return ticks + sum(syncs)
 
     def _simulate_raw(self, strategy: Strategy,
                       dot_path: Optional[str] = None):

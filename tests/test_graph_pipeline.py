@@ -706,12 +706,88 @@ def test_interleaved_requires_1f1b():
         cfg.validate()
 
 
-def test_interleaved_eval_unsupported():
+@pytest.mark.parametrize("v", [2, 4])
+def test_interleaved_eval_matches_reference(v):
+    """Forward/evaluate under virtual stages: the forward-only
+    interleaved schedule must reproduce unpipelined numerics."""
     mesh = make_mesh((2,), ("pipe",))
-    ff = build_deep_mlp(mesh=mesh, cfg=cfg_interleaved(2))
+    ref = build_deep_mlp()
+    ff = build_deep_mlp(mesh=mesh, cfg=cfg_interleaved(v))
+    copy_weights(ff, ref, DEEP)
     b = batches(1)[0]
-    with pytest.raises(NotImplementedError, match="interleaved"):
-        ff.evaluate({"input": b["input"]}, b["label"])
+    np.testing.assert_allclose(
+        np.asarray(ref.forward(b)), np.asarray(ff.forward(b)),
+        rtol=1e-5, atol=1e-6)
+    ev_p = ff.evaluate({"input": b["input"]}, b["label"])
+    ev_r = ref.evaluate({"input": b["input"]}, b["label"])
+    np.testing.assert_allclose(ev_p["loss"], ev_r["loss"], rtol=1e-5)
+
+
+def test_interleaved_eval_dp_pp_mesh():
+    mesh = make_mesh((2, 2), ("data", "pipe"))
+    ref = build_deep_mlp()
+    ff = build_deep_mlp(mesh=mesh, cfg=cfg_interleaved(2))
+    copy_weights(ff, ref, DEEP)
+    b = batches(1)[0]
+    ev_p = ff.evaluate({"input": b["input"]}, b["label"])
+    ev_r = ref.evaluate({"input": b["input"]}, b["label"])
+    np.testing.assert_allclose(ev_p["loss"], ev_r["loss"], rtol=1e-5)
+
+
+def test_forward_schedule_properties():
+    from flexflow_tpu.parallel.graph_pipeline import (
+        FWD, IDLE, interleaved_forward_schedule)
+    for D, v, M in [(2, 1, 4), (2, 2, 8), (4, 4, 8), (2, 4, 16)]:
+        kind, mbi, sidx, depth = interleaved_forward_schedule(D, v, M)
+        S = D * v
+        # every (stage, microbatch) forward runs exactly once
+        runs = {}
+        for t in range(kind.shape[0]):
+            for d in range(D):
+                if kind[t, d] == FWD:
+                    s, m = int(sidx[t, d]), int(mbi[t, d])
+                    assert s % D == d  # round-robin residency
+                    assert (s, m) not in runs
+                    runs[(s, m)] = t
+        assert len(runs) == S * M
+        for (s, m), t in runs.items():  # dataflow order
+            if s > 0:
+                assert runs[(s - 1, m)] < t
+        assert 1 <= depth <= M
+
+
+def _price_staged(hidden, v):
+    from flexflow_tpu.search.simulator import Simulator
+    from flexflow_tpu.parallel.pconfig import Strategy as Strat, \
+        OpStrategy as OS
+    mesh = make_mesh((2,), ("pipe",))
+    cfg = FFConfig(batch_size=256)
+    cfg.pipeline_stages = 2
+    cfg.pipeline_schedule = "1f1b"
+    cfg.pipeline_microbatches = 8
+    cfg.pipeline_virtual_stages = v
+    ff = FFModel(cfg)
+    x = ff.create_tensor((256, hidden), name="input")
+    t = x
+    for i in range(8):
+        t = ff.dense(t, hidden, activation="relu", name=f"fc{i}")
+    ff.softmax(ff.dense(t, 10, name="head"))
+    sim = Simulator(ff, mesh)
+    stage_of = sim._staged_assignment(Strat(default=OS({})))
+    assert stage_of is not None
+    assert max(stage_of.values()) + 1 == 2 * v  # compile's actual cut
+    return sim._simulate_staged(Strat(default=OS({})), stage_of)[0]
+
+
+def test_simulator_prices_virtual_stages():
+    """1F1B strategies price from the executor's ACTUAL schedule tables
+    (tick-lockstep: per-tick max unit cost + both wire ppermutes), so
+    the simulator sees BOTH sides of interleaving: v=4 cuts the bubble
+    (wins when per-tick compute dominates, hidden=4096) but pays ~v x
+    more wire hops (loses on the hop-heavy hidden=2048 model). A
+    bubble-only model would always prefer v>1."""
+    assert _price_staged(4096, 4) < _price_staged(4096, 1)
+    assert _price_staged(2048, 4) > _price_staged(2048, 1)
 
 
 def test_virtual_stages_warn_when_unused():
