@@ -128,6 +128,13 @@ def measure_op(op: Op, sample_shard: int = 1, repeats: int = 10,
         for wname, spec in op.weight_specs().items():
             params[wname] = jnp.ones(spec.shape,
                                      np.dtype(spec.dtype)) * 0.01
+        # stateful ops (BatchNorm running stats) read ctx.state_in —
+        # feed init-valued state or every BN in a conv net silently
+        # falls back to the analytic price (exactly the memory-bound
+        # ops grounding exists to capture)
+        state_in = {name: jnp.full(spec.shape, spec.init_value,
+                                   np.dtype(spec.dtype))
+                    for name, spec in op.state_specs().items()}
         rng = jax.random.PRNGKey(0)
 
         # differentiate w.r.t. params and FLOAT inputs only — integer
@@ -139,8 +146,8 @@ def measure_op(op: Op, sample_shard: int = 1, repeats: int = 10,
             for i, v in zip(float_idx, floats):
                 full[i] = v
             ctx = OpContext(training=True, rng=rng,
-                            seq_length=seq_length, mesh=None,
-                            op_strategy=None)
+                            seq_length=seq_length, state_in=state_in,
+                            mesh=None, op_strategy=None)
             ys = op.forward(p, full, ctx)
             return sum(jnp.sum(y.astype(jnp.float32)) for y in ys)
 

@@ -157,3 +157,20 @@ def test_failed_measurement_not_persisted():
     op_measure._DISK_LOADED.clear()
     assert op_measure.measure_op(bad_op, sample_shard=2,
                                  repeats=2) is not None
+
+
+def test_stateful_op_is_measurable():
+    """BatchNorm reads ctx.state_in (running stats); measure_op must
+    feed init-valued state rather than cache the op as unmeasurable —
+    conv nets put a BN after every conv, so an unmeasurable BN leaves
+    a third of the graph's memory-bound ops at the analytic price."""
+    cfg = FFConfig(batch_size=8)
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 16, 8, 8), name="input")
+    t = ff.conv2d(x, 16, 3, 3, 1, 1, 1, 1, name="c0")
+    t = ff.batch_norm(t, name="bn0")
+    ff.softmax(ff.dense(ff.flat(t), 10, name="head"))
+    bn = next(o for o in ff.ops if o.name == "bn0")
+    assert bn.state_specs()  # the premise: BN is stateful
+    m = op_measure.measure_op(bn, sample_shard=1, repeats=3)
+    assert m is not None and m["fwd"] > 0 and m["bwd"] > 0

@@ -111,9 +111,11 @@ def wall_clock(schedule):
 def interleaved_bubbles():
     """Schedule-level bubble fractions: plain 1F1B (v=1) vs the
     interleaved wave schedule at v in {2, 4} (round 4's
-    --pipeline-virtual-stages)."""
+    --pipeline-virtual-stages), and the forward-only schedule that
+    eval/predict runs (`pipeline_logits_interleaved`)."""
     from flexflow_tpu.parallel.graph_pipeline import (
-        interleaved_schedule, schedule_bubble)
+        interleaved_forward_schedule, interleaved_schedule,
+        schedule_bubble)
     rows = []
     for D, M in [(2, 8), (4, 8), (4, 16), (8, 32)]:
         row = {"devices": D, "microbatches": M}
@@ -121,6 +123,10 @@ def interleaved_bubbles():
             kind, _m, _s, depth = interleaved_schedule(D, v, M)
             row[f"bubble_v{v}"] = round(schedule_bubble(kind), 4)
             row[f"depth_v{v}"] = depth
+            fkind, _fm, _fs, fdepth = interleaved_forward_schedule(
+                D, v, M)
+            row[f"fwd_bubble_v{v}"] = round(schedule_bubble(fkind), 4)
+            row[f"fwd_depth_v{v}"] = fdepth
         rows.append(row)
     return rows
 

@@ -62,6 +62,11 @@ def one(name, builder, kw, batch, measure_ops):
     ff.compile(optimizer=SGDOptimizer(lr=0.01),
                loss_type="sparse_categorical_crossentropy", metrics=[])
     measured, predicted = ff.calibrate_simulator(steps=5)
+    if measured < 0.02:
+        # sub-20ms steps: 5 steps is inside dispatch-jitter noise (the
+        # dlrm row swung -7% -> -41% between otherwise-identical runs);
+        # re-measure over enough steps to amortize it
+        measured, predicted = ff.calibrate_simulator(steps=200)
     return {"measured_ms": measured * 1e3,
             "predicted_ms": predicted * 1e3,
             "error_pct": 100.0 * (predicted - measured) / measured}
@@ -75,9 +80,11 @@ def main():
             continue  # ~5 min XLA CPU compile
         entry = {}
         # N caps measurement signatures (shape classes). Inception has
-        # ~90 DISTINCT conv shapes — it needs a deeper sweep where the
-        # other models saturate at a handful
-        deep = 48 if name == "inception" else 8
+        # ~90 DISTINCT conv shapes plus a BatchNorm after every one of
+        # them — the budget must reach past the convs into the
+        # memory-bound BN/pool/concat signatures or they stay at the
+        # (platform-mismatched) analytic price
+        deep = 192 if name == "inception" else 8
         for mode, n in (("analytic", 0), ("measured", deep)):
             try:
                 entry[mode] = one(name, builder, kw, batch, n)
