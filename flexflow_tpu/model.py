@@ -398,6 +398,30 @@ class FFModel:
             if self.config.export_strategy_file:
                 self.strategy.save(self.config.export_strategy_file)
 
+        # a search-discovered interleaved pipeline rides the strategy's
+        # `pipeline` block (pins cannot express v stages per device) —
+        # apply it to the config knobs the auto-cut lowering below
+        # reads, so --import replays the whole exported plan
+        pl = (getattr(self.strategy, "pipeline", None)
+              if self.strategy is not None else None)
+        if pl:
+            if not isinstance(pl, dict) \
+                    or not isinstance(pl.get("stages"), int) \
+                    or pl["stages"] < 1:
+                # Strategy.load validates files; this guards strategies
+                # constructed in code with a malformed block
+                raise ValueError(
+                    f"strategy.pipeline must be a dict with an int "
+                    f"\"stages\" >= 1 (got {pl!r})")
+            self.config.pipeline_stages = pl["stages"]
+            self.config.pipeline_virtual_stages = int(
+                pl.get("virtual_stages", 1))
+            self.config.pipeline_schedule = pl.get(
+                "schedule", self.config.pipeline_schedule)
+            self.config.pipeline_microbatches = int(pl.get(
+                "microbatches", self.config.pipeline_microbatches))
+            self.config.validate()
+
         # device-explicit placement lowering. Per-table ids on
         # distributed_embedding execute via the slot layout
         # (ops/embedding.py apply_placement). Whole-op pins on other ops

@@ -88,6 +88,13 @@ class Strategy:
                  default: Optional[OpStrategy] = None):
         self.op_strategies: Dict[str, OpStrategy] = op_strategies or {}
         self.default = default or OpStrategy({"sample": "data"})
+        # search-discovered pipeline lowering that cannot ride per-op
+        # pins (interleaved auto-cut: v stages per device) — carried so
+        # --export/--import round-trips the whole winning plan:
+        # {"stages": D, "virtual_stages": v, "schedule": "1f1b",
+        #  "microbatches": M}. compile() applies it to the config knobs
+        # its auto-cut lowering reads.
+        self.pipeline: Optional[Dict] = None
 
     def for_op(self, op_name: str) -> OpStrategy:
         return self.op_strategies.get(op_name, self.default)
@@ -96,10 +103,12 @@ class Strategy:
         self.op_strategies[op_name] = strategy
 
     def copy(self) -> "Strategy":
-        return Strategy(
+        out = Strategy(
             {k: v.copy() for k, v in self.op_strategies.items()},
             self.default.copy(),
         )
+        out.pipeline = dict(self.pipeline) if self.pipeline else None
+        return out
 
     # ---- file I/O ----
     # Native format is JSON ({"default": {...}, "ops": {name: axis_map}}).
@@ -112,6 +121,8 @@ class Strategy:
             "default": self.default.axis_map,
             "ops": {k: v.axis_map for k, v in self.op_strategies.items()},
         }
+        if self.pipeline:
+            data["pipeline"] = self.pipeline
         with open(path, "w") as f:
             json.dump(data, f, indent=2)
 
@@ -119,10 +130,21 @@ class Strategy:
     def load(path: str) -> "Strategy":
         with open(path) as f:
             data = json.load(f)
-        return Strategy(
+        out = Strategy(
             {k: OpStrategy(v) for k, v in data.get("ops", {}).items()},
             OpStrategy(data.get("default", {"sample": "data"})),
         )
+        pl = data.get("pipeline")
+        if pl is not None:
+            # fail at load with the file in hand, not deep in compile
+            if not isinstance(pl, dict) \
+                    or not isinstance(pl.get("stages"), int) \
+                    or pl["stages"] < 1:
+                raise ValueError(
+                    f"{path}: \"pipeline\" must be an object with an "
+                    f"int \"stages\" >= 1 (got {pl!r})")
+            out.pipeline = pl
+        return out
 
     def __repr__(self):
         return (f"Strategy(default={self.default.axis_map}, "

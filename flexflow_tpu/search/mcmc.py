@@ -109,6 +109,19 @@ def candidate_maps(op, mesh, cfg, op_index: int = 0) -> List[Dict[str, str]]:
     return out
 
 
+def _pipe_candidate_sizes(mesh) -> List[int]:
+    """Non-data mesh-axis sizes a pipeline could ride — the shared
+    enumeration for v=1 staged candidates and the v>1 sweep."""
+    return sorted({size for name, size in mesh.shape.items()
+                   if name != "data" and size > 1})
+
+
+def _pin_free_strategy(mesh) -> Strategy:
+    """The data-default strategy staged candidates build on."""
+    return Strategy(default=OpStrategy({"sample": "data"}
+                                       if "data" in mesh.shape else {}))
+
+
 def staged_strategies(model, mesh, cfg) -> List[Strategy]:
     """Whole-graph pipeline candidates: flops-balanced stage cuts
     expressed as per-op whole-device pins (the executable graph-PP form,
@@ -125,9 +138,7 @@ def staged_strategies(model, mesh, cfg) -> List[Strategy]:
     from ..parallel.graph_pipeline import (
         balanced_stages, build_stage_plan, pick_pipe_axis)
     out: List[Strategy] = []
-    sizes = sorted({size for name, size in mesh.shape.items()
-                    if name != "data" and size > 1})
-    for S in sizes:
+    for S in _pipe_candidate_sizes(mesh):
         if pick_pipe_axis(mesh, S) is None or len(model.ops) < 2:
             continue
         stage_of = balanced_stages(model, S)
@@ -137,8 +148,7 @@ def staged_strategies(model, mesh, cfg) -> List[Strategy]:
             build_stage_plan(model, stage_of)  # stateful ops etc.
         except (ValueError, NotImplementedError):
             continue
-        s = Strategy(default=OpStrategy({"sample": "data"}
-                                        if "data" in mesh.shape else {}))
+        s = _pin_free_strategy(mesh)
         for op in model.ops:
             if op.op_type == "distributed_embedding":
                 continue  # table placement has its own executable form
@@ -221,7 +231,12 @@ def optimize_with_mesh(model, budget: int = 1000, alpha: float = 0.05,
     # (reference --budget semantics): a per-shape floor would silently
     # multiply a deliberately small budget several-fold
     per_budget = max(1, budget // max(1, len(shapes)))
-    best = None  # (cost, strategy, mesh)
+    best = None  # (cost, strategy, mesh, sim, pipeline_knobs)
+    # optimize() records an interleaved-pipeline win on the config
+    # knobs (_interleaved_upgrade) — snapshot/restore them per shape so
+    # one shape's win cannot distort another shape's annealing, then
+    # re-apply only the WINNING shape's knobs at the end
+    base_knobs = (cfg.pipeline_stages, cfg.pipeline_virtual_stages)
     for shape in shapes:
         mesh = make_mesh(tuple(shape.values()), tuple(shape.keys()),
                          devices)
@@ -233,10 +248,13 @@ def optimize_with_mesh(model, budget: int = 1000, alpha: float = 0.05,
         strat = optimize(model, budget=per_budget, alpha=alpha, mesh=mesh,
                          seed=seed, verbose=False, simulator=sim)
         cost = sim.simulate(strat)
+        knobs = (cfg.pipeline_stages, cfg.pipeline_virtual_stages)
+        cfg.pipeline_stages, cfg.pipeline_virtual_stages = base_knobs
         if verbose:
             print(f"[search/mesh] {shape}: {cost*1e3:.3f} ms/step")
         if best is None or cost < best[0]:
-            best = (cost, strat, mesh, sim)
+            best = (cost, strat, mesh, sim, knobs)
+    cfg.pipeline_stages, cfg.pipeline_virtual_stages = best[4]
     if verbose:
         print(f"[search/mesh] best: {dict(best[2].shape)} "
               f"at {best[0]*1e3:.3f} ms/step")
@@ -244,6 +262,64 @@ def optimize_with_mesh(model, budget: int = 1000, alpha: float = 0.05,
         # each wrote their own shape's graph; last is not best)
         best[3].simulate(best[1], dot_path=cfg.taskgraph_file)
     return best[1], best[2]
+
+
+def _interleaved_upgrade(model, cfg, mesh, sim, best, best_cost=None,
+                         verbose=False):
+    """Search the virtual-stage dimension: price auto-cut interleaved
+    pipelines (D devices x v chunks, v in {2, 4}) against the per-op
+    search winner through the same tick-table pricing the executor's
+    schedule defines (simulator._price_1f1b_ticks). The v dimension
+    cannot ride a Strategy — pins express at most one stage per device
+    — so, like optimize_with_mesh returning a mesh, a win is recorded
+    on the CONFIG knobs compile's auto-cut lowering reads
+    (pipeline_stages, pipeline_virtual_stages) and the returned
+    strategy carries no pins. Gated exactly like the executor:
+    interleaving requires the 1f1b schedule."""
+    if mesh is None or not getattr(cfg, "enable_pipeline_parallel",
+                                   False):
+        return best
+    if getattr(cfg, "pipeline_schedule", "gpipe") != "1f1b":
+        return best
+    if any(op.op_type == "pipeline_blocks" for op in model.ops):
+        return best
+    from ..parallel.graph_pipeline import pick_pipe_axis
+    base_knobs = (cfg.pipeline_stages, cfg.pipeline_virtual_stages)
+    pin_free = _pin_free_strategy(mesh)
+    if best_cost is None:
+        best_cost = sim.simulate(best)
+    win = None
+    try:
+        for D in _pipe_candidate_sizes(mesh):
+            if pick_pipe_axis(mesh, D) is None:
+                continue
+            for v in (2, 4):
+                cfg.pipeline_stages = D
+                cfg.pipeline_virtual_stages = v
+                stage_of = sim._staged_assignment(pin_free)
+                if stage_of is None or \
+                        max(stage_of.values()) + 1 != D * v:
+                    continue  # graph too small for D*v real stages
+                c = sim.simulate(pin_free)
+                if c < best_cost:
+                    best_cost, win = c, (D, v)
+                    if verbose:
+                        print(f"[search] interleaved pipeline wins: "
+                              f"{D} devices x v={v} "
+                              f"{c*1e3:.3f} ms/step")
+    finally:
+        cfg.pipeline_stages, cfg.pipeline_virtual_stages = base_knobs
+    if win is None:
+        return best
+    cfg.pipeline_stages, cfg.pipeline_virtual_stages = win
+    # carried on the strategy too, so --export round-trips the whole
+    # plan (pins cannot express v stages per device)
+    pin_free.pipeline = {
+        "stages": win[0], "virtual_stages": win[1],
+        "schedule": "1f1b",
+        "microbatches": int(getattr(cfg, "pipeline_microbatches", 4)),
+    }
+    return pin_free
 
 
 def optimize(model, budget: int = 1000, alpha: float = 0.05,
@@ -276,8 +352,13 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
     cands = {op.name: candidate_maps(op, mesh, cfg, op_index=i)
              for i, op in enumerate(model.ops)}
 
-    def finish(strategy):
-        """Every return path funnels here so --taskgraph always exports."""
+    def finish(strategy, cost=None):
+        """Every return path funnels here so the interleaved-variant
+        comparison and --taskgraph export always run. `cost` is the
+        caller's already-computed sim.simulate(strategy), when it has
+        one, to spare a re-simulation."""
+        strategy = _interleaved_upgrade(model, cfg, mesh, sim, strategy,
+                                        best_cost=cost, verbose=verbose)
         if cfg.taskgraph_file:
             sim.simulate(strategy, dot_path=cfg.taskgraph_file)
         return strategy
@@ -306,6 +387,7 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
                                 verbose=verbose)
         if found is not None:
             best = found
+            best_cost = None
             if staged:  # compare only when candidates exist: the
                 best_cost = sim.simulate(found)  # extra sim is theirs
                 for st in staged:
@@ -315,7 +397,7 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
                         if verbose:
                             print(f"[search] staged pipeline wins: "
                                   f"{best_cost*1e3:.3f} ms/step")
-            return finish(best)
+            return finish(best, best_cost)
         assert use_native is not True, "native search requested but " \
             "the native library is unavailable"
     _, edges = op_edges(model)
@@ -335,7 +417,7 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
 
     searchable = [op for op in model.ops if len(cands[op.name]) > 1]
     if not searchable:
-        return finish(best)
+        return finish(best, best_cost)
 
     reset_every = max(1, budget // 100)
     for it in range(budget):
@@ -387,4 +469,4 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
 
     if verbose:
         print(f"[search] best estimated step time: {best_cost*1e3:.3f} ms")
-    return finish(best)
+    return finish(best, best_cost)

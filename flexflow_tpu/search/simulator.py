@@ -340,10 +340,13 @@ class Simulator:
             cache = getattr(self, "_balanced_cache", None)
             if cache is None:
                 cache = self._balanced_cache = {}
-            if S_req not in cache:
-                cache[S_req] = viable(
+            # keyed by (S, v): the same stage count can be viable under
+            # one interleaving factor and not another (the pipe axis
+            # carries S/v devices), and the search sweeps v
+            if (S_req, v) not in cache:
+                cache[(S_req, v)] = viable(
                     balanced_stages(self.model, S_req), vstages=v)
-            stage_of = cache[S_req]
+            stage_of = cache[(S_req, v)]
             if stage_of is not None:
                 self._staged_vstages = v
         return stage_of

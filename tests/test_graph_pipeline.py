@@ -790,6 +790,108 @@ def test_simulator_prices_virtual_stages():
     assert _price_staged(2048, 4) > _price_staged(2048, 1)
 
 
+def _search_model(hidden):
+    from flexflow_tpu.search.mcmc import optimize
+    cfg = FFConfig(batch_size=256)
+    cfg.enable_pipeline_parallel = True
+    cfg.pipeline_schedule = "1f1b"
+    cfg.pipeline_microbatches = 8
+    ff = FFModel(cfg)
+    x = ff.create_tensor((256, hidden), name="input")
+    t = x
+    for i in range(8):
+        t = ff.dense(t, hidden, activation="relu", name=f"fc{i}")
+    ff.softmax(ff.dense(t, 10, name="head"))
+    mesh = make_mesh((2,), ("pipe",))
+    strat = optimize(ff, budget=30, mesh=mesh, seed=0)
+    return cfg, strat
+
+
+def test_search_discovers_virtual_stages():
+    """The search explores the v dimension (auto-cut interleaved
+    candidates priced through the tick tables) and records a win on
+    the config knobs compile's auto-cut lowering reads — the v
+    analog of optimize_with_mesh returning a mesh."""
+    cfg, strat = _search_model(4096)  # compute-dominated: v>1 wins
+    assert cfg.pipeline_virtual_stages in (2, 4)
+    assert cfg.pipeline_stages == 2
+    assert not any(strat.for_op(f"fc{i}").device_ids for i in range(8))
+
+
+def test_search_keeps_v1_when_hops_dominate():
+    cfg, _ = _search_model(512)  # hop-heavy: interleaving must lose
+    assert cfg.pipeline_virtual_stages == 1
+
+
+def test_interleaved_win_roundtrips_strategy_file(tmp_path):
+    """--export after a v>1 search win must carry the pipeline block;
+    --import replays it: a fresh model + config compiles into the same
+    interleaved executor without re-searching."""
+    from flexflow_tpu.search.mcmc import optimize
+    path = str(tmp_path / "strat.json")
+    cfg = FFConfig(batch_size=64)
+    cfg.enable_pipeline_parallel = True
+    cfg.pipeline_schedule = "1f1b"
+    cfg.pipeline_microbatches = 8
+
+    def build(c, mesh=None):
+        ff = FFModel(c, mesh=mesh)
+        x = ff.create_tensor((64, 4096), name="input")
+        t = x
+        for i in range(8):
+            t = ff.dense(t, 4096, activation="relu", name=f"fc{i}")
+        ff.softmax(ff.dense(t, 10, name="head"))
+        return ff
+
+    mesh = make_mesh((2,), ("pipe",))
+    ff = build(cfg)
+    strat = optimize(ff, budget=20, mesh=mesh, seed=0)
+    assert strat.pipeline and strat.pipeline["virtual_stages"] > 1
+    strat.save(path)
+
+    cfg2 = FFConfig(batch_size=64)  # fresh config: no pipeline knobs
+    cfg2.import_strategy_file = path
+    ff2 = build(cfg2, mesh=mesh)
+    ff2.compile(optimizer=SGDOptimizer(lr=0.01),
+                loss_type="sparse_categorical_crossentropy",
+                metrics=[], mesh=mesh)
+    assert isinstance(ff2.executor, StagedExecutor)
+    assert ff2.executor.virtual_stages == strat.pipeline["virtual_stages"]
+    b = batches(1, feat=4096)[0]
+    assert np.isfinite(float(ff2.train_batch(b)["loss"]))
+
+
+def test_interleaved_not_blocked_by_stale_viability_cache():
+    """viable() verdicts depend on v (the pipe axis carries S/v
+    devices), so the simulator's balanced cache must key on (S, v): a
+    None cached for (S=4, v=1) on a pipe=2 mesh must not block the
+    genuinely viable (D=2, v=2) candidate that also cuts 4 stages."""
+    from flexflow_tpu.search.mcmc import _interleaved_upgrade
+    from flexflow_tpu.search.simulator import Simulator
+    from flexflow_tpu.parallel.pconfig import Strategy as Strat, \
+        OpStrategy as OS
+    cfg = FFConfig(batch_size=256)
+    cfg.enable_pipeline_parallel = True
+    cfg.pipeline_schedule = "1f1b"
+    cfg.pipeline_microbatches = 8
+    cfg.pipeline_stages = 4  # no size-4 axis on this mesh
+    ff = FFModel(cfg)
+    x = ff.create_tensor((256, 4096), name="input")
+    t = x
+    for i in range(8):
+        t = ff.dense(t, 4096, activation="relu", name=f"fc{i}")
+    ff.softmax(ff.dense(t, 10, name="head"))
+    mesh = make_mesh((2,), ("pipe",))
+    sim = Simulator(ff, mesh)
+    pin_free = Strat(default=OS({}))
+    # primes the (S=4, v=1) cache entry with None
+    assert sim._staged_assignment(pin_free) is None
+    best = _interleaved_upgrade(ff, cfg, mesh, sim, pin_free)
+    assert cfg.pipeline_virtual_stages in (2, 4)
+    assert cfg.pipeline_stages == 2
+    assert not any(best.for_op(f"fc{i}").device_ids for i in range(8))
+
+
 def test_virtual_stages_warn_when_unused():
     """--pipeline-virtual-stages outside the auto-cut path must warn,
     not silently run non-interleaved."""
