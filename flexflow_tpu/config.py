@@ -94,6 +94,12 @@ class FFConfig:
     # TPU-first additions: new parallel axes (SURVEY.md section 2.4 calls
     # these out as absent from the reference and required here).
     enable_sequence_parallel: bool = False
+    # SP attention lowering: "ring" (K/V rotate over ICI, no score
+    # materialization — arbitrary lengths), "alltoall" (heads scatter /
+    # seq gathers, full-MXU blocks — needs heads % axis == 0), or
+    # "auto" (alltoall when heads divide and the per-device score
+    # matrix fits; parallel/ulysses.sp_mode_for)
+    sp_attention: str = "auto"
     enable_expert_parallel: bool = False
     enable_pipeline_parallel: bool = False
     enable_propagation: bool = False
@@ -205,6 +211,10 @@ class FFConfig:
             raise ValueError(
                 f"moe_dispatch must be 'auto', 'dense' or 'sorted', "
                 f"got {self.moe_dispatch!r}")
+        if self.sp_attention not in ("auto", "ring", "alltoall"):
+            raise ValueError(
+                f"sp_attention must be 'auto', 'ring' or 'alltoall', "
+                f"got {self.sp_attention!r}")
         if self.pipeline_virtual_stages < 1:
             raise ValueError(
                 f"pipeline_virtual_stages must be >= 1, got "
@@ -247,6 +257,7 @@ class FFConfig:
         "--conv-layout": ("conv_layout", str),
         "--measure-ops": ("measure_top_ops", int),
         "--moe-dispatch": ("moe_dispatch", str),
+        "--sp-attention": ("sp_attention", str),
         "--pipeline-stages": ("pipeline_stages", int),
         "--pipeline-microbatches": ("pipeline_microbatches", int),
         "--pipeline-schedule": ("pipeline_schedule", str),

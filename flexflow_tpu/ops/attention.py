@@ -171,11 +171,23 @@ class MultiHeadAttention(Op):
                 and q.shape[1] % seq_size == 0
                 and k.shape[1] % seq_size == 0):
             from ..parallel.ring_attention import ring_attention
+            from ..parallel.ulysses import alltoall_attention, sp_mode_for
             data_ax = ctx.mesh_axis_name("sample") or "data"
             data_size = (ctx.mesh.shape.get(data_ax, 1)
                          if ctx.mesh is not None else 1)
             if q.shape[0] % max(1, data_size) == 0:
-                return ring_attention(
+                # two SP lowerings: ring (K/V rotate, never materializes
+                # scores) vs all-to-all (heads scatter, full-seq blocks
+                # on the MXU); sp_mode_for is the single policy both
+                # execution and the cost model consult
+                mode = sp_mode_for(
+                    getattr(self.model.config, "sp_attention", "auto"),
+                    num_heads=self.num_heads, seq_size=seq_size,
+                    batch_local=q.shape[0] // max(1, data_size),
+                    seq_q=q.shape[1], seq_kv=k.shape[1])
+                attend = (alltoall_attention if mode == "alltoall"
+                          else ring_attention)
+                return attend(
                     q, k, v, ctx.mesh, seq_axis=ctx.mesh_axis_name("seq"),
                     batch_axis=data_ax, causal=self.causal,
                     scale=1.0 / math.sqrt(self.head_dim))

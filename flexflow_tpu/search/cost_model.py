@@ -291,11 +291,29 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
         bwd_comm += mm.all_gather(act_bytes / dp, table,
                                   _axis_name(strategy, "table"))
 
-    # --- SP ring attention: (S-1) kv-shard hops each way
+    # --- SP attention: priced per the lowering that actually executes
+    # (parallel/ulysses.sp_mode_for — the op consults the same policy)
     if sp > 1 and op.op_type == "multihead_attention":
-        kv_bytes = 2 * in_bytes / 3 / max(1, dp)  # k+v of the three inputs
-        fwd_comm += (sp - 1) * mm.ppermute(kv_bytes / sp, seq_ax)
-        bwd_comm += 2 * (sp - 1) * mm.ppermute(kv_bytes / sp, seq_ax)
+        from ..parallel.ulysses import sp_mode_for
+        b, s_q = op.inputs[0].shape[0], op.inputs[0].shape[1]
+        # key input carries the kv length in cross-attention
+        s_kv = (op.inputs[1].shape[1] if len(op.inputs) > 1
+                else s_q)
+        mode = sp_mode_for(
+            getattr(op.model.config, "sp_attention", "auto"),
+            num_heads=getattr(op, "num_heads", 1), seq_size=sp,
+            batch_local=max(1, b // max(1, dp)), seq_q=s_q, seq_kv=s_kv)
+        if mode == "alltoall":
+            # fwd: q,k,v head-scatter + out seq-scatter = 4 all-to-alls
+            # of one activation shard; bwd mirrors them
+            act = in_bytes / 3 / max(1, dp)
+            fwd_comm += 4 * mm.all_to_all(act / sp, sp, seq_ax)
+            bwd_comm += 4 * mm.all_to_all(act / sp, sp, seq_ax)
+        else:
+            # ring: (S-1) kv-shard hops each way
+            kv_bytes = 2 * in_bytes / 3 / max(1, dp)  # k+v of the three
+            fwd_comm += (sp - 1) * mm.ppermute(kv_bytes / sp, seq_ax)
+            bwd_comm += 2 * (sp - 1) * mm.ppermute(kv_bytes / sp, seq_ax)
 
     # --- EP: dispatch + combine all-to-alls of the capacity buffers
     if ep > 1 and op.op_type == "moe_ffn":

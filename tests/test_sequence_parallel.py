@@ -104,3 +104,115 @@ def test_sp_non_divisible_seq_falls_back():
     m = ff.train_batch({"input": rng.randn(4, 12, 16).astype(np.float32),
                         "label": np.zeros(4, np.int32)})
     assert np.isfinite(float(m["loss"]))
+
+
+# ----------------------------------------- all-to-all (Ulysses) SP mode
+def test_alltoall_attention_matches_reference():
+    from flexflow_tpu.parallel.ulysses import alltoall_attention
+    mesh = make_mesh((2, 4), ("data", "seq"))
+    rng = np.random.RandomState(2)
+    b, s, h, d = 4, 16, 4, 8  # h % seq_size == 0
+    q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    for causal in (False, True):
+        ref = reference_attention(q, k, v, causal=causal)
+        out = jax.jit(lambda q, k, v: alltoall_attention(
+            q, k, v, mesh, causal=causal))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_alltoall_rejects_indivisible_heads():
+    from flexflow_tpu.parallel.ulysses import alltoall_attention
+    mesh = make_mesh((1, 8), ("data", "seq"))
+    x = jnp.zeros((2, 32, 4, 8))  # 4 heads over 8-way seq axis
+    with pytest.raises(ValueError, match="divisible"):
+        alltoall_attention(x, x, x, mesh, causal=True)
+
+
+def test_sp_mode_policy():
+    """auto: alltoall when heads divide AND scores fit, else ring;
+    explicit modes pass through (alltoall still needs divisibility)."""
+    from flexflow_tpu.parallel.ulysses import sp_mode_for
+
+    def mode(m, heads, s, skv=None):
+        return sp_mode_for(m, num_heads=heads, seq_size=4,
+                           batch_local=8, seq_q=s,
+                           seq_kv=s if skv is None else skv)
+
+    assert mode("auto", 8, 1024) == "alltoall"
+    assert mode("auto", 6, 1024) == "ring"  # 6 % 4 != 0
+    assert mode("auto", 8, 512 * 1024) == "ring"  # scores blow the limit
+    # cross-attention: the (sq x skv) product decides, not sq^2
+    assert mode("auto", 8, 128, 512 * 1024) == "ring"
+    assert mode("auto", 8, 512 * 1024, 128) == "ring"
+    assert mode("ring", 8, 64) == "ring"
+    assert mode("alltoall", 8, 512 * 1024) == "alltoall"
+    assert mode("alltoall", 6, 64) == "ring"  # forced but indivisible
+
+
+def test_alltoall_causal_cross_attention():
+    """Review regression: causal cross-attention (sq != sk) must mask
+    over the global (sq x sk) block, matching the ring path."""
+    from flexflow_tpu.parallel.ulysses import alltoall_attention
+    mesh = make_mesh((1, 4), ("data", "seq"))
+    rng = np.random.RandomState(4)
+    b, h, d = 2, 4, 8
+    q = jnp.asarray(rng.randn(b, 8, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, 16, h, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, 16, h, d).astype(np.float32))
+    ref = reference_attention(q, k, v, causal=True)
+    out = jax.jit(lambda q, k, v: alltoall_attention(
+        q, k, v, mesh, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_sp_transformer_alltoall_matches_unsharded():
+    """Same end-to-end parity as the ring test, forced through the
+    all-to-all lowering."""
+    def build(mesh=None, strategy=None):
+        cfg = FFConfig()
+        cfg.batch_size = 8
+        cfg.sp_attention = "alltoall"
+        ff = build_transformer(cfg, batch_size=8, seq_len=16, hidden=32,
+                               num_heads=4, num_layers=2, ff_dim=64,
+                               num_classes=4, mesh=mesh, strategy=strategy)
+        ff.compile(optimizer=SGDOptimizer(lr=0.05),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=["accuracy"], mesh=mesh, strategy=strategy)
+        return ff
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(32, 16, 32).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.int32)
+    ff1 = build()
+    h1 = ff1.fit({"input": x}, y, epochs=2, shuffle=False, verbose=False)
+    mesh = make_mesh((2, 4), ("data", "seq"))
+    ff2 = build(mesh=mesh, strategy=sequence_parallel_strategy())
+    h2 = ff2.fit({"input": x}, y, epochs=2, shuffle=False, verbose=False)
+    assert abs(h1[-1]["loss"] - h2[-1]["loss"]) < 1e-3, (h1[-1], h2[-1])
+
+
+def test_sp_cost_model_prices_both_modes():
+    """The cost model consults the same policy the op executes: forced
+    modes produce different comm costs (a2a vs ring hops)."""
+    from flexflow_tpu import FFModel
+    from flexflow_tpu.search.cost_model import op_cost
+    from flexflow_tpu.search.machine_model import default_machine_model
+    from flexflow_tpu.parallel.pconfig import OpStrategy
+    mesh = make_mesh((2, 4), ("data", "seq"))
+    costs = {}
+    for mode in ("ring", "alltoall"):
+        cfg = FFConfig(batch_size=8)
+        cfg.sp_attention = mode
+        ff = FFModel(cfg, mesh=mesh)
+        x = ff.create_tensor((8, 64, 32), name="input")
+        ff.multihead_attention(x, x, x, 32, 8, name="attn")
+        op = next(o for o in ff.ops if o.name == "attn")
+        c = op_cost(op, OpStrategy({"sample": "data", "seq": "seq"}),
+                    mesh, default_machine_model(mesh))
+        costs[mode] = c.fwd_comm
+    assert costs["ring"] > 0 and costs["alltoall"] > 0
+    assert costs["ring"] != costs["alltoall"]
