@@ -1,0 +1,63 @@
+"""Long-context training: sequence parallelism over a `seq` mesh axis.
+
+The reference has no sequence-parallel axis at all (SURVEY.md 2.4);
+this framework ships two TPU-native lowerings and picks per shape:
+
+  * ring attention  — K/V shards rotate over ICI (`lax.ppermute`),
+    scores never materialize: arbitrary sequence lengths.
+  * all-to-all      — heads scatter while the sequence gathers
+    (DeepSpeed-Ulysses pattern): full-sequence MXU blocks + the flash
+    kernel, when heads divide the axis and scores fit.
+
+Run (8 virtual CPU devices stand in for a TPU slice):
+
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m flexflow_tpu examples/python/native/long_context_attention.py \
+      -b 8 -e 2 --sp-attention auto
+"""
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer, make_mesh
+from flexflow_tpu.parallel.pconfig import sequence_parallel_strategy
+
+SEQ = 512
+HIDDEN = 64
+CLASSES = 4
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    import jax
+    n = len(jax.devices())
+    if n < 2:
+        print("needs >= 2 devices (set "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+        return
+    # batch over `data`, sequence over `seq`: tokens of one example
+    # live across devices, attention runs sequence-parallel
+    mesh = make_mesh((max(1, n // 4), min(4, n)), ("data", "seq"))
+    cfg.enable_sequence_parallel = True
+
+    ff = FFModel(cfg, mesh=mesh, strategy=sequence_parallel_strategy())
+    x = ff.create_tensor((cfg.batch_size, SEQ, HIDDEN), name="input")
+    t = ff.multihead_attention(x, x, x, HIDDEN, 8, causal=True,
+                               name="attn0")
+    t = ff.dense(t, HIDDEN, activation="relu", name="ffn0")
+    t = ff.multihead_attention(t, t, t, HIDDEN, 8, causal=True,
+                               name="attn1")
+    # mean-pool the sequence, classify
+    t = ff.reduce_mean(t, axis=1, name="pool")
+    ff.softmax(ff.dense(t, CLASSES, name="head"))
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"], mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(cfg.batch_size * 4, SEQ, HIDDEN).astype(np.float32)
+    y_np = rng.randint(0, CLASSES, cfg.batch_size * 4).astype(np.int32)
+    ff.fit({"input": x_np}, y_np, epochs=cfg.epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -277,6 +277,18 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def flash_profitable(b: int, h: int, sq: int, sk: int, d: int) -> bool:
+    """The measured auto-dispatch gate, shared by every flash call site
+    (unsharded ops/attention.py and the all-to-all SP lowering,
+    parallel/ulysses.py) so a re-tune propagates everywhere. Constants
+    from the v5e b8/h8 2026-07 sweep (tests_tpu/test_flash_tpu.py): at
+    d=64 the 128-lane padding doubles the kernel's dot FLOPs and XLA
+    ties or wins; at d=128 flash wins from s>=1024; at any d flash wins
+    once the materialized (b,h,sq,sk) score tensor stresses HBM."""
+    score_bytes = b * h * sq * sk * 6  # f32 logits + bf16 probs
+    return (d % 128 == 0 and sk >= 1024) or score_bytes > 2**31
+
+
 def flash_attention_bshd(q, k, v, *, causal=False,
                          block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                          interpret=False, pad_lanes=True):

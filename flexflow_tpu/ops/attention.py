@@ -185,9 +185,14 @@ class MultiHeadAttention(Op):
                     num_heads=self.num_heads, seq_size=seq_size,
                     batch_local=q.shape[0] // max(1, data_size),
                     seq_q=q.shape[1], seq_kv=k.shape[1])
-                attend = (alltoall_attention if mode == "alltoall"
-                          else ring_attention)
-                return attend(
+                if mode == "alltoall":
+                    return alltoall_attention(
+                        q, k, v, ctx.mesh,
+                        seq_axis=ctx.mesh_axis_name("seq"),
+                        batch_axis=data_ax, causal=self.causal,
+                        scale=1.0 / math.sqrt(self.head_dim),
+                        use_flash=self.use_flash)
+                return ring_attention(
                     q, k, v, ctx.mesh, seq_axis=ctx.mesh_axis_name("seq"),
                     batch_axis=data_ax, causal=self.causal,
                     scale=1.0 / math.sqrt(self.head_dim))
@@ -198,24 +203,18 @@ class MultiHeadAttention(Op):
         # flash path handles neither seq_length truncation nor the
         # (now off-block-size) zero-attn row; use XLA for those.
         #
-        # use_flash is tri-state: None = auto (measured heuristic below),
-        # True = force the Pallas kernel whenever shapes allow (caller
-        # override), False = never.
-        #
-        # Auto heuristic, measured on v5e (b8/h8, 2026-07 sweep; see
-        # tests_tpu/test_flash_tpu.py): at d=64 the 128-lane padding
-        # doubles the kernel's dot FLOPs and XLA ties or wins (s1024: 4.1
-        # vs 4.8ms fwd); at d=128 flash wins from s>=1024 (causal s1024:
-        # 4.3 vs 5.2ms; s2048: 5.0 vs 7.3ms fwd, 9.7 vs 12.1ms bwd), and
-        # at any d once the materialized (b,h,sq,sk) score tensor would
-        # stress HBM. pad_lanes=False for d=64 showed no consistent win
-        # in the same sweep, so it stays opt-in via flash_attention_bshd.
+        # use_flash is tri-state: None = auto (the measured
+        # flash_profitable gate, kernels/flash_attention.py — shared
+        # with the all-to-all SP lowering), True = force the Pallas
+        # kernel whenever shapes allow, False = never. pad_lanes=False
+        # for d=64 showed no consistent win in the same sweep, so it
+        # stays opt-in via flash_attention_bshd.
         b, sq, h, d = q.shape
         sk = k.shape[1]
-        score_bytes = b * h * sq * sk * 6  # f32 logits + bf16 probs
-        flash_profitable = (d % 128 == 0 and sk >= 1024) or score_bytes > 2**31
+        from ..kernels.flash_attention import flash_profitable
         if ((self.use_flash is True
-             or (self.use_flash is None and flash_profitable))
+             or (self.use_flash is None
+                 and flash_profitable(b, h, sq, sk, d)))
                 and not has_seq_trunc and not self.add_zero_attn):
             from ..kernels.flash_attention import flash_attention_bshd
             try:

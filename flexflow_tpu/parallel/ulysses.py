@@ -61,7 +61,8 @@ def _a2a(x, axis_name, *, split_axis, concat_axis):
                           concat_axis=concat_axis, tiled=True)
 
 
-def _alltoall_attn_local(q, k, v, *, axis_name, causal, scale):
+def _alltoall_attn_local(q, k, v, *, axis_name, causal, scale,
+                         use_flash):
     """Runs inside shard_map: q,k,v are (b, s_local, h, d) seq-shards."""
     # heads scatter, sequence gathers -> (b, s_global, h_local, d)
     q = _a2a(q, axis_name, split_axis=2, concat_axis=1)
@@ -69,13 +70,16 @@ def _alltoall_attn_local(q, k, v, *, axis_name, causal, scale):
     v = _a2a(v, axis_name, split_axis=2, concat_axis=1)
     # full-sequence blocks mean the flash kernel applies unchanged —
     # the point of this lowering at long s (ring's per-hop blocks are
-    # s/n x s/n). Same profitability gate + fallback as the unsharded
-    # dispatch (ops/attention.py); the kernel bakes in 1/sqrt(d).
+    # s/n x s/n). Same tri-state + measured gate as the unsharded
+    # dispatch (ops/attention.py); the kernel bakes in 1/sqrt(d), so a
+    # caller-custom scale falls back to the XLA path.
+    from ..kernels.flash_attention import flash_profitable
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    flash_profitable = ((d % 128 == 0 and sk >= 1024)
-                        or b * h * sq * sk * 6 > 2**31)
-    if flash_profitable and abs(scale * math.sqrt(d) - 1.0) < 1e-6:
+    want_flash = (use_flash is True
+                  or (use_flash is None
+                      and flash_profitable(b, h, sq, sk, d)))
+    if want_flash and abs(scale * math.sqrt(d) - 1.0) < 1e-6:
         try:
             from ..kernels.flash_attention import flash_attention_bshd
             out = flash_attention_bshd(q, k, v, causal=causal)
@@ -100,11 +104,12 @@ def _alltoall_attn_local(q, k, v, *, axis_name, causal, scale):
 
 def alltoall_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
                        batch_axis: str = "data", causal: bool = False,
-                       scale: float = None):
+                       scale: float = None, use_flash=None):
     """(b, s, h, d) attention with s sharded over `seq_axis`, lowered
     via head-scatter/seq-gather all-to-alls. Exact (softmax over the
     full sequence); numerics match unsharded attention. Requires
-    h % axis_size == 0."""
+    h % axis_size == 0. `use_flash` is the op's tri-state (None=auto /
+    True=force / False=never) for the per-device kernel."""
     n = int(mesh.shape[seq_axis])
     if q.shape[2] % n != 0:
         raise ValueError(
@@ -115,6 +120,6 @@ def alltoall_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
     batch_ax = batch_axis if batch_axis in mesh.shape else None
     spec = P(batch_ax, seq_axis, None, None)
     fn = partial(_alltoall_attn_local, axis_name=seq_axis,
-                 causal=causal, scale=scale)
+                 causal=causal, scale=scale, use_flash=use_flash)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)

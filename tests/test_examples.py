@@ -48,6 +48,8 @@ def run_example(script, *args, cpu_devices=2, timeout=240):
      ["-b", "8", "-e", "1"]),
     ("examples/python/native/cifar10_cnn_concat.py",
      ["-b", "8", "--samples", "32", "-e", "1"]),
+    ("examples/python/native/long_context_attention.py",
+     ["-b", "4", "-e", "1", "--sp-attention", "auto"]),
     ("examples/python/native/pipelined_mlp.py",
      ["-b", "64", "-e", "1", "--pipeline-schedule", "1f1b"]),
 ])
