@@ -40,6 +40,7 @@ from ..parallel.graph_pipeline import (
 )
 
 PACKED = "__stages__"
+STATE_PACKED = "__stage_state__"
 
 
 class StagedExecutor(Executor):
@@ -88,6 +89,22 @@ class StagedExecutor(Executor):
         self.plan: StagePlan = build_stage_plan(model, stage_of)
         self.pack: PackSpec = make_pack_spec(
             self.plan, n_dev=int(mesh.shape[pipe_axis]))
+        # functional state (BatchNorm running stats) packs into its own
+        # per-stage rows; the GPipe forward updates them per microbatch
+        # in order (gradient-accumulation semantics). The 1F1B path
+        # recomputes each stage's forward inside vjp — state updates
+        # would run twice — so stateful ops stay rejected there.
+        stateful = [op.name for op in model.ops if op.state_specs()]
+        if stateful and schedule == "1f1b":
+            raise NotImplementedError(
+                f"stateful ops {stateful} (running stats) are not "
+                f"supported under the 1f1b schedule (its per-stage vjp "
+                f"recompute would re-run state updates); use "
+                f"pipeline_schedule='gpipe'")
+        self.state_pack: Optional[PackSpec] = (
+            make_pack_spec(self.plan, n_dev=int(mesh.shape[pipe_axis]),
+                           specs_of=lambda op: op.state_specs())
+            if stateful else None)
 
     # The sparse-embedding fast path gathers rows outside the
     # differentiated region — incompatible with packed stage rows.
@@ -120,6 +137,19 @@ class StagedExecutor(Executor):
         packed = {dt: self._place_packed(a)
                   for dt, a in packed_host.items()}
         params = {PACKED: packed}
+        states = {}
+        if self.state_pack is not None:
+            st_by_op = {}
+            for op in self.model.ops:
+                sspecs = op.state_specs()
+                if sspecs:
+                    st_by_op[op.name] = {
+                        sname: np.full(spec.shape, spec.init_value,
+                                       np.dtype(spec.dtype))
+                        for sname, spec in sspecs.items()}
+            st_host = pack_params(self.state_pack, st_by_op)
+            states = {STATE_PACKED: {dt: self._place_packed(a)
+                                     for dt, a in st_host.items()}}
         opt_state = (self.optimizer.init_state(params)
                      if self.optimizer and self.comp_mode != "inference"
                      else {})
@@ -128,7 +158,7 @@ class StagedExecutor(Executor):
         opt_state = jax.tree_util.tree_map(
             lambda a: self._place_packed(np.asarray(a)), opt_state)
         from .executor import TrainState
-        return TrainState(params, {}, opt_state, self._init_step())
+        return TrainState(params, states, opt_state, self._init_step())
 
     def _packed_sharding(self):
         return NamedSharding(self.mesh, P(self.pipe_axis, None))
@@ -173,11 +203,15 @@ class StagedExecutor(Executor):
                 self.num_microbatches, self.model, training=training,
                 seq_length=seq_length)
         else:
-            logits, aux = pipeline_logits(
+            logits, aux, st = pipeline_logits(
                 self.plan, self.pack, params[PACKED], inputs, rng,
                 self.mesh, self.pipe_axis, self._data_axis(),
                 self.num_microbatches, self.model, training=training,
-                seq_length=seq_length, schedule="gpipe")
+                seq_length=seq_length, schedule="gpipe",
+                state_pack=self.state_pack,
+                state_packed=states.get(STATE_PACKED))
+            if st is not None:
+                states = {STATE_PACKED: st}
         loss = jnp.asarray(0.0, jnp.float32)
         if self.loss_fn is not None and "label" in batch:
             loss = self.loss_fn(logits, batch["label"])
@@ -202,6 +236,28 @@ class StagedExecutor(Executor):
         new_host = write_op_weights(self.pack, host, op_name, weights)
         state.params[PACKED] = {dt: self._place_packed(a)
                                 for dt, a in new_host.items()}
+
+    def get_op_states(self, state, op_name: str):
+        """Per-op view of functional state (BN running stats) out of
+        the packed stage rows."""
+        if self.state_pack is None:
+            raise KeyError(f"op {op_name!r} has no functional state")
+        host = {dt: np.asarray(jax.device_get(a))
+                for dt, a in state.states[STATE_PACKED].items()}
+        out = read_op_weights(self.state_pack, host, op_name)
+        if not out:
+            raise KeyError(f"op {op_name!r} has no functional state")
+        return out
+
+    def set_op_states(self, state, op_name: str, values) -> None:
+        if self.state_pack is None:
+            raise KeyError(f"op {op_name!r} has no functional state")
+        host = {dt: np.asarray(jax.device_get(a))
+                for dt, a in state.states[STATE_PACKED].items()}
+        new_host = write_op_weights(self.state_pack, host, op_name,
+                                    values)
+        state.states[STATE_PACKED] = {dt: self._place_packed(a)
+                                      for dt, a in new_host.items()}
 
     def get_op_opt_slots(self, state, op_name: str):
         """Per-op view of optimizer slots (packed layout mirrors

@@ -989,11 +989,22 @@ class FFModel:
         """Host set of non-trainable op state (e.g. BN running stats) —
         same role as set_weights for the reference's non-Parameter
         regions."""
+        if hasattr(self.executor, "set_op_states"):
+            self.executor.set_op_states(self.state, op_name, states)
+            return
         cur = self.state.states[op_name]
         for k, v in states.items():
             assert cur[k].shape == v.shape, (op_name, k, cur[k].shape, v.shape)
             host = np.asarray(v, dtype=np.dtype(cur[k].dtype))
             cur[k] = place_global(host, cur[k].sharding)
+
+    def get_states(self, op_name: str) -> Dict[str, np.ndarray]:
+        """Host view of non-trainable op state (e.g. BN running
+        stats)."""
+        if hasattr(self.executor, "get_op_states"):
+            return self.executor.get_op_states(self.state, op_name)
+        return {k: np.asarray(jax.device_get(v))
+                for k, v in self.state.states[op_name].items()}
 
     def summary(self) -> str:
         lines = [f"{'op':30s} {'type':20s} {'output':24s} {'params':>12s}"]

@@ -77,13 +77,11 @@ class StagePlan:
 
 
 def _check_supported(model, stage_of: Dict[str, int]) -> None:
+    # stateful ops (BatchNorm) are legal here — the GPipe schedule
+    # updates their packed state rows per microbatch in order
+    # (grad-accumulation semantics); the 1F1B schedule rejects them in
+    # StagedExecutor (its vjp recompute would re-run state updates)
     for op in model.ops:
-        if op.state_specs():
-            raise NotImplementedError(
-                f"graph pipeline: op {op.name!r} ({op.op_type}) carries "
-                f"functional state (e.g. BatchNorm running stats); "
-                f"stateful ops are not supported under pipelined "
-                f"execution")
         if op.op_type == "pipeline_blocks":
             raise NotImplementedError(
                 f"graph pipeline: {op.name!r} is itself a pipeline "
@@ -325,8 +323,13 @@ class PackSpec:
                 if seg.stage == stage]
 
 
-def make_pack_spec(plan: StagePlan, n_dev: Optional[int] = None
-                   ) -> PackSpec:
+def make_pack_spec(plan: StagePlan, n_dev: Optional[int] = None,
+                   specs_of=None) -> PackSpec:
+    """Flat-pack layout for per-stage tensors. `specs_of` selects what
+    packs (default: weight_specs; pass `lambda op: op.state_specs()`
+    for the functional-state rows BatchNorm et al. carry)."""
+    if specs_of is None:
+        specs_of = lambda op: op.weight_specs()  # noqa: E731
     S = plan.num_stages
     v = 1
     if n_dev is not None and n_dev > 0 and S != n_dev:
@@ -345,7 +348,7 @@ def make_pack_spec(plan: StagePlan, n_dev: Optional[int] = None
     for s, ops in enumerate(plan.stages):
         offsets: Dict[str, int] = {}
         for op in ops:
-            for wname, spec in op.weight_specs().items():
+            for wname, spec in specs_of(op).items():
                 dt = np.dtype(spec.dtype).name
                 size = int(np.prod(spec.shape)) if spec.shape else 1
                 off = offsets.get(dt, 0)
@@ -381,6 +384,23 @@ def unpack_stage(spec: PackSpec, packed_row: Dict[str, jax.Array],
         flat = lax.dynamic_slice_in_dim(packed_row[seg.dtype],
                                         seg.offset, seg.size)
         out.setdefault(opn, {})[wn] = flat.reshape(seg.shape)
+    return out
+
+
+def update_stage_row(spec: PackSpec, row: Dict[str, jax.Array],
+                     stage: int, by_op: Dict[str, Dict[str, jax.Array]]
+                     ) -> Dict[str, jax.Array]:
+    """Trace-time: write per-op entries (e.g. ctx.state_out) back into
+    one stage's packed row ({dtype: (L,)}). `stage` is static."""
+    out = dict(row)
+    for opn, wn, seg in spec.row_layout(stage):
+        val = by_op.get(opn, {}).get(wn)
+        if val is None:
+            continue
+        out[seg.dtype] = lax.dynamic_update_slice_in_dim(
+            out[seg.dtype],
+            val.reshape(-1).astype(out[seg.dtype].dtype),
+            seg.offset, axis=0)
     return out
 
 
@@ -447,11 +467,15 @@ def _wire_layouts(plan: StagePlan):
 
 def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
                        widths, mb_local: int, *, training: bool,
-                       seq_length: int, remat: bool = False):
+                       seq_length: int, remat: bool = False,
+                       state_pack: Optional[PackSpec] = None):
     """Shared stage body for both schedules: unpack weights + incoming
-    wire, run the stage's ops, emit (wire_out, final, aux). Pure
-    compute — collectives stay at the tick level (SPMD-uniform across
-    switch branches). `remat=True` wraps each stage tick in
+    wire, run the stage's ops, emit (wire_out, final, aux,
+    state_row_out). Pure compute — collectives stay at the tick level
+    (SPMD-uniform across switch branches). `state_pack` carries
+    functional state (BatchNorm running stats) as packed per-stage
+    rows, updated in place each tick; without it state_row passes
+    through untouched. `remat=True` wraps each stage tick in
     jax.checkpoint so the GPipe backward recomputes stage activations
     from the saved tick inputs instead of storing every intermediate —
     most of 1F1B's activation savings without the interleaved schedule
@@ -462,19 +486,23 @@ def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
 
     def run_stage(s: int, row: Dict[str, jax.Array],
                   wire_in: Dict[str, jax.Array],
-                  mb_in: Dict[str, jax.Array], mb_rng):
+                  mb_in: Dict[str, jax.Array], mb_rng,
+                  state_row: Optional[Dict[str, jax.Array]] = None):
+        if state_row is None:
+            state_row = {}
         if remat and training and mb_rng is not None:
             # prevent_cse=False: the CSE-prevention barriers exist for
             # remat OUTSIDE scans; inside the tick lax.scan they only
             # block fusion (per the jax.checkpoint docs)
             return jax.checkpoint(functools.partial(_stage_core, s),
                                   prevent_cse=False)(
-                row, wire_in, mb_in, mb_rng)
-        return _stage_core(s, row, wire_in, mb_in, mb_rng)
+                row, wire_in, mb_in, mb_rng, state_row)
+        return _stage_core(s, row, wire_in, mb_in, mb_rng, state_row)
 
     def _stage_core(s: int, row: Dict[str, jax.Array],
                     wire_in: Dict[str, jax.Array],
-                    mb_in: Dict[str, jax.Array], mb_rng):
+                    mb_in: Dict[str, jax.Array], mb_rng,
+                    state_row: Dict[str, jax.Array]):
         values: Dict[int, jax.Array] = {}
         for name, v in mb_in.items():
             values[name_of_input[name]] = v
@@ -484,6 +512,9 @@ def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
                     wire_in[dt], off * mb_local, size * mb_local)
                 values[uid] = flat.reshape((mb_local,) + shape)
         params_s = unpack_stage(pack, row, s)
+        states_s = (unpack_stage(state_pack, state_row, s)
+                    if state_pack is not None else {})
+        state_updates: Dict[str, Dict[str, jax.Array]] = {}
         aux = jnp.float32(0.0)
         for i, op in enumerate(plan.stages[s]):
             ctx = OpContext(
@@ -491,6 +522,7 @@ def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
                 rng=(jax.random.fold_in(mb_rng, i)
                      if mb_rng is not None else None),
                 seq_length=seq_length,
+                state_in=states_s.get(op.name, {}),
                 mesh=None, op_strategy=None)
             xs = [values[t.uid] for t in op.inputs]
             ys = op.forward(params_s.get(op.name, {}), xs, ctx)
@@ -498,6 +530,12 @@ def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
                 values[t.uid] = y
             if ctx.aux_loss is not None:
                 aux = aux + ctx.aux_loss
+            if ctx.state_out:
+                state_updates[op.name] = ctx.state_out
+        state_row_out = (update_stage_row(state_pack, state_row, s,
+                                          state_updates)
+                         if state_pack is not None and state_updates
+                         else state_row)
         wire_out = {dt: jnp.zeros((w * mb_local,), dtype=dt)
                     for dt, w in widths.items()}
         if s < S - 1:
@@ -511,7 +549,7 @@ def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
         else:
             final = jnp.zeros((mb_local,) + tuple(final_t.shape[1:]),
                               dtype=final_t.dtype)
-        return wire_out, final, aux
+        return wire_out, final, aux, state_row_out
 
     return run_stage
 
@@ -530,9 +568,18 @@ def pipeline_logits(plan: StagePlan, pack: PackSpec, packed,
                     inputs: Dict[str, jax.Array], rng, mesh: Mesh,
                     pipe_axis: str, data_axis: Optional[str],
                     num_microbatches: int, model, *, training: bool,
-                    seq_length: int = -1, schedule: str = "gpipe"):
+                    seq_length: int = -1, schedule: str = "gpipe",
+                    state_pack: Optional[PackSpec] = None,
+                    state_packed=None):
     """Run the staged graph pipelined over `pipe_axis`; returns
-    (logits (B, ...), aux_loss scalar).
+    (logits (B, ...), aux_loss scalar, new_state_packed).
+
+    `state_pack`/`state_packed` carry functional state (BatchNorm
+    running stats) as {dtype: (S, L)} rows sharded like the weights;
+    each stage's forward tick updates its row in microbatch order —
+    gradient-accumulation semantics. On a data axis every shard
+    computes LOCAL batch statistics (standard DDP BatchNorm behavior)
+    and the returned rows are the mean over data shards.
 
     GPipe schedule, M microbatches over S stages: tick t has stage s
     computing microbatch t - s; activations hop via ppermute. Backward
@@ -563,26 +610,35 @@ def pipeline_logits(plan: StagePlan, pack: PackSpec, packed,
     run_stage = _make_stage_runner(
         plan, pack, model, layouts, widths, mb_local,
         training=training, seq_length=seq_length,
-        remat=bool(getattr(model.config, "remat", False)))
+        remat=bool(getattr(model.config, "remat", False)),
+        state_pack=state_pack)
+    has_state = state_pack is not None and state_packed is not None
+    if state_packed is None:
+        state_packed = {}
 
-    def local_fn(packed_local, inputs_local, rng_op):
+    def local_fn(packed_local, inputs_local, state_local, rng_op):
         # packed_local: {dt: (1, L)}; inputs_local: {name: (M, mb_l, ...)}
         idx = lax.axis_index(pipe_axis)
         row = {dt: a[0] for dt, a in packed_local.items()}
+        st_row0 = {dt: a[0] for dt, a in state_local.items()}
         branches = [functools.partial(run_stage, s) for s in range(S)]
 
         def tick(carry, t):
-            wire, outputs, aux_acc = carry
+            wire, outputs, aux_acc, st_row = carry
             mb_idx = jnp.clip(t - idx, 0, M - 1)
             mb_in = {k: lax.dynamic_index_in_dim(v, mb_idx,
                                                  keepdims=False)
                      for k, v in inputs_local.items()}
             mb_rng = (jax.random.fold_in(rng_op, mb_idx)
                       if rng_op is not None else None)
-            wire_out, final, aux = lax.switch(
-                idx, branches, row, wire, mb_in, mb_rng)
+            wire_out, final, aux, st_new = lax.switch(
+                idx, branches, row, wire, mb_in, mb_rng, st_row)
             valid = jnp.logical_and(t - idx >= 0, t - idx < M)
             aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+            # state updates only on valid ticks (warmup/drain garbage
+            # microbatches must not touch running stats)
+            st_row = {dt: jnp.where(valid, st_new[dt], st_row[dt])
+                      for dt in st_row}
             perm = [(i, (i + 1) % S) for i in range(S)]
             wire_nxt = {dt: lax.ppermute(a, pipe_axis, perm)
                         for dt, a in wire_out.items()}
@@ -592,15 +648,15 @@ def pipeline_logits(plan: StagePlan, pack: PackSpec, packed,
             cur = lax.dynamic_index_in_dim(outputs, safe, keepdims=False)
             outputs = lax.dynamic_update_index_in_dim(
                 outputs, jnp.where(write, final, cur), safe, 0)
-            return (wire_nxt, outputs, aux_acc), None
+            return (wire_nxt, outputs, aux_acc, st_row), None
 
         wire0 = {dt: jnp.zeros((w * mb_local,), dtype=dt)
                  for dt, w in widths.items()}
         outputs0 = jnp.zeros(
             (M, mb_local) + tuple(final_t.shape[1:]),
             dtype=final_t.dtype)
-        (_, outputs, aux_acc), _ = lax.scan(
-            tick, (wire0, outputs0, jnp.float32(0.0)),
+        (_, outputs, aux_acc, st_row), _ = lax.scan(
+            tick, (wire0, outputs0, jnp.float32(0.0), st_row0),
             jnp.arange(M + S - 1))
         outputs = lax.psum(
             jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs)),
@@ -612,20 +668,29 @@ def pipeline_logits(plan: StagePlan, pack: PackSpec, packed,
         aux_total = lax.psum(
             aux_acc, (pipe_axis,) if data_ax is None
             else (pipe_axis, data_ax)) / (M * ndata)
-        return outputs, aux_total
+        # state rows: per-data-shard local statistics (DDP BatchNorm
+        # behavior) mean-reduced over the data axis so the returned
+        # rows are deterministic and replica-uniform
+        if data_ax is not None:
+            st_row = {dt: lax.pmean(a, data_ax)
+                      for dt, a in st_row.items()}
+        st_out = {dt: a[None] for dt, a in st_row.items()}
+        return outputs, aux_total, st_out
 
     packed_spec = {dt: P(pipe_axis, None) for dt in packed}
+    state_spec = {dt: P(pipe_axis, None) for dt in state_packed}
     in_spec = {k: P(None, data_ax, *([None] * (v.ndim - 2)))
                for k, v in inputs_mb.items()}
     out_spec = P(None, data_ax,
                  *([None] * (len(final_t.shape) - 1)))
 
-    out, aux = shard_map(
+    out, aux, st = shard_map(
         local_fn, mesh=mesh,
-        in_specs=(packed_spec, in_spec, P()),
-        out_specs=(out_spec, P()),
-        check_vma=False)(packed, inputs_mb, rng)
-    return out.reshape((B,) + tuple(final_t.shape[1:])), aux
+        in_specs=(packed_spec, in_spec, state_spec, P()),
+        out_specs=(out_spec, P(), state_spec),
+        check_vma=False)(packed, inputs_mb, state_packed, rng)
+    logits = out.reshape((B,) + tuple(final_t.shape[1:]))
+    return logits, aux, (st if has_state else None)
 
 
 # --------------------------------------------------------------------------
@@ -920,8 +985,8 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
             wire_in = {dt: lax.dynamic_index_in_dim(
                 act_buf[dt], slot(c, m), keepdims=False)
                 for dt in act_buf}
-            wire_out, final, aux = run_stage(s, row, wire_in, mb_in,
-                                             mb_rng)
+            wire_out, final, aux, _st = run_stage(s, row, wire_in,
+                                                  mb_in, mb_rng)
             return wire_out, _zero_wire(), final, gacc, aux
 
         def bwd_branch(s, rows, act_buf, ct_buf, wire_f, wire_b, m,
@@ -934,8 +999,8 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
                 for dt in act_buf}
             if s == S - 1:
                 def objective(r, w):
-                    _wire_o, final, aux = run_stage(s, r, w, mb_in,
-                                                    mb_rng)
+                    _wire_o, final, aux, _st = run_stage(s, r, w, mb_in,
+                                                         mb_rng)
                     obj = aux_scale * aux
                     if loss_fn is not None and label_local is not None:
                         lbl = lax.dynamic_index_in_dim(
@@ -946,8 +1011,8 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
                 d_row, d_wire = pull(jnp.float32(1.0))
             else:
                 def emit(r, w):
-                    wire_o, _final, aux = run_stage(s, r, w, mb_in,
-                                                    mb_rng)
+                    wire_o, _final, aux, _st = run_stage(s, r, w, mb_in,
+                                                         mb_rng)
                     return wire_o, aux
                 _out, pull = jax.vjp(emit, row, wire_in)
                 ct_wire = {dt: lax.dynamic_index_in_dim(
@@ -1167,8 +1232,8 @@ def pipeline_logits_interleaved(plan: StagePlan, pack: PackSpec, packed,
             wire_in = {dt: lax.dynamic_index_in_dim(
                 act_buf[dt], slot(c, m), keepdims=False)
                 for dt in act_buf}
-            wire_out, final, aux = run_stage(s, row, wire_in, mb_in,
-                                             mb_rng)
+            wire_out, final, aux, _st = run_stage(s, row, wire_in,
+                                                  mb_in, mb_rng)
             return wire_out, final, aux
 
         def idle_branch(rows, act_buf, m, mb_rng):

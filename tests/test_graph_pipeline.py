@@ -428,22 +428,105 @@ def test_no_matching_mesh_axis_warns():
     assert not isinstance(ff.executor, StagedExecutor)
 
 
-def test_stateful_op_rejected():
-    mesh = make_mesh((2,), ("pipe",))
-    cfg = FFConfig(batch_size=BS)
-    ff = FFModel(cfg, mesh=mesh,
-                 strategy=pin({"c1": 0, "head": 1}))
+def build_cnn_bn(mesh=None, strategy=None, cfg=None):
+    cfg = cfg or FFConfig(batch_size=BS)
+    ff = FFModel(cfg, mesh=mesh, strategy=strategy)
     x = ff.create_tensor((BS, 3, 8, 8), name="input")
-    t = ff.conv2d(x, 8, 3, 3, 1, 1, 1, 1, name="c1")
-    t = ff.batch_norm(t, name="bn")  # stateful: running stats
-    t = ff.flat(t)
-    t = ff.dense(t, 10, name="head")
-    ff.softmax(t)
-    with pytest.warns(UserWarning, match="cannot execute as a pipeline"):
-        ff.compile(optimizer=SGDOptimizer(lr=0.01),
-                   loss_type="sparse_categorical_crossentropy",
-                   metrics=[], mesh=mesh)
-    assert not isinstance(ff.executor, StagedExecutor)
+    t = ff.conv2d(x, 8, 3, 3, 1, 1, 1, 1, name="c0")
+    t = ff.batch_norm(t, name="bn0")
+    t = ff.conv2d(t, 8, 3, 3, 1, 1, 1, 1, name="c1")
+    t = ff.batch_norm(t, name="bn1")
+    ff.softmax(ff.dense(ff.flat(t), 10, name="head"))
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=[], mesh=mesh)
+    return ff
+
+
+CNN_BN_PINS = {"c0": 0, "bn0": 0, "c1": 1, "bn1": 1, "head": 1}
+
+
+def cnn_batches(n=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"input": rng.randn(BS, 3, 8, 8).astype(np.float32),
+             "label": rng.randint(0, 10, BS).astype(np.int32)}
+            for _ in range(n)]
+
+
+def test_bn_pipeline_matches_grad_accum():
+    """Stateful ops (BatchNorm) execute under GPipe graph pipelines:
+    each stage's forward tick advances its packed state row per
+    microbatch IN ORDER, so the pipelined step equals unpipelined
+    gradient accumulation over the same microbatches exactly — loss,
+    weights, and running stats."""
+    M = 4
+    cfg = FFConfig(batch_size=BS)
+    cfg.pipeline_microbatches = M
+    mesh = make_mesh((2,), ("pipe",))
+    ref = build_cnn_bn()
+    ff = build_cnn_bn(mesh=mesh, cfg=cfg, strategy=pin(CNN_BN_PINS))
+    assert isinstance(ff.executor, StagedExecutor)
+    copy_weights(ff, ref, ("c0", "c1", "head"))
+    mb = BS // M
+    for b in cnn_batches(3):
+        micro = [{k: v[i * mb:(i + 1) * mb] for k, v in b.items()}
+                 for i in range(M)]
+        mr = ref.train_batch_accum(micro)
+        mp = ff.train_batch(b)
+        np.testing.assert_allclose(float(mp["loss"]), float(mr["loss"]),
+                                   rtol=1e-5)
+    for n in ("bn0", "bn1"):
+        sp = ff.get_states(n)
+        sr = ref.get_states(n)
+        for k in sr:
+            np.testing.assert_allclose(sp[k], sr[k], rtol=1e-5,
+                                       atol=1e-6)
+    for n in ("c0", "c1", "head"):
+        np.testing.assert_allclose(ff.get_weights(n)["kernel"],
+                                   ref.get_weights(n)["kernel"],
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_bn_pipeline_eval_uses_running_stats():
+    M = 4
+    cfg = FFConfig(batch_size=BS)
+    cfg.pipeline_microbatches = M
+    mesh = make_mesh((2,), ("pipe",))
+    ref = build_cnn_bn()
+    ff = build_cnn_bn(mesh=mesh, cfg=cfg, strategy=pin(CNN_BN_PINS))
+    copy_weights(ff, ref, ("c0", "c1", "head"))
+    b = cnn_batches(1)[0]
+    mb = BS // M
+    ref.train_batch_accum([{k: v[i * mb:(i + 1) * mb]
+                            for k, v in b.items()} for i in range(M)])
+    ff.train_batch(b)
+    ev_p = ff.evaluate({"input": b["input"]}, b["label"])
+    ev_r = ref.evaluate({"input": b["input"]}, b["label"])
+    np.testing.assert_allclose(ev_p["loss"], ev_r["loss"], rtol=1e-5)
+
+
+def test_bn_pipeline_dp_pp_runs():
+    """On a data x pipe mesh BN computes per-shard statistics (DDP
+    BatchNorm semantics) with rows mean-reduced over the data axis —
+    the step must run and stay finite/deterministic."""
+    cfg = FFConfig(batch_size=BS)
+    cfg.pipeline_microbatches = 4
+    mesh = make_mesh((2, 2), ("data", "pipe"))
+    ff = build_cnn_bn(mesh=mesh, cfg=cfg, strategy=pin(CNN_BN_PINS))
+    b = cnn_batches(1)[0]
+    m1 = float(ff.train_batch(b)["loss"])
+    assert np.isfinite(m1)
+    st = ff.get_states("bn0")
+    assert all(np.isfinite(v).all() for v in st.values())
+
+
+def test_bn_1f1b_still_rejected():
+    cfg = FFConfig(batch_size=BS)
+    cfg.pipeline_schedule = "1f1b"
+    cfg.pipeline_microbatches = 4
+    mesh = make_mesh((2,), ("pipe",))
+    with pytest.raises(NotImplementedError, match="gpipe"):
+        build_cnn_bn(mesh=mesh, cfg=cfg, strategy=pin(CNN_BN_PINS))
 
 
 # ------------------------------------------------------- stage planning
