@@ -221,43 +221,47 @@ class StagedExecutor(Executor):
     def _data_axis(self) -> Optional[str]:
         return "data" if "data" in self.mesh.shape else None
 
-    # ---------------- weight access hooks (model.get/set_weights) ----
-    def get_op_weights(self, state, op_name: str):
+    # ------- weight/state access hooks (model.get/set_weights/states)
+    # weights and functional state share one marshalling path: fetch
+    # the packed rows to host, read/write the op's segments, re-place
+    def _read_packed(self, pack, packed, op_name, what):
+        if pack is None:
+            raise KeyError(f"op {op_name!r} has no {what}")
         host = {dt: np.asarray(jax.device_get(a))
-                for dt, a in state.params[PACKED].items()}
-        out = read_op_weights(self.pack, host, op_name)
+                for dt, a in packed.items()}
+        out = read_op_weights(pack, host, op_name)
         if not out:
-            raise KeyError(f"op {op_name!r} has no weights")
+            raise KeyError(f"op {op_name!r} has no {what}")
         return out
 
-    def set_op_weights(self, state, op_name: str, weights) -> None:
+    def _write_packed(self, pack, packed, op_name, values, what):
+        if pack is None:
+            raise KeyError(f"op {op_name!r} has no {what}")
         host = {dt: np.asarray(jax.device_get(a))
-                for dt, a in state.params[PACKED].items()}
-        new_host = write_op_weights(self.pack, host, op_name, weights)
-        state.params[PACKED] = {dt: self._place_packed(a)
-                                for dt, a in new_host.items()}
+                for dt, a in packed.items()}
+        new_host = write_op_weights(pack, host, op_name, values)
+        return {dt: self._place_packed(a) for dt, a in new_host.items()}
+
+    def get_op_weights(self, state, op_name: str):
+        return self._read_packed(self.pack, state.params[PACKED],
+                                 op_name, "weights")
+
+    def set_op_weights(self, state, op_name: str, weights) -> None:
+        state.params[PACKED] = self._write_packed(
+            self.pack, state.params[PACKED], op_name, weights,
+            "weights")
 
     def get_op_states(self, state, op_name: str):
         """Per-op view of functional state (BN running stats) out of
         the packed stage rows."""
-        if self.state_pack is None:
-            raise KeyError(f"op {op_name!r} has no functional state")
-        host = {dt: np.asarray(jax.device_get(a))
-                for dt, a in state.states[STATE_PACKED].items()}
-        out = read_op_weights(self.state_pack, host, op_name)
-        if not out:
-            raise KeyError(f"op {op_name!r} has no functional state")
-        return out
+        return self._read_packed(
+            self.state_pack, state.states.get(STATE_PACKED, {}),
+            op_name, "functional state")
 
     def set_op_states(self, state, op_name: str, values) -> None:
-        if self.state_pack is None:
-            raise KeyError(f"op {op_name!r} has no functional state")
-        host = {dt: np.asarray(jax.device_get(a))
-                for dt, a in state.states[STATE_PACKED].items()}
-        new_host = write_op_weights(self.state_pack, host, op_name,
-                                    values)
-        state.states[STATE_PACKED] = {dt: self._place_packed(a)
-                                      for dt, a in new_host.items()}
+        state.states[STATE_PACKED] = self._write_packed(
+            self.state_pack, state.states.get(STATE_PACKED, {}),
+            op_name, values, "functional state")
 
     def get_op_opt_slots(self, state, op_name: str):
         """Per-op view of optimizer slots (packed layout mirrors
