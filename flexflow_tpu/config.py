@@ -100,6 +100,12 @@ class FFConfig:
     # "auto" (alltoall when heads divide and the per-device score
     # matrix fits; parallel/ulysses.sp_mode_for)
     sp_attention: str = "auto"
+    # ZeRO-1: shard dense optimizer slots (momentum/adam moments) over
+    # the `data` mesh axis — pure GSPMD annotations (the slot arrays
+    # get a data-sharded NamedSharding and the update constrains them
+    # to stay there; XLA inserts the reduce-scatter/all-gather), no
+    # manual collectives. Cuts optimizer memory by the DP degree.
+    zero_optimizer_sharding: bool = False
     enable_expert_parallel: bool = False
     enable_pipeline_parallel: bool = False
     enable_propagation: bool = False
@@ -277,6 +283,7 @@ class FFConfig:
         "--enable-propagation": "enable_propagation",
         "--search-mesh-shapes": "search_mesh_shapes",
         "--enable-device-placement": "enable_device_placement",
+        "--zero": "zero_optimizer_sharding",
         "--synthetic-input": "synthetic_input",
         "--sparse-embedding-lazy": "sparse_embedding_lazy",
     }

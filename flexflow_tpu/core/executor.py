@@ -40,6 +40,10 @@ from ..parallel.sharding import (
     weight_sharding,
 )
 
+# sentinel marking "no pinned sharding" in the recorded optimizer-slot
+# sharding tree (None would read as an empty pytree under tree_map)
+_NO_SHARDING = object()
+
 
 class TrainState:
     """Flat container; registered as a pytree for jit/donation."""
@@ -160,7 +164,68 @@ class Executor:
         opt_state = (self.optimizer.init_state(params)
                      if self.optimizer and self.comp_mode != "inference"
                      else {})
+        opt_state = self._zero_shard_slots(opt_state)
         return TrainState(params, states, opt_state, self._init_step())
+
+    def _zero_shard_slots(self, opt_state):
+        """ZeRO-1 (config.zero_optimizer_sharding): re-place dense
+        optimizer slots sharded over the `data` axis — the first
+        still-unsharded dimension that divides takes it. Pure GSPMD:
+        the update's sharding constraint (_apply_update) keeps them
+        there across steps and XLA inserts the reduce-scatter /
+        all-gather. Sparse-table slots keep their layout (their scatter
+        update addresses rows by index). Records the slot sharding tree
+        either way so _apply_update can pin outputs."""
+        self._opt_shardings = None
+        if not opt_state:
+            return opt_state
+        zero = (getattr(self.config, "zero_optimizer_sharding", False)
+                and self.mesh is not None
+                and self.mesh.shape.get("data", 1) > 1)
+        if zero:
+            nd = self.mesh.shape["data"]
+            sparse = {op.name for op in self.model.ops
+                      if op.op_type in ("embedding",
+                                        "distributed_embedding")}
+
+            def place(path, arr):
+                if not isinstance(arr, jax.Array) or arr.ndim == 0:
+                    return arr
+                # path = (slot, op_name, weight_name)
+                if len(path) >= 2 and str(getattr(
+                        path[1], "key", "")) in sparse:
+                    return arr
+                sh = arr.sharding
+                spec = (list(sh.spec) if isinstance(sh, NamedSharding)
+                        else [])
+                spec += [None] * (arr.ndim - len(spec))
+                used = {ax for e in spec if e
+                        for ax in (e if isinstance(e, tuple) else (e,))}
+                if "data" in used:
+                    return arr
+                for i in range(arr.ndim):
+                    if spec[i] is None and arr.shape[i] % nd == 0:
+                        spec[i] = "data"
+                        # freshly-initialized slots are zeros by
+                        # construction (SGD momentum / Adam m,v), so
+                        # materialize host-side and place_global —
+                        # multi-controller meshes span devices this
+                        # process cannot address (device_put/device_get
+                        # would both fail there)
+                        return place_global(
+                            np.zeros(arr.shape, arr.dtype),
+                            NamedSharding(self.mesh, P(*spec)))
+                return arr
+
+            opt_state = jax.tree_util.tree_map_with_path(place,
+                                                         opt_state)
+            self._opt_shardings = jax.tree_util.tree_map(
+                lambda a: (a.sharding
+                           if isinstance(a, jax.Array)
+                           and isinstance(a.sharding, NamedSharding)
+                           else _NO_SHARDING),
+                opt_state)
+        return opt_state
 
     def _init_step(self):
         """Step counter, committed to the mesh (replicated) when one
@@ -370,6 +435,16 @@ class Executor:
         else:
             new_params, new_opt = self.optimizer.update(
                 state.params, grads, state.opt_state, state.step)
+        shardings = getattr(self, "_opt_shardings", None)
+        if shardings is not None:
+            # ZeRO slots must STAY data-sharded across steps: without
+            # the constraint XLA's propagation may emit replicated slot
+            # outputs, silently un-sharding them after one step
+            new_opt = jax.tree_util.tree_map(
+                lambda a, sh: (a if sh is _NO_SHARDING
+                               else jax.lax.with_sharding_constraint(
+                                   a, sh)),
+                new_opt, shardings)
         return TrainState(new_params, new_states, new_opt, state.step + 1)
 
     def _step_body(self, state: TrainState, batch: Dict[str, jax.Array],

@@ -157,6 +157,14 @@ class StagedExecutor(Executor):
         # same per-stage sharding so optimizer state is stage-resident
         opt_state = jax.tree_util.tree_map(
             lambda a: self._place_packed(np.asarray(a)), opt_state)
+        if opt_state and getattr(self.config,
+                                 "zero_optimizer_sharding", False):
+            import warnings
+            warnings.warn(
+                "--zero is not applied under staged (pipelined) "
+                "execution: optimizer slots are already stage-resident "
+                "(1/pipe memory); data-axis slot sharding for packed "
+                "rows is not implemented")
         from .executor import TrainState
         return TrainState(params, states, opt_state, self._init_step())
 

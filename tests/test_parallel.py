@@ -163,3 +163,70 @@ def test_fit_feature_matrix_on_mesh(mesh8):
     np.testing.assert_allclose(ff_a.get_weights("dense")["kernel"],
                                ff_b.get_weights("dense")["kernel"],
                                rtol=1e-4, atol=1e-6)
+
+
+# ------------------------------------------------- ZeRO-1 slot sharding
+def _zero_model(zero: bool):
+    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, make_mesh
+    mesh = make_mesh((8,), ("data",))
+    cfg = FFConfig(batch_size=64)
+    cfg.zero_optimizer_sharding = zero
+    ff = FFModel(cfg, mesh=mesh)
+    x = ff.create_tensor((64, 256), name="input")
+    t = ff.dense(x, 256, activation="relu", name="fc0")
+    ff.softmax(ff.dense(t, 10, name="head"))
+    ff.compile(optimizer=AdamOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=[], mesh=mesh)
+    return ff
+
+
+def test_zero_shards_slots_and_matches_numerics():
+    """--zero: Adam m/v slots shard over the data axis (1/dp memory per
+    device), stay sharded across steps (the update's sharding
+    constraint), and numerics match the unsharded run exactly."""
+    rng = np.random.RandomState(0)
+    batches = [{"input": rng.randn(64, 256).astype(np.float32),
+                "label": rng.randint(0, 10, 64).astype(np.int32)}
+               for _ in range(3)]
+    ff_z = _zero_model(True)
+    ff_r = _zero_model(False)
+    for n in ("fc0", "head"):
+        ff_r.set_weights(n, ff_z.get_weights(n))
+
+    m = ff_z.state.opt_state["m"]["fc0"]["kernel"]
+    assert "data" in jax.tree_util.tree_leaves(
+        [list(m.sharding.spec)]), m.sharding
+    assert m.addressable_shards[0].data.size == m.size // 8
+
+    for b in batches:
+        lz = float(ff_z.train_batch(b)["loss"])
+        lr_ = float(ff_r.train_batch(b)["loss"])
+        np.testing.assert_allclose(lz, lr_, rtol=1e-6)
+    # still sharded after real steps (not silently re-replicated)
+    m = ff_z.state.opt_state["m"]["fc0"]["kernel"]
+    assert m.addressable_shards[0].data.size == m.size // 8
+    np.testing.assert_allclose(ff_z.get_weights("fc0")["kernel"],
+                               ff_r.get_weights("fc0")["kernel"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_zero_warns_under_staged_pipeline():
+    """--zero must not be a silent no-op where it cannot apply."""
+    from flexflow_tpu import FFConfig, FFModel, make_mesh
+    from flexflow_tpu.parallel.pconfig import (DEVICE_KEY, OpStrategy,
+                                               Strategy)
+    mesh = make_mesh((2,), ("pipe",))
+    cfg = FFConfig(batch_size=32)
+    cfg.zero_optimizer_sharding = True
+    strat = Strategy(default=OpStrategy({}))
+    strat.set("fc0", OpStrategy({DEVICE_KEY: (0,)}))
+    strat.set("head", OpStrategy({DEVICE_KEY: (1,)}))
+    ff = FFModel(cfg, mesh=mesh, strategy=strat)
+    x = ff.create_tensor((32, 16), name="input")
+    t = ff.dense(x, 16, activation="relu", name="fc0")
+    ff.softmax(ff.dense(t, 10, name="head"))
+    with pytest.warns(UserWarning, match="--zero is not applied"):
+        ff.compile(optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=[], mesh=mesh)
