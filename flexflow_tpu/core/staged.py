@@ -87,8 +87,21 @@ class StagedExecutor(Executor):
                     f"plainly stacked inside their stage")
                 op.apply_placement(None, None)
         self.plan: StagePlan = build_stage_plan(model, stage_of)
+        # ZeRO-1 under staging: pad row length to the data-axis size so
+        # the optimizer slot rows' L dimension shards cleanly over it
+        self._zero = bool(
+            getattr(model.config, "zero_optimizer_sharding", False)
+            and mesh.shape.get("data", 1) > 1)
+        if getattr(model.config, "zero_optimizer_sharding", False) \
+                and not self._zero:
+            import warnings
+            warnings.warn(
+                "--zero has no effect on this mesh: no `data` axis of "
+                "size > 1 to shard optimizer slots over (slots remain "
+                "stage-resident only)")
         self.pack: PackSpec = make_pack_spec(
-            self.plan, n_dev=int(mesh.shape[pipe_axis]))
+            self.plan, n_dev=int(mesh.shape[pipe_axis]),
+            pad_to=(int(mesh.shape["data"]) if self._zero else 1))
         # functional state (BatchNorm running stats) packs into its own
         # per-stage rows; the GPipe forward updates them per microbatch
         # in order (gradient-accumulation semantics). The 1F1B path
@@ -153,23 +166,30 @@ class StagedExecutor(Executor):
         opt_state = (self.optimizer.init_state(params)
                      if self.optimizer and self.comp_mode != "inference"
                      else {})
-        # optimizer slots mirror the packed rows — place them with the
-        # same per-stage sharding so optimizer state is stage-resident
+        # optimizer slots mirror the packed rows — stage-resident via
+        # the pipe axis, and with --zero ALSO sharded over the data
+        # axis on the (padded) L dimension: (pipe, data) slot layout =
+        # 1/(pp*dp) optimizer memory per chip. The update's sharding
+        # constraint (base _apply_update) keeps them there.
+        from ..parallel.sharding import place_global
+        slot_sharding = (self._zero_sharding() if self._zero
+                         else self._packed_sharding())
         opt_state = jax.tree_util.tree_map(
-            lambda a: self._place_packed(np.asarray(a)), opt_state)
-        if opt_state and getattr(self.config,
-                                 "zero_optimizer_sharding", False):
-            import warnings
-            warnings.warn(
-                "--zero is not applied under staged (pipelined) "
-                "execution: optimizer slots are already stage-resident "
-                "(1/pipe memory); data-axis slot sharding for packed "
-                "rows is not implemented")
+            lambda a: place_global(np.asarray(a), slot_sharding),
+            opt_state)
+        self._opt_shardings = (jax.tree_util.tree_map(
+            lambda a: slot_sharding, opt_state)
+            if self._zero and opt_state else None)
         from .executor import TrainState
         return TrainState(params, states, opt_state, self._init_step())
 
     def _packed_sharding(self):
         return NamedSharding(self.mesh, P(self.pipe_axis, None))
+
+    def _zero_sharding(self):
+        """(pipe, data) layout for optimizer slot rows under --zero:
+        stage-resident AND data-sharded (L padded to divide)."""
+        return NamedSharding(self.mesh, P(self.pipe_axis, "data"))
 
     def _place_packed(self, host):
         from ..parallel.sharding import place_global

@@ -324,10 +324,12 @@ class PackSpec:
 
 
 def make_pack_spec(plan: StagePlan, n_dev: Optional[int] = None,
-                   specs_of=None) -> PackSpec:
+                   specs_of=None, pad_to: int = 1) -> PackSpec:
     """Flat-pack layout for per-stage tensors. `specs_of` selects what
     packs (default: weight_specs; pass `lambda op: op.state_specs()`
-    for the functional-state rows BatchNorm et al. carry)."""
+    for the functional-state rows BatchNorm et al. carry). `pad_to`
+    rounds each dtype's row length up to a multiple — set to the data
+    axis size so ZeRO can shard the optimizer rows' L dimension."""
     if specs_of is None:
         specs_of = lambda op: op.weight_specs()  # noqa: E731
     S = plan.num_stages
@@ -360,6 +362,9 @@ def make_pack_spec(plan: StagePlan, n_dev: Optional[int] = None,
             lengths[dt] = max(lengths.get(dt, 0), end)
     if not lengths:  # weightless graph: keep one dummy lane so the
         lengths["float32"] = 1  # packed tree / optimizer state is non-empty
+    if pad_to > 1:
+        lengths = {dt: -(-L // pad_to) * pad_to
+                   for dt, L in lengths.items()}
     return PackSpec(segments=segments, lengths=lengths,
                     num_stages=S, virtual_stages=v)
 

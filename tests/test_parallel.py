@@ -211,8 +211,55 @@ def test_zero_shards_slots_and_matches_numerics():
                                rtol=1e-5, atol=1e-6)
 
 
-def test_zero_warns_under_staged_pipeline():
-    """--zero must not be a silent no-op where it cannot apply."""
+def _staged_zero_model(zero: bool):
+    from flexflow_tpu import AdamOptimizer, FFConfig, FFModel, make_mesh
+    from flexflow_tpu.parallel.pconfig import (DEVICE_KEY, OpStrategy,
+                                               Strategy)
+    mesh = make_mesh((4, 2), ("data", "pipe"))
+    cfg = FFConfig(batch_size=32)
+    cfg.zero_optimizer_sharding = zero
+    strat = Strategy(default=OpStrategy({}))
+    strat.set("fc0", OpStrategy({DEVICE_KEY: (0,)}))
+    strat.set("head", OpStrategy({DEVICE_KEY: (1,)}))
+    ff = FFModel(cfg, mesh=mesh, strategy=strat)
+    x = ff.create_tensor((32, 16), name="input")
+    t = ff.dense(x, 16, activation="relu", name="fc0")
+    t = ff.dense(t, 16, activation="relu", name="fc1")
+    ff.softmax(ff.dense(t, 10, name="head"))
+    ff.compile(optimizer=AdamOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=[], mesh=mesh)
+    return ff
+
+
+def test_zero_under_staged_pipeline():
+    """--zero composes with pipelining: slot rows land (pipe, data)-
+    sharded — 1/(pp*dp) optimizer memory — stay there across steps,
+    and numerics match the non-zero pipelined run exactly."""
+    rng = np.random.RandomState(0)
+    batches = [{"input": rng.randn(32, 16).astype(np.float32),
+                "label": rng.randint(0, 10, 32).astype(np.int32)}
+               for _ in range(3)]
+    ff_z = _staged_zero_model(True)
+    ff_r = _staged_zero_model(False)
+    for n in ("fc0", "fc1", "head"):
+        ff_r.set_weights(n, ff_z.get_weights(n))
+    m = ff_z.state.opt_state["m"]["__stages__"]["float32"]
+    assert m.addressable_shards[0].data.size == m.size // 8  # pp2*dp4
+    for b in batches:
+        lz = float(ff_z.train_batch(b)["loss"])
+        lr_ = float(ff_r.train_batch(b)["loss"])
+        np.testing.assert_allclose(lz, lr_, rtol=1e-6)
+    m = ff_z.state.opt_state["m"]["__stages__"]["float32"]
+    assert m.addressable_shards[0].data.size == m.size // 8
+    np.testing.assert_allclose(ff_z.get_weights("fc0")["kernel"],
+                               ff_r.get_weights("fc0")["kernel"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_zero_warns_without_data_axis():
+    """--zero on a pipe-only mesh cannot shard slots over data — it
+    must say so, not silently no-op."""
     from flexflow_tpu import FFConfig, FFModel, make_mesh
     from flexflow_tpu.parallel.pconfig import (DEVICE_KEY, OpStrategy,
                                                Strategy)
@@ -226,7 +273,7 @@ def test_zero_warns_under_staged_pipeline():
     x = ff.create_tensor((32, 16), name="input")
     t = ff.dense(x, 16, activation="relu", name="fc0")
     ff.softmax(ff.dense(t, 10, name="head"))
-    with pytest.warns(UserWarning, match="--zero is not applied"):
+    with pytest.warns(UserWarning, match="no effect on this mesh"):
         ff.compile(optimizer=SGDOptimizer(lr=0.01, momentum=0.9),
                    loss_type="sparse_categorical_crossentropy",
                    metrics=[], mesh=mesh)
