@@ -45,6 +45,14 @@ from ..parallel.sharding import (
 _NO_SHARDING = object()
 
 
+def zero_applicable(config, mesh) -> bool:
+    """The single ZeRO-1 eligibility rule (base and staged executors
+    must agree): requested AND a data axis > 1 exists to shard over."""
+    return bool(getattr(config, "zero_optimizer_sharding", False)
+                and mesh is not None
+                and mesh.shape.get("data", 1) > 1)
+
+
 class TrainState:
     """Flat container; registered as a pytree for jit/donation."""
 
@@ -179,10 +187,7 @@ class Executor:
         self._opt_shardings = None
         if not opt_state:
             return opt_state
-        zero = (getattr(self.config, "zero_optimizer_sharding", False)
-                and self.mesh is not None
-                and self.mesh.shape.get("data", 1) > 1)
-        if zero:
+        if zero_applicable(self.config, self.mesh):
             nd = self.mesh.shape["data"]
             sparse = {op.name for op in self.model.ops
                       if op.op_type in ("embedding",

@@ -89,11 +89,11 @@ class StagedExecutor(Executor):
         self.plan: StagePlan = build_stage_plan(model, stage_of)
         # ZeRO-1 under staging: pad row length to the data-axis size so
         # the optimizer slot rows' L dimension shards cleanly over it
-        self._zero = bool(
-            getattr(model.config, "zero_optimizer_sharding", False)
-            and mesh.shape.get("data", 1) > 1)
-        if getattr(model.config, "zero_optimizer_sharding", False) \
-                and not self._zero:
+        from .executor import zero_applicable
+        zero_requested = getattr(model.config,
+                                 "zero_optimizer_sharding", False)
+        self._zero = zero_applicable(model.config, mesh)
+        if zero_requested and not self._zero:
             import warnings
             warnings.warn(
                 "--zero has no effect on this mesh: no `data` axis of "
@@ -103,17 +103,22 @@ class StagedExecutor(Executor):
             self.plan, n_dev=int(mesh.shape[pipe_axis]),
             pad_to=(int(mesh.shape["data"]) if self._zero else 1))
         # functional state (BatchNorm running stats) packs into its own
-        # per-stage rows; the GPipe forward updates them per microbatch
-        # in order (gradient-accumulation semantics). The 1F1B path
-        # recomputes each stage's forward inside vjp — state updates
-        # would run twice — so stateful ops stay rejected there.
+        # per-stage rows; BOTH schedules advance them per microbatch in
+        # order (gradient-accumulation semantics) — fwd ticks run
+        # outside 1F1B's vjp, whose recompute reads state as a
+        # constant. That is only sound when the training output ignores
+        # state_in (Op.training_output_reads_state declares it).
         stateful = [op.name for op in model.ops if op.state_specs()]
-        if stateful and schedule == "1f1b":
-            raise NotImplementedError(
-                f"stateful ops {stateful} (running stats) are not "
-                f"supported under the 1f1b schedule (its per-stage vjp "
-                f"recompute would re-run state updates); use "
-                f"pipeline_schedule='gpipe'")
+        if schedule == "1f1b":
+            reads = [op.name for op in model.ops
+                     if op.state_specs()
+                     and op.training_output_reads_state]
+            if reads:
+                raise NotImplementedError(
+                    f"ops {reads} read their functional state in the "
+                    f"training forward; 1F1B's backward recompute "
+                    f"would see later-microbatch state — use "
+                    f"pipeline_schedule='gpipe'")
         self.state_pack: Optional[PackSpec] = (
             make_pack_spec(self.plan, n_dev=int(mesh.shape[pipe_axis]),
                            specs_of=lambda op: op.state_specs())
@@ -206,16 +211,20 @@ class StagedExecutor(Executor):
         inputs = {t.name: batch[t.name]
                   for t in self.model.input_tensors}
         label = batch.get("label")
-        logits, aux, packed_grads = pipeline_1f1b_grads(
+        logits, aux, packed_grads, st = pipeline_1f1b_grads(
             self.plan, self.pack, params[PACKED], inputs, label,
             self.loss_fn, rng, self.mesh, self.pipe_axis,
             self._data_axis(), self.num_microbatches, self.model,
-            seq_length=self.config.iter_config.seq_length)
+            seq_length=self.config.iter_config.seq_length,
+            state_pack=self.state_pack,
+            state_packed=states.get(STATE_PACKED))
+        new_states = ({STATE_PACKED: st} if st is not None
+                      else dict(states))
         loss = jnp.asarray(0.0, jnp.float32)
         if self.loss_fn is not None and label is not None:
             loss = self.loss_fn(logits, label)
         loss = loss + aux
-        return loss, logits, dict(states), {PACKED: packed_grads}, {}
+        return loss, logits, new_states, {PACKED: packed_grads}, {}
 
     # ---------------- forward/loss ----------------
     def _outputs_and_loss(self, params, states, batch, training, rng,
@@ -229,7 +238,8 @@ class StagedExecutor(Executor):
                 self.plan, self.pack, params[PACKED], inputs, rng,
                 self.mesh, self.pipe_axis, self._data_axis(),
                 self.num_microbatches, self.model, training=training,
-                seq_length=seq_length)
+                seq_length=seq_length, state_pack=self.state_pack,
+                state_packed=states.get(STATE_PACKED))
         else:
             logits, aux, st = pipeline_logits(
                 self.plan, self.pack, params[PACKED], inputs, rng,

@@ -157,6 +157,17 @@ class Op:
     def state_specs(self) -> Dict[str, StateSpec]:
         return {}
 
+    # Does the TRAINING-mode output depend on ctx.state_in? BatchNorm
+    # reads state_in only to produce state_out (running-stat momentum)
+    # — its training output uses batch statistics — so gradients are
+    # state-independent and 1F1B's backward recompute may read the
+    # already-advanced state row as a constant
+    # (parallel/graph_pipeline.pipeline_1f1b_grads). A stateful op
+    # whose training output DOES read state_in (e.g. a streaming/EMA
+    # norm) must override this to True; StagedExecutor then rejects it
+    # under the 1f1b schedule instead of silently mis-differentiating.
+    training_output_reads_state: bool = False
+
     # ---- execution contract ----
     def forward(self, params: Dict[str, jax.Array], xs: List[jax.Array],
                 ctx: OpContext) -> List[jax.Array]:

@@ -77,10 +77,11 @@ class StagePlan:
 
 
 def _check_supported(model, stage_of: Dict[str, int]) -> None:
-    # stateful ops (BatchNorm) are legal here — the GPipe schedule
-    # updates their packed state rows per microbatch in order
-    # (grad-accumulation semantics); the 1F1B schedule rejects them in
-    # StagedExecutor (its vjp recompute would re-run state updates)
+    # stateful ops (BatchNorm) are legal under BOTH schedules: packed
+    # state rows advance per microbatch in order at fwd ticks
+    # (grad-accumulation semantics); 1F1B's backward recompute reads
+    # state as a constant, guarded by Op.training_output_reads_state
+    # (StagedExecutor rejects ops that set it)
     for op in model.ops:
         if op.op_type == "pipeline_blocks":
             raise NotImplementedError(
@@ -898,9 +899,19 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
                         label, loss_fn, rng, mesh: Mesh,
                         pipe_axis: str, data_axis: Optional[str],
                         num_microbatches: int, model, *,
-                        seq_length: int = -1):
+                        seq_length: int = -1,
+                        state_pack: Optional[PackSpec] = None,
+                        state_packed=None):
     """One-forward-one-backward pipelined TRAINING step: returns
-    (logits (B, ...), aux scalar, grads {dtype: (S, L)}).
+    (logits (B, ...), aux scalar, grads {dtype: (S, L)},
+    new_state_packed).
+
+    Functional state (BatchNorm running stats): fwd ticks run OUTSIDE
+    the vjp, so state rows advance there per microbatch in order —
+    identical semantics to the GPipe path — while the bwd recompute
+    reads the state row as a constant and its state writes are
+    discarded (in training mode gradients do not depend on state_in,
+    which only feeds the running-stat momentum update).
 
     Unlike the GPipe path (autodiff transpose of the forward schedule),
     this computes gradients EXPLICITLY inside the tick loop: each
@@ -935,7 +946,10 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
     data_ax, ndata, mb_local = _data_split(mesh, data_axis, mb)
     run_stage = _make_stage_runner(
         plan, pack, model, layouts, widths, mb_local,
-        training=True, seq_length=seq_length)
+        training=True, seq_length=seq_length, state_pack=state_pack)
+    has_state = state_pack is not None and state_packed is not None
+    if state_packed is None:
+        state_packed = {}
 
     n_dev = int(mesh.shape[pipe_axis])
     v = S // n_dev
@@ -971,7 +985,8 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
 
     _zero_wire, slot, _deposit = _ring_io(widths, mb_local, depth, v, M)
 
-    def local_fn(packed_local, inputs_local, label_local, rng_op):
+    def local_fn(packed_local, inputs_local, state_local, rng_op,
+                 label_local):
         idx = lax.axis_index(pipe_axis)
         # packed_local: {dt: (v, L)} — this device's chunk rows in
         # device-major order; stage s (s % n_dev == this device) reads
@@ -982,30 +997,39 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
             return {k: lax.dynamic_index_in_dim(v_, m, keepdims=False)
                     for k, v_ in inputs_local.items()}
 
+        def st_stage(st, c):
+            return {dt: a[c] for dt, a in st.items()}
+
         def fwd_branch(s, rows, act_buf, ct_buf, wire_f, wire_b, m,
-                       mb_rng, gacc):
+                       mb_rng, gacc, st):
             c = s // n_dev
             row = {dt: a[c] for dt, a in rows.items()}
             mb_in = mb_inputs_at(m)
             wire_in = {dt: lax.dynamic_index_in_dim(
                 act_buf[dt], slot(c, m), keepdims=False)
                 for dt in act_buf}
-            wire_out, final, aux, _st = run_stage(s, row, wire_in,
-                                                  mb_in, mb_rng)
-            return wire_out, _zero_wire(), final, gacc, aux
+            wire_out, final, aux, st_new = run_stage(
+                s, row, wire_in, mb_in, mb_rng,
+                state_row=st_stage(st, c))
+            st = {dt: st[dt].at[c].set(st_new[dt]) for dt in st}
+            return wire_out, _zero_wire(), final, gacc, aux, st
 
         def bwd_branch(s, rows, act_buf, ct_buf, wire_f, wire_b, m,
-                       mb_rng, gacc):
+                       mb_rng, gacc, st):
             c = s // n_dev
             row = {dt: a[c] for dt, a in rows.items()}
             mb_in = mb_inputs_at(m)
             wire_in = {dt: lax.dynamic_index_in_dim(
                 act_buf[dt], slot(c, m), keepdims=False)
                 for dt in act_buf}
+            # the recompute reads state as a CONSTANT (no grad flows
+            # through running stats in training mode); its state
+            # writes are discarded — fwd ticks own the state advance
+            st_c = st_stage(st, c)
             if s == S - 1:
                 def objective(r, w):
-                    _wire_o, final, aux, _st = run_stage(s, r, w, mb_in,
-                                                         mb_rng)
+                    _wire_o, final, aux, _st = run_stage(
+                        s, r, w, mb_in, mb_rng, state_row=st_c)
                     obj = aux_scale * aux
                     if loss_fn is not None and label_local is not None:
                         lbl = lax.dynamic_index_in_dim(
@@ -1016,8 +1040,8 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
                 d_row, d_wire = pull(jnp.float32(1.0))
             else:
                 def emit(r, w):
-                    wire_o, _final, aux, _st = run_stage(s, r, w, mb_in,
-                                                         mb_rng)
+                    wire_o, _final, aux, _st = run_stage(
+                        s, r, w, mb_in, mb_rng, state_row=st_c)
                     return wire_o, aux
                 _out, pull = jax.vjp(emit, row, wire_in)
                 ct_wire = {dt: lax.dynamic_index_in_dim(
@@ -1029,14 +1053,15 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
                 d_row[dt].astype(gacc[dt].dtype)) for dt in gacc}
             final0 = jnp.zeros((mb_local,) + tuple(final_t.shape[1:]),
                                dtype=final_t.dtype)
-            return _zero_wire(), d_wire, final0, gacc, jnp.float32(0.0)
+            return (_zero_wire(), d_wire, final0, gacc,
+                    jnp.float32(0.0), st)
 
         def idle_branch(rows, act_buf, ct_buf, wire_f, wire_b, m,
-                        mb_rng, gacc):
+                        mb_rng, gacc, st):
             final0 = jnp.zeros((mb_local,) + tuple(final_t.shape[1:]),
                                dtype=final_t.dtype)
             return (_zero_wire(), _zero_wire(), final0, gacc,
-                    jnp.float32(0.0))
+                    jnp.float32(0.0), st)
 
         branches = ([idle_branch]
                     + [functools.partial(fwd_branch, s)
@@ -1045,8 +1070,8 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
                        for s in range(S)])
 
         def tick(carry, t):
-            act_buf, ct_buf, wire_f, wire_b, gacc, outputs, aux_acc = \
-                carry
+            (act_buf, ct_buf, wire_f, wire_b, gacc, outputs, aux_acc,
+             st) = carry
             # deposit arrivals into the (chunk, mb) ring buffers
             act_buf = _deposit(act_buf, wire_f, arr_f_a[t, idx],
                                arrc_f_a[t, idx])
@@ -1058,9 +1083,9 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
             mb_rng = (jax.random.fold_in(rng_op, safe_m)
                       if rng_op is not None else None)
             b = bidx_a[t, idx]
-            wire_f_out, wire_b_out, final, gacc, aux = lax.switch(
+            wire_f_out, wire_b_out, final, gacc, aux, st = lax.switch(
                 b, branches, rows, act_buf, ct_buf, wire_f, wire_b,
-                safe_m, mb_rng, gacc)
+                safe_m, mb_rng, gacc, st)
 
             # every 1F1B fwd tick is real work (idle replaces the
             # GPipe warmup garbage), so fwd-tick aux sums are exact
@@ -1077,7 +1102,7 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
             wire_b = {dt: lax.ppermute(a, pipe_axis, bperm)
                       for dt, a in wire_b_out.items()}
             return (act_buf, ct_buf, wire_f, wire_b, gacc, outputs,
-                    aux_acc), None
+                    aux_acc, st), None
 
         def _write_mb(outputs, final, m, flag):
             cur = lax.dynamic_index_in_dim(outputs, m, keepdims=False)
@@ -1092,9 +1117,9 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
                  for dt, L in pack.lengths.items()}
         outputs0 = jnp.zeros((M, mb_local) + tuple(final_t.shape[1:]),
                              dtype=final_t.dtype)
-        (_, _, _, _, gacc, outputs, aux_acc), _ = lax.scan(
+        (_, _, _, _, gacc, outputs, aux_acc, st_rows), _ = lax.scan(
             tick, (act_buf0, ct_buf0, zw, dict(zw), gacc0, outputs0,
-                   jnp.float32(0.0)),
+                   jnp.float32(0.0), state_local),
             jnp.arange(T))
         # the last stage lives on the last device (S-1 = v*n_dev-1)
         outputs = lax.psum(
@@ -1108,9 +1133,14 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
         # across the data axis hold partial sums -> reduce there
         if data_ax is not None:
             gacc = {dt: lax.psum(a, data_ax) for dt, a in gacc.items()}
-        return outputs, aux_total, gacc
+            # state rows: per-shard local stats (DDP BatchNorm) ->
+            # deterministic replica-uniform mean, same as GPipe
+            st_rows = {dt: lax.pmean(a, data_ax)
+                       for dt, a in st_rows.items()}
+        return outputs, aux_total, gacc, st_rows
 
     packed_spec = {dt: P(pipe_axis, None) for dt in packed}
+    state_spec = {dt: P(pipe_axis, None) for dt in state_packed}
     in_spec = {k: P(None, data_ax, *([None] * (v.ndim - 2)))
                for k, v in inputs_mb.items()}
     lbl_spec = (P(None, data_ax,
@@ -1119,13 +1149,14 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
     out_spec = P(None, data_ax, *([None] * (len(final_t.shape) - 1)))
     grad_spec = {dt: P(pipe_axis, None) for dt in packed}
 
-    outputs, aux, grads = shard_map(
+    outputs, aux, grads, st = shard_map(
         local_fn, mesh=mesh,
-        in_specs=(packed_spec, in_spec, lbl_spec, P()),
-        out_specs=(out_spec, P(), grad_spec),
-        check_vma=False)(packed, inputs_mb, label_mb, rng)
+        in_specs=(packed_spec, in_spec, state_spec, P(), lbl_spec),
+        out_specs=(out_spec, P(), grad_spec, state_spec),
+        check_vma=False)(packed, inputs_mb, state_packed, rng,
+                         label_mb)
     logits = outputs.reshape((B,) + tuple(final_t.shape[1:]))
-    return logits, aux, grads
+    return logits, aux, grads, (st if has_state else None)
 
 
 def interleaved_forward_schedule(n_dev: int, v: int, M: int):
@@ -1180,7 +1211,9 @@ def pipeline_logits_interleaved(plan: StagePlan, pack: PackSpec, packed,
                                 mesh: Mesh, pipe_axis: str,
                                 data_axis: Optional[str],
                                 num_microbatches: int, model, *,
-                                training: bool, seq_length: int = -1):
+                                training: bool, seq_length: int = -1,
+                                state_pack: Optional[PackSpec] = None,
+                                state_packed=None):
     """Forward-only pipelined run under an interleaved (virtual-stage)
     layout: S = v * n_dev stages, stage s on device s % n_dev, packed
     rows in device-major order (PackSpec.row_of). The eval/predict
@@ -1202,7 +1235,10 @@ def pipeline_logits_interleaved(plan: StagePlan, pack: PackSpec, packed,
     data_ax, ndata, mb_local = _data_split(mesh, data_axis, mb)
     run_stage = _make_stage_runner(
         plan, pack, model, layouts, widths, mb_local,
-        training=training, seq_length=seq_length)
+        training=training, seq_length=seq_length,
+        state_pack=state_pack)
+    if state_packed is None:
+        state_packed = {}
 
     n_dev = int(mesh.shape[pipe_axis])
     v = S // n_dev
@@ -1225,7 +1261,7 @@ def pipeline_logits_interleaved(plan: StagePlan, pack: PackSpec, packed,
 
     _zero_wire, slot, _deposit = _ring_io(widths, mb_local, depth, v, M)
 
-    def local_fn(packed_local, inputs_local, rng_op):
+    def local_fn(packed_local, inputs_local, state_local, rng_op):
         idx = lax.axis_index(pipe_axis)
         rows = packed_local  # {dt: (v, L)} device-major chunk rows
 
@@ -1237,8 +1273,11 @@ def pipeline_logits_interleaved(plan: StagePlan, pack: PackSpec, packed,
             wire_in = {dt: lax.dynamic_index_in_dim(
                 act_buf[dt], slot(c, m), keepdims=False)
                 for dt in act_buf}
-            wire_out, final, aux, _st = run_stage(s, row, wire_in,
-                                                  mb_in, mb_rng)
+            # state is read-only here (eval/predict: BN consumes its
+            # running stats; updates are dropped — no step stores them)
+            wire_out, final, aux, _st = run_stage(
+                s, row, wire_in, mb_in, mb_rng,
+                state_row={dt: a[c] for dt, a in state_local.items()})
             return wire_out, final, aux
 
         def idle_branch(rows, act_buf, m, mb_rng):
@@ -1290,15 +1329,16 @@ def pipeline_logits_interleaved(plan: StagePlan, pack: PackSpec, packed,
         return outputs, aux_total
 
     packed_spec = {dt: P(pipe_axis, None) for dt in packed}
+    state_spec = {dt: P(pipe_axis, None) for dt in state_packed}
     in_spec = {k: P(None, data_ax, *([None] * (v_.ndim - 2)))
                for k, v_ in inputs_mb.items()}
     out_spec = P(None, data_ax, *([None] * (len(final_t.shape) - 1)))
 
     out, aux = shard_map(
         local_fn, mesh=mesh,
-        in_specs=(packed_spec, in_spec, P()),
+        in_specs=(packed_spec, in_spec, state_spec, P()),
         out_specs=(out_spec, P()),
-        check_vma=False)(packed, inputs_mb, rng)
+        check_vma=False)(packed, inputs_mb, state_packed, rng)
     return out.reshape((B,) + tuple(final_t.shape[1:])), aux
 
 
