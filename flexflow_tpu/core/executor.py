@@ -99,6 +99,10 @@ class Executor:
         self._train_step = None
         self._train_step_multi = None
         self._train_step_accum = None
+        # runtime LR multiplier (model.set_learning_rate / keras
+        # LearningRateScheduler): passed into every jitted step as a
+        # traced scalar, so changing it NEVER recompiles
+        self._lr_scale: float = 1.0
         self._eval_step = None
         self._eval_step_multi = None
         self._sparse_ops_cache = None
@@ -395,7 +399,7 @@ class Executor:
         return loss, logits, new_states, grads, sparse_idx
 
     def _apply_update(self, state: TrainState, grads, sparse_idx,
-                      new_states) -> TrainState:
+                      new_states, lr_scale=1.0) -> TrainState:
         """Apply the optimizer to dense grads + scatter-apply sparse row
         grads; returns the next TrainState (metrics are the caller's)."""
         from ..ops.embedding import DistributedEmbedding
@@ -412,7 +416,8 @@ class Executor:
                                 if k not in sparse_ops}
                          for slot, tree in state.opt_state.items()}
             new_params, new_opt = self.optimizer.update(
-                dense_params, dense_grads, dense_opt, state.step)
+                dense_params, dense_grads, dense_opt, state.step,
+                lr_scale=lr_scale)
             new_params = dict(new_params)
             new_opt = {slot: dict(tree) for slot, tree in new_opt.items()}
             for name, op in sparse_ops.items():
@@ -426,20 +431,23 @@ class Executor:
                     ntab = table.shape[0]
                     newt, new_slots = jax.vmap(
                         lambda w_, i_, g_, s_: self.optimizer.
-                        sparse_update(w_, i_, g_, s_, state.step)
+                        sparse_update(w_, i_, g_, s_, state.step,
+                                      lr_scale=lr_scale)
                     )(table, sparse_idx[name].reshape(ntab, -1),
                       g.reshape(ntab, -1, dim), slots)
                 else:
                     newt, new_slots = self.optimizer.sparse_update(
                         table, sparse_idx[name].reshape(-1),
-                        g.reshape(-1, dim), slots, state.step)
+                        g.reshape(-1, dim), slots, state.step,
+                        lr_scale=lr_scale)
                 new_params[name] = {**state.params[name], "kernel": newt}
                 for slot, arr in new_slots.items():
                     new_opt[slot][name] = {
                         **state.opt_state[slot][name], "kernel": arr}
         else:
             new_params, new_opt = self.optimizer.update(
-                state.params, grads, state.opt_state, state.step)
+                state.params, grads, state.opt_state, state.step,
+                lr_scale=lr_scale)
         shardings = getattr(self, "_opt_shardings", None)
         if shardings is not None:
             # ZeRO slots must STAY data-sharded across steps: without
@@ -453,13 +461,14 @@ class Executor:
         return TrainState(new_params, new_states, new_opt, state.step + 1)
 
     def _step_body(self, state: TrainState, batch: Dict[str, jax.Array],
-                   rng) -> Tuple[TrainState, Dict[str, jax.Array]]:
+                   rng, lr_scale=1.0
+                   ) -> Tuple[TrainState, Dict[str, jax.Array]]:
         """One optimizer step (pure; shared by the single-step and the
         scanned multi-step compilations)."""
         loss, logits, new_states, grads, sparse_idx = self._compute_grads(
             state.params, state.states, batch, rng)
         new_state = self._apply_update(state, grads, sparse_idx,
-                                       new_states)
+                                       new_states, lr_scale)
         metrics = {"loss": loss}
         if "label" in batch and self.metric_names:
             sparse = self.loss_name.startswith("sparse")
@@ -468,8 +477,7 @@ class Executor:
         return new_state, metrics
 
     def build_train_step(self):
-        jitted = jax.jit(self._step_body, donate_argnums=(0,))
-        return jitted
+        return jax.jit(self._step_body, donate_argnums=(0,))
 
     def build_train_step_multi(self):
         """K optimizer steps per device dispatch, via `lax.scan` over the
@@ -480,10 +488,10 @@ class Executor:
         amortized instead of paid per step. Metrics come back stacked
         with a leading (K,) axis."""
 
-        def train_multi(state: TrainState, batches, rngs):
+        def train_multi(state: TrainState, batches, rngs, lr_scale):
             def body(st, xs):
                 batch, rng = xs
-                return self._step_body(st, batch, rng)
+                return self._step_body(st, batch, rng, lr_scale)
 
             return jax.lax.scan(body, state, (batches, rngs))
 
@@ -503,7 +511,7 @@ class Executor:
         own microbatch moments, as torch/keras accumulation loops do)."""
         sparse_ops = self._sparse_table_ops()
 
-        def train_accum(state: TrainState, batches, rngs):
+        def train_accum(state: TrainState, batches, rngs, lr_scale):
             k = jax.tree_util.tree_leaves(batches)[0].shape[0]
             dense_zero = jax.tree_util.tree_map(
                 lambda w: jnp.zeros(w.shape, jnp.float32),
@@ -553,7 +561,7 @@ class Executor:
                 grads[name] = {"__rows__": r}
                 sparse_idx[name] = i
             new_state = self._apply_update(state, grads, sparse_idx,
-                                           new_states)
+                                           new_states, lr_scale)
             # one optimizer step happened, whatever K was: fold the
             # per-microbatch metrics like one K x batch (sums of
             # sum-style metrics, mean loss)
@@ -600,6 +608,11 @@ class Executor:
                 "optimizer state); recompile with comp_mode=TRAINING "
                 "to train")
 
+    def _lr(self):
+        """The runtime LR multiplier as a traced scalar input — a value
+        change re-dispatches, never recompiles."""
+        return jnp.asarray(self._lr_scale, jnp.float32)
+
     @property
     def train_step(self):
         self._require_training()
@@ -609,7 +622,8 @@ class Executor:
         self._sparse_table_ops()
         if self._train_step is None:
             self._train_step = self.build_train_step()
-        return self._train_step
+        jitted = self._train_step
+        return lambda st, b, r: jitted(st, b, r, self._lr())
 
     @property
     def train_step_multi(self):
@@ -617,7 +631,8 @@ class Executor:
         self._sparse_table_ops()
         if self._train_step_multi is None:
             self._train_step_multi = self.build_train_step_multi()
-        return self._train_step_multi
+        jitted = self._train_step_multi
+        return lambda st, bs, rs: jitted(st, bs, rs, self._lr())
 
     @property
     def train_step_accum(self):
@@ -625,7 +640,8 @@ class Executor:
         self._sparse_table_ops()
         if self._train_step_accum is None:
             self._train_step_accum = self.build_train_step_accum()
-        return self._train_step_accum
+        jitted = self._train_step_accum
+        return lambda st, bs, rs: jitted(st, bs, rs, self._lr())
 
     @property
     def eval_step(self):

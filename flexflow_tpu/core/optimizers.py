@@ -52,8 +52,11 @@ class Optimizer:
     def init_state(self, params) -> Any:
         raise NotImplementedError
 
-    def update(self, params, grads, state, step) -> tuple:
-        """Returns (new_params, new_state)."""
+    def update(self, params, grads, state, step, lr_scale=1.0) -> tuple:
+        """Returns (new_params, new_state). `lr_scale` is a runtime
+        (traced) multiplier on the base lr — the LR-schedule hook
+        (model.set_learning_rate / keras LearningRateScheduler) without
+        recompiling the step."""
         raise NotImplementedError
 
     def sparse_mode(self):
@@ -68,7 +71,7 @@ class Optimizer:
         FFConfig.sparse_embedding_lazy opts in."""
         return None
 
-    def sparse_update(self, w, idx, g, slots, step):
+    def sparse_update(self, w, idx, g, slots, step, lr_scale=1.0):
         """Scatter-apply the update for the touched rows only: `w` is the
         full (vocab, dim) table, `idx` (n,) row ids (duplicates allowed),
         `g` (n, dim) the gradient of those gathered rows, `slots` this
@@ -99,8 +102,8 @@ class SGDOptimizer(Optimizer):
             return {}
         return {"v": jax.tree_util.tree_map(jnp.zeros_like, params)}
 
-    def update(self, params, grads, state, step):
-        lr = jnp.asarray(self.lr, jnp.float32)
+    def update(self, params, grads, state, step, lr_scale=1.0):
+        lr = jnp.asarray(self.lr, jnp.float32) * lr_scale
 
         def upd(w, g, v=None):
             g = g.astype(jnp.float32) + self.weight_decay * w.astype(jnp.float32)
@@ -141,9 +144,10 @@ class SGDOptimizer(Optimizer):
             return None
         return "exact" if self.momentum == 0.0 else "lazy"
 
-    def sparse_update(self, w, idx, g, slots, step):
+    def sparse_update(self, w, idx, g, slots, step, lr_scale=1.0):
+        lr = jnp.asarray(self.lr, jnp.float32) * lr_scale
         if self.momentum == 0.0:
-            upd = (-self.lr) * g.astype(jnp.float32)
+            upd = (-lr) * g.astype(jnp.float32)
             return w.at[idx].add(upd.astype(w.dtype)), slots
         vocab = w.shape[0]
         uidx, gsum = coalesce_rows(idx, g.astype(jnp.float32), vocab)
@@ -151,7 +155,7 @@ class SGDOptimizer(Optimizer):
         v_rows = self.momentum * v_rows + gsum
         step_dir = gsum + self.momentum * v_rows if self.nesterov \
             else v_rows
-        new_w = w.at[uidx].add((-self.lr * step_dir).astype(w.dtype),
+        new_w = w.at[uidx].add((-lr * step_dir).astype(w.dtype),
                                mode="drop")
         new_v = slots["v"].at[uidx].set(v_rows, mode="drop")
         return new_w, {"v": new_v}
@@ -179,9 +183,9 @@ class AdamOptimizer(Optimizer):
             lambda w: jnp.zeros(w.shape, jnp.float32), params)
         return {"m": z, "v": jax.tree_util.tree_map(jnp.zeros_like, z)}
 
-    def update(self, params, grads, state, step):
+    def update(self, params, grads, state, step, lr_scale=1.0):
         t = step.astype(jnp.float32) + 1.0
-        alpha_t = self.lr * jnp.sqrt(1.0 - self.beta2 ** t) / (
+        alpha_t = self.lr * lr_scale * jnp.sqrt(1.0 - self.beta2 ** t) / (
             1.0 - self.beta1 ** t)
 
         def upd(w, g, m, v):
@@ -213,9 +217,9 @@ class AdamOptimizer(Optimizer):
         # SparseAdam). Weight decay would touch every row -> dense.
         return "lazy" if self.weight_decay == 0.0 else None
 
-    def sparse_update(self, w, idx, g, slots, step):
+    def sparse_update(self, w, idx, g, slots, step, lr_scale=1.0):
         t = step.astype(jnp.float32) + 1.0
-        alpha_t = self.lr * jnp.sqrt(1.0 - self.beta2 ** t) / (
+        alpha_t = self.lr * lr_scale * jnp.sqrt(1.0 - self.beta2 ** t) / (
             1.0 - self.beta1 ** t)
         vocab = w.shape[0]
         uidx, gsum = coalesce_rows(idx, g.astype(jnp.float32), vocab)

@@ -18,9 +18,11 @@ builder calls, optimizer/loss/metric name shims, callbacks. Same usage:
 """
 
 from . import datasets, layers
-from .callbacks import Callback, EarlyStopping, VerifyMetrics
+from .callbacks import (Callback, EarlyStopping, EpochVerifyMetrics,
+                        LearningRateScheduler, VerifyMetrics)
 from .models import Model, Sequential
 from .optimizers import SGD, Adam
 
 __all__ = ["datasets", "layers", "Model", "Sequential", "SGD", "Adam",
-           "Callback", "EarlyStopping", "VerifyMetrics"]
+           "Callback", "EarlyStopping", "EpochVerifyMetrics",
+           "LearningRateScheduler", "VerifyMetrics"]

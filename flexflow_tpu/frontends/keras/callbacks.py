@@ -68,3 +68,33 @@ class VerifyMetrics(Callback):
     def on_train_end(self, logs=None):
         assert self.last is not None and self.last >= self.threshold, (
             f"{self.metric}={self.last} below threshold {self.threshold}")
+
+
+class LearningRateScheduler(Callback):
+    """Per-epoch LR schedule (reference:
+    python/flexflow/keras/callbacks.py:49-62, which rewrote the
+    config's learning rate each epoch). Here `schedule(epoch) -> lr`
+    rescales the compiled step's traced lr input — the step never
+    recompiles."""
+
+    def __init__(self, schedule):
+        self.schedule = schedule
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.model.ffmodel.set_learning_rate(self.schedule(epoch))
+
+
+class EpochVerifyMetrics(Callback):
+    """Assert a metric threshold at EVERY epoch end (reference:
+    python/flexflow/keras/callbacks.py:75-87; the per-epoch form of
+    VerifyMetrics)."""
+
+    def __init__(self, metric="accuracy", threshold=0.9):
+        self.metric = metric
+        self.threshold = threshold
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.metric)
+        assert cur is not None and cur >= self.threshold, (
+            f"epoch {epoch}: {self.metric}={cur} below threshold "
+            f"{self.threshold}")

@@ -16,6 +16,17 @@ import jax.numpy as jnp
 _uid = itertools.count()
 
 
+def reset_layer_uids() -> None:
+    """Restart layer auto-naming (the keras backend.clear_session
+    analog). Weight-init keys fold on op NAMES, so deterministic names
+    make model construction reproducible regardless of what was built
+    earlier in the process — tests reset between cases for exactly
+    that."""
+    global _uid
+    _uid = itertools.count()
+    Layer._counter = itertools.count()
+
+
 class KTensor:
     """Symbolic Keras-level tensor: records the producing layer + inputs."""
 

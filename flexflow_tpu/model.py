@@ -998,6 +998,21 @@ class FFModel:
             host = np.asarray(v, dtype=np.dtype(cur[k].dtype))
             cur[k] = place_global(host, cur[k].sharding)
 
+    def set_learning_rate(self, lr: float) -> None:
+        """Runtime LR control (reference keras LearningRateScheduler,
+        python/flexflow/keras/callbacks.py:49-62, which rewrote the
+        config's lr each epoch): rescales the compiled step's TRACED
+        lr input, so a schedule never recompiles the step."""
+        base = float(getattr(self.optimizer, "lr", 0.0) or 0.0)
+        if base == 0.0:
+            raise ValueError(
+                "optimizer has no nonzero base lr to schedule against")
+        self.executor._lr_scale = float(lr) / base
+
+    def get_learning_rate(self) -> float:
+        base = float(getattr(self.optimizer, "lr", 0.0) or 0.0)
+        return base * float(getattr(self.executor, "_lr_scale", 1.0))
+
     def get_states(self, op_name: str) -> Dict[str, np.ndarray]:
         """Host view of non-trainable op state (e.g. BN running
         stats)."""

@@ -80,7 +80,11 @@ def hlo_cost(model, batch) -> dict:
     ex = model.executor
     batch = ex.shard_batch(batch)
     rng = jax.random.PRNGKey(0)
-    compiled = ex.train_step.lower(model.state, batch, rng).compile()
+    # the public train_step property wraps the jitted fn to inject the
+    # runtime lr scalar; lower() needs the raw jit object underneath
+    ex.train_step  # ensure built
+    compiled = ex._train_step.lower(model.state, batch, rng,
+                                    ex._lr()).compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0] if cost else {}

@@ -36,3 +36,13 @@ def mesh8():
 def mesh_2d():
     from flexflow_tpu.parallel.mesh import make_mesh
     return make_mesh((4, 2), ("data", "model"))
+
+
+@pytest.fixture(autouse=True)
+def _reset_keras_layer_names():
+    """Layer auto-names feed the name-keyed weight-init rng; reset the
+    global counter per test so keras-frontend models initialize
+    identically regardless of suite order."""
+    from flexflow_tpu.frontends.keras.layers import reset_layer_uids
+    reset_layer_uids()
+    yield
