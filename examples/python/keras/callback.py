@@ -1,6 +1,8 @@
 """Callback example (reference: examples/python/keras/callback.py;
-tests/multi_gpu_tests.sh): EarlyStopping + the accuracy-verification
-callback from accuracy_tests.sh.
+tests/multi_gpu_tests.sh): EarlyStopping + LearningRateScheduler (the
+schedule's lr rides the compiled step as a traced scalar — per-epoch
+changes never recompile) + the accuracy-verification callback from
+accuracy_tests.sh.
 
   python examples/python/keras/callback.py -e 10
 """
@@ -30,8 +32,11 @@ def top_level_task():
     y = np.argmax(x @ w, axis=1).astype(np.int32)
 
     stop = keras.EarlyStopping(monitor="loss", patience=2, min_delta=1e-4)
-    hist = model.fit(x, y, batch_size=64, epochs=epochs, callbacks=[stop])
-    print(f"trained {len(hist)} epochs (early stop at patience=2); "
+    sched = keras.LearningRateScheduler(lambda e: 0.1 * (0.9 ** e))
+    hist = model.fit(x, y, batch_size=64, epochs=epochs,
+                     callbacks=[stop, sched])
+    print(f"trained {len(hist)} epochs (early stop at patience=2, "
+          f"final lr {model.ffmodel.get_learning_rate():.4f}); "
           f"final accuracy: {hist[-1]['accuracy']:.3f}")
 
 
