@@ -103,6 +103,8 @@ class Executor:
         # LearningRateScheduler): passed into every jitted step as a
         # traced scalar, so changing it NEVER recompiles
         self._lr_scale: float = 1.0
+        self._lr_device = None  # cached device scalar (see _lr)
+        self._lr_device_scale = None
         self._eval_step = None
         self._eval_step_multi = None
         self._sparse_ops_cache = None
@@ -610,8 +612,19 @@ class Executor:
 
     def _lr(self):
         """The runtime LR multiplier as a traced scalar input — a value
-        change re-dispatches, never recompiles."""
-        return jnp.asarray(self._lr_scale, jnp.float32)
+        change re-dispatches, never recompiles.
+
+        The device scalar is CACHED: re-making it per dispatch would put
+        one synchronous host->device transfer on every train_batches
+        call, serializing the otherwise-async dispatch queue on host
+        (or, through the axon tunnel, network) round trips — all other
+        dispatch arguments (donated state, staged batches) are already
+        device-resident by design."""
+        if (self._lr_device is None
+                or self._lr_device_scale != self._lr_scale):
+            self._lr_device = jnp.asarray(self._lr_scale, jnp.float32)
+            self._lr_device_scale = self._lr_scale
+        return self._lr_device
 
     @property
     def train_step(self):

@@ -18,7 +18,16 @@ LABELS = {
     "alexnet": "AlexNet/CIFAR-10",
     "inception": "Inception-v3 299px",
     "nmt_lstm": "NMT LSTM (s40)",
-    "dlrm": "DLRM (1M-row tables)",
+    "dlrm": "DLRM",
+}
+
+# dlrm's table size is preset-dependent (bench.py vocab map) — label
+# from the RECORDED preset so a small-preset capture can't masquerade
+# as the 1M-row full config (r4 review finding)
+DLRM_PRESET_LABEL = {
+    "full": "DLRM (26x 1M-row tables)",
+    "small": "DLRM (26x 100k-row tables)",
+    "tiny": "DLRM (8x 1k-row tables)",
 }
 ORDER = ["transformer", "alexnet", "inception", "nmt_lstm", "dlrm"]
 
@@ -44,6 +53,8 @@ def row(model, entry):
         util_s = f"{bold}{util:.2f}{bold} ({vsb:.2f}x target)"
     stale = " *(stale)*" if e.get("stale") else ""
     label = LABELS.get(model, model)
+    if model == "dlrm":
+        label = DLRM_PRESET_LABEL.get(e.get("preset"), label)
     if e.get("batch"):
         label += f" b{e['batch']}"
     return (f"| {label}{stale} | "
