@@ -164,6 +164,14 @@ class FFConfig:
     # transpose at their boundaries and XLA cancels the interior pairs).
     conv_layout: str = "NCHW"
 
+    # multi-step dispatch body: "auto" unrolls the K steps (instead of
+    # lax.scan) only when donated params are a large fraction of device
+    # memory — a TPU scan carry is double-buffered, so at DLRM scale
+    # (26x1M-row tables) the scanned program needs 2x-table scratch and
+    # OOMs a chip the unrolled/single-step program fits. True/False
+    # force either body.
+    multi_step_unroll: object = "auto"
+
     # sparse embedding updates: when the optimizer's exact rule can be
     # applied row-wise (SGD, no momentum/decay), embedding tables whose
     # index tensors are graph inputs skip the dense-gradient sweep and

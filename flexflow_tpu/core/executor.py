@@ -481,6 +481,35 @@ class Executor:
     def build_train_step(self):
         return jax.jit(self._step_body, donate_argnums=(0,))
 
+    def _multi_step_unroll(self) -> bool:
+        """Should train_step_multi unroll its K steps instead of
+        lax.scan? config.multi_step_unroll: True / False / "auto".
+        Auto unrolls only when the donated params are a large fraction
+        of device memory (the scan's double-buffered carry would 2x
+        them); everything else keeps the scan (constant compile time)."""
+        mode = getattr(self.config, "multi_step_unroll", "auto")
+        if mode is True or mode is False:
+            return mode
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return False  # CPU/GPU alias scan carries in place
+        try:
+            limit = (dev.memory_stats() or {}).get("bytes_limit")
+        except Exception:  # tunnel devices may not expose stats
+            limit = None
+        limit = limit or 16e9  # v5e-class default when unreported
+        state = getattr(self.model, "state", None)
+        if state is None:
+            return False
+        # the double-buffered carry is the WHOLE donated TrainState:
+        # params + op states + optimizer slots (Adam's m/v triple the
+        # param bytes), not just params
+        pbytes = sum(
+            x.size * jnp.dtype(x.dtype).itemsize
+            for x in jax.tree_util.tree_leaves(
+                (state.params, state.states, state.opt_state)))
+        return pbytes > 0.25 * limit
+
     def build_train_step_multi(self):
         """K optimizer steps per device dispatch, via `lax.scan` over the
         leading (step) axis of a stacked batch. This is the TPU analog of
@@ -490,12 +519,35 @@ class Executor:
         amortized instead of paid per step. Metrics come back stacked
         with a leading (K,) axis."""
 
-        def train_multi(state: TrainState, batches, rngs, lr_scale):
-            def body(st, xs):
-                batch, rng = xs
-                return self._step_body(st, batch, rng, lr_scale)
+        if self._multi_step_unroll():
+            # UNROLLED K steps: a lax.scan carry is double-buffered on
+            # TPU (old + new buffer live across the body), which doubles
+            # the resident footprint of the donated params — at DLRM
+            # scale (26x1M-row tables = 6.2G) the scanned program needs
+            # 2x-table scratch and OOMs a 16G chip that the single-step
+            # program fits comfortably. Straight-line sequential updates
+            # alias in place, keeping the one-dispatch amortization
+            # without the 2x liveness. Compile time grows with K, so
+            # this is gated on param bytes (big-param models have small
+            # graphs in practice).
+            def train_multi(state: TrainState, batches, rngs, lr_scale):
+                k = jax.tree_util.tree_leaves(batches)[0].shape[0]
+                out = []
+                for i in range(k):
+                    batch = jax.tree_util.tree_map(lambda x: x[i], batches)
+                    state, metrics = self._step_body(
+                        state, batch, rngs[i], lr_scale)
+                    out.append(metrics)
+                stacked = jax.tree_util.tree_map(
+                    lambda *ms: jnp.stack(ms), *out)
+                return state, stacked
+        else:
+            def train_multi(state: TrainState, batches, rngs, lr_scale):
+                def body(st, xs):
+                    batch, rng = xs
+                    return self._step_body(st, batch, rng, lr_scale)
 
-            return jax.lax.scan(body, state, (batches, rngs))
+                return jax.lax.scan(body, state, (batches, rngs))
 
         return jax.jit(train_multi, donate_argnums=(0,))
 
@@ -642,6 +694,13 @@ class Executor:
     def train_step_multi(self):
         self._require_training()
         self._sparse_table_ops()
+        # the compiled body bakes in the scan-vs-unroll choice: a
+        # post-build change to config.multi_step_unroll (the documented
+        # OOM override) must rebuild, same as the sparse-routing key
+        unroll = self._multi_step_unroll()
+        if getattr(self, "_train_step_multi_unroll", None) != unroll:
+            self._train_step_multi = None
+            self._train_step_multi_unroll = unroll
         if self._train_step_multi is None:
             self._train_step_multi = self.build_train_step_multi()
         jitted = self._train_step_multi

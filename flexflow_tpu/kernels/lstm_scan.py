@@ -42,6 +42,15 @@ except Exception:  # pragma: no cover
     _HAS_PLTPU = False
 
 
+def _prec(dtype):
+    """f32 operands dot at HIGHEST so the kernel and the XLA scan agree
+    to f32 accuracy (DEFAULT lets Mosaic and XLA pick different bf16
+    pass counts on the MXU); bf16 operands stay DEFAULT — single-pass
+    native, and precision would only slow them down."""
+    return (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+
 def _gates(lin, h):
     """lin (B, 4H) f32 logits -> activated i, f, g, o, each (B, H)."""
     hdim = h
@@ -65,6 +74,7 @@ def _fwd_kernel(xg_ref, wh_ref, h0_ref, c0_ref, ys_ref, cs_ref,
     h_prev = h_scr[:]
     lin = xg_ref[:].astype(jnp.float32) + jax.lax.dot(
         h_prev.astype(wh_ref.dtype), wh_ref[:],
+        precision=_prec(wh_ref.dtype),
         preferred_element_type=jnp.float32)
     i, f, g, o = _gates(lin, hdim)
     c = f * c_scr[:] + i * g
@@ -121,6 +131,7 @@ def _bwd_kernel(xg_ref, wh_ref, hprev_ref, cprev_ref, cs_ref, dys_ref,
     h_prev = hprev_ref[:].astype(jnp.float32)
     lin = xg_ref[:].astype(jnp.float32) + jax.lax.dot(
         h_prev.astype(wh_ref.dtype), wh_ref[:],
+        precision=_prec(wh_ref.dtype),
         preferred_element_type=jnp.float32)
     i, f, g, o = _gates(lin, hdim)
     c = cs_ref[:].astype(jnp.float32)
@@ -143,10 +154,12 @@ def _bwd_kernel(xg_ref, wh_ref, hprev_ref, cprev_ref, cs_ref, dys_ref,
     dxg_ref[:] = dlin.astype(dxg_ref.dtype)
     dwh_scr[:] += jax.lax.dot_general(
         h_prev.astype(wh_ref.dtype), dlin.astype(wh_ref.dtype),
-        (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        (((0,), (0,)), ((), ())), precision=_prec(wh_ref.dtype),
+        preferred_element_type=jnp.float32)
     dh_scr[:] = jax.lax.dot_general(
         dlin.astype(wh_ref.dtype), wh_ref[:],
-        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        (((1,), (1,)), ((), ())), precision=_prec(wh_ref.dtype),
+        preferred_element_type=jnp.float32)
     dc_scr[:] = dc * f
 
     @pl.when(t_is_last)
@@ -232,6 +245,7 @@ def scan_reference(xg, wh, h0, c0):
         h_prev, c_prev = carry
         lin = xg_t.astype(jnp.float32) + jnp.dot(
             h_prev.astype(wh.dtype), wh,
+            precision=_prec(wh.dtype),
             preferred_element_type=jnp.float32)
         i, f, g, o = jnp.split(lin, 4, axis=-1)
         c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)

@@ -179,6 +179,41 @@ def test_train_batches_matches_sequential():
                                    rtol=1e-5)
 
 
+def test_train_batches_unrolled_matches_scan():
+    """config.multi_step_unroll=True (the big-param body that avoids the
+    TPU scan carry's double-buffering — DLRM 26x1M tables OOM'd the
+    scanned program on v5e, evidence/tpu_session_20260731T101421Z.log)
+    must be bit-compatible with the scanned body."""
+    import jax
+
+    rng = np.random.RandomState(7)
+    batches = [{"input": rng.randn(8, 16).astype(np.float32),
+                "label": rng.randint(0, 4, (8,))} for _ in range(3)]
+
+    def build(unroll):
+        cfg = FFConfig()
+        cfg.batch_size = 8
+        cfg.multi_step_unroll = unroll
+        ff = FFModel(cfg)
+        t = ff.create_tensor((8, 16), name="input")
+        h = ff.dense(t, 32, activation="relu")
+        ff.dense(h, 4)
+        ff.compile(optimizer=SGDOptimizer(lr=0.1),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=["accuracy"])
+        return ff
+
+    scan, unrolled = build(False), build(True)
+    ls = jax.device_get(scan.train_batches(batches)["loss"])
+    lu = jax.device_get(unrolled.train_batches(batches)["loss"])
+    assert ls.shape == lu.shape == (3,)
+    np.testing.assert_allclose(ls, lu, rtol=1e-6)
+    name = scan.ops[-1].name
+    for k, v in scan.get_weights(name).items():
+        np.testing.assert_allclose(v, unrolled.get_weights(name)[k],
+                                   rtol=1e-5)
+
+
 def test_fit_steps_per_dispatch():
     ff = make_mlp()
     ff.compile(optimizer=SGDOptimizer(lr=0.1),

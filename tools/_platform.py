@@ -1,0 +1,25 @@
+"""Shared platform selection for the on-chip/off-chip tools.
+
+The one subtle invariant, in one place: "tpu" must NOT be forced into
+jax_platforms — through the axon tunnel the TPU registers under the
+"axon" plugin (forcing 'tpu' fails with "No jellyfish device found").
+Leave the image default and verify the backend that actually came up.
+"""
+
+import os
+
+import jax
+
+
+def select_platform(env_var: str, default: str = "cpu") -> str:
+    """Apply the tool's platform choice from `env_var`. Returns the
+    requested platform name; raises SystemExit if tpu was requested but
+    the ambient backend isn't one."""
+    plat = os.environ.get(env_var, default)
+    if plat != "tpu":
+        jax.config.update("jax_platforms", plat)
+    elif jax.devices()[0].platform != "tpu":
+        raise SystemExit(
+            f"{env_var}=tpu but the default backend is "
+            f"{jax.devices()[0].platform}")
+    return plat

@@ -25,8 +25,10 @@ import sys
 
 import jax
 
-jax.config.update("jax_platforms",
-                  os.environ.get("CONV_TABLE_PLATFORM", "cpu"))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _platform import select_platform  # noqa: E402
+
+_plat = select_platform("CONV_TABLE_PLATFORM")
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 

@@ -28,8 +28,10 @@ import jax
 
 # default CPU (the always-available validation platform); the TPU
 # session runs with SIM_VALIDATION_PLATFORM=tpu for the on-chip table
-jax.config.update("jax_platforms",
-                  os.environ.get("SIM_VALIDATION_PLATFORM", "cpu"))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _platform import select_platform  # noqa: E402
+
+_plat = select_platform("SIM_VALIDATION_PLATFORM")
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 

@@ -32,7 +32,9 @@ run_all() {
   python tools/perf_report.py --write || echo "perf report FAILED rc=$?"
 
   echo "--- 2. on-chip test suite (tests_tpu/)"
-  timeout 1800 python -m pytest tests_tpu/ -q 2>&1 | tail -5 \
+  # FULL output into the session log (a failure whose traceback wasn't
+  # captured cost round 4 a diagnosis round trip)
+  timeout 1800 python -m pytest tests_tpu/ -q -ra 2>&1 \
       || echo "tests_tpu FAILED rc=$?"
 
   if [ "${1:-}" != "quick" ]; then
@@ -51,11 +53,18 @@ run_all() {
     for m in inception alexnet; do
       for layout in NCHW NHWC; do
         echo "· $m $layout"
-        BENCH_CONV_LAYOUT=$layout timeout 600 python bench.py --child \
+        # 900s: inception's NHWC variant compiles >600s cold (timed out
+        # in the 10:14Z session); the XLA cache makes re-runs cheap
+        BENCH_CONV_LAYOUT=$layout timeout 900 python bench.py --child \
           --model $m --preset full --steps 30 | tail -1 \
           || echo "FAILED rc=$? ($m $layout)"
       done
     done
+    echo "--- 5b. DLRM full preset (26x1M tables; scan-OOM auto-falls
+    back to per_dispatch=1 single-step dispatch)"
+    timeout 900 python bench.py --child \
+      --model dlrm --preset full --steps 30 | tail -1 \
+      || echo "FAILED rc=$? (dlrm full)"
     echo "--- 6. placement A/B (measured vs simulated, EVIDENCE.md row)"
     timeout 900 python tools/placement_ab.py \
       | tee evidence/placement_ab_tpu_$(date -u +%Y%m%d).json.txt \
