@@ -287,8 +287,14 @@ def run_child(model: str, preset: str, steps: int) -> int:
     # of `per_dispatch` steps, so tunnel/dispatch latency (~4ms/call via
     # axon) is amortized the same way begin/end_trace amortizes Legion
     # dependence analysis in the reference hot loop (alexnet.cc:106-111)
-    per_dispatch = max(1, min(int(os.environ.get(
-        "BENCH_PER_DISPATCH", "10")), steps))
+    pd_env = os.environ.get("BENCH_PER_DISPATCH", "10")
+    try:
+        pd = int(pd_env)
+    except ValueError:
+        raise SystemExit(f"BENCH_PER_DISPATCH={pd_env!r} is not an integer")
+    if pd <= 0:
+        raise SystemExit(f"BENCH_PER_DISPATCH must be positive, got {pd}")
+    per_dispatch = min(pd, steps)
     try:
         group = ff.stage_batches([batch_data] * per_dispatch)
         t_c = time.perf_counter()

@@ -519,7 +519,10 @@ class Executor:
         amortized instead of paid per step. Metrics come back stacked
         with a leading (K,) axis."""
 
-        if self._multi_step_unroll():
+        unroll = getattr(self, "_train_step_multi_unroll", None)
+        if unroll is None:  # direct build_* callers (tests): resolve now
+            unroll = self._multi_step_unroll()
+        if unroll:
             # UNROLLED K steps: a lax.scan carry is double-buffered on
             # TPU (old + new buffer live across the body), which doubles
             # the resident footprint of the donated params — at DLRM
@@ -696,11 +699,16 @@ class Executor:
         self._sparse_table_ops()
         # the compiled body bakes in the scan-vs-unroll choice: a
         # post-build change to config.multi_step_unroll (the documented
-        # OOM override) must rebuild, same as the sparse-routing key
-        unroll = self._multi_step_unroll()
-        if getattr(self, "_train_step_multi_unroll", None) != unroll:
+        # OOM override) must rebuild, same as the sparse-routing key.
+        # The RESOLVED decision is cached against the config value —
+        # _multi_step_unroll() itself touches jax.devices().
+        # memory_stats() and sums the param tree, which must not run
+        # per dispatch in the hot loop this property serves
+        mode = getattr(self.config, "multi_step_unroll", "auto")
+        if getattr(self, "_train_step_multi_mode", object()) != mode:
             self._train_step_multi = None
-            self._train_step_multi_unroll = unroll
+            self._train_step_multi_mode = mode
+            self._train_step_multi_unroll = self._multi_step_unroll()
         if self._train_step_multi is None:
             self._train_step_multi = self.build_train_step_multi()
         jitted = self._train_step_multi

@@ -65,6 +65,9 @@ run_all() {
     timeout 900 python bench.py --child \
       --model dlrm --preset full --steps 30 | tail -1 \
       || echo "FAILED rc=$? (dlrm full)"
+    echo "--- 5c. flash dispatch-threshold sweep (EVIDENCE.md row 3)"
+    FLASH_SWEEP_PLATFORM=tpu timeout 1200 python tools/flash_sweep.py \
+      || echo "flash sweep FAILED rc=$?"
     echo "--- 6. placement A/B (measured vs simulated, EVIDENCE.md row)"
     timeout 900 python tools/placement_ab.py \
       | tee evidence/placement_ab_tpu_$(date -u +%Y%m%d).json.txt \
@@ -94,6 +97,20 @@ run_all() {
         --model dlrm --preset full --steps 30 | tail -1 \
         || echo "FAILED rc=$? (dlrm stacked=$v)"
     done
+  fi
+  if [ "${1:-}" != "quick" ]; then
+    # full-queue completion sentinel for the watcher: only meaningful
+    # if the tunnel SURVIVED the whole queue (every step above is
+    # ||-protected, so reaching here proves nothing by itself) — gate
+    # on a final liveness probe; a mid-queue tunnel death leaves the
+    # sentinel absent and the next window re-runs the full queue
+    if timeout 90 python -c \
+        "import jax; assert jax.devices()[0].platform=='tpu'"; then
+      touch .scratch/tpu_session_full_done
+      echo "full queue completed with live tunnel; sentinel written"
+    else
+      echo "tunnel dead at queue end; full session will re-run"
+    fi
   fi
   echo "=== done $(date -u +%FT%TZ) ==="
 }
