@@ -133,7 +133,7 @@ class Model:
 
     def fit(self, x, y, batch_size: int = 64, epochs: int = 1,
             callbacks: Sequence = (), shuffle: bool = True,
-            verbose: bool = True, steps_per_dispatch: int = 1):
+            verbose: bool = True, steps_per_dispatch="auto"):
         xs = x if isinstance(x, (list, tuple)) else [x]
         bs = self._batch_size or batch_size
         self._ensure_ff(bs)  # builds Sequential graphs lazily

@@ -109,3 +109,21 @@ def test_keras_lr_scheduler_callback():
           shuffle=False, verbose=False)
     np.testing.assert_array_equal(
         w_after_e0, m.ffmodel.get_weights("dense_1")["kernel"])
+
+
+def test_lr_device_scalar_is_cached():
+    """The lr scalar handed to every dispatch must be the SAME device
+    buffer until set_learning_rate changes it: re-making it per dispatch
+    put one synchronous host->device transfer on each train_batches
+    call, serializing the async dispatch queue on (tunnel) round trips
+    — the round-4 on-chip regression (alexnet 11.0 vs 5.0 ms/step,
+    evidence/tpu_session_20260731T101421Z.log)."""
+    ff = build(lr=0.1)
+    ex = ff.executor
+    a, b = ex._lr(), ex._lr()
+    assert a is b
+    ff.set_learning_rate(0.05)
+    c = ex._lr()
+    assert c is not a
+    assert float(c) == pytest.approx(0.5)  # scale vs base lr 0.1
+    assert ex._lr() is c

@@ -89,7 +89,7 @@ def main():
             row["flash_error"] = str(e)[:100]
         row["xla_fwdbwd_us"] = round(timed(
             jax.jit(jax.grad(loss_x, argnums=(0, 1, 2))), (q, k, v)) * 1e6)
-        if row["flash_fwdbwd_us"]:
+        if row["flash_fwdbwd_us"] is not None:
             row["flash_wins"] = row["flash_fwdbwd_us"] < row["xla_fwdbwd_us"]
             row["gate_correct"] = row["flash_wins"] == row["gate_says_flash"]
         print(row, flush=True)

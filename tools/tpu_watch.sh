@@ -1,15 +1,18 @@
 #!/bin/bash
 # Detached TPU-uptime watcher: probe every ~2.5 min; at each tunnel-up
 # window run the on-chip session (tools/tpu_session.sh) and commit its
-# artifacts. The FIRST completed session this watch runs in full;
-# later windows re-run in full only while .scratch/tpu_session_complete
-# is absent (i.e. the full queue never finished), else refresh quickly.
+# artifacts. Windows run the FULL queue until one completes cleanly —
+# tpu_session.sh writes .scratch/tpu_session_full_done only then —
+# after which later windows refresh quickly. The sentinel is cleared at
+# watch start so a new watch (new code, new queue steps) always begins
+# with a full session.
 # Transcript: evidence/ (session) + .scratch/tpu_watch.log (probe loop).
 # Start with:
 #   nohup setsid bash tools/tpu_watch.sh > .scratch/tpu_watch.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p .scratch
+rm -f .scratch/tpu_session_full_done
 for i in $(seq 1 288); do  # up to 12h at the fast cadence
   echo "[watch $(date -u +%FT%TZ)] probe $i"
   if timeout 90 env JAX_PLATFORMS=tpu python -c \
