@@ -121,6 +121,22 @@ def step_bytes(ff, batch=None):
         "hbm_roofline_approx"
 
 
+def positive_int_env(name: str, default: int) -> int:
+    """Sweep-knob env var -> positive int, failing loudly on junk (a
+    typo'd knob in a session script must show in the evidence log as a
+    message, not a traceback)."""
+    v = os.environ.get(name)
+    if not v:
+        return default
+    try:
+        n = int(v)
+    except ValueError:
+        raise SystemExit(f"{name}={v!r} is not an integer")
+    if n <= 0:
+        raise SystemExit(f"{name} must be positive, got {n}")
+    return n
+
+
 def jnp_dtype_size(dt) -> int:
     import numpy as _np
     try:
@@ -147,16 +163,7 @@ def build(model: str, preset: str):
         # batch-sensitive on conv models; tools/tpu_session.sh A/Bs it).
         # Child-mode only — main() strips it in ladder mode so the
         # preset fallback keeps reducing batch on OOM/timeouts.
-        v = os.environ.get("BENCH_BATCH")
-        if not v:
-            return default
-        try:
-            b = int(v)
-        except ValueError:
-            raise SystemExit(f"BENCH_BATCH={v!r} is not an integer")
-        if b <= 0:
-            raise SystemExit(f"BENCH_BATCH must be positive, got {b}")
-        return b
+        return positive_int_env("BENCH_BATCH", default)
 
     if model == "transformer":
         batch, seq, hidden, layers, ffd = {
@@ -287,39 +294,31 @@ def run_child(model: str, preset: str, steps: int) -> int:
     # of `per_dispatch` steps, so tunnel/dispatch latency (~4ms/call via
     # axon) is amortized the same way begin/end_trace amortizes Legion
     # dependence analysis in the reference hot loop (alexnet.cc:106-111)
-    pd_env = os.environ.get("BENCH_PER_DISPATCH", "10")
-    try:
-        pd = int(pd_env)
-    except ValueError:
-        raise SystemExit(f"BENCH_PER_DISPATCH={pd_env!r} is not an integer")
-    if pd <= 0:
-        raise SystemExit(f"BENCH_PER_DISPATCH must be positive, got {pd}")
-    per_dispatch = min(pd, steps)
-    try:
-        group = ff.stage_batches([batch_data] * per_dispatch)
-        t_c = time.perf_counter()
-        m = ff.train_batches(group)
-        float(np.sum(np.asarray(m["loss"], dtype=np.float64)))
-        log(f"multi-step compile done in {time.perf_counter() - t_c:.1f}s")
-    except Exception as exc:  # noqa: BLE001
-        # the scanned program double-buffers the carried params, so at
-        # param scales near HBM capacity (DLRM 26x1M tables) the K-step
-        # scan can OOM where the single-step program (true in-place
-        # donation) fits — degrade to 1 step/dispatch instead of dying
-        if per_dispatch == 1 or "ran out of memory" not in str(exc).lower():
-            raise
-        log(f"multi-step scan OOM'd ({str(exc).splitlines()[0][:120]}); "
-            f"falling back to per_dispatch=1")
-        per_dispatch = 1
-        # an EXECUTION-time OOM has already consumed the donated state
-        # buffers ("Array has been deleted" on reuse) — rebuild the
-        # model fresh; build() is deterministic (seeded RandomState)
-        ff, batch_data = build(model, preset)
-        group = ff.stage_batches([batch_data])
-        t_c = time.perf_counter()
-        m = ff.train_batches(group)
-        float(np.sum(np.asarray(m["loss"], dtype=np.float64)))
-        log(f"single-step compile done in {time.perf_counter() - t_c:.1f}s")
+    per_dispatch = min(positive_int_env("BENCH_PER_DISPATCH", 10), steps)
+    # two candidate groupings: the K-step program, then 1 step/dispatch.
+    # The K-step program double-buffers the carried params, so at param
+    # scales near HBM capacity (DLRM 26x1M tables) it can OOM where the
+    # single-step program (true in-place donation) fits.
+    for pd_try in dict.fromkeys((per_dispatch, 1)):
+        try:
+            per_dispatch = pd_try
+            group = ff.stage_batches([batch_data] * per_dispatch)
+            t_c = time.perf_counter()
+            m = ff.train_batches(group)
+            float(np.sum(np.asarray(m["loss"], dtype=np.float64)))
+            log(f"{per_dispatch}-step compile done in "
+                f"{time.perf_counter() - t_c:.1f}s")
+            break
+        except Exception as exc:  # noqa: BLE001
+            if pd_try == 1 or "ran out of memory" not in str(exc).lower():
+                raise
+            log(f"multi-step scan OOM'd "
+                f"({str(exc).splitlines()[0][:120]}); "
+                f"falling back to per_dispatch=1")
+            # an EXECUTION-time OOM has already consumed the donated
+            # state buffers ("Array has been deleted" on reuse) —
+            # rebuild fresh; build() is deterministic (seeded)
+            ff, batch_data = build(model, preset)
     n_disp = max(1, steps // per_dispatch)
     log(f"warmup done; timing {n_disp} dispatches x {per_dispatch} steps...")
 

@@ -105,6 +105,10 @@ class Executor:
         self._lr_scale: float = 1.0
         self._lr_device = None  # cached device scalar (see _lr)
         self._lr_device_scale = None
+        # resolved scan-vs-unroll decision for train_step_multi, keyed
+        # on config.multi_step_unroll (see the property)
+        self._train_step_multi_mode = None
+        self._train_step_multi_unroll = None
         self._eval_step = None
         self._eval_step_multi = None
         self._sparse_ops_cache = None
@@ -519,7 +523,7 @@ class Executor:
         amortized instead of paid per step. Metrics come back stacked
         with a leading (K,) axis."""
 
-        unroll = getattr(self, "_train_step_multi_unroll", None)
+        unroll = self._train_step_multi_unroll
         if unroll is None:  # direct build_* callers (tests): resolve now
             unroll = self._multi_step_unroll()
         if unroll:
@@ -705,7 +709,8 @@ class Executor:
         # memory_stats() and sums the param tree, which must not run
         # per dispatch in the hot loop this property serves
         mode = getattr(self.config, "multi_step_unroll", "auto")
-        if getattr(self, "_train_step_multi_mode", object()) != mode:
+        if (self._train_step_multi_mode != mode
+                or self._train_step_multi_unroll is None):
             self._train_step_multi = None
             self._train_step_multi_mode = mode
             self._train_step_multi_unroll = self._multi_step_unroll()

@@ -14,6 +14,22 @@ mkdir -p evidence
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-.scratch/xla_cache}"
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
+# queue-step failure accounting (lives inside run_all's subshell, which
+# is where the sentinel decision is made — grepping the live $LOG races
+# tee and overmatches bench's benign ladder messages):
+#   cmd || note_rc "label"
+# logs the failure and counts rc=124/137 (timeout/kill — the
+# tunnel-death signature) separately from deterministic failures.
+TIMEOUTS=0
+note_rc() {
+  local rc=$?
+  echo "FAILED rc=$rc ($1)"
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    TIMEOUTS=$((TIMEOUTS + 1))
+  fi
+  return 0
+}
+
 run_all() {
   echo "=== tpu session $(date -u +%FT%TZ) ==="
   if ! timeout 120 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu'; print('TPU:', d.device_kind)"; then
@@ -26,16 +42,16 @@ run_all() {
   # before anything else
   echo "--- 1. full bench sweep -> bench_all.json"
   BENCH_DEADLINE_S=2400 timeout 2600 python bench.py --all --steps 50 \
-      || echo "bench sweep FAILED rc=$?"
+      || note_rc "bench sweep"
 
   echo "--- 1b. regenerate the README perf table from the fresh sweep"
-  python tools/perf_report.py --write || echo "perf report FAILED rc=$?"
+  python tools/perf_report.py --write || note_rc "perf report"
 
   echo "--- 2. on-chip test suite (tests_tpu/)"
   # FULL output into the session log (a failure whose traceback wasn't
   # captured cost round 4 a diagnosis round trip)
   timeout 1800 python -m pytest tests_tpu/ -q -ra 2>&1 \
-      || echo "tests_tpu FAILED rc=$?"
+      || note_rc "tests_tpu"
 
   if [ "${1:-}" != "quick" ]; then
     # round-4 evidence first: if the tunnel window is short, the
@@ -44,11 +60,11 @@ run_all() {
     echo "--- 3. sim-vs-real validation, all five models (VERDICT r3 #6)"
     SIM_VALIDATION_PLATFORM=tpu timeout 1800 \
       python tools/sim_validation.py \
-      || echo "sim validation FAILED rc=$?"
+      || note_rc "sim validation"
     echo "--- 4. per-shape conv table (inception MFU diagnosis)"
     CONV_TABLE_PLATFORM=tpu timeout 1800 \
       python tools/conv_shape_table.py \
-      || echo "conv table FAILED rc=$?"
+      || note_rc "conv table"
     echo "--- 5. conv layout A/B (inception + alexnet)"
     for m in inception alexnet; do
       for layout in NCHW NHWC; do
@@ -57,60 +73,59 @@ run_all() {
         # in the 10:14Z session); the XLA cache makes re-runs cheap
         BENCH_CONV_LAYOUT=$layout timeout 900 python bench.py --child \
           --model $m --preset full --steps 30 | tail -1 \
-          || echo "FAILED rc=$? ($m $layout)"
+          || note_rc "$m $layout"
       done
     done
-    echo "--- 5b. DLRM full preset (26x1M tables; scan-OOM auto-falls
-    back to per_dispatch=1 single-step dispatch)"
+    echo "--- 5b. DLRM full preset (26x1M tables; scan-OOM auto-falls"
+    echo "    back to per_dispatch=1 single-step dispatch)"
     timeout 900 python bench.py --child \
       --model dlrm --preset full --steps 30 | tail -1 \
-      || echo "FAILED rc=$? (dlrm full)"
+      || note_rc "dlrm full"
     echo "--- 5c. flash dispatch-threshold sweep (EVIDENCE.md row 3)"
     FLASH_SWEEP_PLATFORM=tpu timeout 1200 python tools/flash_sweep.py \
-      || echo "flash sweep FAILED rc=$?"
+      || note_rc "flash sweep"
     echo "--- 6. placement A/B (measured vs simulated, EVIDENCE.md row)"
     timeout 900 python tools/placement_ab.py \
       | tee evidence/placement_ab_tpu_$(date -u +%Y%m%d).json.txt \
-      || echo "placement A/B FAILED rc=$?"
+      || note_rc "placement A/B"
     echo "--- 7. LSTM Pallas kernel A/B (nmt_lstm; decides use_pallas default)"
     for v in 0 1; do
       echo "· FLEXFLOW_TPU_LSTM_PALLAS=$v"
       FLEXFLOW_TPU_LSTM_PALLAS=$v timeout 600 python bench.py --child \
         --model nmt_lstm --preset full --steps 30 | tail -1 \
-        || echo "FAILED rc=$? (lstm pallas=$v)"
+        || note_rc "lstm pallas=$v"
     done
     echo "--- 8. inception conv audit (layout A/B + tiling flags)"
     timeout 1200 python tools/inception_audit.py \
       | tee evidence/inception_audit_$(date -u +%Y%m%d).log \
-      || echo "inception audit FAILED rc=$?"
+      || note_rc "inception audit"
     echo "--- 9. inception batch sweep (MFU is batch-sensitive on convs)"
     for b in 48 64; do
       echo "· inception batch=$b"
       BENCH_BATCH=$b timeout 600 python bench.py --child \
         --model inception --preset full --steps 30 | tail -1 \
-        || echo "FAILED rc=$? (inception batch=$b)"
+        || note_rc "inception batch=$b"
     done
     echo "--- 10. DLRM stacked-vs-separate tables A/B"
     for v in 0 1; do
       echo "· BENCH_DLRM_STACKED=$v"
       BENCH_DLRM_STACKED=$v timeout 600 python bench.py --child \
         --model dlrm --preset full --steps 30 | tail -1 \
-        || echo "FAILED rc=$? (dlrm stacked=$v)"
+        || note_rc "dlrm stacked=$v"
     done
   fi
   if [ "${1:-}" != "quick" ]; then
     # full-queue completion sentinel for the watcher (every step above
     # is ||-protected, so reaching here proves nothing by itself).
-    # Written only when (a) no step TIMED OUT — rc=124 is the
-    # tunnel-death signature; the tunnel may have died mid-queue and
-    # recovered before this line, silently skipping steps — and (b)
-    # the tunnel is alive now. Deterministic failures (rc=1) do NOT
-    # block the sentinel: re-running the full queue can't fix those
-    # and would burn every future window repeating them.
-    if grep -q "FAILED rc=124" "$LOG" 2>/dev/null \
-        || grep -q "timed out" "$LOG" 2>/dev/null; then
-      echo "queue had timeouts (tunnel likely died mid-queue); full" \
-           "session will re-run at the next window"
+    # Written only when (a) no step TIMED OUT — counted in $TIMEOUTS,
+    # the tunnel-death signature (the tunnel may have died mid-queue
+    # and recovered before this line, silently skipping steps) — and
+    # (b) the tunnel is alive now. Deterministic failures (rc=1) do
+    # NOT block the sentinel: re-running the full queue can't fix
+    # those and would burn every future window repeating them.
+    if [ "$TIMEOUTS" -gt 0 ]; then
+      echo "queue had $TIMEOUTS step timeout(s) (tunnel likely died" \
+           "mid-queue); full session will re-run at the next window"
     elif timeout 90 python -c \
         "import jax; assert jax.devices()[0].platform=='tpu'"; then
       touch .scratch/tpu_session_full_done
