@@ -21,6 +21,7 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 # logs the failure and counts rc=124/137 (timeout/kill — the
 # tunnel-death signature) separately from deterministic failures.
 TIMEOUTS=0
+SWEEP_INCOMPLETE=0
 note_rc() {
   local rc=$?
   echo "FAILED rc=$rc ($1)"
@@ -41,8 +42,12 @@ run_all() {
   # headline artifact (bench_all.json refresh, VERDICT #1) must land
   # before anything else
   echo "--- 1. full bench sweep -> bench_all.json"
+  # bench --all exits nonzero unless ALL FIVE configs measured fresh on
+  # chip this run (its internal ladder hides tunnel deaths behind
+  # CPU/stale fallbacks) — an incomplete sweep must block the
+  # full-queue sentinel so the next window re-runs in full
   BENCH_DEADLINE_S=2400 timeout 2600 python bench.py --all --steps 50 \
-      || note_rc "bench sweep"
+      || { note_rc "bench sweep"; SWEEP_INCOMPLETE=1; }
 
   echo "--- 1b. regenerate the README perf table from the fresh sweep"
   python tools/perf_report.py --write || note_rc "perf report"
@@ -54,17 +59,30 @@ run_all() {
       || note_rc "tests_tpu"
 
   if [ "${1:-}" != "quick" ]; then
-    # round-4 evidence first: if the tunnel window is short, the
-    # VERDICT-requested artifacts (five-model sim validation + the
-    # per-shape conv table) must land before the preset sweeps
-    echo "--- 3. sim-vs-real validation, all five models (VERDICT r3 #6)"
-    SIM_VALIDATION_PLATFORM=tpu timeout 1800 \
-      python tools/sim_validation.py \
-      || note_rc "sim validation"
-    echo "--- 4. per-shape conv table (inception MFU diagnosis)"
-    CONV_TABLE_PLATFORM=tpu timeout 1800 \
-      python tools/conv_shape_table.py \
-      || note_rc "conv table"
+    # Ordering principle (windows observed at 2-29 min): SHORT,
+    # decision-driving A/Bs first — each lands a committed artifact in
+    # minutes — then the long instrumented tables (sim validation +
+    # conv table, 30-min caps each) that only pay off if the window
+    # survives them.
+    echo "--- 3. LSTM Pallas kernel A/B (nmt_lstm; decides use_pallas default)"
+    for v in 0 1; do
+      echo "· FLEXFLOW_TPU_LSTM_PALLAS=$v"
+      FLEXFLOW_TPU_LSTM_PALLAS=$v timeout 600 python bench.py --child \
+        --model nmt_lstm --preset full --steps 30 | tail -1 \
+        || note_rc "lstm pallas=$v"
+    done
+    echo "--- 4. DLRM full preset (26x1M tables; scan-OOM auto-falls"
+    echo "    back to unroll / per_dispatch=1)"
+    timeout 900 python bench.py --child \
+      --model dlrm --preset full --steps 30 | tail -1 \
+      || note_rc "dlrm full"
+    echo "--- 4b. DLRM stacked-vs-separate tables A/B"
+    for v in 0 1; do
+      echo "· BENCH_DLRM_STACKED=$v"
+      BENCH_DLRM_STACKED=$v timeout 600 python bench.py --child \
+        --model dlrm --preset full --steps 30 | tail -1 \
+        || note_rc "dlrm stacked=$v"
+    done
     echo "--- 5. conv layout A/B (inception + alexnet)"
     for m in inception alexnet; do
       for layout in NCHW NHWC; do
@@ -76,43 +94,32 @@ run_all() {
           || note_rc "$m $layout"
       done
     done
-    echo "--- 5b. DLRM full preset (26x1M tables; scan-OOM auto-falls"
-    echo "    back to per_dispatch=1 single-step dispatch)"
-    timeout 900 python bench.py --child \
-      --model dlrm --preset full --steps 30 | tail -1 \
-      || note_rc "dlrm full"
-    echo "--- 5c. flash dispatch-threshold sweep (EVIDENCE.md row 3)"
-    FLASH_SWEEP_PLATFORM=tpu timeout 1200 python tools/flash_sweep.py \
-      || note_rc "flash sweep"
-    echo "--- 6. placement A/B (measured vs simulated, EVIDENCE.md row)"
-    timeout 900 python tools/placement_ab.py \
-      | tee evidence/placement_ab_tpu_$(date -u +%Y%m%d).json.txt \
-      || note_rc "placement A/B"
-    echo "--- 7. LSTM Pallas kernel A/B (nmt_lstm; decides use_pallas default)"
-    for v in 0 1; do
-      echo "· FLEXFLOW_TPU_LSTM_PALLAS=$v"
-      FLEXFLOW_TPU_LSTM_PALLAS=$v timeout 600 python bench.py --child \
-        --model nmt_lstm --preset full --steps 30 | tail -1 \
-        || note_rc "lstm pallas=$v"
-    done
-    echo "--- 8. inception conv audit (layout A/B + tiling flags)"
-    timeout 1200 python tools/inception_audit.py \
-      | tee evidence/inception_audit_$(date -u +%Y%m%d).log \
-      || note_rc "inception audit"
-    echo "--- 9. inception batch sweep (MFU is batch-sensitive on convs)"
+    echo "--- 6. inception batch sweep (MFU is batch-sensitive on convs)"
     for b in 48 64; do
       echo "· inception batch=$b"
       BENCH_BATCH=$b timeout 600 python bench.py --child \
         --model inception --preset full --steps 30 | tail -1 \
         || note_rc "inception batch=$b"
     done
-    echo "--- 10. DLRM stacked-vs-separate tables A/B"
-    for v in 0 1; do
-      echo "· BENCH_DLRM_STACKED=$v"
-      BENCH_DLRM_STACKED=$v timeout 600 python bench.py --child \
-        --model dlrm --preset full --steps 30 | tail -1 \
-        || note_rc "dlrm stacked=$v"
-    done
+    echo "--- 7. flash dispatch-threshold sweep (EVIDENCE.md row 3)"
+    FLASH_SWEEP_PLATFORM=tpu timeout 1200 python tools/flash_sweep.py \
+      || note_rc "flash sweep"
+    echo "--- 8. placement A/B (measured vs simulated, EVIDENCE.md row)"
+    timeout 900 python tools/placement_ab.py \
+      | tee evidence/placement_ab_tpu_$(date -u +%Y%m%d).json.txt \
+      || note_rc "placement A/B"
+    echo "--- 9. sim-vs-real validation, all five models (VERDICT r3 #6)"
+    SIM_VALIDATION_PLATFORM=tpu timeout 1800 \
+      python tools/sim_validation.py \
+      || note_rc "sim validation"
+    echo "--- 10. per-shape conv table (inception MFU diagnosis)"
+    CONV_TABLE_PLATFORM=tpu timeout 1800 \
+      python tools/conv_shape_table.py \
+      || note_rc "conv table"
+    echo "--- 11. inception conv audit (layout A/B + tiling flags)"
+    timeout 1200 python tools/inception_audit.py \
+      | tee evidence/inception_audit_$(date -u +%Y%m%d).log \
+      || note_rc "inception audit"
   fi
   if [ "${1:-}" != "quick" ]; then
     # full-queue completion sentinel for the watcher (every step above
@@ -123,9 +130,10 @@ run_all() {
     # (b) the tunnel is alive now. Deterministic failures (rc=1) do
     # NOT block the sentinel: re-running the full queue can't fix
     # those and would burn every future window repeating them.
-    if [ "$TIMEOUTS" -gt 0 ]; then
-      echo "queue had $TIMEOUTS step timeout(s) (tunnel likely died" \
-           "mid-queue); full session will re-run at the next window"
+    if [ "$TIMEOUTS" -gt 0 ] || [ "$SWEEP_INCOMPLETE" -ne 0 ]; then
+      echo "queue incomplete (timeouts=$TIMEOUTS" \
+           "sweep_incomplete=$SWEEP_INCOMPLETE); full session will" \
+           "re-run at the next window"
     elif timeout 90 python -c \
         "import jax; assert jax.devices()[0].platform=='tpu'"; then
       touch .scratch/tpu_session_full_done
