@@ -1,0 +1,93 @@
+"""Distill a tpu_session.sh transcript into a decision table.
+
+Parses the per-arm JSON lines (each `bench.py --child | tail -1`
+prints one) together with the `· <arm>` markers the session script
+echoes before each arm, and prints winners per A/B group plus the
+headline sweep deltas. Usage:
+
+  python tools/session_report.py [evidence/tpu_session_<UTC>.log]
+
+Defaults to the newest session log under evidence/.
+"""
+
+import glob
+import json
+import os
+import sys
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def newest_log():
+    logs = sorted(glob.glob(os.path.join(
+        ROOT, "evidence", "tpu_session_*.log")))
+    if not logs:
+        raise SystemExit("no evidence/tpu_session_*.log found")
+    return logs[-1]
+
+
+def parse(path):
+    """-> (step_header, arm_label) -> result dict, in file order."""
+    rows = []
+    step, arm = None, None
+    with open(path, errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("--- "):
+                step, arm = line[4:], None
+            elif line.startswith("· "):
+                arm = line[2:]
+            elif line.startswith("{") and '"metric"' in line:
+                try:
+                    rows.append((step, arm, json.loads(line)))
+                except json.JSONDecodeError:
+                    continue
+    return rows
+
+
+def is_stale(res):
+    """bench surfaces staleness at top level AND in extra precisely so
+    summaries like this one can't misattribute a replayed historical
+    number to the current session."""
+    return bool(res.get("stale") or res.get("extra", {}).get("stale"))
+
+
+def fmt(res):
+    e = res.get("extra", {})
+    util = (f"hbm {e['hbm_util']:.3f}" if e.get("util_basis", "").
+            startswith("hbm") else f"mfu {e.get('mfu', 0):.3f}")
+    return (f"{res.get('value', 0):>10,.0f} samples/s  {util}  "
+            f"{e.get('ms_per_step', 0):6.1f} ms/step  "
+            f"[{e.get('platform','?')} {e.get('preset','?')}"
+            f" b{e.get('batch','?')}]"
+            + ("  (STALE replay, not this session)" if is_stale(res)
+               else ""))
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else newest_log()
+    rows = parse(path)
+    if not rows:
+        print(f"{path}: no bench JSON lines found")
+        return 1
+    print(f"== {os.path.basename(path)} ==")
+    by_step = {}
+    for step, arm, res in rows:
+        by_step.setdefault(step, []).append((arm, res))
+    for step, arms in by_step.items():
+        print(f"\n--- {step}")
+        best = None
+        for arm, res in arms:
+            label = arm or res.get("metric", "?").split("_train")[0]
+            print(f"  {label:34s} {fmt(res)}")
+            v = res.get("value") or 0
+            if res.get("extra", {}).get("platform") == "tpu" \
+                    and (best is None or v > best[1]):
+                best = (label, v)
+        if best and len(arms) > 1:
+            print(f"  WINNER: {best[0]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

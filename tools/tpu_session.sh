@@ -146,4 +146,7 @@ run_all() {
 }
 
 run_all "${1:-}" 2>&1 | tee -a "$LOG"
-exit "${PIPESTATUS[0]}"
+rc="${PIPESTATUS[0]}"
+# decision summary (A/B winners per step) appended to the transcript
+python tools/session_report.py "$LOG" 2>&1 | tee -a "$LOG" || true
+exit "$rc"
