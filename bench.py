@@ -260,6 +260,18 @@ def run_child(model: str, preset: str, steps: int) -> int:
     devs = jax.devices()
     platform = devs[0].platform
     log(f"backend up: {devs[0].device_kind} ({platform}) x{len(devs)}")
+    if platform != "tpu" and not os.environ.get("BENCH_FORCE_CPU"):
+        # the sitecustomize registers platforms "axon,cpu": a FAST axon
+        # failure silently lands here on CPU with rc=0, which let a
+        # dead-tunnel session arm look measured. rc=75 (EX_TEMPFAIL —
+        # distinct from pytest's 0-5 and timeout's 124/137) is the
+        # shared tunnel-signature code (tools/_platform.py, note_rc in
+        # tools/tpu_session.sh); the ladder's CPU rung sets
+        # BENCH_FORCE_CPU so the deliberate fallback is unaffected.
+        log(f"child expected tpu but backend is {platform} — exiting "
+            f"rc=75 without measuring (tunnel down? set "
+            f"BENCH_FORCE_CPU=1 to measure on CPU deliberately)")
+        return 75
 
     ff, batch_data = build(model, preset)
     log("model built + compiled graph-side; warming up (jit compile)...")
@@ -310,7 +322,13 @@ def run_child(model: str, preset: str, steps: int) -> int:
                 f"{time.perf_counter() - t_c:.1f}s")
             break
         except Exception as exc:  # noqa: BLE001
-            if pd_try == 1 or "ran out of memory" not in str(exc).lower():
+            msg = str(exc).lower()
+            # XLA/TPU allocators phrase OOM three ways: "ran out of
+            # memory", "out of memory while trying to allocate", and
+            # bare RESOURCE_EXHAUSTED status strings
+            oom = ("out of memory" in msg or "resource_exhausted" in msg
+                   or "resource exhausted" in msg)
+            if pd_try == 1 or not oom:
                 raise
             log(f"multi-step scan OOM'd "
                 f"({str(exc).splitlines()[0][:120]}); "

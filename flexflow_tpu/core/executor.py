@@ -507,9 +507,27 @@ class Executor:
             return False
         # the double-buffered carry is the WHOLE donated TrainState:
         # params + op states + optimizer slots (Adam's m/v triple the
-        # param bytes), not just params
+        # param bytes), not just params — counted PER DEVICE: on a
+        # multi-device mesh a sharded leaf occupies only its shard
+        # bytes per chip, and comparing global bytes against one
+        # chip's bytes_limit would over-trigger the unrolled body
+        # (paying K-times compile) on models that actually fit scanned
+        def _per_device_bytes(x):
+            itemsize = jnp.dtype(x.dtype).itemsize
+            shd = getattr(x, "sharding", None)
+            if shd is not None:
+                try:
+                    shard_shape = shd.shard_shape(x.shape)
+                    n = 1
+                    for d in shard_shape:
+                        n *= d
+                    return n * itemsize
+                except Exception:
+                    pass
+            return x.size * itemsize
+
         pbytes = sum(
-            x.size * jnp.dtype(x.dtype).itemsize
+            _per_device_bytes(x)
             for x in jax.tree_util.tree_leaves(
                 (state.params, state.states, state.opt_state)))
         return pbytes > 0.25 * limit

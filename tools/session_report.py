@@ -77,14 +77,21 @@ def main():
     for step, arms in by_step.items():
         print(f"\n--- {step}")
         best = None
+        fresh_tpu = 0
         for arm, res in arms:
             label = arm or res.get("metric", "?").split("_train")[0]
             print(f"  {label:34s} {fmt(res)}")
             v = res.get("value") or 0
             if res.get("extra", {}).get("platform") == "tpu" \
-                    and (best is None or v > best[1]):
-                best = (label, v)
-        if best and len(arms) > 1:
+                    and not is_stale(res):
+                fresh_tpu += 1
+                if best is None or v > best[1]:
+                    best = (label, v)
+        # a WINNER line is decision-driving: only print one when at
+        # least two arms actually raced fresh on chip this session
+        # (stale replays and CPU fallbacks are excluded from `best`,
+        # so counting them in would crown a one-sided comparison)
+        if best and fresh_tpu > 1:
             print(f"  WINNER: {best[0]}")
     return 0
 

@@ -22,18 +22,48 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 # tunnel-death signature) separately from deterministic failures.
 TIMEOUTS=0
 SWEEP_INCOMPLETE=0
+MODE=""
+PROBE_OK_AT=0
+probe_tunnel() {  # probe_tunnel <timeout_s> — the one liveness probe
+  if timeout "$1" python -c \
+      "import jax; d=jax.devices()[0]; assert d.platform=='tpu'; print('TPU:', d.device_kind)"; then
+    PROBE_OK_AT=$(date +%s)
+    return 0
+  fi
+  return 1
+}
 note_rc() {
   local rc=$?
   echo "FAILED rc=$rc ($1)"
-  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ] || [ "$rc" -eq 75 ]; then
+    # 124/137 = step timed out/killed; 75 (EX_TEMPFAIL) = the step
+    # itself detected the axon->CPU silent fallback (bench.py --child /
+    # tools/_platform.py) — tunnel-death signatures, not deterministic
+    # failures (pytest's INTERNALERROR=3 must NOT block the sentinel)
     TIMEOUTS=$((TIMEOUTS + 1))
+  elif [ "$MODE" != "quick" ] && [ "$TIMEOUTS" -eq 0 ] \
+      && [ "$SWEEP_INCOMPLETE" -eq 0 ] \
+      && [ $(( $(date +%s) - PROBE_OK_AT )) -gt 90 ]; then
+    # a tunnel death that fails FAST with an untagged rc must also
+    # block the sentinel, or the step is silently skipped forever once
+    # the tunnel recovers before queue end — re-probe right after any
+    # failed step and count a dead probe as a timeout-equivalent.
+    # Skipped when: quick mode (never writes the sentinel), the
+    # sentinel is already blocked (TIMEOUTS>0), or a probe succeeded
+    # <90s ago (several deterministic failures back-to-back would
+    # otherwise burn minutes of a short window re-verifying liveness).
+    if ! probe_tunnel 60 >/dev/null 2>&1; then
+      echo "  (tunnel probe dead after failure — counting as timeout)"
+      TIMEOUTS=$((TIMEOUTS + 1))
+    fi
   fi
   return 0
 }
 
 run_all() {
+  MODE="${1:-}"
   echo "=== tpu session $(date -u +%FT%TZ) ==="
-  if ! timeout 120 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu'; print('TPU:', d.device_kind)"; then
+  if ! probe_tunnel 120; then
       echo "TPU backend not reachable; aborting"
       return 1
   fi
@@ -47,7 +77,7 @@ run_all() {
   # CPU/stale fallbacks) — an incomplete sweep must block the
   # full-queue sentinel so the next window re-runs in full
   BENCH_DEADLINE_S=2400 timeout 2600 python bench.py --all --steps 50 \
-      || { note_rc "bench sweep"; SWEEP_INCOMPLETE=1; }
+      || { SWEEP_INCOMPLETE=1; note_rc "bench sweep"; }
 
   echo "--- 1b. regenerate the README perf table from the fresh sweep"
   python tools/perf_report.py --write || note_rc "perf report"
@@ -58,7 +88,7 @@ run_all() {
   timeout 1800 python -m pytest tests_tpu/ -q -ra 2>&1 \
       || note_rc "tests_tpu"
 
-  if [ "${1:-}" != "quick" ]; then
+  if [ "$MODE" != "quick" ]; then
     # Ordering principle (windows observed at 2-29 min): SHORT,
     # decision-driving A/Bs first — each lands a committed artifact in
     # minutes — then the long instrumented tables (sim validation +
@@ -121,7 +151,7 @@ run_all() {
       | tee evidence/inception_audit_$(date -u +%Y%m%d).log \
       || note_rc "inception audit"
   fi
-  if [ "${1:-}" != "quick" ]; then
+  if [ "$MODE" != "quick" ]; then
     # full-queue completion sentinel for the watcher (every step above
     # is ||-protected, so reaching here proves nothing by itself).
     # Written only when (a) no step TIMED OUT — counted in $TIMEOUTS,
@@ -134,8 +164,7 @@ run_all() {
       echo "queue incomplete (timeouts=$TIMEOUTS" \
            "sweep_incomplete=$SWEEP_INCOMPLETE); full session will" \
            "re-run at the next window"
-    elif timeout 90 python -c \
-        "import jax; assert jax.devices()[0].platform=='tpu'"; then
+    elif probe_tunnel 90 >/dev/null; then
       touch .scratch/tpu_session_full_done
       echo "full queue completed with live tunnel; sentinel written"
     else
