@@ -156,6 +156,17 @@ class FFConfig:
     # fusion (reference: --fusion flag, model.cc:1472)
     perform_fusion: bool = False
 
+    # sibling-conv batching: convs that read the SAME tensor with the
+    # SAME geometry (the 1x1 branch heads of an Inception module)
+    # execute as ONE conv with their kernels concatenated along
+    # channel-out, outputs sliced back per branch. Exact numerics (each
+    # output channel's contraction is unchanged); the win is MXU lane
+    # occupancy — three couts of 192/160/160 pad to 256 lanes each
+    # (25-37% waste) where the merged 512 tiles perfectly. No reference
+    # analog (cuDNN picks per-conv algorithms instead,
+    # conv_2d.cu:173-260); this is the TPU-shaped counterpart.
+    sibling_conv_fusion: bool = True
+
     # remat: trade FLOPs for HBM (no reference analog; TPU-first)
     remat: bool = False
 
@@ -297,6 +308,7 @@ class FFConfig:
     }
     _NEG_BOOL_FLAGS = {
         "--no-sparse-embedding": "sparse_embedding_updates",
+        "--no-sibling-conv-fusion": "sibling_conv_fusion",
     }
 
     def parse_args(self, argv: Sequence[str]) -> None:

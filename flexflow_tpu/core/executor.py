@@ -130,6 +130,20 @@ class Executor:
             from .fusion import boundary_ops, compute_fusion_groups
             self._sharding_boundary = boundary_ops(
                 compute_fusion_groups(model, self.strategy))
+        # sibling-conv batching (core/fusion.conv_sibling_groups): the
+        # group leader runs the merged conv at its walk position; the
+        # other members pop their pre-sliced output. Skipped when a
+        # member has its own sharding strategy entry (a per-branch
+        # channel-out split would shard the merged conv differently).
+        self._conv_merge_leader = {}
+        if getattr(self.config, "sibling_conv_fusion", True):
+            from .fusion import _strategy_key, conv_sibling_groups
+            for group in conv_sibling_groups(model):
+                strat_keys = {_strategy_key(self.strategy, op.name)
+                              for op in group}
+                if len(strat_keys) > 1:
+                    continue
+                self._conv_merge_leader[group[0].name] = group
 
     # ---------------- initialization ----------------
     def init_state(self, rng) -> TrainState:
@@ -264,6 +278,9 @@ class Executor:
             values[t.uid] = inputs[t.name]
         new_states: Dict[str, Dict[str, jax.Array]] = {}
         aux_losses = []
+        # pre-sliced outputs of merged sibling convs, keyed by the
+        # member op that will claim them at its own walk position
+        merged_pending: Dict[str, jax.Array] = {}
         for op in self.model.ops:
             ctx = OpContext(
                 training=training,
@@ -281,7 +298,22 @@ class Executor:
             # with functional state (BN) or aux losses (MoE) are excluded —
             # their ctx side-channel values must not escape the
             # checkpointed trace (tracer leak otherwise).
-            if (self.config.remat and op.weight_specs()
+            if op.name in merged_pending:
+                ys = [merged_pending.pop(op.name)]
+            elif op.name in self._conv_merge_leader:
+                from ..ops.conv import merged_conv_forward
+                group = self._conv_merge_leader[op.name]
+                plist = [params.get(m.name, {}) for m in group]
+                if self.config.remat:
+                    outs = jax.checkpoint(
+                        lambda ps, x, _g=group:
+                        merged_conv_forward(_g, ps, x))(plist, xs[0])
+                else:
+                    outs = merged_conv_forward(group, plist, xs[0])
+                for m, y in zip(group[1:], outs[1:]):
+                    merged_pending[m.name] = y
+                ys = [outs[0]]
+            elif (self.config.remat and op.weight_specs()
                     and not op.state_specs()
                     and not getattr(op, "has_aux_loss", False)):
                 ys = jax.checkpoint(

@@ -71,3 +71,30 @@ def compute_fusion_groups(model, strategy: Optional[Strategy]
 def boundary_ops(groups: List[List[str]]) -> set:
     """Names of ops that end a fused group (where sharding is pinned)."""
     return {g[-1] for g in groups}
+
+
+def conv_sibling_groups(model) -> List[List]:
+    """Groups of Conv2D ops that read the SAME input tensor with the
+    SAME geometry — the 1x1 branch heads of an Inception module.
+
+    Such siblings execute as one conv with kernels concatenated along
+    channel-out (ops/conv.py merged_conv_forward): exact numerics, much
+    better MXU lane occupancy when each branch's cout is a poor fit for
+    the 128-lane tile. Members are returned in model.ops order; the
+    first is the group leader (executes the merged conv at its walk
+    position; the rest pop their pre-sliced output).
+
+    Grouping requires identical kernel/stride/padding/activation/
+    use_bias and groups == 1 (feature_group_count partitions cin, which
+    concatenation along cout would scramble).
+    """
+    by_key: Dict[Tuple, List] = {}
+    for op in model.ops:
+        if getattr(op, "op_type", None) != "conv2d":
+            continue
+        if op.groups != 1:
+            continue
+        key = (op.inputs[0].uid, op.kernel, op.stride, op.padding,
+               op.activation, op.use_bias)
+        by_key.setdefault(key, []).append(op)
+    return [g for g in by_key.values() if len(g) > 1]

@@ -132,6 +132,53 @@ class Conv2D(Op):
                 * (self.in_channels // self.groups) * kh * kw)
 
 
+def merged_conv_forward(ops: List["Conv2D"], params_list, x):
+    """Execute sibling Conv2D ops (core/fusion.conv_sibling_groups) as
+    ONE conv: kernels concatenate along channel-out, the output splits
+    back per member. Exact numerics — each output channel's contraction
+    is untouched; only MXU lane packing changes. The trace-time kernel
+    concat is a weight-sized copy (KBs for 1x1 convs), dwarfed by the
+    conv itself, and autodiff slices the cotangent back to the per-op
+    kernels so optimizer/checkpoint state stays per-layer.
+
+    All members share geometry by construction, so the leader's stride/
+    padding/activation speak for the group.
+    """
+    lead = ops[0]
+    ph, pw = lead.padding
+    nhwc = lead.model.config.conv_layout == "NHWC"
+    kernel = jnp.concatenate(
+        [p["kernel"].astype(x.dtype) for p in params_list], axis=0)
+    if nhwc:
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    y = lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=lead.stride,
+        padding=[(ph, ph), (pw, pw)],
+        dimension_numbers=(("NHWC", "OIHW", "NHWC") if nhwc
+                           else ("NCHW", "OIHW", "NCHW")),
+    )
+    bshape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
+    if lead.use_bias:
+        bias = jnp.concatenate(
+            [p["bias"] for p in params_list]).astype(y.dtype)
+        y = y + bias.reshape(bshape)
+    y = apply_activation(y, lead.activation)
+    sizes = [op.out_channels for op in ops]
+    offsets = [0]
+    for s in sizes:
+        offsets.append(offsets[-1] + s)
+    ch_axis = 3 if nhwc else 1
+    outs = []
+    for i in range(len(ops)):
+        sl = lax.slice_in_dim(y, offsets[i], offsets[i + 1], axis=ch_axis)
+        if nhwc:
+            sl = jnp.transpose(sl, (0, 3, 1, 2))
+        outs.append(sl)
+    return outs
+
+
 @register_op
 class Pool2D(Op):
     op_type = "pool2d"

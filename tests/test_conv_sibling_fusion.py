@@ -1,0 +1,110 @@
+"""Sibling-conv batching must be a pure execution change: convs that
+read the same tensor with the same geometry run as ONE merged conv
+(kernels concatenated along channel-out, outputs sliced back), and the
+losses/weights after training must match the unmerged walk. This is the
+TPU-shaped counterpart of the reference's per-shape cuDNN algorithm
+selection (src/ops/conv_2d.cu:173-260): there the fix for poor conv
+shapes is a better algorithm, here it is better MXU lane packing."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.core.fusion import conv_sibling_groups
+
+
+def _build_inception_module(fuse, layout="NCHW"):
+    """An Inception-ish module: three 1x1 branch heads on one input
+    (mergeable), one 1x1 on the pooled input (different tensor — NOT
+    mergeable), a 3x3 on one branch, then concat."""
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.sibling_conv_fusion = fuse
+    cfg.conv_layout = layout
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 16, 8, 8), name="input")
+    b1 = ff.conv2d(x, 12, 1, 1, 1, 1, 0, 0, activation="relu")
+    b2 = ff.conv2d(x, 6, 1, 1, 1, 1, 0, 0, activation="relu")
+    b3 = ff.conv2d(x, 10, 1, 1, 1, 1, 0, 0, activation="relu")
+    b3 = ff.conv2d(b3, 8, 3, 3, 1, 1, 1, 1, activation="relu")
+    p = ff.pool2d(x, 3, 3, 1, 1, 1, 1)
+    b4 = ff.conv2d(p, 4, 1, 1, 1, 1, 0, 0, activation="relu")
+    t = ff.concat([b1, b2, b3, b4], axis=1)
+    t = ff.flat(t)
+    t = ff.dense(t, 4)
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    return ff
+
+
+def test_sibling_groups_found():
+    ff = _build_inception_module(fuse=True)
+    groups = conv_sibling_groups(ff)
+    assert len(groups) == 1
+    (g,) = groups
+    # the three 1x1 heads on the module input — NOT the 3x3 (geometry),
+    # NOT the pool-projection (different input tensor)
+    assert [op.out_channels for op in g] == [12, 6, 10]
+    assert ff.executor._conv_merge_leader  # wired into the walk
+
+
+def test_different_stride_not_grouped():
+    cfg = FFConfig()
+    ff = FFModel(cfg)
+    x = ff.create_tensor((4, 8, 8, 8), name="input")
+    ff.conv2d(x, 4, 1, 1, 1, 1, 0, 0)
+    ff.conv2d(x, 4, 1, 1, 2, 2, 0, 0)  # stride differs
+    ff.conv2d(x, 4, 3, 3, 1, 1, 1, 1)  # kernel differs
+    assert conv_sibling_groups(ff) == []
+
+
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+def test_merged_matches_unmerged_training(layout):
+    rng = np.random.RandomState(0)
+    batches = [{"input": rng.randn(8, 16, 8, 8).astype(np.float32),
+                "label": rng.randint(0, 4, (8,))} for _ in range(3)]
+    a = _build_inception_module(fuse=False, layout=layout)
+    b = _build_inception_module(fuse=True, layout=layout)
+    for batch in batches:
+        la = float(a.train_batch(batch)["loss"])
+        lb = float(b.train_batch(batch)["loss"])
+        np.testing.assert_allclose(la, lb, rtol=2e-5)
+    for op in a.ops:
+        if not op.weight_specs():
+            continue
+        wa = a.get_weights(op.name)
+        wb = b.get_weights(op.name)
+        for k in wa:
+            np.testing.assert_allclose(
+                wa[k], wb[k], rtol=2e-4, atol=2e-5,
+                err_msg=f"{op.name}.{k} diverged under sibling fusion")
+
+
+def test_remat_composes_with_sibling_fusion():
+    rng = np.random.RandomState(1)
+    batch = {"input": rng.randn(8, 16, 8, 8).astype(np.float32),
+             "label": rng.randint(0, 4, (8,))}
+    a = _build_inception_module(fuse=False)
+    cfg_loss = float(a.train_batch(batch)["loss"])
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    cfg.sibling_conv_fusion = True
+    cfg.remat = True
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 16, 8, 8), name="input")
+    b1 = ff.conv2d(x, 12, 1, 1, 1, 1, 0, 0, activation="relu")
+    b2 = ff.conv2d(x, 6, 1, 1, 1, 1, 0, 0, activation="relu")
+    b3 = ff.conv2d(x, 10, 1, 1, 1, 1, 0, 0, activation="relu")
+    b3 = ff.conv2d(b3, 8, 3, 3, 1, 1, 1, 1, activation="relu")
+    p = ff.pool2d(x, 3, 3, 1, 1, 1, 1)
+    b4 = ff.conv2d(p, 4, 1, 1, 1, 1, 0, 0, activation="relu")
+    t = ff.concat([b1, b2, b3, b4], axis=1)
+    t = ff.flat(t)
+    t = ff.dense(t, 4)
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    np.testing.assert_allclose(
+        float(ff.train_batch(batch)["loss"]), cfg_loss, rtol=2e-5)
