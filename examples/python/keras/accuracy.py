@@ -1,0 +1,13 @@
+"""Expected-accuracy floors for the keras example zoo (reference:
+examples/python/keras/accuracy.py — the enum the CI accuracy tests
+assert against)."""
+
+from enum import Enum
+
+
+class ModelAccuracy(Enum):
+    MNIST_MLP = 90.0
+    MNIST_CNN = 98.0
+    REUTERS_MLP = 78.0
+    CIFAR10_CNN = 78.0
+    CIFAR10_ALEXNET = 71.0

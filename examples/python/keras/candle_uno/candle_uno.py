@@ -1,0 +1,91 @@
+"""Uno drug-response model on the Keras frontend (reference:
+examples/python/keras/candle_uno/candle_uno.py — per-feature dense
+towers concatenated into a regression trunk, parameters via the CANDLE
+Benchmark machinery, data via uno_data).
+
+  python examples/python/keras/candle_uno/candle_uno.py -e 1
+"""
+
+import os
+import sys
+
+file_path = os.path.dirname(os.path.realpath(__file__))
+sys.path.insert(0, file_path)
+sys.path.append(os.path.abspath(os.path.join(
+    file_path, "..", "..", "..", "..")))
+
+import numpy as np  # noqa: E402
+
+import uno as benchmark  # noqa: E402
+from default_utils import finalize_parameters  # noqa: E402
+from generic_utils import to_list  # noqa: E402
+from uno_data import CombinedDataGenerator, CombinedDataLoader  # noqa: E402
+
+from flexflow_tpu.frontends import keras  # noqa: E402
+
+
+def initialize_parameters(default_model="uno_default_model.txt"):
+    bmk = benchmark.BenchmarkUno(
+        benchmark.file_path, default_model, "keras", prog="uno_baseline",
+        desc="Build neural network based models to predict tumor "
+             "response to single and paired drugs.")
+    return finalize_parameters(bmk)
+
+
+def build_feature_model(input_shape, name="", dense_layers=(64, 64),
+                        activation="relu", residual=False):
+    x_input = keras.layers.Input(input_shape)
+    h = x_input
+    for width in to_list(dense_layers):
+        x = h
+        h = keras.layers.Dense(width, activation=activation)(h)
+        if residual and x.shape[-1] == h.shape[-1]:
+            h = keras.layers.Add()([h, x])
+    return x_input, h
+
+
+def build_model(params, loader):
+    inputs, towers = [], []
+    for fname, dim in loader.input_features.items():
+        if dim <= 1:
+            inp = keras.layers.Input((dim,))
+            inputs.append(inp)
+            towers.append(inp)
+            continue
+        inp, tower = build_feature_model(
+            (dim,), name=fname,
+            dense_layers=params["dense_feature_layers"],
+            activation=params["activation"],
+            residual=params["residual"])
+        inputs.append(inp)
+        towers.append(tower)
+    t = keras.layers.Concatenate(axis=1)(towers)
+    for width in to_list(params["dense"]):
+        t = keras.layers.Dense(width,
+                               activation=params["activation"])(t)
+    out = keras.layers.Dense(1)(t)
+    return keras.Model(inputs=inputs, outputs=out)
+
+
+def run(params):
+    loader = CombinedDataLoader(samples=params["samples"]).load()
+    model = build_model(params, loader)
+    model.compile(
+        optimizer=keras.SGD(learning_rate=params["learning_rate"]),
+        loss="mean_squared_error", metrics=["mse"])
+    gen = CombinedDataGenerator(loader,
+                                batch_size=params["batch_size"])
+    xs, y = gen.get_slice()
+    hist = model.fit(xs, y, batch_size=params["batch_size"],
+                     epochs=params["epochs"])
+    print(f"final loss: {hist[-1]['loss']:.4f}")
+    return hist
+
+
+def main():
+    params = initialize_parameters()
+    run(params)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""CANDLE-style benchmark parameter infrastructure (reference role:
+examples/python/keras/candle_uno/default_utils.py — Benchmark base
+class + finalize_parameters merging file defaults, registered
+additional definitions, and CLI flags into one param dict)."""
+
+import argparse
+
+from generic_utils import str2bool  # noqa: F401  (re-export, CANDLE API)
+
+DEFAULTS = {
+    "batch_size": 64,
+    "epochs": 1,
+    "learning_rate": 0.01,
+    "dense": [256, 128],
+    "dense_feature_layers": [64, 64],
+    "activation": "relu",
+    "residual": False,
+    "optimizer": "sgd",
+    "loss": "mse",
+    "use_synthetic_data": True,
+    "samples": 512,
+}
+
+
+class Benchmark:
+    """Holds the parameter registry for one benchmark. Subclasses add
+    entries via set_locals()."""
+
+    def __init__(self, file_path, default_model, framework,
+                 prog=None, desc=None):
+        self.file_path = file_path
+        self.default_model = default_model
+        self.framework = framework
+        self.prog = prog
+        self.desc = desc
+        self.required = set()
+        self.additional_definitions = []
+        self.set_locals()
+
+    def set_locals(self):  # overridden per benchmark
+        pass
+
+    def parser(self):
+        p = argparse.ArgumentParser(prog=self.prog,
+                                    description=self.desc)
+        for d in self.additional_definitions:
+            name = "--" + d["name"].replace("_", "-")
+            kw = {}
+            if d.get("type") is bool:
+                kw["type"] = str2bool
+            elif d.get("type"):
+                kw["type"] = d["type"]
+            if "default" in d:
+                kw["default"] = d["default"]
+            if d.get("nargs"):
+                kw["nargs"] = d["nargs"]
+            if d.get("choices"):
+                kw["choices"] = d["choices"]
+            p.add_argument(name, help=d.get("help", ""), **kw)
+        p.add_argument("-e", "--epochs", type=int)
+        p.add_argument("-b", "--batch-size", type=int)
+        return p
+
+
+def finalize_parameters(bmk, argv=None):
+    """DEFAULTS <- benchmark definitions <- CLI flags, left to right."""
+    params = dict(DEFAULTS)
+    for d in bmk.additional_definitions:
+        if "default" in d:
+            params[d["name"]] = d["default"]
+    args, _ = bmk.parser().parse_known_args(argv)
+    for k, v in vars(args).items():
+        if v is not None:
+            params[k] = v
+    missing = bmk.required - set(params)
+    if missing:
+        raise ValueError(f"missing required params: {sorted(missing)}")
+    return params

@@ -1,0 +1,26 @@
+"""File resolution for the candle_uno suite (reference role:
+examples/python/keras/candle_uno/file_utils.py — download-and-cache
+from the CANDLE data portal). This environment has no network egress,
+so get_file resolves local paths and fails loudly on URLs instead of
+silently hanging."""
+
+import os
+
+
+def get_file(fname, origin=None, cache_dir=None):
+    """Return a local path for `fname`. A plain existing path passes
+    through; a URL origin raises with a clear offline message."""
+    if os.path.exists(fname):
+        return fname
+    cache_dir = cache_dir or os.path.join(
+        os.path.expanduser("~"), ".candle_cache")
+    cached = os.path.join(cache_dir, fname)
+    if os.path.exists(cached):
+        return cached
+    if origin:
+        raise RuntimeError(
+            f"{fname} not cached and this environment has no network "
+            f"egress (origin={origin}); place the file at {cached} or "
+            f"run with synthetic data (use_synthetic_data=True, the "
+            f"default in this suite)")
+    raise FileNotFoundError(fname)

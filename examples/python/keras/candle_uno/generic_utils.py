@@ -1,0 +1,19 @@
+"""Small shared helpers for the candle_uno suite (reference role:
+examples/python/keras/candle_uno/generic_utils.py)."""
+
+
+def to_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+def str2bool(v):
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("yes", "true", "t", "1")
+
+
+class Struct:
+    """Dot-access view over a parameter dict."""
+
+    def __init__(self, **entries):
+        self.__dict__.update(entries)

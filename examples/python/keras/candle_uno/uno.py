@@ -1,0 +1,39 @@
+"""Uno benchmark definition (reference role:
+examples/python/keras/candle_uno/uno.py — BenchmarkUno parameter spec
+for the drug-response model)."""
+
+import os
+import sys
+
+file_path = os.path.dirname(os.path.realpath(__file__))
+sys.path.insert(0, file_path)
+
+from default_utils import Benchmark  # noqa: E402
+
+additional_definitions = [
+    {"name": "agg_dose", "type": str, "default": None,
+     "choices": ["AUC", "IC50", "EC50", "HS"],
+     "help": "dose-independent response aggregation metric"},
+    {"name": "cell_features", "nargs": "+", "default": ["rnaseq"],
+     "choices": ["rnaseq", "none"],
+     "help": "cell line feature set"},
+    {"name": "drug_features", "nargs": "+", "default": ["descriptors"],
+     "choices": ["descriptors", "none"],
+     "help": "drug feature set"},
+    {"name": "dense_feature_layers", "nargs": "+", "type": int,
+     "default": [64, 64],
+     "help": "per-feature tower widths"},
+    {"name": "residual", "type": bool, "default": False,
+     "help": "residual connections inside towers"},
+    {"name": "samples", "type": int, "default": 512,
+     "help": "synthetic sample count"},
+]
+
+required = {"batch_size", "epochs", "learning_rate", "dense",
+            "activation", "loss"}
+
+
+class BenchmarkUno(Benchmark):
+    def set_locals(self):
+        self.required = set(required)
+        self.additional_definitions = additional_definitions

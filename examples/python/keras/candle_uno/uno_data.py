@@ -1,0 +1,53 @@
+"""Data provider for the candle_uno suite (reference role:
+examples/python/keras/candle_uno/uno_data.py — CombinedDataLoader /
+CombinedDataGenerator over the CANDLE drug-response CSVs). Offline by
+design: synthetic cell-line/drug feature frames with a planted linear
+response, so the model has real signal to fit without any downloads."""
+
+import numpy as np
+
+FEATURE_SHAPES = {
+    "dose": 1,
+    "cell.rnaseq": 64,
+    "drug1.descriptors": 48,
+}
+
+
+class CombinedDataLoader:
+    def __init__(self, seed=2018, samples=512):
+        self.seed = seed
+        self.samples = samples
+        self.input_features = dict(FEATURE_SHAPES)
+
+    def load(self):
+        rng = np.random.RandomState(self.seed)
+        n = self.samples
+        self.x = {k: rng.randn(n, d).astype(np.float32)
+                  for k, d in self.input_features.items()}
+        # planted response: dose-weighted combination of a few feature
+        # columns + noise, in [0, 1] like AUC
+        raw = (self.x["dose"][:, 0]
+               + 0.5 * self.x["cell.rnaseq"][:, :4].sum(axis=1)
+               - 0.3 * self.x["drug1.descriptors"][:, :4].sum(axis=1))
+        raw = raw + 0.05 * rng.randn(n).astype(np.float32)
+        self.y = ((raw - raw.min()) / (np.ptp(raw) + 1e-9)) \
+            .astype(np.float32).reshape(n, 1)
+        return self
+
+
+class CombinedDataGenerator:
+    """Mini-batch iterator over a loaded CombinedDataLoader."""
+
+    def __init__(self, loader, batch_size=64):
+        self.loader = loader
+        self.batch_size = batch_size
+
+    def flow(self):
+        n = len(self.loader.y)
+        for i in range(0, n - self.batch_size + 1, self.batch_size):
+            xs = [v[i:i + self.batch_size]
+                  for v in self.loader.x.values()]
+            yield xs, self.loader.y[i:i + self.batch_size]
+
+    def get_slice(self):
+        return list(self.loader.x.values()), self.loader.y

@@ -1,0 +1,48 @@
+"""Reuters topic-classification MLP, Sequential-API variant
+(reference: examples/python/keras/seq_reuters_mlp.py — the Sequential
+twin of reuters_mlp.py's functional build).
+
+  python examples/python/keras/seq_reuters_mlp.py -e 1
+"""
+
+import argparse
+
+import numpy as np
+
+from flexflow_tpu.frontends import keras
+from flexflow_tpu.frontends.keras.datasets import reuters
+
+
+def vectorize(seqs, dim):
+    out = np.zeros((len(seqs), dim), np.float32)
+    for i, s in enumerate(seqs):
+        out[i, np.asarray(list(s), np.int64) % dim] = 1.0
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-e", "--epochs", type=int, default=2)
+    ap.add_argument("--max-words", type=int, default=1000)
+    ap.add_argument("-n", "--samples", type=int, default=2048)
+    args, _ = ap.parse_known_args()
+
+    (x_train, y_train), _ = reuters.load_data(num_words=args.max_words)
+    x = vectorize(x_train[:args.samples], args.max_words)
+    y = np.asarray(y_train[:args.samples], np.int32)
+    classes = max(46, int(y.max()) + 1)
+
+    model = keras.Sequential([
+        keras.layers.Dense(512, activation="relu",
+                           input_shape=(args.max_words,)),
+        keras.layers.Dense(classes, activation="softmax"),
+    ])
+    model.compile(optimizer=keras.SGD(learning_rate=0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    hist = model.fit(x, y, batch_size=64, epochs=args.epochs)
+    print(f"final accuracy: {hist[-1]['accuracy']:.4f}")
+
+
+if __name__ == "__main__":
+    main()

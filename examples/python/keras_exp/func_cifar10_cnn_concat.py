@@ -1,0 +1,48 @@
+"""keras_exp functional CIFAR-10 CNN with branch concat (reference:
+examples/python/keras_exp/func_cifar10_cnn_concat.py). Import-gated:
+without tensorflow this prints a clear skip and exits 0.
+
+  python examples/python/keras_exp/func_cifar10_cnn_concat.py -e 1
+"""
+
+import sys
+
+import numpy as np
+
+from flexflow_tpu.frontends.keras_exp import HAS_TF
+
+
+def top_level_task():
+    if not HAS_TF:
+        print("tensorflow not installed; skipping "
+              "(pip install tensorflow to run)")
+        return
+
+    from tensorflow import keras as tfk
+
+    from flexflow_tpu.frontends.keras_exp import from_tf_keras
+
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+
+    inp = tfk.Input((3, 32, 32), name="input")
+    a = tfk.layers.Conv2D(32, 3, padding="same", activation="relu",
+                          data_format="channels_first")(inp)
+    b = tfk.layers.Conv2D(32, 3, padding="same", activation="relu",
+                          data_format="channels_first")(inp)
+    t = tfk.layers.Concatenate(axis=1)([a, b])
+    t = tfk.layers.MaxPooling2D(2, data_format="channels_first")(t)
+    t = tfk.layers.Flatten()(t)
+    out = tfk.layers.Dense(10, activation="softmax")(t)
+    ff = from_tf_keras(tfk.Model(inp, out), batch_size=16)
+    ff.compile(loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (64,)).astype(np.int32)
+    ff.fit({"input": x}, y, epochs=epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,9 +1,8 @@
-"""keras_exp-frontend example (reference:
-examples/python/keras_exp/mnist_mlp.py — import a REAL tf.keras model
-object). Import-gated: without tensorflow this prints a clear skip
-message and exits 0.
+"""keras_exp functional MLP with tower concat (reference:
+examples/python/keras_exp/func_mnist_mlp_concat.py). Import-gated:
+without tensorflow this prints a clear skip and exits 0.
 
-  python examples/python/keras_exp/func_mnist_mlp_exp.py -e 1
+  python examples/python/keras_exp/func_mnist_mlp_concat.py -e 1
 """
 
 import sys
@@ -27,11 +26,11 @@ def top_level_task():
         if "-e" in sys.argv else 1
 
     inp = tfk.Input((784,), name="input")
-    t = tfk.layers.Dense(256, activation="relu")(inp)
+    a = tfk.layers.Dense(256, activation="relu")(inp)
+    b = tfk.layers.Dense(256, activation="relu")(inp)
+    t = tfk.layers.Concatenate(axis=1)([a, b])
     out = tfk.layers.Dense(10, activation="softmax")(t)
-    tf_model = tfk.Model(inp, out)
-
-    ff = from_tf_keras(tf_model, batch_size=64)
+    ff = from_tf_keras(tfk.Model(inp, out), batch_size=64)
     ff.compile(loss_type="sparse_categorical_crossentropy",
                metrics=["accuracy"])
 
@@ -39,8 +38,7 @@ def top_level_task():
     x = rng.randn(512, 784).astype(np.float32)
     w = rng.randn(784, 10).astype(np.float32)
     y = np.argmax(x @ w, axis=1).astype(np.int32)
-    hist = ff.fit({ff.input_tensors[0].name: x}, y, epochs=epochs)
-    print(f"final accuracy: {hist[-1]['accuracy']:.3f}")
+    ff.fit({"input": x}, y, epochs=epochs)
 
 
 if __name__ == "__main__":

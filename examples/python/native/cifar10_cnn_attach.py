@@ -1,0 +1,55 @@
+"""CIFAR-10 CNN driven by attached per-tensor data loaders (reference:
+examples/python/native/cifar10_cnn_attach.py — the SingleDataLoader
+attach variant of cifar10_cnn.py; see mnist_mlp_attach.py for the MLP
+twin).
+
+  python -m flexflow_tpu examples/python/native/cifar10_cnn_attach.py -e 1
+"""
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    bs = cfg.batch_size
+    ff = FFModel(cfg)
+    x = ff.create_tensor((bs, 3, 32, 32), name="input")
+    t = ff.conv2d(x, 32, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = ff.conv2d(t, 32, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.conv2d(t, 64, 3, 3, 1, 1, 1, 1, activation="relu")
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 512, activation="relu")
+    t = ff.dense(t, 10)
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    import sys
+    n = 64
+    if "--samples" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--samples") + 1])
+    rng = np.random.RandomState(cfg.seed)
+    xs = rng.randn(n, 3, 32, 32).astype(np.float32)
+    ys = rng.randint(0, 10, (n,)).astype(np.int32)
+
+    x_loader = ff.create_data_loader("input", xs)
+    y_loader = ff.create_data_loader("label", ys)
+    steps = n // bs
+    for epoch in range(cfg.epochs):
+        x_loader.reset()
+        y_loader.reset()
+        last = None
+        for _ in range(steps):
+            batch = {"input": x_loader.next_batch(),
+                     "label": y_loader.next_batch()}
+            last = ff.train_batch(batch)
+        print(f"epoch {epoch}: loss={float(last['loss']):.4f}")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,42 @@
+"""Inline-map demo: read and mutate attached tensor data on the host
+(reference: examples/python/native/print_input.py —
+inline_map/get_array over input tensors; here the analog is the
+data-loader attach + host-side numpy views, since JAX arrays are
+host-visible by construction).
+
+  python -m flexflow_tpu examples/python/native/print_input.py
+"""
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel
+
+
+def top_level_task():
+    cfg = FFConfig.from_args()
+    bs = cfg.batch_size
+    ff = FFModel(cfg)
+    ff.create_tensor((bs, 3, 8, 8), name="input1")
+    ff.create_tensor((bs, 256), name="input2")
+
+    rng = np.random.RandomState(cfg.seed)
+    x1 = rng.randn(bs * 2, 3, 8, 8).astype(np.float32)
+    x2 = np.zeros((bs * 2, 256), np.float32) + 2.2
+
+    loader1 = ff.create_data_loader("input1", x1)
+    loader2 = ff.create_data_loader("input2", x2)
+    loader1.reset()
+    loader2.reset()
+    b1 = np.asarray(loader1.next_batch())
+    b2 = np.asarray(loader2.next_batch())
+    print(b1.shape)
+    print(b1)
+    print(b2.shape)
+    print(b2)
+    assert b1.shape == (bs, 3, 8, 8)
+    assert float(b2[0, 0]) == np.float32(2.2)
+    print("print_input OK")
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,12 @@
+"""Expected-accuracy floors for the onnx example zoo (reference:
+examples/python/onnx/accuracy.py — an enum of per-model accuracy
+floors the CI accuracy tests assert against)."""
+
+from enum import Enum
+
+
+class ModelAccuracy(Enum):
+    MNIST_MLP = 90.0
+    MNIST_CNN = 98.0
+    CIFAR10_CNN = 78.0
+    CIFAR10_ALEXNET = 71.0

@@ -1,0 +1,41 @@
+"""Export a torch AlexNet to .onnx for the importer example
+(reference: examples/python/onnx/alexnet_pt.py — the export half;
+onnx/alexnet.py trains the file. CIFAR-sized 32x32 input like the
+in-tree native alexnet so the training half is a fast smoke).
+
+  python examples/python/onnx/alexnet_pt.py [alexnet.onnx]
+"""
+
+import os
+import sys
+
+import torch
+import torch.nn as nn
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))))
+
+
+def make_alexnet(num_classes=10):
+    return nn.Sequential(
+        nn.Conv2d(3, 64, 5, 1, 2), nn.ReLU(), nn.MaxPool2d(2, 2),
+        nn.Conv2d(64, 192, 3, 1, 1), nn.ReLU(), nn.MaxPool2d(2, 2),
+        nn.Conv2d(192, 384, 3, 1, 1), nn.ReLU(),
+        nn.Conv2d(384, 256, 3, 1, 1), nn.ReLU(),
+        nn.Conv2d(256, 256, 3, 1, 1), nn.ReLU(), nn.MaxPool2d(2, 2),
+        nn.Flatten(),
+        nn.Linear(256 * 4 * 4, 1024), nn.ReLU(),
+        nn.Linear(1024, 1024), nn.ReLU(),
+        nn.Linear(1024, num_classes), nn.Softmax(dim=-1))
+
+
+def main():
+    from flexflow_tpu.frontends.onnx import export_torch_onnx
+    out = sys.argv[1] if len(sys.argv) > 1 else "alexnet.onnx"
+    export_torch_onnx(make_alexnet(), torch.randn(16, 3, 32, 32), out,
+                      input_names=["input"])
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

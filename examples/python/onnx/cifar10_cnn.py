@@ -1,0 +1,54 @@
+"""Import the CIFAR-10 CNN .onnx graph and train it (reference:
+examples/python/onnx/cifar10_cnn.py; export half is
+cifar10_cnn_pt.py. Exports in-process when no file is given).
+
+  python examples/python/onnx/cifar10_cnn.py [cnn.onnx] -e 1
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+import torch
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from cifar10_cnn_pt import make_cnn  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer  # noqa: E402
+from flexflow_tpu.frontends.onnx import (ONNXModel,  # noqa: E402
+                                         export_torch_onnx)
+
+
+def top_level_task():
+    args = [a for a in sys.argv[1:] if a.endswith(".onnx")]
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = 16
+
+    if args:
+        om = ONNXModel(args[0])
+    else:
+        with tempfile.NamedTemporaryFile(suffix=".onnx") as f:
+            export_torch_onnx(make_cnn(), torch.randn(bs, 3, 32, 32),
+                              f.name, input_names=["input"])
+            om = ONNXModel(f.name)
+
+    cfg = FFConfig.from_args()
+    cfg.batch_size = bs
+    ff = FFModel(cfg)
+    inp = ff.create_tensor((bs, 3, 32, 32), name="input")
+    om.apply(ff, {"input": inp})
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    n = int(os.environ.get("SAMPLES", 64))
+    x = rng.randn(n, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (n,)).astype(np.int32)
+    ff.fit({"input": x}, y, epochs=epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

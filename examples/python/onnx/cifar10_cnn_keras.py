@@ -1,0 +1,68 @@
+"""Export a tf.keras CIFAR-10 CNN to .onnx and train it (reference:
+examples/python/onnx/cifar10_cnn_keras.py). Gated like
+mnist_mlp_keras.py: without tensorflow/tf2onnx this prints a clear
+skip and exits 0 (cifar10_cnn_pt.py is the torch-export equivalent).
+
+  python examples/python/onnx/cifar10_cnn_keras.py -e 1
+"""
+
+import sys
+
+
+def top_level_task():
+    try:
+        import tensorflow as tf  # noqa: F401
+        import tf2onnx  # noqa: F401
+    except ImportError:
+        print("tensorflow/tf2onnx not installed; skipping "
+              "(examples/python/onnx/cifar10_cnn_pt.py is the "
+              "torch-export equivalent)")
+        return
+
+    import tempfile
+
+    import numpy as np
+    from tensorflow import keras as tfk
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.frontends.onnx import ONNXModel
+
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = 16
+
+    model = tfk.Sequential([
+        tfk.layers.Conv2D(32, 3, padding="same", activation="relu",
+                          input_shape=(3, 32, 32),
+                          data_format="channels_first"),
+        tfk.layers.Conv2D(32, 3, padding="same", activation="relu",
+                          data_format="channels_first"),
+        tfk.layers.MaxPooling2D(2, data_format="channels_first"),
+        tfk.layers.Flatten(),
+        tfk.layers.Dense(512, activation="relu"),
+        tfk.layers.Dense(10, activation="softmax")])
+    spec = (tf.TensorSpec((bs, 3, 32, 32), tf.float32, name="input"),)
+    with tempfile.NamedTemporaryFile(suffix=".onnx") as f:
+        import tf2onnx.convert
+        tf2onnx.convert.from_keras(model, input_signature=spec,
+                                   output_path=f.name)
+        om = ONNXModel(f.name)
+
+    cfg = FFConfig.from_args()
+    cfg.batch_size = bs
+    ff = FFModel(cfg)
+    inp = ff.create_tensor((bs, 3, 32, 32), name="input")
+    om.apply(ff, {"input": inp})
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    n = 64
+    x = rng.randn(n, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (n,)).astype(np.int32)
+    ff.fit({"input": x}, y, epochs=epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,38 @@
+"""Export a torch CIFAR-10 CNN to .onnx (reference:
+examples/python/onnx/cifar10_cnn_pt.py; onnx/cifar10_cnn.py trains
+the exported file).
+
+  python examples/python/onnx/cifar10_cnn_pt.py [cnn.onnx]
+"""
+
+import os
+import sys
+
+import torch
+import torch.nn as nn
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))))
+
+
+def make_cnn(num_classes=10):
+    return nn.Sequential(
+        nn.Conv2d(3, 32, 3, 1, 1), nn.ReLU(),
+        nn.Conv2d(32, 32, 3, 1, 1), nn.ReLU(), nn.MaxPool2d(2, 2),
+        nn.Conv2d(32, 64, 3, 1, 1), nn.ReLU(),
+        nn.Conv2d(64, 64, 3, 1, 1), nn.ReLU(), nn.MaxPool2d(2, 2),
+        nn.Flatten(),
+        nn.Linear(64 * 8 * 8, 512), nn.ReLU(),
+        nn.Linear(512, num_classes), nn.Softmax(dim=-1))
+
+
+def main():
+    from flexflow_tpu.frontends.onnx import export_torch_onnx
+    out = sys.argv[1] if len(sys.argv) > 1 else "cifar10_cnn.onnx"
+    export_torch_onnx(make_cnn(), torch.randn(16, 3, 32, 32), out,
+                      input_names=["input"])
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Import the MNIST MLP .onnx graph and train it (reference:
+examples/python/onnx/mnist_mlp.py; export half is mnist_mlp_pt.py.
+Exports in-process when no file is given; see also mnist_mlp_onnx.py,
+the original in-tree round-trip demo).
+
+  python examples/python/onnx/mnist_mlp.py [mnist_mlp.onnx] -e 1
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+import torch
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mnist_mlp_pt import make_mlp  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer  # noqa: E402
+from flexflow_tpu.frontends.onnx import (ONNXModel,  # noqa: E402
+                                         export_torch_onnx)
+
+
+def top_level_task():
+    args = [a for a in sys.argv[1:] if a.endswith(".onnx")]
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = 64
+
+    if args:
+        om = ONNXModel(args[0])
+    else:
+        with tempfile.NamedTemporaryFile(suffix=".onnx") as f:
+            export_torch_onnx(make_mlp(), torch.randn(bs, 784), f.name,
+                              input_names=["input"])
+            om = ONNXModel(f.name)
+
+    cfg = FFConfig.from_args()
+    cfg.batch_size = bs
+    ff = FFModel(cfg)
+    inp = ff.create_tensor((bs, 784), name="input")
+    om.apply(ff, {"input": inp})
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(1024, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    ff.fit({"input": x}, y, epochs=epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,63 @@
+"""Export a tf.keras MNIST MLP to .onnx and train it (reference:
+examples/python/onnx/mnist_mlp_keras.py — keras2onnx export). Gated:
+tensorflow is not a dependency of this image; without it the script
+prints a clear skip and exits 0 (mnist_mlp_pt.py is the torch-export
+equivalent that always runs).
+
+  python examples/python/onnx/mnist_mlp_keras.py -e 1
+"""
+
+import sys
+
+
+def top_level_task():
+    try:
+        import tensorflow as tf  # noqa: F401
+        import tf2onnx  # noqa: F401
+    except ImportError:
+        print("tensorflow/tf2onnx not installed; skipping "
+              "(examples/python/onnx/mnist_mlp_pt.py is the "
+              "torch-export equivalent)")
+        return
+
+    import tempfile
+
+    import numpy as np
+    from tensorflow import keras as tfk
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.frontends.onnx import ONNXModel
+
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = 64
+
+    model = tfk.Sequential([
+        tfk.layers.Dense(512, activation="relu", input_shape=(784,)),
+        tfk.layers.Dense(512, activation="relu"),
+        tfk.layers.Dense(10, activation="softmax")])
+    spec = (tf.TensorSpec((bs, 784), tf.float32, name="input"),)
+    with tempfile.NamedTemporaryFile(suffix=".onnx") as f:
+        import tf2onnx.convert
+        tf2onnx.convert.from_keras(model, input_signature=spec,
+                                   output_path=f.name)
+        om = ONNXModel(f.name)
+
+    cfg = FFConfig.from_args()
+    cfg.batch_size = bs
+    ff = FFModel(cfg)
+    inp = ff.create_tensor((bs, 784), name="input")
+    om.apply(ff, {"input": inp})
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(1024, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    ff.fit({"input": x}, y, epochs=epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,34 @@
+"""Export a torch MNIST MLP to .onnx (reference:
+examples/python/onnx/mnist_mlp_pt.py; onnx/mnist_mlp.py trains the
+exported file).
+
+  python examples/python/onnx/mnist_mlp_pt.py [mnist_mlp.onnx]
+"""
+
+import os
+import sys
+
+import torch
+import torch.nn as nn
+
+sys.path.append(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))))
+
+
+def make_mlp(num_classes=10):
+    return nn.Sequential(
+        nn.Linear(784, 512), nn.ReLU(),
+        nn.Linear(512, 512), nn.ReLU(),
+        nn.Linear(512, num_classes), nn.Softmax(dim=-1))
+
+
+def main():
+    from flexflow_tpu.frontends.onnx import export_torch_onnx
+    out = sys.argv[1] if len(sys.argv) > 1 else "mnist_mlp.onnx"
+    export_torch_onnx(make_mlp(), torch.randn(64, 784), out,
+                      input_names=["input"])
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Import the ResNet .onnx graph and train it (reference:
+examples/python/onnx/resnet.py; export half is resnet_pt.py. Exports
+in-process when no file is given).
+
+  python examples/python/onnx/resnet.py [resnet.onnx] -e 1
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+import torch
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.append(os.path.join(os.path.dirname(_here), "pytorch"))
+from resnet_defs import resnet18  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer  # noqa: E402
+from flexflow_tpu.frontends.onnx import (ONNXModel,  # noqa: E402
+                                         export_torch_onnx)
+
+
+def top_level_task():
+    args = [a for a in sys.argv[1:] if a.endswith(".onnx")]
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = 16
+
+    if args:
+        om = ONNXModel(args[0])
+    else:
+        with tempfile.NamedTemporaryFile(suffix=".onnx") as f:
+            export_torch_onnx(resnet18(num_classes=10, image_size=32),
+                              torch.randn(bs, 3, 32, 32), f.name,
+                              input_names=["input"])
+            om = ONNXModel(f.name)
+
+    cfg = FFConfig.from_args()
+    cfg.batch_size = bs
+    ff = FFModel(cfg)
+    inp = ff.create_tensor((bs, 3, 32, 32), name="input")
+    om.apply(ff, {"input": inp})
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    n = int(os.environ.get("SAMPLES", 64))
+    x = rng.randn(n, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (n,)).astype(np.int32)
+    ff.fit({"input": x}, y, epochs=epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

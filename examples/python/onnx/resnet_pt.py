@@ -1,0 +1,29 @@
+"""Export a torch ResNet-18 to .onnx (reference:
+examples/python/onnx/resnet_pt.py; onnx/resnet.py trains the exported
+file — residual Adds exercise the importer's elementwise path).
+
+  python examples/python/onnx/resnet_pt.py [resnet.onnx]
+"""
+
+import os
+import sys
+
+import torch
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.append(os.path.join(os.path.dirname(_here), "pytorch"))
+sys.path.append(os.path.dirname(os.path.dirname(os.path.dirname(_here))))
+from resnet_defs import resnet18  # noqa: E402
+
+
+def main():
+    from flexflow_tpu.frontends.onnx import export_torch_onnx
+    out = sys.argv[1] if len(sys.argv) > 1 else "resnet.onnx"
+    export_torch_onnx(resnet18(num_classes=10, image_size=32),
+                      torch.randn(16, 3, 32, 32), out,
+                      input_names=["input"])
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Train an fx-exported CIFAR-10 CNN graph file (reference:
+examples/python/pytorch/cifar10_cnn.py — loads cnn.ff and trains; the
+export half is cifar10_cnn_torch.py. Exports in-process when no path
+is given).
+
+  python examples/python/pytorch/cifar10_cnn.py [cnn.ff] -e 1
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+import torch.nn as nn
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.frontends.torchfx import PyTorchModel, export_ff
+
+
+def make_cnn():
+    return nn.Sequential(
+        nn.Conv2d(3, 32, 3, 1, 1), nn.ReLU(),
+        nn.Conv2d(32, 32, 3, 1, 1), nn.ReLU(),
+        nn.MaxPool2d(2, 2),
+        nn.Conv2d(32, 64, 3, 1, 1), nn.ReLU(),
+        nn.Conv2d(64, 64, 3, 1, 1), nn.ReLU(),
+        nn.MaxPool2d(2, 2),
+        nn.Flatten(),
+        nn.Linear(64 * 8 * 8, 512), nn.ReLU(),
+        nn.Linear(512, 10), nn.Softmax(dim=-1))
+
+
+def top_level_task():
+    args = [a for a in sys.argv[1:] if a.endswith(".ff")]
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = 16
+
+    td = None
+    if args:
+        path = args[0]
+    else:
+        td = tempfile.TemporaryDirectory()
+        path = os.path.join(td.name, "cnn.ff")
+        export_ff(make_cnn(), path)
+    ptm = PyTorchModel(path)
+
+    cfg = FFConfig.from_args()
+    cfg.batch_size = bs
+    ff = FFModel(cfg)
+    inp = ff.create_tensor((bs, 3, 32, 32), name="input")
+    ptm.apply(ff, [inp])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    n = int(os.environ.get("SAMPLES", 64))
+    x = rng.randn(n, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (n,)).astype(np.int32)
+    ff.fit({"input": x}, y, epochs=epochs)
+    if td is not None:
+        td.cleanup()
+
+
+if __name__ == "__main__":
+    top_level_task()

@@ -1,0 +1,22 @@
+"""Trace a RegNetX model to a .ff graph file (reference:
+examples/python/pytorch/export_regnet_fx.py — classy_vision's
+RegNetX32gf through flexflow.torch.fx; the in-tree RegNetX blocks
+stand in, see regnet_defs.py).
+
+  python examples/python/pytorch/export_regnet_fx.py [out.ff]
+"""
+
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)
+# runnable directly (no launcher): repo root for flexflow_tpu
+sys.path.append(os.path.dirname(os.path.dirname(os.path.dirname(_here))))
+from regnet_defs import regnet_x  # noqa: E402
+
+from flexflow_tpu.frontends.torchfx import export_ff  # noqa: E402
+
+out = sys.argv[1] if len(sys.argv) > 1 else "regnetx.ff"
+export_ff(regnet_x(), out)
+print(f"wrote {out}")

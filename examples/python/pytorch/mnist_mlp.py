@@ -1,0 +1,57 @@
+"""Train an fx-exported MLP graph file (reference:
+examples/python/pytorch/mnist_mlp.py — the import half of the
+round trip; mnist_mlp_torch.py is the export half. If no path is
+given, the graph is exported in-process first).
+
+  python examples/python/pytorch/mnist_mlp.py [mnist_mlp.ff] -e 1
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+import torch.nn as nn
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.frontends.torchfx import PyTorchModel, export_ff
+
+
+def top_level_task():
+    args = [a for a in sys.argv[1:] if a.endswith(".ff")]
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = 64
+
+    td = None
+    if args:
+        path = args[0]
+    else:
+        td = tempfile.TemporaryDirectory()
+        path = os.path.join(td.name, "mnist_mlp.ff")
+        export_ff(nn.Sequential(
+            nn.Linear(784, 512), nn.ReLU(),
+            nn.Linear(512, 512), nn.ReLU(),
+            nn.Linear(512, 10), nn.Softmax(dim=-1)), path)
+    ptm = PyTorchModel(path)
+
+    cfg = FFConfig.from_args()
+    cfg.batch_size = bs
+    ff = FFModel(cfg)
+    inp = ff.create_tensor((bs, 784), name="input")
+    ptm.apply(ff, [inp])
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(1024, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    ff.fit({"input": x}, y, epochs=epochs)
+    if td is not None:
+        td.cleanup()
+
+
+if __name__ == "__main__":
+    top_level_task()

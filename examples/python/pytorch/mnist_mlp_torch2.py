@@ -1,0 +1,60 @@
+"""Pure-torch MLP baseline, module-class variant (reference:
+examples/python/pytorch/mnist_mlp_torch2.py — same network as
+mnist_mlp.py trained directly in torch, for loss-trajectory
+comparison against the framework import path).
+
+  python examples/python/pytorch/mnist_mlp_torch2.py -e 1
+"""
+
+import sys
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+
+class MLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 512)
+        self.fc2 = nn.Linear(512, 512)
+        self.fc3 = nn.Linear(512, 10)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self.relu(self.fc1(x))
+        x = self.relu(self.fc2(x))
+        return self.fc3(x)
+
+
+def main():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = 64
+    torch.manual_seed(0)
+    model = MLP()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    loss_fn = nn.CrossEntropyLoss()
+
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(1024, 784).astype(np.float32)
+    w = rng.randn(784, 10).astype(np.float32)
+    y_np = np.argmax(x_np @ w, axis=1).astype(np.int64)
+    x, y = torch.from_numpy(x_np), torch.from_numpy(y_np)
+
+    for epoch in range(epochs):
+        total, correct = 0.0, 0
+        for i in range(0, len(x), bs):
+            opt.zero_grad()
+            logits = model(x[i:i + bs])
+            loss = loss_fn(logits, y[i:i + bs])
+            loss.backward()
+            opt.step()
+            total += float(loss) * len(logits)
+            correct += int((logits.argmax(-1) == y[i:i + bs]).sum())
+        print(f"epoch {epoch}: loss={total / len(x):.4f} "
+              f"acc={correct / len(x):.4f}")
+
+
+if __name__ == "__main__":
+    main()

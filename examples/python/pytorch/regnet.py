@@ -1,0 +1,50 @@
+"""fx-import a RegNetX model and train it (reference:
+examples/python/pytorch/regnet.py — load the .ff exported by
+export_regnet_fx.py and train; grouped 3x3 convs exercise the
+frontend's feature_group_count path).
+
+  python examples/python/pytorch/regnet.py -e 1
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from regnet_defs import regnet_x  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel, SGDOptimizer  # noqa: E402
+from flexflow_tpu.frontends.torchfx import (PyTorchModel,  # noqa: E402
+                                            export_ff)
+
+
+def top_level_task():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = 16
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "regnetx.ff")
+        export_ff(regnet_x(num_classes=10, image_size=32), path)
+        ptm = PyTorchModel(path)
+
+    cfg = FFConfig.from_args()
+    cfg.batch_size = bs
+    ff = FFModel(cfg)
+    inp = ff.create_tensor((bs, 3, 32, 32), name="input")
+    ptm.apply(ff, [inp])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    n = int(os.environ.get("SAMPLES", 64))
+    x = rng.randn(n, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (n,)).astype(np.int32)
+    ff.fit({"input": x}, y, epochs=epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

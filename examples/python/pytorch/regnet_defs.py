@@ -1,0 +1,49 @@
+"""Plain-torch RegNetX blocks (reference:
+examples/python/pytorch/export_regnet_fx.py pulls RegNetX32gf from
+classy_vision; that package is not a dependency, so the X-block
+architecture — 1x1 reduce, 3x3 grouped conv, 1x1 expand, residual —
+is expressed here directly with the torchfx-importable layer set."""
+
+import torch.nn as nn
+
+
+class XBlock(nn.Module):
+    def __init__(self, cin, cout, stride=1, group_width=8):
+        super().__init__()
+        groups = max(1, cout // group_width)
+        self.a = nn.Sequential(
+            nn.Conv2d(cin, cout, 1, bias=False),
+            nn.BatchNorm2d(cout), nn.ReLU())
+        self.b = nn.Sequential(
+            nn.Conv2d(cout, cout, 3, stride, 1, groups=groups,
+                      bias=False),
+            nn.BatchNorm2d(cout), nn.ReLU())
+        self.c = nn.Sequential(
+            nn.Conv2d(cout, cout, 1, bias=False),
+            nn.BatchNorm2d(cout))
+        self.relu = nn.ReLU()
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idt = x if self.down is None else self.down(x)
+        return self.relu(self.c(self.b(self.a(x))) + idt)
+
+
+def regnet_x(widths=(32, 64, 128), depths=(1, 2, 2), num_classes=10,
+             image_size=32, group_width=8):
+    stem = [nn.Conv2d(3, widths[0], 3, 1, 1, bias=False),
+            nn.BatchNorm2d(widths[0]), nn.ReLU()]
+    blocks, cin = [], widths[0]
+    for i, (w, d) in enumerate(zip(widths, depths)):
+        for j in range(d):
+            stride = 2 if (i > 0 and j == 0) else 1
+            blocks.append(XBlock(cin, w, stride, group_width))
+            cin = w
+    final = image_size // (2 ** (len(widths) - 1))
+    head = [nn.AvgPool2d(final), nn.Flatten(),
+            nn.Linear(cin, num_classes), nn.Softmax(dim=-1)]
+    return nn.Sequential(*(stem + blocks + head))

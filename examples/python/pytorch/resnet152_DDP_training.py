@@ -1,0 +1,65 @@
+"""torch DistributedDataParallel ResNet-152 training (reference:
+examples/python/pytorch/resnet152_DDP_training.py — the NCCL/DDP
+baseline the reference compares its own data parallelism against; here
+gloo over CPU processes so it runs anywhere).
+
+  python examples/python/pytorch/resnet152_DDP_training.py -e 1
+  WORLD=2 python examples/python/pytorch/resnet152_DDP_training.py
+"""
+
+import os
+import sys
+
+import numpy as np
+import torch
+import torch.distributed as dist
+import torch.multiprocessing as mp
+import torch.nn as nn
+from torch.nn.parallel import DistributedDataParallel as DDP
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from resnet_defs import resnet152  # noqa: E402
+
+
+def worker(rank, world, epochs):
+    os.environ.setdefault("MASTER_ADDR", "127.0.0.1")
+    os.environ.setdefault("MASTER_PORT", "29541")
+    dist.init_process_group("gloo", rank=rank, world_size=world)
+    torch.manual_seed(0)
+    width = int(os.environ.get("WIDTH", 16))  # 64 = the real model
+    model = DDP(resnet152(num_classes=10, image_size=32, width=width))
+    opt = torch.optim.SGD(model.parameters(), lr=0.01, momentum=0.9)
+    loss_fn = nn.NLLLoss()
+
+    bs, n = int(os.environ.get("BATCH", 4)), int(os.environ.get("SAMPLES", 8))
+    rng = np.random.RandomState(rank)  # each rank its own shard
+    x = torch.from_numpy(rng.randn(n, 3, 32, 32).astype(np.float32))
+    y = torch.from_numpy(rng.randint(0, 10, (n,)).astype(np.int64))
+
+    for epoch in range(epochs):
+        total = 0.0
+        for i in range(0, n, bs):
+            opt.zero_grad()
+            probs = model(x[i:i + bs])
+            loss = loss_fn(torch.log(probs + 1e-8), y[i:i + bs])
+            loss.backward()  # DDP all-reduces grads here
+            opt.step()
+            total += float(loss) * min(bs, n - i)
+        if rank == 0:
+            print(f"epoch {epoch}: loss={total / n:.4f} "
+                  f"(world={world})")
+    dist.destroy_process_group()
+
+
+def main():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    world = int(os.environ.get("WORLD", 1))
+    if world == 1:
+        worker(0, 1, epochs)
+    else:
+        mp.spawn(worker, args=(world, epochs), nprocs=world, join=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Pure-torch ResNet-152 training (reference:
+examples/python/pytorch/resnet152_training.py — torchvision's
+resnet152 trained single-process; here the architecture is built
+in-tree since torchvision is not a dependency, and shapes are kept
+small so the script is a runnable smoke rather than an ImageNet run).
+
+  python examples/python/pytorch/resnet152_training.py -e 1
+"""
+
+import os
+import sys
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from resnet_defs import resnet152  # noqa: E402
+
+
+def main():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = int(os.environ.get("BATCH", 4))
+    n = int(os.environ.get("SAMPLES", 8))
+    width = int(os.environ.get("WIDTH", 16))  # 64 = the real model
+
+    torch.manual_seed(0)
+    model = resnet152(num_classes=10, image_size=32, width=width)
+    opt = torch.optim.SGD(model.parameters(), lr=0.01, momentum=0.9)
+    loss_fn = nn.NLLLoss()
+
+    rng = np.random.RandomState(0)
+    x = torch.from_numpy(rng.randn(n, 3, 32, 32).astype(np.float32))
+    y = torch.from_numpy(rng.randint(0, 10, (n,)).astype(np.int64))
+
+    for epoch in range(epochs):
+        total = 0.0
+        for i in range(0, n, bs):
+            opt.zero_grad()
+            probs = model(x[i:i + bs])
+            loss = loss_fn(torch.log(probs + 1e-8), y[i:i + bs])
+            loss.backward()
+            opt.step()
+            total += float(loss) * min(bs, n - i)
+        print(f"epoch {epoch}: loss={total / n:.4f}")
+
+
+if __name__ == "__main__":
+    main()

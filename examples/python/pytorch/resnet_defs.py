@@ -1,0 +1,87 @@
+"""Plain-torch ResNet builders shared by the pytorch example scripts
+(reference: examples/python/pytorch/resnet_torch.py defines its own
+copy; torchvision is not assumed to be installed).
+
+Standard He et al. architecture expressed with the layer set the
+torchfx frontend understands (Conv2d / BatchNorm2d / ReLU / pools /
+add / flatten / Linear)."""
+
+import torch.nn as nn
+
+
+class BasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(cout)
+        self.relu = nn.ReLU()
+        self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idt = x if self.down is None else self.down(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return self.relu(y + idt)
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, width, stride=1):
+        super().__init__()
+        cout = width * self.expansion
+        self.conv1 = nn.Conv2d(cin, width, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.conv2 = nn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(width)
+        self.conv3 = nn.Conv2d(width, cout, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(cout)
+        self.relu = nn.ReLU()
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idt = x if self.down is None else self.down(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return self.relu(y + idt)
+
+
+def resnet(block, layers, num_classes=10, image_size=32, width=64):
+    """Stack `layers` (e.g. [2,2,2,2] = resnet18, [3,8,36,3] =
+    resnet152) of `block` into a sequential model ending in a fixed
+    avg-pool + linear head (adaptive pooling is avoided so the graph
+    traces into the frontends' fixed-shape op set)."""
+    stem = [nn.Conv2d(3, width, 3, 1, 1, bias=False),
+            nn.BatchNorm2d(width), nn.ReLU()]
+    blocks, cin = [], width
+    for i, n in enumerate(layers):
+        w = width * (2 ** i)
+        for j in range(n):
+            stride = 2 if (i > 0 and j == 0) else 1
+            blocks.append(block(cin, w, stride))
+            cin = w * block.expansion
+    final = image_size // (2 ** (len(layers) - 1))
+    head = [nn.AvgPool2d(final), nn.Flatten(),
+            nn.Linear(cin, num_classes), nn.Softmax(dim=-1)]
+    return nn.Sequential(*(stem + blocks + head))
+
+
+def resnet18(**kw):
+    return resnet(BasicBlock, [2, 2, 2, 2], **kw)
+
+
+def resnet152(**kw):
+    return resnet(Bottleneck, [3, 8, 36, 3], **kw)

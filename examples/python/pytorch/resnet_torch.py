@@ -1,0 +1,47 @@
+"""Pure-torch ResNet-18 training baseline (reference:
+examples/python/pytorch/resnet_torch.py — the torch-only twin of
+resnet.py, used to compare loss trajectories between the framework
+and native torch on the same architecture).
+
+  python examples/python/pytorch/resnet_torch.py -e 1
+"""
+
+import os
+import sys
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from resnet_defs import resnet18  # noqa: E402
+
+
+def main():
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = 16
+    torch.manual_seed(0)
+    model = resnet18(num_classes=10, image_size=32)
+    opt = torch.optim.SGD(model.parameters(), lr=0.01)
+    loss_fn = nn.NLLLoss()
+
+    rng = np.random.RandomState(0)
+    n = int(os.environ.get("SAMPLES", 64))
+    x = torch.from_numpy(rng.randn(n, 3, 32, 32).astype(np.float32))
+    y = torch.from_numpy(rng.randint(0, 10, (n,)).astype(np.int64))
+
+    for epoch in range(epochs):
+        total = 0.0
+        for i in range(0, n, bs):
+            opt.zero_grad()
+            probs = model(x[i:i + bs])
+            loss = loss_fn(torch.log(probs + 1e-8), y[i:i + bs])
+            loss.backward()
+            opt.step()
+            total += float(loss) * min(bs, n - i)
+        print(f"epoch {epoch}: loss={total / n:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""fx-import a torchvision model (reference:
+examples/python/pytorch/torch_vision.py — torchvision.models through
+the fx exporter). Import-gated: torchvision is not a dependency of
+this image; without it the script prints a clear skip and exits 0.
+
+  python examples/python/pytorch/torch_vision.py -e 1
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+
+def top_level_task():
+    try:
+        import torchvision.models as tvm
+    except ImportError:
+        print("torchvision not installed; skipping "
+              "(pip install torchvision to run; "
+              "examples/python/pytorch/resnet.py is the in-tree "
+              "equivalent)")
+        return
+
+    from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.frontends.torchfx import PyTorchModel, export_ff
+
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = 8
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "tv_resnet18.ff")
+        export_ff(tvm.resnet18(num_classes=10), path)
+        ptm = PyTorchModel(path)
+
+    cfg = FFConfig.from_args()
+    cfg.batch_size = bs
+    ff = FFModel(cfg)
+    inp = ff.create_tensor((bs, 3, 224, 224), name="input")
+    ptm.apply(ff, [inp])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+
+    rng = np.random.RandomState(0)
+    n = int(os.environ.get("SAMPLES", 16))
+    x = rng.randn(n, 3, 224, 224).astype(np.float32)
+    y = rng.randint(0, 10, (n,)).astype(np.int32)
+    ff.fit({"input": x}, y, epochs=epochs)
+
+
+if __name__ == "__main__":
+    top_level_task()

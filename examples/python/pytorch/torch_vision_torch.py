@@ -1,0 +1,51 @@
+"""Pure-torchvision training baseline (reference:
+examples/python/pytorch/torch_vision_torch.py). Import-gated like
+torch_vision.py.
+
+  python examples/python/pytorch/torch_vision_torch.py -e 1
+"""
+
+import os
+import sys
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+
+def main():
+    try:
+        import torchvision.models as tvm
+    except ImportError:
+        print("torchvision not installed; skipping "
+              "(pip install torchvision to run; "
+              "examples/python/pytorch/resnet_torch.py is the "
+              "in-tree equivalent)")
+        return
+
+    epochs = int(sys.argv[sys.argv.index("-e") + 1]) \
+        if "-e" in sys.argv else 1
+    bs = 8
+    torch.manual_seed(0)
+    model = tvm.resnet18(num_classes=10)
+    opt = torch.optim.SGD(model.parameters(), lr=0.01)
+    loss_fn = nn.CrossEntropyLoss()
+
+    rng = np.random.RandomState(0)
+    n = int(os.environ.get("SAMPLES", 16))
+    x = torch.from_numpy(rng.randn(n, 3, 224, 224).astype(np.float32))
+    y = torch.from_numpy(rng.randint(0, 10, (n,)).astype(np.int64))
+
+    for epoch in range(epochs):
+        total = 0.0
+        for i in range(0, n, bs):
+            opt.zero_grad()
+            loss = loss_fn(model(x[i:i + bs]), y[i:i + bs])
+            loss.backward()
+            opt.step()
+            total += float(loss) * min(bs, n - i)
+        print(f"epoch {epoch}: loss={total / n:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -65,19 +65,12 @@ def from_tf_keras(tf_model, config=None, batch_size: Optional[int] = None,
         values[_tref(inp)] = ff.create_tensor(
             (bs,) + shape, name=inp.name.split(":")[0])
 
-    for layer in tf_model.layers:
-        ltype = type(layer).__name__
-        if ltype == "InputLayer":
-            continue
-        ins = [values[_tref(t)] for t in _flat_inputs(layer)]
-        out = _emit_layer(ff, layer, ltype, ins)
-        for t in _flat_outputs(layer):
-            values[_tref(t)] = out
+    _replay_layers(ff, tf_model, values)
 
     # stage trained weights; FFModel.compile applies them after
     # init_state (state does not exist yet at this point)
     ops_by_name = {op.name: op for op in ff.ops}
-    for layer in tf_model.layers:
+    for layer in _leaf_layers(tf_model):
         w = layer.get_weights()
         if not w:
             continue
@@ -162,6 +155,66 @@ def _map_layer_weights(ltype, layer, w, op):
             f"keras_exp: layer {ltype} ({layer.name}) has weights but no "
             f"weight-import mapping")
     return params, states
+
+
+def _replay_layers(ff, tf_model, values):
+    """Walk a Model's layer graph, emitting framework ops. A nested
+    Model used as a layer (reference keras_exp func_cifar10_cnn_nested
+    pattern) is inlined: its symbolic inputs are bound to the caller's
+    incoming tensors and its internal graph replays into the same
+    FFModel."""
+    for layer in tf_model.layers:
+        ltype = type(layer).__name__
+        if ltype == "InputLayer":
+            continue
+        if hasattr(layer, "layers") and getattr(layer, "inputs", None):
+            # nested Model as a layer: `layer.inputs/outputs` are its
+            # OWN construction graph; the call-site tensors live on the
+            # inbound node. Bind call-site -> internal inputs, replay
+            # the internal graph, then bind internal outputs back to
+            # the call-site tensors downstream layers reference.
+            if len(getattr(layer, "_inbound_nodes", [])) > 1:
+                raise NotImplementedError(
+                    f"keras_exp: nested Model {layer.name!r} is called "
+                    f"at {len(layer._inbound_nodes)} sites; shared "
+                    f"submodels are unsupported (weight-tying across "
+                    f"call sites has no op-per-layer mapping) — call "
+                    f"each submodel once or flatten the model")
+            node = layer._inbound_nodes[-1]
+            outer_ins = node.input_tensors
+            if not isinstance(outer_ins, (list, tuple)):
+                outer_ins = [outer_ins]
+            for inner, outer in zip(layer.inputs, outer_ins):
+                values[_tref(inner)] = values[_tref(outer)]
+            _replay_layers(ff, layer, values)
+            outer_outs = node.output_tensors
+            if not isinstance(outer_outs, (list, tuple)):
+                outer_outs = [outer_outs]
+            for outer, inner in zip(outer_outs, layer.outputs):
+                values[_tref(outer)] = values[_tref(inner)]
+            continue
+        ins = [values[_tref(t)] for t in _flat_inputs(layer)]
+        # Keras guarantees unique layer names only PER model; inlining
+        # a nested Model can bring an inner 'fc' next to an outer 'fc'.
+        # Ops/params/imported_weights are all name-keyed — a silent
+        # duplicate would make one layer read the other's weights.
+        if any(op.name == layer.name for op in ff.ops):
+            raise NotImplementedError(
+                f"keras_exp: duplicate layer name {layer.name!r} after "
+                f"nested-Model inlining; give inner and outer layers "
+                f"distinct names")
+        out = _emit_layer(ff, layer, ltype, ins)
+        for t in _flat_outputs(layer):
+            values[_tref(t)] = out
+
+
+def _leaf_layers(tf_model):
+    """Layers with weights of their own, nested Models flattened."""
+    for layer in tf_model.layers:
+        if hasattr(layer, "layers"):
+            yield from _leaf_layers(layer)
+        else:
+            yield layer
 
 
 def _flat_inputs(layer):

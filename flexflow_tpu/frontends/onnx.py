@@ -355,6 +355,12 @@ class ONNXModel:
             elif node.op_type == "Transpose":
                 t = ffmodel.transpose(values[ins[0]], a["perm"], name=name)
             elif node.op_type == "Identity":
+                if ins[0] in self.inits and ins[0] not in values:
+                    # torch's BN-folding export aliases a shared
+                    # initializer to one Identity per consumer; keep it
+                    # an initializer so Conv/Gemm read it as a weight
+                    self.inits[node.output[0]] = self.inits[ins[0]]
+                    continue
                 t = values[ins[0]]
             else:
                 raise NotImplementedError(

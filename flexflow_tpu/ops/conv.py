@@ -94,27 +94,11 @@ class Conv2D(Op):
 
     def forward(self, params, xs, ctx: OpContext):
         (x,) = xs
-        ph, pw = self.padding
         nhwc = self.model.config.conv_layout == "NHWC"
-        # no preferred_element_type: the MXU accumulates bf16 convs in
-        # f32 natively, and conv's gradient transpose rejects the mixed
-        # f32-cotangent/bf16-operand pair the flag would create (unlike
-        # dot_general's); output dtype follows the activations.
-        if nhwc:
-            x = jnp.transpose(x, (0, 2, 3, 1))
-        y = lax.conv_general_dilated(
-            x,
-            params["kernel"].astype(x.dtype),
-            window_strides=self.stride,
-            padding=[(ph, ph), (pw, pw)],
-            dimension_numbers=(("NHWC", "OIHW", "NHWC") if nhwc
-                               else ("NCHW", "OIHW", "NCHW")),
-            feature_group_count=self.groups,
-        )
-        bshape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
-        if self.use_bias:
-            y = y + params["bias"].reshape(bshape).astype(y.dtype)
-        y = apply_activation(y, self.activation)
+        y = _conv_apply(x, params["kernel"].astype(x.dtype),
+                        params["bias"] if self.use_bias else None,
+                        self.stride, self.padding, nhwc,
+                        self.activation, self.groups)
         if nhwc:
             y = jnp.transpose(y, (0, 3, 1, 2))
         return [y]
@@ -132,6 +116,35 @@ class Conv2D(Op):
                 * (self.in_channels // self.groups) * kh * kw)
 
 
+def _conv_apply(x, kernel, bias, stride, padding, nhwc, activation,
+                groups=1):
+    """Core conv lowering shared by Conv2D.forward and
+    merged_conv_forward (so the fused and unfused paths cannot
+    diverge). Returns y in COMPUTE layout (NHWC when nhwc, else NCHW);
+    the caller transposes back.
+
+    No preferred_element_type: the MXU accumulates bf16 convs in f32
+    natively, and conv's gradient transpose rejects the mixed
+    f32-cotangent/bf16-operand pair the flag would create (unlike
+    dot_general's); output dtype follows the activations."""
+    ph, pw = padding
+    if nhwc:
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    y = lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=stride,
+        padding=[(ph, ph), (pw, pw)],
+        dimension_numbers=(("NHWC", "OIHW", "NHWC") if nhwc
+                           else ("NCHW", "OIHW", "NCHW")),
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        bshape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
+        y = y + bias.reshape(bshape).astype(y.dtype)
+    return apply_activation(y, activation)
+
+
 def merged_conv_forward(ops: List["Conv2D"], params_list, x):
     """Execute sibling Conv2D ops (core/fusion.conv_sibling_groups) as
     ONE conv: kernels concatenate along channel-out, the output splits
@@ -145,30 +158,16 @@ def merged_conv_forward(ops: List["Conv2D"], params_list, x):
     padding/activation speak for the group.
     """
     lead = ops[0]
-    ph, pw = lead.padding
     nhwc = lead.model.config.conv_layout == "NHWC"
     kernel = jnp.concatenate(
         [p["kernel"].astype(x.dtype) for p in params_list], axis=0)
-    if nhwc:
-        x = jnp.transpose(x, (0, 2, 3, 1))
-    y = lax.conv_general_dilated(
-        x,
-        kernel,
-        window_strides=lead.stride,
-        padding=[(ph, ph), (pw, pw)],
-        dimension_numbers=(("NHWC", "OIHW", "NHWC") if nhwc
-                           else ("NCHW", "OIHW", "NCHW")),
-    )
-    bshape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
-    if lead.use_bias:
-        bias = jnp.concatenate(
-            [p["bias"] for p in params_list]).astype(y.dtype)
-        y = y + bias.reshape(bshape)
-    y = apply_activation(y, lead.activation)
-    sizes = [op.out_channels for op in ops]
+    bias = (jnp.concatenate([p["bias"] for p in params_list])
+            if lead.use_bias else None)
+    y = _conv_apply(x, kernel, bias, lead.stride, lead.padding, nhwc,
+                    lead.activation)
     offsets = [0]
-    for s in sizes:
-        offsets.append(offsets[-1] + s)
+    for op in ops:
+        offsets.append(offsets[-1] + op.out_channels)
     ch_axis = 3 if nhwc else 1
     outs = []
     for i in range(len(ops)):

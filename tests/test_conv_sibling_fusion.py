@@ -13,7 +13,7 @@ from flexflow_tpu import FFConfig, FFModel, SGDOptimizer
 from flexflow_tpu.core.fusion import conv_sibling_groups
 
 
-def _build_inception_module(fuse, layout="NCHW"):
+def _build_inception_module(fuse, layout="NCHW", remat=False):
     """An Inception-ish module: three 1x1 branch heads on one input
     (mergeable), one 1x1 on the pooled input (different tensor — NOT
     mergeable), a 3x3 on one branch, then concat."""
@@ -21,6 +21,7 @@ def _build_inception_module(fuse, layout="NCHW"):
     cfg.batch_size = 8
     cfg.sibling_conv_fusion = fuse
     cfg.conv_layout = layout
+    cfg.remat = remat
     ff = FFModel(cfg)
     x = ff.create_tensor((8, 16, 8, 8), name="input")
     b1 = ff.conv2d(x, 12, 1, 1, 1, 1, 0, 0, activation="relu")
@@ -87,24 +88,6 @@ def test_remat_composes_with_sibling_fusion():
              "label": rng.randint(0, 4, (8,))}
     a = _build_inception_module(fuse=False)
     cfg_loss = float(a.train_batch(batch)["loss"])
-
-    cfg = FFConfig()
-    cfg.batch_size = 8
-    cfg.sibling_conv_fusion = True
-    cfg.remat = True
-    ff = FFModel(cfg)
-    x = ff.create_tensor((8, 16, 8, 8), name="input")
-    b1 = ff.conv2d(x, 12, 1, 1, 1, 1, 0, 0, activation="relu")
-    b2 = ff.conv2d(x, 6, 1, 1, 1, 1, 0, 0, activation="relu")
-    b3 = ff.conv2d(x, 10, 1, 1, 1, 1, 0, 0, activation="relu")
-    b3 = ff.conv2d(b3, 8, 3, 3, 1, 1, 1, 1, activation="relu")
-    p = ff.pool2d(x, 3, 3, 1, 1, 1, 1)
-    b4 = ff.conv2d(p, 4, 1, 1, 1, 1, 0, 0, activation="relu")
-    t = ff.concat([b1, b2, b3, b4], axis=1)
-    t = ff.flat(t)
-    t = ff.dense(t, 4)
-    ff.softmax(t)
-    ff.compile(optimizer=SGDOptimizer(lr=0.1),
-               loss_type="sparse_categorical_crossentropy", metrics=[])
+    b = _build_inception_module(fuse=True, remat=True)
     np.testing.assert_allclose(
-        float(ff.train_batch(batch)["loss"]), cfg_loss, rtol=2e-5)
+        float(b.train_batch(batch)["loss"]), cfg_loss, rtol=2e-5)

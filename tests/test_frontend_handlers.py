@@ -314,6 +314,39 @@ def test_keras_exp_real_tf_dense_model_matches_predict():
 
 
 @needs_tf
+def test_keras_exp_real_tf_nested_model_matches_predict():
+    """A tf.keras Model used as a LAYER inside another Model (reference
+    keras_exp func_cifar10_cnn_nested pattern) inlines: call-site
+    tensors bind through the inbound node, internal weights import, and
+    forward numerics match tf's own predict."""
+    tfk = tf.keras
+    feat_in = tfk.Input((12,))
+    ft = tfk.layers.Dense(16, activation="relu", name="feat_fc")(feat_in)
+    features = tfk.Model(feat_in, ft)
+
+    inp = tfk.Input((12,), name="input")
+    t = features(inp)
+    out = tfk.layers.Dense(4, name="head")(t)
+    tf_model = tfk.Model(inp, out)
+
+    cfg = FFConfig()
+    cfg.batch_size = 8
+    ff = from_tf_keras(tf_model, config=cfg, batch_size=8)
+    ff.softmax(ff.ops[-1].outputs[0])
+    ff.compile(loss_type="sparse_categorical_crossentropy", metrics=[])
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(8, 12).astype(np.float32)
+    want = tf_model.predict(xv, verbose=0)
+    logits = ff.ops[-2].outputs[0]
+    values, _ = ff.executor.forward_values(
+        ff.state.params, ff.state.states,
+        {ff.input_tensors[0].name: xv}, False, None)
+    np.testing.assert_allclose(np.asarray(values[logits.uid]), want,
+                               atol=1e-4)
+
+
+@needs_tf
 def test_keras_exp_real_tf_channels_last_conv_fails_loudly():
     tfk = tf.keras
     inp = tfk.Input((16, 16, 3))
