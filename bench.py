@@ -157,6 +157,10 @@ def build(model: str, preset: str):
     layout = os.environ.get("BENCH_CONV_LAYOUT")
     if layout:
         cfg.conv_layout = layout
+    # sibling-conv batching A/B knob (default on; the session queue
+    # captures the merged-vs-unmerged delta on chip)
+    if os.environ.get("BENCH_SIBLING_FUSION") == "0":
+        cfg.sibling_conv_fusion = False
 
     def _b(default):
         # BENCH_BATCH: sweep knob for per-chip batch (MFU is

@@ -206,7 +206,7 @@ class Simulator:
                 or c.pipeline is not None:
             return c
         from .cost_model import compute_shards
-        from .op_measure import measure_op
+        from .op_measure import CONV_CHAIN_TYPES, measure_op
         from ..parallel.pconfig import OpStrategy
         shards_total = compute_shards(op, s, self.mesh)
         s_nosample = OpStrategy({k: v for k, v in s.axis_map.items()
@@ -216,8 +216,15 @@ class Simulator:
         m = measure_op(op, sample_shard=sample_div)
         if m is None:
             return c
-        return dataclasses.replace(c, fwd=m["fwd"] / resid,
-                                   bwd=m["bwd"] / resid)
+        # conv-chain ops carry the per-device-kind in-situ correction:
+        # isolated microbenchmarks under-predict in-graph conv cost
+        # (op_measure.conv_in_situ_factor; VERDICT r4 #5)
+        f = 1.0
+        if op.op_type in CONV_CHAIN_TYPES:
+            from .op_measure import conv_in_situ_factor
+            f = conv_in_situ_factor()
+        return dataclasses.replace(c, fwd=m["fwd"] * f / resid,
+                                   bwd=m["bwd"] * f / resid)
 
     def _choose_measured_ops(self) -> set:
         """Ops covered by the top-N measurement SIGNATURES (shape

@@ -174,3 +174,55 @@ def test_stateful_op_is_measurable():
     assert bn.state_specs()  # the premise: BN is stateful
     m = op_measure.measure_op(bn, sample_shard=1, repeats=3)
     assert m is not None and m["fwd"] > 0 and m["bwd"] > 0
+
+
+def test_conv_in_situ_factor_cached_and_clamped(tmp_path, monkeypatch):
+    """The isolated->in-situ conv correction: measured once, persisted
+    per device kind, clamped to [1, 3], and 1.0 on failure (grounding
+    must degrade to uncorrected, never break the search)."""
+    monkeypatch.setattr(op_measure, "_insitu_path",
+                        lambda kind: str(tmp_path / f"insitu_{kind}.json"))
+    op_measure._INSITU.clear()
+    monkeypatch.setattr(op_measure, "_measure_insitu_factor",
+                        lambda: 1.8)
+    f = op_measure.conv_in_situ_factor()
+    assert f == 1.8
+    # second call: memo, no re-measure
+    monkeypatch.setattr(op_measure, "_measure_insitu_factor",
+                        lambda: (_ for _ in ()).throw(AssertionError))
+    assert op_measure.conv_in_situ_factor() == 1.8
+    # fresh process analog: memo cleared, disk cache serves
+    op_measure._INSITU.clear()
+    assert op_measure.conv_in_situ_factor() == 1.8
+    # failure path -> 1.0 in-process AND NOT persisted (a cached
+    # failure would defeat re-measurement forever)
+    op_measure._INSITU.clear()
+    fail_path = tmp_path / "other.json"
+    monkeypatch.setattr(op_measure, "_insitu_path",
+                        lambda kind: str(fail_path))
+    monkeypatch.setattr(op_measure, "_measure_insitu_factor",
+                        lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    assert op_measure.conv_in_situ_factor() == 1.0
+    assert not fail_path.exists()
+
+    # corrupt/out-of-range disk values clamp on load: 100 -> 3, 0 -> 1,
+    # NaN -> 1
+    import json as _json
+    for raw, want in ((100.0, 3.0), (0.0, 1.0), (float("nan"), 1.0)):
+        op_measure._INSITU.clear()
+        fail_path.write_text(_json.dumps({"factor": raw}))
+        assert op_measure.conv_in_situ_factor() == want
+
+    # out-of-range MEASURED values clamp before persisting
+    op_measure._INSITU.clear()
+    fail_path.unlink()
+    monkeypatch.setattr(op_measure, "_measure_insitu_factor",
+                        lambda: 40.0)
+    try:
+        assert op_measure.conv_in_situ_factor() == 3.0
+        assert _json.loads(fail_path.read_text())["factor"] == 3.0
+    finally:
+        # the memo is module-global and keyed by the REAL device kind —
+        # a leaked 3.0 would silently triple conv costs for any later
+        # test that grounds ops in this process
+        op_measure._INSITU.clear()

@@ -1,0 +1,59 @@
+#!/bin/bash
+# Committed CI gate — the reference's .circleci/config.yml analog
+# (build + pytest + multi-GPU script tests + accuracy tests per
+# commit). Everything here runs on the virtual 8-device CPU platform,
+# so it needs no hardware and cannot be blocked by the TPU tunnel.
+#
+#   bash tools/ci.sh          # fast gate: default pytest profile
+#                             #   (<~5 min) + multichip dryrun +
+#                             #   3 example smokes
+#   bash tools/ci.sh --full   # + the slow remainder (-m slow):
+#                             #   example zoo, model smokes,
+#                             #   multiprocess, pipelines (~35 min)
+#
+# Writes .scratch/ci_last_green (HEAD sha + UTC stamp + mode) on
+# success; EVIDENCE.md cites that file as the last green run.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+FULL="${1:-}"
+fail=0
+
+echo "=== ci $(date -u +%FT%TZ) HEAD=$(git rev-parse --short HEAD) mode=${FULL:-fast} ==="
+
+echo "--- 1. fast CPU suite (default profile: -m 'not slow')"
+python -m pytest tests/ -q || fail=1
+
+if [ "$FULL" = "--full" ]; then
+  echo "--- 1b. slow remainder (-m slow)"
+  python -m pytest tests/ -q -m slow || fail=1
+fi
+
+echo "--- 2. multichip dryrun (all parallel axes on 8 virtual devices)"
+env XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    JAX_PLATFORMS=cpu python -c "
+import jax; jax.config.update('jax_platforms', 'cpu')
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+fn, args = g.entry(); jax.jit(fn)(*args)
+print('entry() compile OK')" || fail=1
+
+echo "--- 3. example smokes (native / frontend / keras)"
+timeout 300 python -m flexflow_tpu --cpu-devices 2 \
+    examples/python/native/alexnet.py -b 8 --samples 16 -e 1 \
+    >/dev/null || fail=1
+timeout 300 python -m flexflow_tpu --cpu-devices 2 \
+    examples/python/pytorch/mnist_mlp_torch.py -e 1 \
+    >/dev/null || fail=1
+timeout 300 python -m flexflow_tpu --cpu-devices 2 \
+    examples/python/keras/mnist_mlp.py -e 1 >/dev/null || fail=1
+echo "example smokes rc=$fail"
+
+if [ "$fail" -eq 0 ]; then
+  mkdir -p .scratch
+  echo "$(git rev-parse HEAD) $(date -u +%FT%TZ) mode=${FULL:-fast}" \
+      > .scratch/ci_last_green
+  echo "=== ci GREEN ==="
+else
+  echo "=== ci RED ==="
+fi
+exit "$fail"

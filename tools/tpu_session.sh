@@ -124,6 +124,14 @@ run_all() {
           || note_rc "$m $layout"
       done
     done
+    echo "--- 5b. inception sibling-conv fusion A/B (merged 1x1 branch"
+    echo "    heads vs plain; decides the default stays on)"
+    for v in 1 0; do
+      echo "· BENCH_SIBLING_FUSION=$v"
+      BENCH_SIBLING_FUSION=$v timeout 900 python bench.py --child \
+        --model inception --preset full --steps 30 | tail -1 \
+        || note_rc "inception sibling=$v"
+    done
     echo "--- 6. inception batch sweep (MFU is batch-sensitive on convs)"
     for b in 48 64; do
       echo "· inception batch=$b"
