@@ -74,6 +74,13 @@ jax.tree_util.register_pytree_node(
     TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
 
 
+def _permute_nhwc_sharding(s, mesh):
+    """NCHW-axes NamedSharding -> the same logical sharding over an
+    NHWC-permuted runtime value (executor NHWC residency)."""
+    sp = tuple(s.spec) + (None,) * (4 - len(tuple(s.spec)))
+    return NamedSharding(mesh, P(sp[0], sp[2], sp[3], sp[1]))
+
+
 class Executor:
     def __init__(self, model, optimizer: Optimizer, loss_fn, metric_names,
                  mesh: Optional[Mesh] = None,
@@ -144,6 +151,53 @@ class Executor:
                 if len(strat_keys) > 1:
                     continue
                 self._conv_merge_leader[group[0].name] = group
+        # NHWC layout residency: under conv_layout="NHWC", values flow
+        # channels-last BETWEEN conv-family ops instead of each op
+        # transposing in and out. Per-op transpose pairs rely on XLA
+        # cancellation, which breaks at Concat module boundaries and
+        # ballooned compile time (round-4 NHWC arm >600s); residency
+        # removes the pairs structurally. _nhwc_resident = tensor uids
+        # whose runtime value is NHWC-permuted; _nhwc_reads = ops that
+        # consume their inputs in that form.
+        self._nhwc_resident, self._nhwc_reads = (
+            self._compute_nhwc_resident()
+            if self.config.conv_layout == "NHWC" else (set(), set()))
+
+    def _compute_nhwc_resident(self):
+        """Static dataflow pass for conv_layout="NHWC": which tensor
+        values stay NHWC-permuted between ops, and which ops read them
+        that way. Conv/Pool/BN always EMIT resident outputs (they
+        compute in NHWC anyway); Concat-on-channels and same-shape
+        pointwise ops PROPAGATE residency when every tensor input is
+        resident; everything else reads NCHW (the walk inserts the
+        transpose at the read). Per-op NCHW semantics (weights, state,
+        output_axes, get/set_weights) are untouched — this is purely
+        about the runtime value layout between ops."""
+        core = {"conv2d", "pool2d", "batch_norm"}
+        pointwise = {"element_unary", "element_binary", "dropout"}
+        resident: set = set()
+        reads: set = set()
+        for op in self.model.ops:
+            ins = op.inputs
+            all_res = bool(ins) and all(t.uid in resident for t in ins)
+            out4 = (op.outputs
+                    and len(op.outputs[0].shape) == 4)
+            if op.op_type in core and out4 \
+                    and len(ins[0].shape) == 4:
+                if all_res:
+                    reads.add(op.name)
+                resident.update(t.uid for t in op.outputs)
+            elif (op.op_type == "concat" and out4 and all_res
+                    and getattr(op, "axis", None) == 1):
+                reads.add(op.name)
+                resident.update(t.uid for t in op.outputs)
+            elif (op.op_type in pointwise and out4 and all_res
+                    and all(tuple(t.shape) == tuple(op.outputs[0].shape)
+                            for t in ins)):
+                # pointwise on identical shapes: layout-transparent
+                reads.add(op.name)
+                resident.update(t.uid for t in op.outputs)
+        return resident, reads
 
     # ---------------- initialization ----------------
     def init_state(self, rng) -> TrainState:
@@ -290,8 +344,20 @@ class Executor:
                 state_in=states.get(op.name, {}),
                 mesh=self.mesh,
                 op_strategy=self.strategy.for_op(op.name),
+                nhwc_in=op.name in self._nhwc_reads,
+                nhwc_out=bool(op.outputs
+                              and op.outputs[0].uid
+                              in self._nhwc_resident),
             )
-            xs = [values[t.uid] for t in op.inputs]
+            xs = []
+            for t in op.inputs:
+                v = values[t.uid]
+                if (t.uid in self._nhwc_resident
+                        and op.name not in self._nhwc_reads):
+                    # layout boundary: this consumer wants NCHW (XLA
+                    # CSEs the duplicate when several consumers read)
+                    v = jnp.transpose(v, (0, 3, 1, 2))
+                xs.append(v)
             op_params = params.get(op.name, {})
             # remat: recompute this op's activations in backward instead of
             # saving them (HBM-for-FLOPs trade, SURVEY.md env notes). Ops
@@ -304,12 +370,17 @@ class Executor:
                 from ..ops.conv import merged_conv_forward
                 group = self._conv_merge_leader[op.name]
                 plist = [params.get(m.name, {}) for m in group]
+                # group members share the leader's input and geometry,
+                # so the leader's residency flags speak for the group
+                nin, nout = ctx.nhwc_in, ctx.nhwc_out
                 if self.config.remat:
                     outs = jax.checkpoint(
-                        lambda ps, x, _g=group:
-                        merged_conv_forward(_g, ps, x))(plist, xs[0])
+                        lambda ps, x, _g=group, _i=nin, _o=nout:
+                        merged_conv_forward(_g, ps, x, _i, _o))(
+                            plist, xs[0])
                 else:
-                    outs = merged_conv_forward(group, plist, xs[0])
+                    outs = merged_conv_forward(group, plist, xs[0],
+                                               nin, nout)
                 for m, y in zip(group[1:], outs[1:]):
                     merged_pending[m.name] = y
                 ys = [outs[0]]
@@ -326,6 +397,14 @@ class Executor:
                     or op.name in self._sharding_boundary):
                 shardings = op_output_sharding(
                     op, self.strategy.for_op(op.name), self.mesh)
+                # NHWC-resident values are permuted (N,H,W,C) at
+                # runtime while op axes speak NCHW — permute the spec
+                # with them or the constraint pins the wrong dims
+                shardings = [
+                    _permute_nhwc_sharding(s, self.mesh)
+                    if (t.uid in self._nhwc_resident
+                        and len(t.shape) == 4) else s
+                    for t, s in zip(op.outputs, shardings)]
                 ys = [jax.lax.with_sharding_constraint(y, s)
                       for y, s in zip(ys, shardings)]
             for t, y in zip(op.outputs, ys):
@@ -338,6 +417,12 @@ class Executor:
         for name, s in states.items():
             new_states.setdefault(name, s)
         self._last_aux_losses = aux_losses
+        # normalize NHWC-resident values back to logical NCHW so every
+        # caller (loss, metrics, tests reading intermediate tensors)
+        # sees declared shapes; under jit the unused transposes are DCE'd
+        for uid in self._nhwc_resident:
+            if uid in values and values[uid].ndim == 4:
+                values[uid] = jnp.transpose(values[uid], (0, 3, 1, 2))
         return values, new_states
 
     def _outputs_and_loss(self, params, states, batch, training, rng,

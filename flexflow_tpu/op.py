@@ -97,11 +97,12 @@ class OpContext:
     """
 
     __slots__ = ("training", "rng", "seq_length", "state_in", "state_out",
-                 "mesh", "op_strategy", "aux_loss")
+                 "mesh", "op_strategy", "aux_loss", "nhwc_in", "nhwc_out")
 
     def __init__(self, training: bool, rng=None, seq_length: int = -1,
                  state_in: Optional[dict] = None, mesh=None,
-                 op_strategy=None):
+                 op_strategy=None, nhwc_in: bool = False,
+                 nhwc_out: bool = False):
         self.training = training
         self.rng = rng
         self.seq_length = seq_length
@@ -112,6 +113,14 @@ class OpContext:
         # ops may set a scalar auxiliary loss (e.g. MoE load-balancing);
         # the executor adds it to the training objective.
         self.aux_loss = None
+        # NHWC layout residency (executor._compute_nhwc_resident): under
+        # conv_layout="NHWC", values flow channels-last BETWEEN
+        # conv-family ops; nhwc_in says this op's tensor inputs already
+        # arrive NHWC-permuted, nhwc_out says its outputs should stay
+        # NHWC (a consumer will read them that way). Both False outside
+        # the executor walk — ops then do their own boundary transposes.
+        self.nhwc_in = nhwc_in
+        self.nhwc_out = nhwc_out
 
     def mesh_axis_size(self, logical_axis: str) -> int:
         """Size of the mesh axis a logical axis maps to (1 if unmapped)."""

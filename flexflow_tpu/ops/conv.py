@@ -98,8 +98,9 @@ class Conv2D(Op):
         y = _conv_apply(x, params["kernel"].astype(x.dtype),
                         params["bias"] if self.use_bias else None,
                         self.stride, self.padding, nhwc,
-                        self.activation, self.groups)
-        if nhwc:
+                        self.activation, self.groups,
+                        already_nhwc=ctx.nhwc_in)
+        if nhwc and not ctx.nhwc_out:
             y = jnp.transpose(y, (0, 3, 1, 2))
         return [y]
 
@@ -117,18 +118,19 @@ class Conv2D(Op):
 
 
 def _conv_apply(x, kernel, bias, stride, padding, nhwc, activation,
-                groups=1):
+                groups=1, already_nhwc=False):
     """Core conv lowering shared by Conv2D.forward and
     merged_conv_forward (so the fused and unfused paths cannot
     diverge). Returns y in COMPUTE layout (NHWC when nhwc, else NCHW);
-    the caller transposes back.
+    the caller transposes back. `already_nhwc` marks an input that the
+    executor's residency pass left channels-last.
 
     No preferred_element_type: the MXU accumulates bf16 convs in f32
     natively, and conv's gradient transpose rejects the mixed
     f32-cotangent/bf16-operand pair the flag would create (unlike
     dot_general's); output dtype follows the activations."""
     ph, pw = padding
-    if nhwc:
+    if nhwc and not already_nhwc:
         x = jnp.transpose(x, (0, 2, 3, 1))
     y = lax.conv_general_dilated(
         x,
@@ -145,7 +147,8 @@ def _conv_apply(x, kernel, bias, stride, padding, nhwc, activation,
     return apply_activation(y, activation)
 
 
-def merged_conv_forward(ops: List["Conv2D"], params_list, x):
+def merged_conv_forward(ops: List["Conv2D"], params_list, x,
+                        nhwc_in=False, nhwc_out=False):
     """Execute sibling Conv2D ops (core/fusion.conv_sibling_groups) as
     ONE conv: kernels concatenate along channel-out, the output splits
     back per member. Exact numerics — each output channel's contraction
@@ -164,7 +167,7 @@ def merged_conv_forward(ops: List["Conv2D"], params_list, x):
     bias = (jnp.concatenate([p["bias"] for p in params_list])
             if lead.use_bias else None)
     y = _conv_apply(x, kernel, bias, lead.stride, lead.padding, nhwc,
-                    lead.activation)
+                    lead.activation, already_nhwc=nhwc_in)
     offsets = [0]
     for op in ops:
         offsets.append(offsets[-1] + op.out_channels)
@@ -172,7 +175,7 @@ def merged_conv_forward(ops: List["Conv2D"], params_list, x):
     outs = []
     for i in range(len(ops)):
         sl = lax.slice_in_dim(y, offsets[i], offsets[i + 1], axis=ch_axis)
-        if nhwc:
+        if nhwc and not nhwc_out:
             sl = jnp.transpose(sl, (0, 3, 1, 2))
         outs.append(sl)
     return outs
@@ -211,7 +214,8 @@ class Pool2D(Op):
         ph, pw = self.padding
         nhwc = self.model.config.conv_layout == "NHWC"
         if nhwc:
-            x = jnp.transpose(x, (0, 2, 3, 1))
+            if not ctx.nhwc_in:
+                x = jnp.transpose(x, (0, 2, 3, 1))
             window = (1, kh, kw, 1)
             strides = (1, sh, sw, 1)
             pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
@@ -227,7 +231,7 @@ class Pool2D(Op):
             # cuDNN CUDNN_POOLING_AVERAGE_COUNT_INCLUDE_PADDING semantics
             y = summed / float(kh * kw)
         y = apply_activation(y, self.activation)
-        if nhwc:
+        if nhwc and not ctx.nhwc_out:
             y = jnp.transpose(y, (0, 3, 1, 2))
         return [y]
 
@@ -284,7 +288,8 @@ class BatchNorm(Op):
         nhwc = (x.ndim == 4
                 and self.model.config.conv_layout == "NHWC")
         if nhwc:
-            x = jnp.transpose(x, (0, 2, 3, 1))
+            if not ctx.nhwc_in:
+                x = jnp.transpose(x, (0, 2, 3, 1))
             reduce_axes = (0, 1, 2)
             ch_axis = 3
         else:
@@ -314,7 +319,7 @@ class BatchNorm(Op):
             x.dtype) + params["bias"].reshape(shape).astype(x.dtype)
         if self.relu:
             y = jax.nn.relu(y)
-        if nhwc:
+        if nhwc and not ctx.nhwc_out:
             y = jnp.transpose(y, (0, 3, 1, 2))
         return [y]
 

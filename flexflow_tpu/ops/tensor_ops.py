@@ -31,7 +31,12 @@ class Concat(Op):
         return [tuple(shape)]
 
     def forward(self, params, xs, ctx: OpContext):
-        return [jnp.concatenate(xs, axis=self.axis)]
+        axis = self.axis
+        if ctx.nhwc_in and axis == 1 and xs[0].ndim == 4:
+            # NHWC-resident operands (executor residency pass): the
+            # logical channel axis lives at position 3
+            axis = 3
+        return [jnp.concatenate(xs, axis=axis)]
 
 
 @register_op

@@ -42,3 +42,38 @@ def test_nhwc_matches_nchw():
         for k in wa:
             np.testing.assert_allclose(wa[k], wb[k], rtol=2e-4,
                                        atol=2e-5)
+
+
+def test_nhwc_residency_multi_device_matches_single_nchw(mesh8):
+    """NHWC residency (values flow channels-last BETWEEN conv-family
+    ops, executor._compute_nhwc_resident) under 8-way DP must match the
+    single-device NCHW walk — including the permuted sharding
+    constraints on resident values and the Concat channel-axis remap."""
+    from flexflow_tpu.parallel.pconfig import OpStrategy, Strategy
+
+    def run(layout, mesh=None):
+        strategy = (Strategy(default=OpStrategy({"sample": "data"}))
+                    if mesh is not None else None)
+        cfg = FFConfig()
+        cfg.batch_size = 16
+        cfg.conv_layout = layout
+        ff = FFModel(cfg, mesh=mesh, strategy=strategy)
+        x = ff.create_tensor((16, 8, 16, 16), name="input")
+        b1 = ff.conv2d(x, 12, 1, 1, 1, 1, 0, 0, activation="relu")
+        b2 = ff.conv2d(x, 6, 1, 1, 1, 1, 0, 0, activation="relu")
+        t = ff.concat([b1, b2], axis=1)
+        t = ff.batch_norm(t)
+        t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+        ff.softmax(ff.dense(ff.flat(t), 4))
+        ff.compile(optimizer=SGDOptimizer(lr=0.005),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=[])
+        if layout == "NHWC":
+            assert ff.executor._nhwc_resident  # the pass is active
+        rng = np.random.RandomState(0)
+        d = {"input": rng.randn(16, 8, 16, 16).astype(np.float32),
+             "label": rng.randint(0, 4, (16,)).astype(np.int32)}
+        return [float(ff.train_batch(d)["loss"]) for _ in range(3)]
+
+    np.testing.assert_allclose(run("NCHW"), run("NHWC", mesh8),
+                               rtol=2e-5)

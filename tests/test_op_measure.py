@@ -176,13 +176,24 @@ def test_stateful_op_is_measurable():
     assert m is not None and m["fwd"] > 0 and m["bwd"] > 0
 
 
-def test_conv_in_situ_factor_cached_and_clamped(tmp_path, monkeypatch):
+@pytest.fixture
+def _clean_insitu_memo():
+    """The memo is module-global and keyed by the REAL device kind — a
+    leaked fake factor would silently scale conv costs for any later
+    test that grounds ops in this process, even when THIS test fails
+    mid-way."""
+    op_measure._INSITU.clear()
+    yield
+    op_measure._INSITU.clear()
+
+
+def test_conv_in_situ_factor_cached_and_clamped(tmp_path, monkeypatch,
+                                                _clean_insitu_memo):
     """The isolated->in-situ conv correction: measured once, persisted
     per device kind, clamped to [1, 3], and 1.0 on failure (grounding
     must degrade to uncorrected, never break the search)."""
     monkeypatch.setattr(op_measure, "_insitu_path",
                         lambda kind: str(tmp_path / f"insitu_{kind}.json"))
-    op_measure._INSITU.clear()
     monkeypatch.setattr(op_measure, "_measure_insitu_factor",
                         lambda: 1.8)
     f = op_measure.conv_in_situ_factor()
@@ -218,11 +229,5 @@ def test_conv_in_situ_factor_cached_and_clamped(tmp_path, monkeypatch):
     fail_path.unlink()
     monkeypatch.setattr(op_measure, "_measure_insitu_factor",
                         lambda: 40.0)
-    try:
-        assert op_measure.conv_in_situ_factor() == 3.0
-        assert _json.loads(fail_path.read_text())["factor"] == 3.0
-    finally:
-        # the memo is module-global and keyed by the REAL device kind —
-        # a leaked 3.0 would silently triple conv costs for any later
-        # test that grounds ops in this process
-        op_measure._INSITU.clear()
+    assert op_measure.conv_in_situ_factor() == 3.0
+    assert _json.loads(fail_path.read_text())["factor"] == 3.0
