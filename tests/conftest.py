@@ -48,6 +48,7 @@ SLOW_TESTS = (
     "test_sp_non_divisible_seq_falls_back",
     "test_skewed_placement_pads",
     "test_adam_sparse_placed",
+    "test_nhwc_residency_multi_device_matches_single_nchw",
 )
 
 
