@@ -3,7 +3,9 @@ proves numerics; this proves them compiled for the real TPU backend):
 
 - sparse embedding updates at DLRM-ish scale, vs the dense path;
 - NHWC conv compute layout vs NCHW;
-- the scanned multi-step dispatch vs sequential single steps.
+- the scanned multi-step dispatch vs sequential single steps;
+- sibling-conv batching + NHWC layout residency vs the plain walk
+  (round-5 conv paths) on an Inception-style module.
 
 Reference analog: the real-GPU CI legs (tests/multi_gpu_tests.sh).
 """
@@ -100,3 +102,47 @@ def test_multi_step_dispatch_on_chip():
     got = list(np.asarray(jax.device_get(
         grp.train_batches(batches)["loss"]), np.float64))
     np.testing.assert_allclose(want, got, rtol=1e-5)
+
+
+def test_sibling_fusion_and_residency_on_chip():
+    """Round-5 conv paths compiled by the REAL backend: sibling-conv
+    batching (merged 1x1 branch heads) and NHWC layout residency
+    (values channels-last between conv-family ops, concat remapped to
+    the channel axis) must match the plain NCHW unfused walk on an
+    Inception-style module."""
+    def build(fuse, layout):
+        cfg = FFConfig()
+        cfg.batch_size = 16
+        cfg.sibling_conv_fusion = fuse
+        cfg.conv_layout = layout
+        ff = FFModel(cfg)
+        x = ff.create_tensor((16, 16, 16, 16), name="input")
+        b1 = ff.conv2d(x, 24, 1, 1, 1, 1, 0, 0, activation="relu")
+        b2 = ff.conv2d(x, 12, 1, 1, 1, 1, 0, 0, activation="relu")
+        b3 = ff.conv2d(x, 16, 1, 1, 1, 1, 0, 0, activation="relu")
+        b3 = ff.conv2d(b3, 16, 3, 3, 1, 1, 1, 1, activation="relu")
+        p = ff.pool2d(x, 3, 3, 1, 1, 1, 1)
+        b4 = ff.conv2d(p, 8, 1, 1, 1, 1, 0, 0, activation="relu")
+        t = ff.concat([b1, b2, b3, b4], axis=1)
+        t = ff.batch_norm(t, relu=True)
+        t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+        ff.softmax(ff.dense(ff.flat(t), 10))
+        ff.compile(optimizer=SGDOptimizer(lr=0.01),
+                   loss_type="sparse_categorical_crossentropy",
+                   metrics=[])
+        return ff
+
+    rng = np.random.RandomState(2)
+    b = {"input": rng.randn(16, 16, 16, 16).astype(np.float32),
+         "label": rng.randint(0, 10, (16,)).astype(np.int32)}
+    ref = build(False, "NCHW")
+    fused = build(True, "NCHW")
+    resident = build(True, "NHWC")
+    assert fused.executor._conv_merge_leader
+    assert resident.executor._nhwc_resident
+    for _ in range(3):
+        lr_ = float(ref.train_batch(b)["loss"])
+        lf = float(fused.train_batch(b)["loss"])
+        ln = float(resident.train_batch(b)["loss"])
+        np.testing.assert_allclose(lf, lr_, rtol=5e-4)
+        np.testing.assert_allclose(ln, lr_, rtol=5e-4)
