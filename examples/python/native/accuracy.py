@@ -1,0 +1,12 @@
+"""Expected-accuracy floors for the native example zoo (reference:
+examples/python/native/accuracy.py — the enum the CI accuracy tests
+assert against; see tests/test_examples.py for the asserting suite)."""
+
+from enum import Enum
+
+
+class ModelAccuracy(Enum):
+    MNIST_MLP = 90.0
+    MNIST_CNN = 98.0
+    CIFAR10_CNN = 78.0
+    CIFAR10_ALEXNET = 71.0
