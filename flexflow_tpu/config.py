@@ -199,6 +199,22 @@ class FFConfig:
     # optimizer semantics, not just cost.
     sparse_embedding_lazy: bool = False
 
+    # ---- serving (flexflow_tpu.serve) ----
+    # block-paged KV-cache geometry: the pool holds kv_num_pages pages
+    # of kv_page_size tokens each, per layer; page 0 is reserved as the
+    # write sink for padding lanes (serve/kv_cache.py). Sized so
+    # (kv_num_pages - 1) * kv_page_size bounds the total resident
+    # tokens across all concurrent sequences.
+    kv_page_size: int = 16
+    kv_num_pages: int = 257
+    # continuous-batching scheduler caps (serve/scheduler.py): at most
+    # serve_max_seqs sequences hold decode slots at once (this is also
+    # the static decode-batch width the engine compiles ONCE), and one
+    # scheduler step admits at most serve_prefill_budget prompt tokens
+    # of new prefill work (FCFS).
+    serve_max_seqs: int = 8
+    serve_prefill_budget: int = 512
+
     # synthetic input when no dataset is provided (reference: config.h:131)
     synthetic_input: bool = False
 
@@ -244,6 +260,20 @@ class FFConfig:
             raise ValueError(
                 f"pipeline_virtual_stages must be >= 1, got "
                 f"{self.pipeline_virtual_stages}")
+        if self.kv_page_size < 1:
+            raise ValueError(
+                f"kv_page_size must be >= 1, got {self.kv_page_size}")
+        if self.kv_num_pages < 2:
+            raise ValueError(
+                f"kv_num_pages must be >= 2 (page 0 is the serving "
+                f"sink page), got {self.kv_num_pages}")
+        if self.serve_max_seqs < 1:
+            raise ValueError(
+                f"serve_max_seqs must be >= 1, got {self.serve_max_seqs}")
+        if self.serve_prefill_budget < 1:
+            raise ValueError(
+                f"serve_prefill_budget must be >= 1, got "
+                f"{self.serve_prefill_budget}")
         if self.pipeline_virtual_stages > 1 \
                 and self.pipeline_schedule != "1f1b":
             raise ValueError(
@@ -287,6 +317,10 @@ class FFConfig:
         "--pipeline-microbatches": ("pipeline_microbatches", int),
         "--pipeline-schedule": ("pipeline_schedule", str),
         "--pipeline-virtual-stages": ("pipeline_virtual_stages", int),
+        "--kv-page-size": ("kv_page_size", int),
+        "--kv-num-pages": ("kv_num_pages", int),
+        "--serve-max-seqs": ("serve_max_seqs", int),
+        "--serve-prefill-budget": ("serve_prefill_budget", int),
     }
     _BOOL_FLAGS = {
         "--profiling": "profiling",

@@ -289,6 +289,171 @@ def flash_profitable(b: int, h: int, sq: int, sk: int, d: int) -> bool:
     return (d % 128 == 0 and sk >= 1024) or score_bytes > 2**31
 
 
+# ------------------------------------------------- paged decode attention
+#
+# The serving decode path (flexflow_tpu/serve): ONE query token per
+# sequence attends to that sequence's whole K/V history, which lives in
+# fixed-size PAGES addressed through a per-sequence page table
+# (serve/kv_cache.py — the "Ragged Paged Attention" layout, PAPERS.md).
+# Two implementations with identical semantics:
+#
+#   * _paged_decode_jnp — gather pages with jnp.take, masked online-free
+#     softmax in f32. XLA lowers the gather to dynamic-gather; for
+#     single-query decode the op is HBM-bound either way, so this is
+#     also a credible TPU path, and it is the reference the Pallas
+#     kernel is tested against bit-for-bit on CPU.
+#   * _paged_decode_pallas — scalar-prefetch kernel: the page table
+#     rides in SMEM ahead of the grid so each (sequence, page) grid
+#     step DMAs exactly one K and one V page picked by
+#     table[seq, page]; online max/sum rescaling accumulates across a
+#     sequence's pages in VMEM scratch, and the output is written on
+#     the sequence's last grid step. Never materializes the gathered
+#     (B, max_len, H, D) K/V that the jnp path pays for.
+#
+# paged_attention_decode dispatches: Pallas on TPU (or interpret=True),
+# jnp elsewhere — the CPU-fallback story for the whole serve package.
+
+
+def _paged_decode_jnp(q, k_pages, v_pages, page_table, seq_lens, scale):
+    """q (B,H,D); k/v_pages (P, ps, H, D); page_table (B, pp) int32;
+    seq_lens (B,) int32 -> (B, H, D).
+
+    Padding page-table entries point at the sink page 0; every position
+    >= seq_len is masked to -inf before the softmax, so sink contents
+    are never observed. All statistics in f32."""
+    b, h, d = q.shape
+    ps = k_pages.shape[1]
+    pp = page_table.shape[1]
+    k = jnp.take(k_pages, page_table, axis=0)  # (B, pp, ps, H, D)
+    v = jnp.take(v_pages, page_table, axis=0)
+    k = k.reshape(b, pp * ps, h, d)
+    v = v.reshape(b, pp * ps, h, d)
+    # batch over (seq, head): s[b,h,t] = q[b,h,:] . k[b,t,h,:]
+    s = jax.lax.dot_general(
+        q, k, (((2,), (3,)), ((0, 1), (0, 2))),
+        preferred_element_type=jnp.float32) * scale     # (B, H, pp*ps)
+    pos = jax.lax.broadcasted_iota(jnp.int32, (b, 1, pp * ps), 2)
+    s = jnp.where(pos < seq_lens[:, None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)                                  # (B, H, pp*ps) f32
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(                            # (B, H, D)
+        p, v.astype(jnp.float32), (((2,), (1,)), ((0, 1), (0, 2))),
+        preferred_element_type=jnp.float32)
+    return (o / l).astype(q.dtype)
+
+
+def _paged_decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, page_size, pages_per_seq,
+                         scale):
+    """Grid (B, pages_per_seq); k_ref/v_ref hold THE page selected by
+    the scalar-prefetched table for this (seq, page) step."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]                    # (H, D)
+    k = k_ref[0]                    # (ps, H, D)
+    v = v_ref[0]
+    h, d = q.shape
+    # scores for this page: (H, ps), f32 accumulate on the MXU
+    s = jax.lax.dot_general(
+        q, k, (((1,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32) * scale
+    # mask positions past the sequence length (padding pages are the
+    # sink page; their scores die here)
+    pos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, (h, page_size),
+                                                   1)
+    s = jnp.where(pos < sl_ref[b], s, -jnp.inf)
+
+    m_prev = m_ref[:]               # (H, 1)
+    l_prev = l_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)          # (H, ps); fully-masked rows -> 0
+    alpha = jnp.exp(m_prev - m_new)
+    m_ref[:] = m_new
+    l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    # p stays f32 and v upcasts, matching _paged_decode_jnp exactly —
+    # the two implementations must not diverge for bf16 KV pages
+    pv = jax.lax.dot_general(       # (H, D): p (H,ps) . v (ps,H,D) per-head
+        p, v.astype(jnp.float32), (((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha + pv
+
+    @pl.when(j == pages_per_seq - 1)
+    def _emit():
+        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+
+
+def _paged_decode_pallas(q, k_pages, v_pages, page_table, seq_lens, scale,
+                         interpret):
+    if not _HAS_PLTPU:
+        raise NotImplementedError("pallas TPU backend unavailable")
+    b, h, d = q.shape
+    ps = k_pages.shape[1]
+    pp = page_table.shape[1]
+    kern = functools.partial(_paged_decode_kernel, page_size=ps,
+                             pages_per_seq=pp, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, seq_lens
+        grid=(b, pp),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda b, j, pt, sl: (b, 0, 0)),
+            pl.BlockSpec((1, ps, h, d),
+                         lambda b, j, pt, sl: (pt[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, ps, h, d),
+                         lambda b, j, pt, sl: (pt[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda b, j, pt, sl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),   # running max
+            pltpu.VMEM((h, 1), jnp.float32),   # running sum
+            pltpu.VMEM((h, d), jnp.float32),   # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(page_table, seq_lens, q, k_pages, v_pages)
+
+
+def paged_attention_decode(q, k_pages, v_pages, page_table, seq_lens, *,
+                           scale=None, use_pallas=None, interpret=False):
+    """Single-query attention through a page table (decode step).
+
+    q (B, H, D) — one query token per sequence; k_pages/v_pages
+    (num_pages, page_size, H, D); page_table (B, pages_per_seq) int32
+    physical page ids (0 = sink/padding); seq_lens (B,) int32 tokens
+    resident per sequence (positions >= seq_len are masked). Every
+    seq_lens entry must be >= 1: a zero-length lane has every score
+    masked to -inf, which NaNs the softmax in both implementations —
+    callers with empty lanes must clamp them to 1 and aim their page
+    table at the sink (serve/engine.py does exactly this). Returns
+    (B, H, D).
+
+    use_pallas: None = auto (Pallas kernel on TPU, jnp gather path
+    elsewhere — the CPU fallback that makes the whole serve package run
+    under JAX_PLATFORMS=cpu), True = force (combine with interpret=True
+    off TPU), False = always jnp (wins over interpret).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_pallas is None:
+        use_pallas = (interpret or (_HAS_PLTPU
+                                    and jax.default_backend() == "tpu"))
+    if use_pallas:
+        return _paged_decode_pallas(q, k_pages, v_pages, page_table,
+                                    seq_lens, scale, interpret)
+    return _paged_decode_jnp(q, k_pages, v_pages, page_table, seq_lens,
+                             scale)
+
+
 def flash_attention_bshd(q, k, v, *, causal=False,
                          block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                          interpret=False, pad_lanes=True):

@@ -2,7 +2,7 @@
 (SURVEY.md 2.7), built on the framework's builder API."""
 
 from .alexnet import build_alexnet
-from .transformer import build_transformer
+from .transformer import build_transformer, build_transformer_lm
 from .resnet import build_resnet
 from .inception import build_inception_v3
 from .dlrm import build_dlrm
@@ -13,6 +13,7 @@ from .nmt_lstm import build_nmt_lstm, build_nmt_seq2seq
 __all__ = [
     "build_alexnet",
     "build_transformer",
+    "build_transformer_lm",
     "build_resnet",
     "build_inception_v3",
     "build_dlrm",

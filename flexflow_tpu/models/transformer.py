@@ -16,6 +16,50 @@ from ..config import FFConfig
 from ..model import FFModel
 
 
+def build_transformer_lm(config: Optional[FFConfig] = None,
+                         vocab_size: int = 256, max_seq_len: int = 128,
+                         batch_size: int = None, hidden: int = 256,
+                         num_heads: int = 4, num_layers: int = 2,
+                         ff_dim: int = 512, dtype=jnp.float32,
+                         mesh=None, strategy=None,
+                         layer_norm: bool = True) -> FFModel:
+    """Causal decoder LM — the serving counterpart of the encoder
+    classifier below, consumed by flexflow_tpu.serve.ServeEngine.
+
+    Token + learned-position embeddings, pre-LN causal-attention blocks,
+    final LN, tied-nothing vocab head. The op NAMES are the contract
+    the ServeEngine reads weights through (tok_embed / pos_embed /
+    layer{i}_{ln1,attn,ln2,ff1,ff2} / final_ln / lm_head) — the graph
+    itself also runs as a normal FFModel (training the LM uses the
+    ordinary executor; serving bypasses the graph for the cached decode
+    path but the parameters are the same arrays)."""
+    cfg = config or FFConfig()
+    bs = batch_size or cfg.batch_size
+    ff = FFModel(cfg, mesh=mesh, strategy=strategy)
+    tokens = ff.create_tensor((bs, max_seq_len), dtype=jnp.int32,
+                              name="tokens")
+    positions = ff.create_tensor((bs, max_seq_len), dtype=jnp.int32,
+                                 name="positions")
+    te = ff.embedding(tokens, vocab_size, hidden, aggr="none",
+                      name="tok_embed", dtype=dtype)
+    pe = ff.embedding(positions, max_seq_len, hidden, aggr="none",
+                      name="pos_embed", dtype=dtype)
+    t = ff.add(te, pe, name="embed_add")
+    for i in range(num_layers):
+        a_in = ff.layer_norm(t, name=f"layer{i}_ln1") if layer_norm else t
+        a = ff.multihead_attention(a_in, a_in, a_in, hidden, num_heads,
+                                   causal=True, name=f"layer{i}_attn")
+        t = ff.add(a, t, name=f"layer{i}_res1")
+        f_in = ff.layer_norm(t, name=f"layer{i}_ln2") if layer_norm else t
+        h = ff.dense(f_in, ff_dim, activation="relu", name=f"layer{i}_ff1")
+        h = ff.dense(h, hidden, name=f"layer{i}_ff2")
+        t = ff.add(h, t, name=f"layer{i}_res2")
+    if layer_norm:
+        t = ff.layer_norm(t, name="final_ln")
+    ff.dense(t, vocab_size, name="lm_head")
+    return ff
+
+
 def build_transformer(config: Optional[FFConfig] = None,
                       batch_size: int = None, seq_len: int = 128,
                       hidden: int = 512, num_heads: int = 8,

@@ -1,0 +1,32 @@
+"""Version-compat shims for the parallel subsystem.
+
+`shard_map` has moved across jax releases: new jax exports it at the
+top level (`jax.shard_map`), older releases only under
+`jax.experimental.shard_map` — and the replication-check kwarg was
+renamed (`check_rep` -> `check_vma`). Every shard_map consumer in this
+package (ring_attention, ulysses, pipeline, graph_pipeline — and
+core/staged.py through graph_pipeline) imports it from here so the
+version probe lives in exactly one place. Call sites use the NEW
+spelling (`check_vma`); the wrapper translates for old jax.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:  # jax >= 0.6: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+__all__ = ["shard_map"]

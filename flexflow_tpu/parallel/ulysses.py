@@ -29,7 +29,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 # score-matrix bytes per device above which `auto` falls back to ring

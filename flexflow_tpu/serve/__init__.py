@@ -1,0 +1,22 @@
+"""flexflow_tpu.serve — continuous-batching inference.
+
+The training half of the framework compiles an op graph into one jitted
+SPMD step; this package opens the inference half: a block-paged KV-cache
+(:mod:`kv_cache`), a continuous-batching scheduler (:mod:`scheduler`),
+and a :class:`ServeEngine` (:mod:`engine`) that wraps a built LM into
+jitted prefill/decode steps with static padded shapes so XLA compiles
+each bucket exactly once.
+"""
+
+from .kv_cache import KVCacheConfig, PagedKVCache
+from .scheduler import ContinuousBatchingScheduler, Request, RequestState
+from .engine import ServeEngine
+
+__all__ = [
+    "KVCacheConfig",
+    "PagedKVCache",
+    "ContinuousBatchingScheduler",
+    "Request",
+    "RequestState",
+    "ServeEngine",
+]

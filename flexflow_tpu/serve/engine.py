@@ -1,0 +1,456 @@
+"""ServeEngine: jitted prefill/decode steps over a paged KV-cache.
+
+Wraps an LM built by models/transformer.build_transformer_lm into the
+two functions autoregressive serving actually runs:
+
+  prefill — one sequence's whole prompt in one pass: full causal
+    attention (the MXU-friendly shape), K/V scattered into the
+    sequence's pages, logits of the LAST real position returned.
+  decode  — ONE token for EVERY running sequence as a single batch:
+    single-query attention through the page tables
+    (kernels/flash_attention.paged_attention_decode), new K/V written
+    in-place at each sequence's tail.
+
+Static shapes are the whole game on TPU: decode always runs at the
+full slot width (max_seqs lanes; empty lanes aim at the sink page), and
+prompts pad to power-of-two token BUCKETS, so XLA compiles one decode
+program plus one prefill program per bucket — ever. After
+`warmup()` a serving process never recompiles (generate() can assert
+this via `compile_counts()`), which is what keeps p99 latency flat.
+
+The engine reads weights straight out of the compiled FFModel's
+TrainState and re-implements the block math as pure functions — the
+graph executor has no notion of carried state, and threading a cache
+through it would force every op to learn about sequence position. The
+ops' numerics are mirrored exactly (LayerNorm f32 statistics, f32
+matmul accumulation), so `generate_reference` (naive no-cache
+re-forward each step) produces identical tokens — the parity test.
+
+Caches flow functionally: generate() owns (k_pages, v_pages) for its
+lifetime and threads them through the jitted steps with donated
+buffers, so the update is in-place on device and the host never holds
+two copies.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import CompMode
+from ..kernels.flash_attention import paged_attention_decode
+from .kv_cache import KVCacheConfig, PagedKVCache
+from .scheduler import ContinuousBatchingScheduler, Request
+
+
+def _ln(p, x, eps):
+    """LayerNorm with f32 statistics — must mirror ops/elementwise.py
+    LayerNorm.forward exactly (the reference-parity contract)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _dense(p, x, activation=None):
+    y = jnp.dot(x, p["kernel"].astype(x.dtype),
+                preferred_element_type=jnp.float32).astype(x.dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    if activation == "relu":
+        y = jax.nn.relu(y)
+    return y
+
+
+class ServeEngine:
+    """Continuous-batching generation over a build_transformer_lm model.
+
+    model must be compiled (any comp_mode); if not, it is compiled here
+    in INFERENCE mode (no optimizer slots). All serving knobs come from
+    the model's FFConfig (kv_page_size / kv_num_pages / serve_max_seqs /
+    serve_prefill_budget).
+    """
+
+    def __init__(self, model, *, max_seq_len: Optional[int] = None,
+                 use_pallas: Optional[bool] = None, interpret: bool = False):
+        if model.state is None:
+            model.compile(comp_mode=CompMode.INFERENCE)
+        self.model = model
+        self.config = model.config
+        self._use_pallas = use_pallas
+        self._interpret = interpret
+        self._read_arch(model)
+        if max_seq_len is None:
+            max_seq_len = self.max_positions
+        if max_seq_len > self.max_positions:
+            raise ValueError(
+                f"max_seq_len {max_seq_len} exceeds the LM's learned "
+                f"positions ({self.max_positions})")
+        self.cache_cfg = KVCacheConfig.from_ff(
+            self.config, num_layers=self.num_layers,
+            num_heads=self.num_heads, head_dim=self.head_dim,
+            max_seq_len=max_seq_len)
+        self.cache_cfg.validate()
+        # prompt-length buckets: powers of two from one page up to the
+        # page-table ceiling — each bucket is one prefill compilation
+        cap = self.cache_cfg.pages_per_seq * self.cache_cfg.page_size
+        b = max(self.cache_cfg.page_size, 16)
+        self.buckets = []
+        while b < cap:
+            self.buckets.append(b)
+            b *= 2
+        self.buckets.append(cap)
+        self._prefill_jit = jax.jit(self._prefill_impl,
+                                    donate_argnums=(1, 2))
+        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2))
+        self._forward_jit = jax.jit(self._forward_logits)  # naive reference
+        # shape signatures seen per serving function: the version-proof
+        # compile counter (jit._cache_size is a private API) — a new
+        # signature IS a new XLA program under jit
+        self._shapes_seen: Dict[str, set] = {"prefill": set(),
+                                             "decode": set()}
+        self.last_stats: Optional[dict] = None
+
+    def _call_counted(self, name, fn, *args):
+        self._shapes_seen[name].add(tuple(
+            (tuple(a.shape), str(a.dtype)) for a in args
+            if hasattr(a, "shape")))
+        return fn(*args)
+
+    # ---------------- model introspection -----------------------------
+    def _read_arch(self, model) -> None:
+        ops = {op.name: op for op in model.ops}
+        for required in ("tok_embed", "pos_embed", "lm_head"):
+            if required not in ops:
+                raise ValueError(
+                    f"ServeEngine needs a build_transformer_lm-shaped "
+                    f"model (missing op {required!r})")
+        self.vocab_size = ops["tok_embed"].num_entries
+        self.max_positions = ops["pos_embed"].num_entries
+        self.layer_norm = "layer0_ln1" in ops
+        self.num_layers = 0
+        while f"layer{self.num_layers}_attn" in ops:
+            self.num_layers += 1
+        if self.num_layers == 0:
+            raise ValueError("model has no layer{i}_attn blocks")
+        attn0 = ops[f"layer{0}_attn"]
+        if not attn0.causal:
+            raise ValueError("serving needs causal attention blocks")
+        self.num_heads = attn0.num_heads
+        self.head_dim = attn0.head_dim
+        self.hidden = attn0.embed_dim
+        self.ln_eps = ops["layer0_ln1"].eps if self.layer_norm else 1e-5
+        self.params = model.state.params  # live references, not copies
+
+    # ---------------- pure block math ----------------------------------
+    def _embed(self, params, tokens, positions):
+        te = jnp.take(params["tok_embed"]["kernel"], tokens, axis=0)
+        pe = jnp.take(params["pos_embed"]["kernel"], positions, axis=0)
+        return (te + pe).astype(jnp.float32)
+
+    def _attn_qkv(self, p, h):
+        """h (..., E) -> q, k, v (..., H, D)."""
+        q = jnp.einsum("...e,ehd->...hd", h, p["wq"].astype(h.dtype))
+        k = jnp.einsum("...e,ehd->...hd", h, p["wk"].astype(h.dtype))
+        v = jnp.einsum("...e,ehd->...hd", h, p["wv"].astype(h.dtype))
+        return q, k, v
+
+    def _attn_out(self, p, o, x):
+        y = jnp.einsum("...hd,hde->...e", o, p["wo"].astype(o.dtype))
+        if "bo" in p:
+            y = y + p["bo"].astype(y.dtype)
+        return x + y
+
+    def _ffn(self, params, i, x):
+        h = _ln(params[f"layer{i}_ln2"], x, self.ln_eps) \
+            if self.layer_norm else x
+        h = _dense(params[f"layer{i}_ff1"], h, activation="relu")
+        h = _dense(params[f"layer{i}_ff2"], h)
+        return x + h
+
+    def _head(self, params, x):
+        if self.layer_norm:
+            x = _ln(params["final_ln"], x, self.ln_eps)
+        return _dense(params["lm_head"], x)
+
+    # ---------------- full-sequence forward (prefill + reference) ------
+    def _forward_tokens(self, params, tokens, length, kv=None):
+        """Causal forward over (1, S) padded tokens; returns the
+        logits of position length-1 plus the (possibly updated)
+        caches. `kv = (k_pages, v_pages, pt_row)` scatters each
+        layer's K/V into the sequence's pages on the way through
+        (prefill); kv=None is the pure no-cache forward (the naive
+        reference) — ONE implementation so the parity oracle and the
+        serving path can never drift apart."""
+        ps = self.cache_cfg.page_size
+        s = tokens.shape[1]
+        positions = jnp.arange(s, dtype=jnp.int32)[None, :]
+        x = self._embed(params, tokens, positions)        # (1, S, E)
+        if kv is not None:
+            k_pages, v_pages, pt_row = kv
+            pages = jnp.take(pt_row, positions[0] // ps)  # (S,)
+            offs = positions[0] % ps
+        scale = 1.0 / np.sqrt(self.head_dim)
+        causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+        for i in range(self.num_layers):
+            p = params[f"layer{i}_attn"]
+            h = _ln(params[f"layer{i}_ln1"], x, self.ln_eps) \
+                if self.layer_norm else x
+            q, k, v = self._attn_qkv(p, h)                # (1, S, H, D)
+            if kv is not None:
+                k_pages = k_pages.at[i, pages, offs].set(k[0])
+                v_pages = v_pages.at[i, pages, offs].set(v[0])
+            logits = jnp.einsum("bihd,bjhd->bhij", q, k,
+                                preferred_element_type=jnp.float32) * scale
+            logits = jnp.where(causal, logits, -jnp.inf)
+            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+            o = jnp.einsum("bhij,bjhd->bihd", probs, v)
+            x = self._attn_out(p, o, x)
+            x = self._ffn(params, i, x)
+        logits = self._head(params, x)                    # (1, S, V)
+        last = jnp.take(logits[0], length - 1, axis=0)    # (V,)
+        return last, (None if kv is None else (k_pages, v_pages))
+
+    # ---------------- prefill ------------------------------------------
+    def _prefill_impl(self, params, k_pages, v_pages, tokens, length,
+                      pt_row):
+        """tokens (1, S) padded to a bucket; length scalar int32 (real
+        prompt tokens); pt_row (pages_per_seq,) the sequence's page
+        table. Returns (last-position logits (V,), k_pages, v_pages).
+
+        Padded positions scatter their K/V through page-table entries
+        normally: entries past the reserved range are 0 (the sink), and
+        padded offsets inside a reserved page are overwritten by decode
+        before the length mask ever exposes them."""
+        last, (k_pages, v_pages) = self._forward_tokens(
+            params, tokens, length, kv=(k_pages, v_pages, pt_row))
+        return last, k_pages, v_pages
+
+    # ---------------- decode -------------------------------------------
+    def _decode_impl(self, params, k_pages, v_pages, tokens, positions,
+                     write_pages, write_offs, page_tables, seq_lens):
+        """One token for every slot lane. tokens/positions (B,) int32;
+        write_pages/write_offs (B,) the physical slot for each lane's
+        new K/V — HOST-computed so lanes that are not decoding this
+        step (empty, or prefilled moments ago) aim at the sink page 0
+        instead of clobbering their own position 0; page_tables
+        (B, pages_per_seq); seq_lens (B,) INCLUDING the token being
+        decoded (its K/V is written here, then attended — position i
+        sees keys 0..i). Non-decoding lanes compute garbage the host
+        never reads. Returns (next_tokens (B,), k_pages, v_pages)."""
+        x = self._embed(params, tokens, positions)        # (B, E)
+        pages, offs = write_pages, write_offs
+        scale = 1.0 / np.sqrt(self.head_dim)
+        for i in range(self.num_layers):
+            p = params[f"layer{i}_attn"]
+            h = _ln(params[f"layer{i}_ln1"], x, self.ln_eps) \
+                if self.layer_norm else x
+            q, k, v = self._attn_qkv(p, h)                # (B, H, D)
+            k_pages = k_pages.at[i, pages, offs].set(k)
+            v_pages = v_pages.at[i, pages, offs].set(v)
+            o = paged_attention_decode(
+                q, k_pages[i], v_pages[i], page_tables, seq_lens,
+                scale=scale, use_pallas=self._use_pallas,
+                interpret=self._interpret)
+            x = self._attn_out(p, o, x)
+            x = self._ffn(params, i, x)
+        logits = self._head(params, x)                    # (B, V)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+            k_pages, v_pages
+
+    # ---------------- naive no-cache reference -------------------------
+    def _forward_logits(self, params, tokens, length):
+        """Full forward over (1, S) tokens, logits at position
+        length-1 — the no-KV-cache greedy-decode reference (the shared
+        _forward_tokens with the cache writes off)."""
+        last, _ = self._forward_tokens(params, tokens, length, kv=None)
+        return last
+
+    # ---------------- bucketing / compile bookkeeping ------------------
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt of {prompt_len} tokens exceeds the largest bucket "
+            f"{self.buckets[-1]}")
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Compiled-program count per serving function. After warmup()
+        these must never grow — the zero-recompile serving contract.
+        Uses jit's compilation-cache size when the (private) API
+        exists, else the engine's own count of distinct argument-shape
+        signatures (each distinct signature is one XLA program), so the
+        contract check can never go vacuous on a jax without
+        _cache_size."""
+        def n(f, name):
+            try:
+                return int(f._cache_size())
+            except AttributeError:  # jit cache API moved across versions
+                return len(self._shapes_seen[name])
+        return {"prefill": n(self._prefill_jit, "prefill"),
+                "decode": n(self._decode_jit, "decode")}
+
+    def warmup(self) -> Dict[str, int]:
+        """Compile every prefill bucket and the decode step once, on
+        throwaway inputs. Returns compile_counts() afterwards."""
+        c = self.cache_cfg
+        kp, vp = PagedKVCache(c).alloc_device_cache()
+        pt_row = jnp.zeros((c.pages_per_seq,), jnp.int32)
+        for b in self.buckets:
+            toks = jnp.zeros((1, b), jnp.int32)
+            _, kp, vp = self._call_counted(
+                "prefill", self._prefill_jit, self.params, kp, vp, toks,
+                jnp.int32(1), pt_row)
+        toks = jnp.zeros((c.max_seqs,), jnp.int32)
+        pos = jnp.zeros((c.max_seqs,), jnp.int32)
+        pts = jnp.zeros((c.max_seqs, c.pages_per_seq), jnp.int32)
+        sls = jnp.ones((c.max_seqs,), jnp.int32)
+        self._call_counted("decode", self._decode_jit, self.params, kp,
+                           vp, toks, pos, toks, pos, pts, sls)
+        return self.compile_counts()
+
+    # ---------------- the serving loop ---------------------------------
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens, eos_token: Optional[int] = None
+                 ) -> List[List[int]]:
+        """Greedy-decode a ragged batch under continuous batching.
+        `max_new_tokens` is an int or a per-prompt sequence. Returns
+        the generated tokens (prompt excluded) per prompt, in order.
+        Per-request latency and per-token timings land in
+        `self.last_stats` (render with utils/profiling.serve_report)."""
+        c = self.cache_cfg
+        cache = PagedKVCache(c)
+        sched = ContinuousBatchingScheduler(
+            cache, prefill_token_budget=int(
+                getattr(self.config, "serve_prefill_budget", 512)))
+        if isinstance(max_new_tokens, int):
+            max_new_tokens = [max_new_tokens] * len(prompts)
+        if len(max_new_tokens) != len(prompts):
+            raise ValueError(
+                f"max_new_tokens has {len(max_new_tokens)} entries for "
+                f"{len(prompts)} prompts")
+        reqs: List[Request] = []
+        t0 = time.perf_counter()
+        for prompt, mnt in zip(prompts, max_new_tokens):
+            r = sched.submit(prompt, mnt, eos_token=eos_token)
+            r.t_submit = time.perf_counter()
+            reqs.append(r)
+        k_pages, v_pages = cache.alloc_device_cache()
+        decode_steps = 0
+        decode_times: List[float] = []   # seconds per decode step
+        decode_widths: List[int] = []    # active lanes per decode step
+        prefill_times: List[Tuple[int, float]] = []  # (bucket, seconds)
+
+        while sched.has_work():
+            plan = sched.schedule()
+            for req in plan.prefills:
+                b = self.bucket_for(len(req.prompt))
+                toks = np.zeros((1, b), np.int32)
+                toks[0, :len(req.prompt)] = req.prompt
+                tp = time.perf_counter()
+                last, k_pages, v_pages = self._call_counted(
+                    "prefill", self._prefill_jit,
+                    self.params, k_pages, v_pages, jnp.asarray(toks),
+                    jnp.int32(len(req.prompt)),
+                    jnp.asarray(cache.page_tables[req.slot]))
+                tok = int(jnp.argmax(last))
+                prefill_times.append((b, time.perf_counter() - tp))
+                req.out_tokens.append(tok)
+                req.t_first_token = time.perf_counter()
+                if req.is_done():
+                    req.t_finish = req.t_first_token
+                    sched.finish(req)
+            if plan.decodes:
+                tokens = np.zeros((c.max_seqs,), np.int32)
+                positions = np.zeros((c.max_seqs,), np.int32)
+                write_pages = np.zeros((c.max_seqs,), np.int32)  # sink
+                write_offs = np.zeros((c.max_seqs,), np.int32)
+                for req in plan.decodes:
+                    # the new token's K/V slot: append BEFORE the step
+                    # so seq_lens includes it (self-attention sees it)
+                    pos = cache.append_token(req.slot)
+                    positions[req.slot] = pos
+                    tokens[req.slot] = req.out_tokens[-1]
+                    write_pages[req.slot] = cache.page_tables[
+                        req.slot, pos // c.page_size]
+                    write_offs[req.slot] = pos % c.page_size
+                seq_lens = np.maximum(cache.seq_lens, 1)  # empty lanes:
+                # >= 1 valid (sink) key so the masked softmax stays NaN-free
+                tp = time.perf_counter()
+                nxt, k_pages, v_pages = self._call_counted(
+                    "decode", self._decode_jit,
+                    self.params, k_pages, v_pages, jnp.asarray(tokens),
+                    jnp.asarray(positions), jnp.asarray(write_pages),
+                    jnp.asarray(write_offs), jnp.asarray(cache.page_tables),
+                    jnp.asarray(seq_lens))
+                nxt = np.asarray(nxt)    # ONE device->host fetch per step
+                now = time.perf_counter()
+                decode_times.append(now - tp)
+                decode_widths.append(len(plan.decodes))
+                decode_steps += 1
+                for req in plan.decodes:
+                    req.out_tokens.append(int(nxt[req.slot]))
+                    if req.is_done():
+                        req.t_finish = time.perf_counter()
+                        sched.finish(req)
+        cache.check_invariants()
+        assert cache.free_pages == c.usable_pages, "pages leaked"
+        total_new = sum(len(r.out_tokens) for r in reqs)
+        wall = time.perf_counter() - t0
+        self.last_stats = {
+            "requests": [
+                {"rid": r.rid, "prompt_tokens": len(r.prompt),
+                 "new_tokens": len(r.out_tokens),
+                 "ttft_s": r.t_first_token - r.t_submit,
+                 "latency_s": r.t_finish - r.t_submit}
+                for r in reqs],
+            "wall_s": wall,
+            "total_new_tokens": total_new,
+            "tokens_per_sec": total_new / wall if wall > 0 else 0.0,
+            "decode_steps": decode_steps,
+            "decode_step_times_s": decode_times,
+            "decode_widths": decode_widths,
+            "prefill_times_s": prefill_times,
+            "compile_counts": self.compile_counts(),
+        }
+        return [list(r.out_tokens) for r in reqs]
+
+    def generate_reference(self, prompts: Sequence[Sequence[int]],
+                           max_new_tokens,
+                           eos_token: Optional[int] = None
+                           ) -> List[List[int]]:
+        """Naive no-cache greedy decode: re-forward the WHOLE sequence
+        for every new token, one request at a time. O(n^2) per token —
+        the correctness oracle generate() is tested against."""
+        if isinstance(max_new_tokens, int):
+            max_new_tokens = [max_new_tokens] * len(prompts)
+        if len(max_new_tokens) != len(prompts):
+            raise ValueError(
+                f"max_new_tokens has {len(max_new_tokens)} entries for "
+                f"{len(prompts)} prompts")
+        out: List[List[int]] = []
+        for prompt, mnt in zip(prompts, max_new_tokens):
+            if mnt < 1:  # mirror scheduler.submit's contract
+                raise ValueError(f"max_new_tokens must be >= 1, got {mnt}")
+            toks = list(prompt)
+            new: List[int] = []
+            while len(new) < mnt:
+                b = self.bucket_for(len(toks))
+                arr = np.zeros((1, b), np.int32)
+                arr[0, :len(toks)] = toks
+                logits = self._forward_jit(self.params, jnp.asarray(arr),
+                                           jnp.int32(len(toks)))
+                tok = int(jnp.argmax(logits))
+                new.append(tok)
+                toks.append(tok)
+                if eos_token is not None and tok == eos_token:
+                    break
+            out.append(new)
+        return out

@@ -1,0 +1,208 @@
+"""Block-paged KV-cache manager.
+
+The device cache is a fixed pool of PAGES — (page_size, heads, head_dim)
+K and V blocks per layer — and each sequence owns a PAGE TABLE mapping
+its logical token positions to physical pages, exactly the layout of
+"Ragged Paged Attention" serving kernels (PAPERS.md): token t of a
+sequence lives at page `table[t // page_size]`, offset `t % page_size`.
+
+Why pages instead of one (max_seqs, max_len) rectangle: a rectangle
+reserves max_len tokens of HBM per slot whether or not the sequence uses
+them; pages let short and long sequences share one pool, so capacity is
+bounded by TOTAL resident tokens, not max_seqs * max_len. Freeing a
+finished sequence returns whole pages to the pool — reuse is
+defrag-free because pages are fixed-size and position-independent.
+
+Page 0 is reserved as the write SINK: padding lanes of the static-shape
+prefill/decode steps (positions past a prompt's real length, inactive
+decode slots) scatter their K/V there through page-table entries of 0,
+so the jitted steps never need a masked scatter. Reads are masked by
+sequence length, so sink contents are never observed.
+
+Host/device split: this class owns only HOST bookkeeping (free list,
+page tables, lengths) as numpy arrays the scheduler mutates freely; the
+device arrays are created once by `alloc_device_cache()` and flow
+functionally through the engine's jitted steps (donated in, returned
+out) — the manager never touches device memory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Geometry of the paged pool. Built from FFConfig + model shape via
+    :meth:`from_ff` so every serving component sizes itself from the
+    same knobs (config.py kv_page_size / kv_num_pages /
+    serve_max_seqs)."""
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    page_size: int = 16
+    num_pages: int = 257  # including the reserved sink page 0
+    max_seqs: int = 8
+    max_seq_len: int = 512  # logical cap; rounds up to whole pages
+
+    @classmethod
+    def from_ff(cls, config, *, num_layers: int, num_heads: int,
+                head_dim: int, max_seq_len: int = 512) -> "KVCacheConfig":
+        return cls(num_layers=num_layers, num_heads=num_heads,
+                   head_dim=head_dim,
+                   page_size=int(getattr(config, "kv_page_size", 16)),
+                   num_pages=int(getattr(config, "kv_num_pages", 257)),
+                   max_seqs=int(getattr(config, "serve_max_seqs", 8)),
+                   max_seq_len=max_seq_len)
+
+    @property
+    def pages_per_seq(self) -> int:
+        """Static page-table width (logical max_seq_len in pages)."""
+        return -(-self.max_seq_len // self.page_size)
+
+    @property
+    def usable_pages(self) -> int:
+        return self.num_pages - 1  # minus the sink
+
+    def validate(self) -> None:
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {self.page_size}")
+        if self.num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is the reserved sink), "
+                f"got {self.num_pages}")
+        if self.pages_per_seq > self.usable_pages:
+            raise ValueError(
+                f"one max-length sequence needs {self.pages_per_seq} pages "
+                f"but the pool only has {self.usable_pages} usable")
+
+
+class PagedKVCache:
+    """Host-side page allocator + per-slot page tables.
+
+    Slots are the static decode-batch lanes (0..max_seqs-1); the
+    scheduler binds a running request to a slot and this class binds the
+    slot to pages. All arrays are padded to static shapes so the jitted
+    steps see one geometry forever:
+
+      page_tables  (max_seqs, pages_per_seq) int32, 0 = sink/unmapped
+      seq_lens     (max_seqs,) int32, 0 = slot empty
+    """
+
+    def __init__(self, cfg: KVCacheConfig):
+        cfg.validate()
+        self.cfg = cfg
+        # LIFO free list: most-recently-freed pages are reused first
+        # (their cache lines are warmest); page 0 never enters the pool.
+        self._free: List[int] = list(range(cfg.num_pages - 1, 0, -1))
+        self.page_tables = np.zeros((cfg.max_seqs, cfg.pages_per_seq),
+                                    dtype=np.int32)
+        self.seq_lens = np.zeros((cfg.max_seqs,), dtype=np.int32)
+        self._slot_free = list(range(cfg.max_seqs - 1, -1, -1))
+
+    # ---------------- capacity queries (scheduler admission) ----------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._slot_free)
+
+    def pages_needed(self, total_tokens: int) -> int:
+        """Pages a sequence of `total_tokens` (prompt + all new tokens)
+        will occupy — the scheduler reserves this worst case at
+        admission so a running sequence can never strand mid-decode with
+        an empty pool (no preemption path)."""
+        return -(-total_tokens // self.cfg.page_size)
+
+    def can_admit(self, total_tokens: int) -> bool:
+        return (self.free_slots > 0
+                and total_tokens <= self.cfg.max_seq_len
+                and self.pages_needed(total_tokens) <= self.free_pages)
+
+    # ---------------- slot lifecycle ----------------------------------
+    def alloc_slot(self, prompt_len: int, reserve_tokens: int) -> int:
+        """Claim a decode slot and map pages for `reserve_tokens` total
+        tokens (prompt + max new). Returns the slot id. The prompt is
+        considered resident immediately (seq_len = prompt_len); decode
+        then advances the length one token at a time through
+        :meth:`append_token`."""
+        if prompt_len < 1:
+            raise ValueError("prompt must be at least 1 token")
+        if prompt_len > reserve_tokens:
+            raise ValueError(
+                f"reserve_tokens ({reserve_tokens}) must cover the "
+                f"prompt ({prompt_len})")
+        if not self.can_admit(reserve_tokens):
+            raise RuntimeError(
+                f"admission bug: alloc_slot for {reserve_tokens} tokens "
+                f"with {self.free_pages} pages / {self.free_slots} slots "
+                f"free (scheduler must check can_admit first)")
+        slot = self._slot_free.pop()
+        n = self.pages_needed(reserve_tokens)
+        for i in range(n):
+            self.page_tables[slot, i] = self._free.pop()
+        self.seq_lens[slot] = prompt_len
+        return slot
+
+    def append_token(self, slot: int) -> int:
+        """Advance the slot's length by one decoded token; returns the
+        new token's position. Pages were reserved at admission, so this
+        never allocates."""
+        if self.seq_lens[slot] == 0:
+            raise RuntimeError(f"append_token on empty slot {slot}")
+        pos = int(self.seq_lens[slot])
+        page_idx = pos // self.cfg.page_size
+        if self.page_tables[slot, page_idx] == 0:
+            raise RuntimeError(
+                f"slot {slot} ran past its reserved pages at position "
+                f"{pos} (admission reserved too few)")
+        self.seq_lens[slot] = pos + 1
+        return pos
+
+    def free_slot(self, slot: int) -> None:
+        """Return the slot's pages to the pool and clear its table —
+        the eviction path the scheduler runs the moment a sequence
+        finishes, which is what lets the waiting queue backfill."""
+        for i in range(self.cfg.pages_per_seq):
+            p = int(self.page_tables[slot, i])
+            if p != 0:
+                self._free.append(p)
+                self.page_tables[slot, i] = 0
+        self.seq_lens[slot] = 0
+        self._slot_free.append(slot)
+
+    # ---------------- device arrays -----------------------------------
+    def alloc_device_cache(self, dtype=None):
+        """The (k_pages, v_pages) device arrays, each
+        (num_layers, num_pages, page_size, num_heads, head_dim). Created
+        once per engine; thereafter they only flow through jitted steps
+        (donated), never through this manager."""
+        import jax.numpy as jnp
+        c = self.cfg
+        shape = (c.num_layers, c.num_pages, c.page_size, c.num_heads,
+                 c.head_dim)
+        dt = dtype or jnp.float32
+        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+    # ---------------- invariant checks (tests) ------------------------
+    def check_invariants(self) -> None:
+        """Property-style asserts: every page is either free, mapped to
+        exactly one slot, or the sink; lengths fit mapped pages."""
+        mapped = [int(p) for row in self.page_tables for p in row if p != 0]
+        assert len(mapped) == len(set(mapped)), "page mapped twice"
+        assert 0 not in mapped, "sink page mapped to a slot"
+        assert not (set(mapped) & set(self._free)), "page both mapped+free"
+        assert len(mapped) + len(self._free) == self.cfg.usable_pages, (
+            f"page leak: {self.cfg.usable_pages - len(mapped) - len(self._free)}"
+            f" pages unaccounted for")
+        for s in range(self.cfg.max_seqs):
+            n_mapped = int(np.count_nonzero(self.page_tables[s]))
+            assert int(self.seq_lens[s]) <= n_mapped * self.cfg.page_size, (
+                f"slot {s} length {self.seq_lens[s]} exceeds its "
+                f"{n_mapped} mapped pages")

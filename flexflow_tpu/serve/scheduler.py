@@ -1,0 +1,153 @@
+"""Continuous-batching scheduler.
+
+Policy (the "continuous batching" of Orca / vLLM, re-cut for TPU static
+shapes — see docs/serving.md):
+
+  * FCFS admission: waiting requests are admitted in arrival order,
+    never reordered, as long as (a) a decode slot is free, (b) the
+    KV-cache can reserve the request's WORST-CASE pages (prompt +
+    max_new_tokens — no preemption path exists, so a running sequence
+    must never be able to strand the pool), and (c) this step's
+    admitted prompt tokens stay under `prefill_token_budget` (bounds
+    the latency hit decode lanes take while prefills run).
+  * Prefill/decode interleaving: every scheduler step first admits
+    prefills under the budget, then decodes ALL running sequences as
+    one batch. A long queue therefore never starves decode, and fresh
+    capacity never idles waiting for the batch to drain.
+  * Eviction + backfill: the moment a sequence finishes, its slot and
+    pages are freed — the NEXT schedule() call immediately admits from
+    the waiting queue into the vacated capacity. The batch composition
+    changes between steps, not between full batches (the whole point
+    of continuous batching vs. static batching).
+
+The scheduler is pure host-side bookkeeping over the PagedKVCache; the
+engine owns all device work. Splitting it this way keeps the policy
+testable as plain Python (tests/test_serve.py property asserts) and
+keeps the jitted steps free of data-dependent shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence
+
+from .kv_cache import PagedKVCache
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"   # prefilled; holds a decode slot
+    FINISHED = "finished"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. `prompt` is token ids; generation stops
+    after `max_new_tokens` or on `eos_token` (if given)."""
+
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token: Optional[int] = None
+
+    state: RequestState = RequestState.WAITING
+    slot: int = -1
+    out_tokens: List[int] = dataclasses.field(default_factory=list)
+    # serving metrics (utils/profiling.serve_report): wall-clock stamps
+    t_submit: float = 0.0
+    t_first_token: float = 0.0
+    t_finish: float = 0.0
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.prompt) + self.max_new_tokens
+
+    def is_done(self) -> bool:
+        if len(self.out_tokens) >= self.max_new_tokens:
+            return True
+        return (self.eos_token is not None and self.out_tokens
+                and self.out_tokens[-1] == self.eos_token)
+
+
+@dataclasses.dataclass
+class StepPlan:
+    """What one engine iteration executes: the prompts to prefill now
+    (each lands in its own freshly-bound slot) and the running set to
+    decode one token for."""
+
+    prefills: List[Request]
+    decodes: List[Request]
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, cache: PagedKVCache,
+                 prefill_token_budget: int = 512):
+        self.cache = cache
+        self.prefill_token_budget = int(prefill_token_budget)
+        self.waiting: Deque[Request] = deque()
+        self.running: Dict[int, Request] = {}  # slot -> request
+        self._next_rid = 0
+
+    # ---------------- submission --------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_token: Optional[int] = None) -> Request:
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1 (got {max_new_tokens}): "
+                f"prefill always emits the first token")
+        total = len(prompt) + int(max_new_tokens)
+        if total > self.cache.cfg.max_seq_len:
+            raise ValueError(
+                f"request needs {total} tokens > max_seq_len "
+                f"{self.cache.cfg.max_seq_len}")
+        req = Request(rid=self._next_rid, prompt=list(prompt),
+                      max_new_tokens=int(max_new_tokens),
+                      eos_token=eos_token)
+        self._next_rid += 1
+        self.waiting.append(req)
+        return req
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # ---------------- the policy --------------------------------------
+    def schedule(self) -> StepPlan:
+        """One step's plan. Admits FCFS under the token budget, then
+        decodes everything running. Head-of-line blocking is
+        deliberate: when the oldest waiting request doesn't fit we stop
+        admitting rather than scan past it, so no request can be
+        starved by a stream of smaller latecomers."""
+        prefills: List[Request] = []
+        budget = self.prefill_token_budget
+        while self.waiting:
+            req = self.waiting[0]
+            # the FIRST admission of a step ignores the budget so a
+            # prompt longer than the whole budget still gets served
+            # (alone in its step) instead of deadlocking the queue
+            if prefills and len(req.prompt) > budget:
+                break
+            if not self.cache.can_admit(req.total_tokens):
+                break
+            self.waiting.popleft()
+            req.slot = self.cache.alloc_slot(len(req.prompt),
+                                             req.total_tokens)
+            req.state = RequestState.RUNNING
+            self.running[req.slot] = req
+            budget -= len(req.prompt)
+            prefills.append(req)
+        decodes = [self.running[s] for s in sorted(self.running)
+                   if self.running[s] not in prefills]
+        return StepPlan(prefills=prefills, decodes=decodes)
+
+    def finish(self, req: Request) -> None:
+        """Evict a finished sequence: free its slot's pages back to the
+        pool so the next schedule() backfills from the waiting queue."""
+        assert req.state == RequestState.RUNNING, req.state
+        req.state = RequestState.FINISHED
+        del self.running[req.slot]
+        self.cache.free_slot(req.slot)
+        req.slot = -1

@@ -52,6 +52,60 @@ def op_profile(model, peak_flops: Optional[float] = None) -> str:
     return "\n".join(lines)
 
 
+def _pct(sorted_vals, q):
+    """Nearest-rank percentile of an ascending list (no numpy dep for a
+    report string)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, int(round(
+        q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def serve_percentiles(stats: dict, qs=(50, 99)) -> dict:
+    """Per-token decode latency percentiles (seconds) from
+    ServeEngine.last_stats: each decode step's wall time divided over
+    the tokens that step produced — the batched-decode amortization IS
+    the per-token number that matters under continuous batching. The
+    one definition serve_report and tools/serve_bench.py both use."""
+    per_tok = sorted(
+        t / w for t, w in zip(stats.get("decode_step_times_s", []),
+                              stats.get("decode_widths", [])) if w > 0)
+    return {q: _pct(per_tok, q) for q in qs}
+
+
+def serve_report(stats: dict) -> str:
+    """Render ServeEngine.last_stats as the serving analog of
+    op_profile: a per-request latency table plus aggregate
+    tokens/sec and per-token latency percentiles. Per-token latency is
+    each decode step's wall time divided over the tokens that step
+    produced (the batched-decode amortization IS the number that
+    matters for continuous batching)."""
+    lines = [f"{'rid':>4s} {'prompt':>7s} {'new':>5s} {'ttft ms':>9s} "
+             f"{'latency ms':>11s} {'tok/s':>8s}"]
+    for r in stats.get("requests", []):
+        lat = r["latency_s"]
+        tps = r["new_tokens"] / lat if lat > 0 else 0.0
+        lines.append(f"{r['rid']:>4d} {r['prompt_tokens']:>7d} "
+                     f"{r['new_tokens']:>5d} {r['ttft_s']*1e3:>9.2f} "
+                     f"{lat*1e3:>11.2f} {tps:>8.1f}")
+    pct = serve_percentiles(stats)
+    lines.append(
+        f"total: {stats.get('total_new_tokens', 0)} tokens in "
+        f"{stats.get('wall_s', 0.0)*1e3:.1f} ms "
+        f"({stats.get('tokens_per_sec', 0.0):.1f} tok/s, "
+        f"{stats.get('decode_steps', 0)} decode steps)")
+    if any(pct.values()):
+        lines.append(
+            f"per-token decode latency: p50={pct[50]*1e3:.3f} ms "
+            f"p99={pct[99]*1e3:.3f} ms")
+    cc = stats.get("compile_counts")
+    if cc:
+        lines.append(f"compiled programs: prefill={cc.get('prefill')} "
+                     f"decode={cc.get('decode')}")
+    return "\n".join(lines)
+
+
 def time_train_steps(model, batch, steps: int = 20, warmup: int = 3
                      ) -> float:
     """Mean seconds per training step, with device sync via a scalar

@@ -309,8 +309,10 @@ def test_reduce_op_modes():
             ff.state.params, ff.state.states,
             {"input": jnp.asarray(x)}, False, None)[0]
         red = next(o for o in ff.ops if o.op_type == "reduce")
+        # rtol covers XLA-vs-numpy f32 reduction-order noise (observed
+        # up to ~4e-6 relative on this CPU build's mean reduction)
         np.testing.assert_allclose(
-            np.asarray(got[red.outputs[0].uid]), ref, rtol=1e-6)
+            np.asarray(got[red.outputs[0].uid]), ref, rtol=1e-5)
         # trains through the reduction (grad flows)
         m = ff.train_batch({"input": x,
                             "label": rng.randint(0, 4, 8).astype(np.int32)})
