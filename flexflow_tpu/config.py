@@ -86,6 +86,25 @@ class FFConfig:
     search_budget: int = 0
     search_alpha: float = 0.05
     search_overlap_backward_update: bool = False
+    # delta re-simulation (Simulator.simulate_delta): per proposal,
+    # re-cost only the moved op(s) and replay the cached scheduled task
+    # graph instead of rebuilding + rescheduling everything — the
+    # paper's delta simulation algorithm; exact (bit-equal makespans),
+    # with periodic full-simulation re-syncs counted in search stats.
+    # --no-delta-sim falls back to full simulation per move.
+    search_delta_sim: bool = True
+    # parallel annealing chains (Python engine): K independent MCMC
+    # walks with per-chain seeds derived from `seed`, splitting the
+    # TOTAL budget and sharing one read-mostly cost cache; best chain
+    # wins. 0 = auto (min(4, cpu_count)).
+    search_chains: int = 0
+    # persistent per-op cost cache (search/cost_cache.py): serialize
+    # simulator costs keyed by (op signature, axis map, machine-model
+    # fingerprint) so repeated searches and mesh-shape sweeps skip
+    # re-deriving/re-measuring. cost_cache_file=None uses
+    # ~/.cache/flexflow_tpu/costcache.json (FLEXFLOW_TPU_CACHE root).
+    search_cost_cache: bool = True
+    cost_cache_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
     export_strategy_file: Optional[str] = None
     enable_sample_parallel: bool = True
@@ -260,6 +279,10 @@ class FFConfig:
             raise ValueError(
                 f"pipeline_virtual_stages must be >= 1, got "
                 f"{self.pipeline_virtual_stages}")
+        if self.search_chains < 0:
+            raise ValueError(
+                f"search_chains must be >= 0 (0 = auto), got "
+                f"{self.search_chains}")
         if self.kv_page_size < 1:
             raise ValueError(
                 f"kv_page_size must be >= 1, got {self.kv_page_size}")
@@ -302,6 +325,8 @@ class FFConfig:
         "--budget": ("search_budget", int),
         "--search-alpha": ("search_alpha", float),
         "--alpha": ("search_alpha", float),
+        "--search-chains": ("search_chains", int),
+        "--cost-cache": ("cost_cache_file", str),
         "--import": ("import_strategy_file", str),
         "--import-strategy": ("import_strategy_file", str),
         "--export": ("export_strategy_file", str),
@@ -343,6 +368,8 @@ class FFConfig:
     _NEG_BOOL_FLAGS = {
         "--no-sparse-embedding": "sparse_embedding_updates",
         "--no-sibling-conv-fusion": "sibling_conv_fusion",
+        "--no-delta-sim": "search_delta_sim",
+        "--no-cost-cache": "search_cost_cache",
     }
 
     def parse_args(self, argv: Sequence[str]) -> None:

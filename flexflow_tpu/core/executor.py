@@ -508,11 +508,15 @@ class Executor:
                     # device-placed permutations)
                     idx = op.slot_ids([batch[t.name]
                                        for t in op.inputs])
-                    rows = jax.vmap(
-                        lambda w, i: jnp.take(w, i, axis=0))(table, idx)
+                    # flat slot-offset gather, NOT vmap(take): the
+                    # batched-gather form mis-partitions under GSPMD
+                    # when the slot axis is sharded (ops/embedding.py
+                    # _slot_gather has the full story)
+                    from ..ops.embedding import _slot_gather
+                    rows = _slot_gather(table, idx)
                 else:
                     idx = batch[op.inputs[0].name].astype(jnp.int32)
-                    rows = jnp.take(table, idx, axis=0)
+                    rows = jnp.take(table, idx, axis=0, mode="clip")
                 sparse_idx[name] = idx
                 diff_params[name] = {"__rows__": rows}
         grad_fn = jax.value_and_grad(

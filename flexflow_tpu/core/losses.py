@@ -47,7 +47,13 @@ def sparse_categorical_crossentropy(logits_or_probs, labels,
         logp = jax.nn.log_softmax(preds, axis=-1)
     else:
         logp = jnp.log(jnp.clip(preds, 1e-12, 1.0))
-    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)
+    # mode="clip" (labels are in-bounds by contract): the "fill" default
+    # emits an OOB-validity select that GSPMD's partitioning of the
+    # gather misfires on when the class dim is model-sharded, silently
+    # corrupting the per-sample nll (same hazard as the embedding
+    # gathers, ops/embedding.py)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1,
+                               mode="clip")
     return jnp.mean(nll)
 
 

@@ -74,7 +74,10 @@ def compute_metrics(metric_names: Sequence[str], preds: jax.Array,
         elif m in (METRICS_CCE, METRICS_SPARSE_CCE):
             logp = jnp.log(jnp.clip(preds, 1e-12, 1.0))
             if sparse:
-                nll = -jnp.take_along_axis(logp, lbl[:, None], axis=-1)
+                # mode="clip": see core/losses.py — the fill-mode OOB
+                # select breaks under GSPMD when classes are sharded
+                nll = -jnp.take_along_axis(logp, lbl[:, None], axis=-1,
+                                           mode="clip")
             else:
                 nll = -jnp.sum(labels * logp, axis=-1)
             out["cce_sum"] = jnp.sum(nll)

@@ -80,6 +80,8 @@ class FFModel:
         self.executor: Optional[Executor] = None
         self.state: Optional[TrainState] = None
         self.simulator = None  # set by calibrate_simulator()
+        self.search_stats = None  # set by search.mcmc.optimize*
+        # (profiling.search_report renders it)
         self.label_tensor: Optional[Tensor] = None
         # pretrained weights staged by frontends before compile()
         # (applied after init_state; reference Parameter::set_weights role)

@@ -35,6 +35,29 @@ AGGR_MODE_SUM = "sum"
 AGGR_MODE_AVG = "avg"
 
 
+def _slot_gather(tables, ids):
+    """(S, vocab, dim) slot-stacked tables x (S, batch, bag) per-slot
+    ids -> (S, batch, bag, dim) rows, via ONE flat gather over the
+    reshaped (S*vocab, dim) table with slot-offset global row ids.
+
+    Deliberately NOT `vmap(take)`: a batched gather whose OPERAND is
+    sharded on its batch (slot) dim trips XLA's SPMD partitioner — the
+    vocab index component gets rescaled by the shard factor, so the
+    kernel reads row 2*v on a 2-way table axis (NaN under take's
+    "fill" OOB default, silently wrong rows under "clip"; the
+    combined-mesh dryrun loss=nan, ROADMAP open item). The flat form
+    keeps dim 0 sharded (slot blocks stay contiguous, so the layout —
+    and the per-device residency the cost model prices — is unchanged)
+    and single-dim gathers partition correctly; mode="clip" matches
+    XLA's native clamp semantics, and real ids are in-bounds by
+    construction (tests/test_distributed_embedding.py pins forward
+    equality to the unsharded reference)."""
+    S, V, _ = tables.shape
+    flat = tables.reshape(S * V, tables.shape[-1])
+    gid = ids + (jnp.arange(S, dtype=ids.dtype)[:, None, None] * V)
+    return jnp.take(flat, gid, axis=0, mode="clip")
+
+
 @register_op
 class Embedding(Op):
     op_type = "embedding"
@@ -83,7 +106,13 @@ class Embedding(Op):
             # scatter-add embedding backward (src/ops/embedding.cu)
             emb = params["__rows__"]
         else:
-            emb = jnp.take(params["kernel"], idx.astype(jnp.int32), axis=0)
+            # mode="clip", not the "fill" (NaN) OOB default: fill mode
+            # wraps the gather in an OOB-validity select that interacts
+            # badly with GSPMD partitioning of sharded gathers (see
+            # _slot_gather); clip is XLA's native clamp semantics and
+            # partitions cleanly, and real ids are in-bounds anyway.
+            emb = jnp.take(params["kernel"], idx.astype(jnp.int32), axis=0,
+                           mode="clip")
         if self.aggr == AGGR_MODE_SUM:
             emb = jnp.sum(emb, axis=-2)
         elif self.aggr == AGGR_MODE_AVG:
@@ -288,11 +317,11 @@ class DistributedEmbedding(Op):
         else:
             tables = params["kernel"]  # (S, vocab, dim), slot order
             ids = self.slot_ids(xs)
-            # per-slot gather, vmapped over the stacked axis: sharded on
-            # `table` (or device-placed via slots), each device gathers
-            # only from its resident tables and GSPMD gathers the
-            # (S, batch, bag, dim) result
-            emb = jax.vmap(lambda w, i: jnp.take(w, i, axis=0))(tables, ids)
+            # flat slot-offset gather (sharded on `table` or
+            # device-placed via slots, each device reads only its
+            # resident tables and GSPMD gathers the result) —
+            # _slot_gather explains why this must not be vmap(take)
+            emb = _slot_gather(tables, ids)
         if self.aggr == AGGR_MODE_SUM:
             emb = jnp.sum(emb, axis=-2)
         elif self.aggr == AGGR_MODE_AVG:

@@ -1,0 +1,193 @@
+"""Persistent per-op cost cache for the strategy search.
+
+The reference keeps its measurement cache alive for exactly one search
+run (hash-keyed in-memory map, simulator.cc:301-321); every new process
+re-measures. Here the simulator's per-(op, op-strategy) costs — analytic
+roofline numbers and, with FFConfig.measure_top_ops, measured-grounded
+ones — are serialized to disk keyed by
+
+    (op signature, shard/axis-map signature, machine-model fingerprint)
+
+so repeated searches, `enumerate_mesh_shapes` sweeps, and tools
+(sim_validation, search_bench) skip re-deriving and re-measuring costs
+entirely. The machine-model fingerprint covers the MachineSpec numbers,
+calibrated efficiency factors, torus/DCN layout, and mesh shape: any
+change to what the cost formulas would see invalidates the entries
+(stale entries for other fingerprints are kept in the file, not used).
+
+Path: ~/.cache/flexflow_tpu/costcache.json by default (root overridable
+via FLEXFLOW_TPU_CACHE like the calibration caches, file overridable
+via FFConfig.cost_cache_file / --cost-cache). One CostCache object per
+path is shared process-wide — parallel annealing chains read and write
+the same store under a lock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+_COST_FIELDS = ("fwd", "bwd", "fwd_comm", "bwd_comm", "sync", "mem",
+                "update")
+
+
+_PRICING_SRC_HASH: Optional[str] = None
+
+
+def _pricing_source_hash() -> str:
+    """Hash of the pricing-code sources (cost_model, machine_model,
+    op_measure): an edited cost formula changes the fingerprint
+    automatically, so stale cache entries can never be served by a
+    forgotten COST_MODEL_VERSION bump. Memoized per process."""
+    global _PRICING_SRC_HASH
+    if _PRICING_SRC_HASH is None:
+        h = hashlib.sha256()
+        base = os.path.dirname(os.path.abspath(__file__))
+        for mod in ("cost_model.py", "machine_model.py",
+                    "op_measure.py"):
+            try:
+                with open(os.path.join(base, mod), "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                h.update(mod.encode())  # zipped install: name only
+        _PRICING_SRC_HASH = h.hexdigest()[:16]
+    return _PRICING_SRC_HASH
+
+
+def machine_fingerprint(mm, mesh=None) -> str:
+    """Stable short hash of everything the cost formulas read from the
+    machine model + mesh (plus the pricing code itself). Shared by the
+    cost cache, sim_validation and perf_report so committed numbers are
+    attributable to one machine state without re-measuring it."""
+    from .cost_model import COST_MODEL_VERSION
+    spec = {f.name: getattr(mm.spec, f.name, None)
+            for f in dataclasses.fields(mm.spec)}
+    blob = {
+        "costmodel_v": COST_MODEL_VERSION,
+        "pricing_src": _pricing_source_hash(),
+        "spec": {k: (list(v) if isinstance(v, tuple) else v)
+                 for k, v in spec.items()},
+        "efficiency": dict(sorted(mm.efficiency.items())),
+        "dcn_axes": list(mm.dcn_axes),
+        "axis_topology": {k: list(v)
+                          for k, v in sorted(mm.axis_topology.items())},
+        "mesh": (sorted(mesh.shape.items()) if mesh is not None else None),
+    }
+    raw = json.dumps(blob, sort_keys=True, default=str)
+    return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+
+def default_path() -> str:
+    root = os.environ.get(
+        "FLEXFLOW_TPU_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "flexflow_tpu"))
+    return os.path.join(root, "costcache.json")
+
+
+class CostCache:
+    """Disk-backed {entry key -> OpCost} map, scoped to one machine
+    fingerprint. Pipeline-expanded costs (OpCost.pipeline) carry nested
+    schedule state and are never persisted."""
+
+    _open: Dict[str, "CostCache"] = {}
+    _open_lock = threading.Lock()
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        # fingerprint -> {key -> [7 floats]}
+        self._data: Dict[str, Dict[str, list]] = {}
+        self._dirty = False
+        self._loaded = False
+        self.hits = 0
+        self.misses = 0
+
+    @classmethod
+    def open(cls, path: Optional[str] = None) -> "CostCache":
+        """Process-wide shared instance per path (parallel chains and
+        mesh-shape sweeps must see one read-mostly store)."""
+        path = path or default_path()
+        with cls._open_lock:
+            if path not in cls._open:
+                cls._open[path] = cls(path)
+            return cls._open[path]
+
+    # ---- keying ----
+    @staticmethod
+    def entry_key(op_sig: str, axis_sig, extra=()) -> str:
+        raw = json.dumps([op_sig, list(axis_sig), list(extra)],
+                         default=str)
+        return hashlib.sha256(raw.encode()).hexdigest()[:24]
+
+    # ---- I/O ----
+    def _ensure_loaded(self) -> None:
+        if self._loaded:
+            return
+        self._loaded = True
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            if isinstance(data, dict):
+                self._data = {fp: dict(entries)
+                              for fp, entries in data.items()
+                              if isinstance(entries, dict)}
+        except (OSError, json.JSONDecodeError):
+            pass  # absent/corrupt cache = empty cache
+
+    def get(self, fingerprint: str, key: str):
+        from .cost_model import OpCost
+        with self._lock:
+            self._ensure_loaded()
+            row = self._data.get(fingerprint, {}).get(key)
+            if row is None or len(row) != len(_COST_FIELDS):
+                self.misses += 1
+                return None
+            self.hits += 1
+            return OpCost(**{f: float(v)
+                             for f, v in zip(_COST_FIELDS, row)})
+
+    def put(self, fingerprint: str, key: str, cost) -> None:
+        if cost.pipeline is not None:
+            return
+        with self._lock:
+            self._ensure_loaded()
+            self._data.setdefault(fingerprint, {})[key] = [
+                float(getattr(cost, f)) for f in _COST_FIELDS]
+            self._dirty = True
+
+    def flush(self) -> None:
+        """Atomic write (tmp + rename), merging entries another process
+        may have written since we loaded. Unwritable cache paths never
+        abort a search (same policy as measure.py)."""
+        with self._lock:
+            if not self._dirty:
+                return
+            try:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                merged = {}
+                try:
+                    with open(self.path) as f:
+                        on_disk = json.load(f)
+                    if isinstance(on_disk, dict):
+                        merged = on_disk
+                except (OSError, json.JSONDecodeError):
+                    pass
+                for fp, entries in self._data.items():
+                    merged.setdefault(fp, {}).update(entries)
+                tmp = self.path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(merged, f)
+                os.replace(tmp, self.path)
+                self._dirty = False
+            except OSError:
+                pass
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            n = sum(len(v) for v in self._data.values())
+            return {"hits": self.hits, "misses": self.misses,
+                    "entries": n}

@@ -17,6 +17,11 @@ from ..op import Op
 from ..parallel.pconfig import OpStrategy
 from .machine_model import TPUMachineModel
 
+# bump when any cost formula changes: part of the persistent cost-cache
+# fingerprint (search/cost_cache.py), so stale entries computed by an
+# older pricing model can never resurrect into a newer search
+COST_MODEL_VERSION = 1
+
 BWD_FLOP_FACTOR = 2.0  # dX and dW GEMMs ≈ 2x fwd (reference bwd = 2 GEMMs)
 # per-op-type overrides: attention bwd recomputes probabilities from the
 # saved logsumexp (flash custom-VJP) + 4 grad einsums ≈ 4x fwd

@@ -17,12 +17,30 @@ SP/EP/PP axes.
 from __future__ import annotations
 
 import math
+import os
 import random
+import time
 from typing import Dict, List, Optional
 
 from ..parallel.pconfig import DEVICE_KEY, OpStrategy, Strategy
 from .measure import calibrated_machine_model
 from .simulator import Simulator, op_edges
+
+
+def _resolve_chains(cfg, chains: Optional[int]) -> int:
+    """Number of parallel annealing chains: explicit arg >
+    FFConfig.search_chains > min(4, cpu_count)."""
+    if chains is None:
+        chains = int(getattr(cfg, "search_chains", 0) or 0)
+    if chains <= 0:
+        chains = min(4, os.cpu_count() or 1)
+    return max(1, chains)
+
+
+def _chain_seed(seed: int, k: int) -> int:
+    """Per-chain RNG seed derived from cfg.seed; chain 0 reproduces the
+    single-chain walk for the same base seed."""
+    return seed + 7919 * k
 
 
 def candidate_maps(op, mesh, cfg, op_index: int = 0) -> List[Dict[str, str]]:
@@ -208,7 +226,9 @@ def enumerate_mesh_shapes(n_devices: int, model, cfg
 
 
 def optimize_with_mesh(model, budget: int = 1000, alpha: float = 0.05,
-                       devices=None, seed: int = 0, verbose: bool = False):
+                       devices=None, seed: Optional[int] = None,
+                       verbose: bool = False,
+                       chains: Optional[int] = None):
     """Search strategy AND mesh factorization jointly: enumerate mesh
     shapes of the device count, anneal within each, return the
     (strategy, mesh) pair with the best simulated step time.
@@ -216,7 +236,13 @@ def optimize_with_mesh(model, budget: int = 1000, alpha: float = 0.05,
     Reference analog: the MCMC search samples parallel DEGREES per op
     (model.cc:512); GSPMD fixes degrees at mesh construction, so the
     degree search moves to the outer loop. Activated by
-    --search-mesh-shapes (FFConfig.search_mesh_shapes)."""
+    --search-mesh-shapes (FFConfig.search_mesh_shapes).
+
+    Mesh-shape candidates are distributed over a thread pool (the
+    annealing phase mutates no shared config state and the per-op cost
+    caches are shared read-mostly stores); the interleaved-pipeline
+    upgrade — which prices candidates THROUGH the config knobs — runs
+    serially afterwards, per shape."""
     import jax
 
     from ..parallel.mesh import make_mesh
@@ -226,18 +252,21 @@ def optimize_with_mesh(model, budget: int = 1000, alpha: float = 0.05,
                    else list(jax.devices()))
     n = len(devices)
     cfg = model.config
+    if seed is None:
+        seed = int(getattr(cfg, "seed", 0) or 0)
     shapes = enumerate_mesh_shapes(n, model, cfg)
+    t0 = time.perf_counter()
     # budget is the TOTAL iteration count across all factorizations
     # (reference --budget semantics): a per-shape floor would silently
     # multiply a deliberately small budget several-fold
     per_budget = max(1, budget // max(1, len(shapes)))
-    best = None  # (cost, strategy, mesh, sim, pipeline_knobs)
     # optimize() records an interleaved-pipeline win on the config
     # knobs (_interleaved_upgrade) — snapshot/restore them per shape so
-    # one shape's win cannot distort another shape's annealing, then
+    # one shape's win cannot distort another shape's pricing, then
     # re-apply only the WINNING shape's knobs at the end
     base_knobs = (cfg.pipeline_stages, cfg.pipeline_virtual_stages)
-    for shape in shapes:
+
+    def anneal_shape(shape):
         mesh = make_mesh(tuple(shape.values()), tuple(shape.keys()),
                          devices)
         sim = Simulator(
@@ -245,11 +274,31 @@ def optimize_with_mesh(model, budget: int = 1000, alpha: float = 0.05,
             calibrated_machine_model(
                 mesh, machine_file=cfg.machine_model_file),
             overlap_backward_sync=cfg.search_overlap_backward_update)
-        strat = optimize(model, budget=per_budget, alpha=alpha, mesh=mesh,
-                         seed=seed, verbose=False, simulator=sim)
-        cost = sim.simulate(strat)
+        found, cost, sim, stats = _optimize_impl(
+            model, per_budget, alpha, mesh, seed, False, sim, None,
+            chains=1)
+        if cost is None:
+            cost = sim.simulate(found)
+        return shape, mesh, sim, found, cost, stats
+
+    workers = min(max(1, len(shapes)), _resolve_chains(cfg, chains))
+    if workers > 1 and len(shapes) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            annealed = list(pool.map(anneal_shape, shapes))
+    else:
+        annealed = [anneal_shape(s) for s in shapes]
+
+    best = None  # (cost, strategy, mesh, sim, pipeline_knobs)
+    agg_stats: Dict[str, object] = {}
+    for shape, mesh, sim, found, cost, stats in annealed:
+        strat = _interleaved_upgrade(model, cfg, mesh, sim, found,
+                                     best_cost=cost, verbose=False)
+        if strat is not found:  # upgrade won: re-price under its knobs
+            cost = sim.simulate(strat)
         knobs = (cfg.pipeline_stages, cfg.pipeline_virtual_stages)
         cfg.pipeline_stages, cfg.pipeline_virtual_stages = base_knobs
+        _merge_stats(agg_stats, stats)
         if verbose:
             print(f"[search/mesh] {shape}: {cost*1e3:.3f} ms/step")
         if best is None or cost < best[0]:
@@ -261,7 +310,34 @@ def optimize_with_mesh(model, budget: int = 1000, alpha: float = 0.05,
     if cfg.taskgraph_file:  # re-export for the WINNING mesh (inner runs
         # each wrote their own shape's graph; last is not best)
         best[3].simulate(best[1], dot_path=cfg.taskgraph_file)
+    best[3].flush_cost_cache()
+    # per-shape wall times overlap in the pool — summing them (what
+    # _merge_stats did for the counters) would understate proposals/sec
+    # by the worker count; report real elapsed time instead
+    agg_stats["wall_s"] = time.perf_counter() - t0
+    agg_stats["mesh_shapes"] = len(shapes)
+    agg_stats["chains"] = 1  # per-shape annealing runs single-chain
+    props = agg_stats.get("proposals", 0)
+    agg_stats["proposals_per_sec"] = (props / agg_stats["wall_s"]
+                                      if agg_stats["wall_s"] > 0 else 0.0)
+    model.search_stats = agg_stats
     return best[1], best[2]
+
+
+def _merge_stats(agg: Dict[str, object], stats: Dict[str, object]) -> None:
+    """Accumulate one search's counters into an aggregate report dict
+    (numeric fields add; nested dicts merge; everything else last-wins)."""
+    for k, v in stats.items():
+        if isinstance(v, (int, float)) and isinstance(agg.get(k), (int,
+                                                                   float)):
+            agg[k] = agg[k] + v
+        elif isinstance(v, dict):
+            agg[k] = dict(v)
+        else:
+            agg[k] = v
+    if "wall_s" in agg and agg.get("proposals"):
+        agg["proposals_per_sec"] = (agg["proposals"] / agg["wall_s"]
+                                    if agg["wall_s"] > 0 else 0.0)
 
 
 def _interleaved_upgrade(model, cfg, mesh, sim, best, best_cost=None,
@@ -322,57 +398,147 @@ def _interleaved_upgrade(model, cfg, mesh, sim, best, best_cost=None,
     return pin_free
 
 
-def optimize(model, budget: int = 1000, alpha: float = 0.05,
-             mesh=None, seed: int = 0, verbose: bool = False,
-             simulator: Optional[Simulator] = None,
-             use_native: Optional[bool] = None) -> Strategy:
-    """Anneal over strategies; returns the best found.
-
-    Reference contract: called from compile() when search_budget > 0
-    (model.cc:1561-1570); unlike the reference we do NOT exit the process
-    after search — the found strategy is used directly (and exported when
-    --export is set).
-
-    The annealing loop runs in the native C++ engine (csrc/mcmc.cc) when
-    available — the analog of the reference keeping search+simulation in
-    C++ — with this Python loop as the fallback.  `use_native=False`
-    forces the Python path.
-    """
-    mesh = mesh or model.mesh
-    if mesh is None:
-        return model.strategy or Strategy()
+def _anneal_chain(model, sim: Simulator, cands, staged, edges,
+                  searchable, init: Strategy, init_cost: float,
+                  budget: int, alpha: float, seed: int,
+                  verbose: bool, chain: int = 0):
+    """One annealing chain (the body of the reference FFModel::optimize
+    loop, model.cc:1905-1968) over `sim`. Proposal costs come from the
+    DELTA path (simulate_delta: re-cost only the moved op, replay the
+    cached scheduled task graph) whenever the template applies; moves
+    that change task-graph structure — staged jumps, pipeline-expansion
+    or placement flips — fall back to a full simulate() and rebase the
+    template. A periodic re-sync full-simulates the current strategy
+    and counts any divergence (stats["drift_resyncs"]); the delta
+    replay is exact, so a nonzero count means a bug, not noise."""
     cfg = model.config
+    rng = random.Random(seed)
+    current = init.copy()
+    cur_cost = init_cost
+    best, best_cost = current.copy(), cur_cost
+    delta_on = sim.delta_rebase(current)
+
+    reset_every = max(1, budget // 100)
+    resync_every = max(64, reset_every)
+    for it in range(budget):
+        if it > 0 and it % reset_every == 0 and cur_cost > best_cost:
+            current, cur_cost = best.copy(), best_cost
+            delta_on = sim.delta_rebase(current)
+        elif delta_on and it > 0 and it % resync_every == 0:
+            # periodic drift re-sync: ground the delta-tracked cost in
+            # a full simulation (guards template-splicing bugs; the
+            # replay is exact, so any divergence counted here is a bug)
+            full = sim.simulate(current)
+            if not math.isclose(full, cur_cost, rel_tol=1e-9,
+                                abs_tol=1e-15):
+                sim.stats["drift_resyncs"] += 1
+                cur_cost = full
+                delta_on = sim.delta_rebase(current)
+
+        # global staged-pipeline move: jump to (or mutate microbatching
+        # of) a whole-graph stage cut — per-op moves cannot assemble a
+        # viable pipeline one pin at a time
+        if staged and rng.random() < 0.1:
+            nxt = rng.choice(staged).copy()
+            nxt_cost = sim.simulate(nxt)
+            delta = nxt_cost - cur_cost
+            if delta <= 0 or rng.random() < math.exp(
+                    -delta / max(1e-12, alpha * cur_cost)):
+                current, cur_cost = nxt, nxt_cost
+                delta_on = sim.delta_rebase(current)
+                if cur_cost < best_cost:
+                    best, best_cost = current.copy(), cur_cost
+                    if verbose:
+                        print(f"[search] iter {it}: staged pipeline "
+                              f"{best_cost*1e3:.3f} ms/step")
+            continue
+        # rewrite/propagate moves mutate `current` IN PLACE (one op's
+        # entry swapped, restored on rejection) — copying the whole
+        # strategy per proposal costs more than the delta simulation
+        # itself at small-graph scale
+        # propagation move is opt-in (reference --enable-propagation,
+        # model.cc:2374), fired with prob 0.25 like model.cc:1807-1903
+        if cfg.enable_propagation and rng.random() < 0.25 and edges:
+            # propagate along a random edge (reference propagation move)
+            src, dst = rng.choice(edges)
+            m = current.for_op(src.name).axis_map
+            if m in cands.get(dst.name, []):
+                changed, new_map = dst.name, dict(m)
+            else:
+                op = rng.choice(searchable)
+                changed = op.name
+                new_map = dict(rng.choice(cands[op.name]))
+        else:
+            op = rng.choice(searchable)
+            changed = op.name
+            new_map = dict(rng.choice(cands[op.name]))
+        # .get: after an accepted staged jump `current` only carries
+        # the pinned ops' entries (for_op falls back to the default)
+        prev = current.op_strategies.get(changed)
+        current.set(changed, OpStrategy(new_map))
+
+        tok = sim.simulate_delta(current, (changed,)) if delta_on else None
+        nxt_cost = tok.cost if tok is not None else sim.simulate(current)
+        delta = nxt_cost - cur_cost
+        if delta <= 0 or rng.random() < math.exp(
+                -delta / max(1e-12, alpha * cur_cost)):
+            cur_cost = nxt_cost
+            if tok is None:
+                # structural move accepted outside the template
+                delta_on = sim.delta_rebase(current)
+            if cur_cost < best_cost:
+                best, best_cost = current.copy(), cur_cost
+                if verbose:
+                    print(f"[search] iter {it}: {best_cost*1e3:.3f} ms/step")
+        else:
+            if prev is None:
+                del current.op_strategies[changed]
+            else:
+                current.op_strategies[changed] = prev
+            if tok is not None:
+                sim.delta_reject(tok)
+
+    if verbose:
+        print(f"[search] chain {chain} best estimated step time: "
+              f"{best_cost*1e3:.3f} ms")
+    return best, best_cost
+
+
+def _optimize_impl(model, budget: int, alpha: float, mesh, seed: int,
+                   verbose: bool, simulator: Optional[Simulator],
+                   use_native: Optional[bool], chains: int):
+    """Engine dispatch + annealing; returns (best, best_cost, sim,
+    stats) with NO config-knob side effects (the interleaved upgrade
+    and taskgraph export stay with the caller, so mesh-shape sweeps
+    and chains can run this concurrently)."""
+    cfg = model.config
+    # fused searches must anneal in the Python engine (the native table
+    # cannot price fusion folding); optimize() raises on an explicit
+    # use_native=True, every other caller (incl. optimize_with_mesh's
+    # per-shape runs) gets coerced here
+    if cfg.perform_fusion and use_native is not True:
+        use_native = False
     sim = simulator or Simulator(
         model, mesh,
         calibrated_machine_model(mesh,
                                  machine_file=cfg.machine_model_file),
         overlap_backward_sync=cfg.search_overlap_backward_update)
-    rng = random.Random(seed)
 
     cands = {op.name: candidate_maps(op, mesh, cfg, op_index=i)
              for i, op in enumerate(model.ops)}
+    t0 = time.perf_counter()
 
-    def finish(strategy, cost=None):
-        """Every return path funnels here so the interleaved-variant
-        comparison and --taskgraph export always run. `cost` is the
-        caller's already-computed sim.simulate(strategy), when it has
-        one, to spare a re-simulation."""
-        strategy = _interleaved_upgrade(model, cfg, mesh, sim, strategy,
-                                        best_cost=cost, verbose=verbose)
-        if cfg.taskgraph_file:
-            sim.simulate(strategy, dot_path=cfg.taskgraph_file)
-        return strategy
+    def stats_for(sims, proposals):
+        out: Dict[str, object] = {}
+        for s in sims:
+            _merge_stats(out, s.search_stats())
+        out["proposals"] = proposals
+        out["chains"] = len(sims)
+        out["wall_s"] = time.perf_counter() - t0
+        out["proposals_per_sec"] = (proposals / out["wall_s"]
+                                    if out["wall_s"] > 0 else 0.0)
+        return out
 
-    # The native engine mirrors the Python simulator task-for-task —
-    # including per-device resources for placed candidates and GPipe
-    # event-loop expansion (csrc/mcmc.cc). The one remaining Python-only
-    # capability is FUSION folding (same-strategy chains costed as one
-    # task), so fused searches route to the Python engine.
-    if cfg.perform_fusion:
-        if use_native is True:
-            raise ValueError("native search does not support "
-                             "perform_fusion; use the Python engine")
-        use_native = False
     # graph-PP staged candidates: a staged strategy's simulated cost is
     # INDEPENDENT of the per-op assignment (the whole graph runs as one
     # pipeline), so the native engine needn't anneal through them — run
@@ -397,17 +563,17 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
                         if verbose:
                             print(f"[search] staged pipeline wins: "
                                   f"{best_cost*1e3:.3f} ms/step")
-            return finish(best, best_cost)
+            return best, best_cost, sim, stats_for([sim], budget)
         assert use_native is not True, "native search requested but " \
             "the native library is unavailable"
     _, edges = op_edges(model)
 
-    current = (model.strategy or Strategy()).copy()
+    init = (model.strategy or Strategy()).copy()
     # materialize every op's map so moves are local
     for op in model.ops:
-        current.set(op.name, current.for_op(op.name).copy())
-    cur_cost = sim.simulate(current)
-    best, best_cost = current.copy(), cur_cost
+        init.set(op.name, init.for_op(op.name).copy())
+    init_cost = sim.simulate(init)
+    best, best_cost = init.copy(), init_cost
 
     # staged candidates compete even when no per-op axis choice exists
     for s in staged:
@@ -416,57 +582,88 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
             best, best_cost = s.copy(), c
 
     searchable = [op for op in model.ops if len(cands[op.name]) > 1]
-    if not searchable:
-        return finish(best, best_cost)
+    if not searchable or budget <= 0:
+        return best, best_cost, sim, stats_for([sim], 0)
 
-    reset_every = max(1, budget // 100)
-    for it in range(budget):
-        if it > 0 and it % reset_every == 0 and cur_cost > best_cost:
-            current, cur_cost = best.copy(), best_cost
+    # K independent chains over a shared read-only candidate set and
+    # one process-wide persistent cost cache; the TOTAL budget is split
+    # across chains (reference --budget semantics — chains diversify
+    # the walk, they don't multiply the work) and the best strategy
+    # across chains wins, ties to the lowest chain id for determinism.
+    per_chain = max(1, budget // chains)
+    sims = [sim] + [Simulator(model, mesh, sim.mm,
+                              overlap_backward_sync=sim.overlap)
+                    for _ in range(chains - 1)]
+    for s_ in sims[1:]:
+        s_.time_scale = sim.time_scale
+        s_.step_overhead = sim.step_overhead
 
-        nxt = current.copy()
-        # global staged-pipeline move: jump to (or mutate microbatching
-        # of) a whole-graph stage cut — per-op moves cannot assemble a
-        # viable pipeline one pin at a time
-        if staged and rng.random() < 0.1:
-            nxt = rng.choice(staged).copy()
-            nxt_cost = sim.simulate(nxt)
-            delta = nxt_cost - cur_cost
-            if delta <= 0 or rng.random() < math.exp(
-                    -delta / max(1e-12, alpha * cur_cost)):
-                current, cur_cost = nxt, nxt_cost
-                if cur_cost < best_cost:
-                    best, best_cost = current.copy(), cur_cost
-                    if verbose:
-                        print(f"[search] iter {it}: staged pipeline "
-                              f"{best_cost*1e3:.3f} ms/step")
-            continue
-        # propagation move is opt-in (reference --enable-propagation,
-        # model.cc:2374), fired with prob 0.25 like model.cc:1807-1903
-        if cfg.enable_propagation and rng.random() < 0.25 and edges:
-            # propagate along a random edge (reference propagation move)
-            src, dst = rng.choice(edges)
-            m = current.for_op(src.name).axis_map
-            if m in cands.get(dst.name, []):
-                nxt.set(dst.name, OpStrategy(dict(m)))
-            else:
-                op = rng.choice(searchable)
-                nxt.set(op.name, OpStrategy(
-                    dict(rng.choice(cands[op.name]))))
-        else:
-            op = rng.choice(searchable)
-            nxt.set(op.name, OpStrategy(dict(rng.choice(cands[op.name]))))
+    def run_chain(k):
+        return _anneal_chain(model, sims[k], cands, staged, edges,
+                             searchable, init, init_cost, per_chain,
+                             alpha, _chain_seed(seed, k), verbose,
+                             chain=k)
 
-        nxt_cost = sim.simulate(nxt)
-        delta = nxt_cost - cur_cost
-        if delta <= 0 or rng.random() < math.exp(
-                -delta / max(1e-12, alpha * cur_cost)):
-            current, cur_cost = nxt, nxt_cost
-            if cur_cost < best_cost:
-                best, best_cost = current.copy(), cur_cost
-                if verbose:
-                    print(f"[search] iter {it}: {best_cost*1e3:.3f} ms/step")
+    if chains == 1:
+        results = [run_chain(0)]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=chains) as pool:
+            results = list(pool.map(run_chain, range(chains)))
+    for cb, cc in results:
+        if cc < best_cost:
+            best, best_cost = cb, cc
+    return best, best_cost, sim, stats_for(sims, per_chain * chains)
 
-    if verbose:
-        print(f"[search] best estimated step time: {best_cost*1e3:.3f} ms")
-    return finish(best, best_cost)
+
+def optimize(model, budget: int = 1000, alpha: float = 0.05,
+             mesh=None, seed: Optional[int] = None, verbose: bool = False,
+             simulator: Optional[Simulator] = None,
+             use_native: Optional[bool] = None,
+             chains: Optional[int] = None) -> Strategy:
+    """Anneal over strategies; returns the best found.
+
+    Reference contract: called from compile() when search_budget > 0
+    (model.cc:1561-1570); unlike the reference we do NOT exit the process
+    after search — the found strategy is used directly (and exported when
+    --export is set).
+
+    The annealing loop runs in the native C++ engine (csrc/mcmc.cc) when
+    available — the analog of the reference keeping search+simulation in
+    C++ — with this Python loop as the fallback.  `use_native=False`
+    forces the Python path, which anneals K parallel chains
+    (--search-chains) with delta re-simulation per move
+    (Simulator.simulate_delta) and a shared persistent cost cache.
+
+    `seed=None` resolves to FFConfig.seed, and ALL randomness flows
+    through per-chain `random.Random` instances — same seed, same
+    strategy, reproducibly. Search counters land on
+    `model.search_stats` (profiling.search_report renders them)."""
+    mesh = mesh or model.mesh
+    if mesh is None:
+        return model.strategy or Strategy()
+    cfg = model.config
+    if seed is None:
+        seed = int(getattr(cfg, "seed", 0) or 0)
+    # The native engine mirrors the Python simulator task-for-task —
+    # including per-device resources for placed candidates and GPipe
+    # event-loop expansion (csrc/mcmc.cc). The one remaining Python-only
+    # capability is FUSION folding (same-strategy chains costed as one
+    # task), so fused searches route to the Python engine.
+    if cfg.perform_fusion:
+        if use_native is True:
+            raise ValueError("native search does not support "
+                             "perform_fusion; use the Python engine")
+        use_native = False
+    best, best_cost, sim, stats = _optimize_impl(
+        model, budget, alpha, mesh, seed, verbose, simulator,
+        use_native, _resolve_chains(cfg, chains))
+    # the interleaved-variant comparison and --taskgraph export run on
+    # every return path; `best_cost` spares a re-simulation when known
+    strategy = _interleaved_upgrade(model, cfg, mesh, sim, best,
+                                    best_cost=best_cost, verbose=verbose)
+    if cfg.taskgraph_file:
+        sim.simulate(strategy, dot_path=cfg.taskgraph_file)
+    sim.flush_cost_cache()
+    model.search_stats = stats
+    return strategy

@@ -12,9 +12,15 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+import threading
+
 from ..parallel.pconfig import OpStrategy, Strategy
-from .cost_model import op_cost
-from .simulator import Simulator, op_edges
+from .simulator import Simulator, _axis_sig, op_edges
+
+# the C++ engine predates the threaded mesh-shape sweep; serialize
+# entry rather than audit csrc/mcmc.cc for hidden global state (the
+# native walk is fast — Python-side annealing still overlaps it)
+_NATIVE_LOCK = threading.Lock()
 
 
 def _map_key(m: Dict[str, object]):
@@ -52,10 +58,13 @@ def lower_to_arrays(model, sim: Simulator, cands: Dict[str, list],
     for i, op in enumerate(ops):
         for j, m in enumerate(cand_lists[i]):
             s = OpStrategy(dict(m))
-            # measured grounding (measure_top_ops) applies to the
-            # native table too — both engines rank on the same numbers
-            c = sim.measured_adjust(op, s,
-                                    op_cost(op, s, sim.mesh, sim.mm))
+            # priced through the simulator's 3-tier cost cache (memory
+            # -> persistent disk store -> compute, with measured
+            # grounding applied at compute) — both engines rank on the
+            # same numbers, and the native table, the biggest per-search
+            # cost consumer (ops x candidates), populates and reuses
+            # the fingerprint-keyed persistent store too
+            c = sim._op_cost_for(op, s, _axis_sig(s))
             table.set(i, j, c, devices=s.device_ids)
 
     _, op_pairs = op_edges(model)
@@ -82,16 +91,17 @@ def optimize_native(model, sim: Simulator, cands: Dict[str, list],
 
     cfg = model.config
     init = (model.strategy or Strategy()).copy()
-    table, edges, prop_match, init_assign, cand_lists = lower_to_arrays(
-        model, sim, cands, init)
-    best_idx, best_cost = mcmc_search(
-        table, edges, prop_match, budget, alpha, seed,
-        enable_propagation=bool(cfg.enable_propagation),
-        overlap_backward_sync=sim.overlap,
-        hbm_capacity=sim.mm.spec.hbm_capacity,
-        time_scale=sim.time_scale,
-        init_cand=init_assign,
-        step_overhead=sim.step_overhead)
+    with _NATIVE_LOCK:
+        table, edges, prop_match, init_assign, cand_lists = \
+            lower_to_arrays(model, sim, cands, init)
+        best_idx, best_cost = mcmc_search(
+            table, edges, prop_match, budget, alpha, seed,
+            enable_propagation=bool(cfg.enable_propagation),
+            overlap_backward_sync=sim.overlap,
+            hbm_capacity=sim.mm.spec.hbm_capacity,
+            time_scale=sim.time_scale,
+            init_cand=init_assign,
+            step_overhead=sim.step_overhead)
 
     best = init.copy()
     for i, op in enumerate(model.ops):

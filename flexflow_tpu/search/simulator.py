@@ -79,12 +79,23 @@ class TaskGraph:
         done = 0
         while q:
             ready, _, t = heapq.heappop(q)
-            keys = t.resource if isinstance(t.resource, list) \
-                else (t.resource,)
-            start = max([ready] + [free.get(k, 0.0) for k in keys])
-            t.finish_time = start + t.duration
-            for k in keys:
-                free[k] = t.finish_time
+            if t.duration == 0.0:
+                # zero-duration tasks are transparent: they neither
+                # consult nor occupy their resource. Provably identical
+                # to the occupy-path for every graph this file builds
+                # (a zero-duration task can never raise free[k] above
+                # any later pop's ready time, since pops are ordered by
+                # ready time), and it makes a materialized zero-cost
+                # comm/sync task exactly equivalent to no task — the
+                # invariant the delta-simulation template relies on.
+                t.finish_time = ready
+            else:
+                keys = t.resource if isinstance(t.resource, list) \
+                    else (t.resource,)
+                start = max([ready] + [free.get(k, 0.0) for k in keys])
+                t.finish_time = start + t.duration
+                for k in keys:
+                    free[k] = t.finish_time
             makespan = max(makespan, t.finish_time)
             done += 1
             for c in children.get(id(t), []):
@@ -110,6 +121,12 @@ class TaskGraph:
             f.write("}\n")
 
 
+def _axis_sig(s) -> tuple:
+    """Hashable signature of one op's axis map — the in-memory cost-cache
+    key and the delta template's change detector."""
+    return tuple(sorted((k, str(v)) for k, v in s.axis_map.items()))
+
+
 def op_edges(model):
     """(producer-map, producer->consumer op pairs) in canonical order:
     iteration over each op's inputs.  Every engine that walks the graph
@@ -126,6 +143,44 @@ def op_edges(model):
             if t.uid in producer:
                 edges.append((producer[t.uid], op))
     return producer, edges
+
+
+@dataclasses.dataclass
+class _BuiltGraph:
+    """One _build_graph result: the task graph plus the metadata the
+    delta path needs to capture a reusable template."""
+    graph: TaskGraph
+    total_mem: float
+    costs: Dict[str, OpCost]
+    slots: Dict[str, Dict[str, SimTask]]   # op -> component -> task
+    expanded: set                          # pipeline-expanded units
+    placed: dict                           # device-placed units
+
+
+_SLOT_NAMES = ("fwd_comm", "fwd", "bwd_comm", "bwd", "sync")
+
+
+class _DeltaTemplate:
+    """Flattened scheduled task graph for delta re-simulation (the
+    paper's delta simulation algorithm: keep the task graph of the
+    current strategy, re-cost only changed ops, re-run the event loop
+    over the cached arrays instead of rebuilding anything). Replaying
+    the heap loop over these arrays reproduces TaskGraph.simulate
+    bit-for-bit — same tie-breaking, same zero-duration transparency —
+    so the delta path is EXACT, not an approximation; the drift counter
+    exists to prove that at runtime, not to paper over error."""
+
+    __slots__ = ("durations", "children", "ndeps0", "roots", "res",
+                 "n_res", "op_slots", "op_sig", "op_class", "op_mem",
+                 "op_order")
+
+
+@dataclasses.dataclass
+class _DeltaToken:
+    """Result of one simulate_delta call: the simulated step seconds
+    plus the undo record delta_reject applies when the move loses."""
+    cost: float
+    undo: list
 
 
 class Simulator:
@@ -150,8 +205,84 @@ class Simulator:
         # fused-unit partition + edges per strategy signature (fusion
         # groups depend only on each op's axis map)
         self._unit_cache: Dict[tuple, tuple] = {}
+        # staged-pipeline candidate caches (previously created lazily via
+        # getattr; proper __init__ state so invalidate() can clear them)
+        self._balanced_cache: Dict[tuple, object] = {}
+        self._staged_cost_cache: Dict[tuple, tuple] = {}
+        self._staged_vstages = 1
+        # delta-simulation template (simulate_delta); None until a
+        # delta_rebase() established one for the current base strategy
+        self._delta: Optional[_DeltaTemplate] = None
+        # search instrumentation, rendered by profiling.search_report
+        self.stats: Dict[str, int] = {
+            "full_sims": 0, "delta_sims": 0, "delta_fallbacks": 0,
+            "drift_resyncs": 0, "cost_mem_hits": 0, "cost_disk_hits": 0,
+            "cost_computes": 0,
+        }
+        # persistent per-op cost cache, keyed by (op signature, axis-map
+        # signature, machine-model fingerprint); shared process-wide
+        cfg = getattr(model, "config", None)
+        self._disk = None
+        self._fingerprint = None
+        if getattr(cfg, "search_cost_cache", True):
+            from .cost_cache import CostCache, machine_fingerprint
+            self._disk = CostCache.open(
+                getattr(cfg, "cost_cache_file", None) or None)
+            self._fingerprint = machine_fingerprint(self.mm, mesh)
+        self._op_sig_memo: Dict[str, str] = {}
+        self._cfg_sig = self._compute_cfg_sig()
         # per-op measured grounding (FFConfig.measure_top_ops)
         self._measured_set: set = self._choose_measured_ops()
+
+    def _compute_cfg_sig(self) -> tuple:
+        """Config/optimizer facts op_cost reads beyond the op + strategy
+        (embedding sparse-update eligibility) — part of the persistent
+        cache key so a flag flip can't resurrect stale entries."""
+        cfg = getattr(self.model, "config", None)
+        opt = getattr(self.model, "optimizer", None)
+        mode = None
+        if opt is not None:
+            try:
+                mode = opt.sparse_mode()
+            except Exception:
+                mode = None
+        return (bool(getattr(cfg, "sparse_embedding_updates", True)),
+                bool(getattr(cfg, "sparse_embedding_lazy", False)),
+                str(mode))
+
+    def invalidate(self) -> None:
+        """Drop every derived cache (op costs, fused units, staged
+        tables, the delta template) — call after mutating the machine
+        model, config cost knobs, or the optimizer. The persistent disk
+        store is not cleared; entries are re-keyed via the fingerprint
+        and config signature instead."""
+        self._cache.clear()
+        self._unit_cache.clear()
+        self._balanced_cache.clear()
+        self._staged_cost_cache.clear()
+        self._delta = None
+        self._op_sig_memo.clear()
+        self._cfg_sig = self._compute_cfg_sig()
+        if self._disk is not None:
+            from .cost_cache import machine_fingerprint
+            self._fingerprint = machine_fingerprint(self.mm, self.mesh)
+        self._measured_set = self._choose_measured_ops()
+
+    def flush_cost_cache(self) -> None:
+        if self._disk is not None:
+            self._disk.flush()
+
+    def search_stats(self) -> Dict[str, object]:
+        """Counter snapshot plus shared-cache state for search_report."""
+        out: Dict[str, object] = dict(self.stats)
+        if self._disk is not None:
+            out["disk_cache"] = self._disk.stats()
+            out["fingerprint"] = self._fingerprint
+        ci = _schedule_tables.cache_info()
+        out["schedule_tables"] = {
+            "hits": ci.hits, "misses": ci.misses,
+            "currsize": ci.currsize, "maxsize": ci.maxsize}
+        return out
 
     def calibrate_end_to_end(self, strategy: Strategy,
                              measured_step_seconds: float) -> float:
@@ -185,14 +316,43 @@ class Simulator:
         time get their fwd/bwd REPLACED by isolated-op jit measurements
         at the strategy's data-sharded sub-shape (op_measure.py — the
         reference's measure_operator_cost, model.cu:20-62); residual
-        non-sample shardings still divide analytically."""
+        non-sample shardings still divide analytically.
+
+        Three tiers: in-memory dict -> persistent disk store (keyed by
+        op signature + axis map + machine fingerprint, cost_cache.py)
+        -> compute. The disk tier is what lets repeated searches and
+        mesh-shape sweeps in NEW processes skip re-deriving (and, under
+        measure_top_ops, re-measuring) every cost."""
         s = strategy.for_op(op.name)
-        key = (op.name, tuple(sorted(
-            (k, str(v)) for k, v in s.axis_map.items())))
-        if key not in self._cache:
-            c = op_cost(op, s, self.mesh, self.mm)
-            self._cache[key] = self.measured_adjust(op, s, c)
-        return self._cache[key]
+        return self._op_cost_for(op, s, _axis_sig(s))
+
+    def _op_cost_for(self, op, s, sig) -> OpCost:
+        key = (op.name, sig)
+        c = self._cache.get(key)
+        if c is not None:
+            self.stats["cost_mem_hits"] += 1
+            return c
+        dkey = None
+        if self._disk is not None:
+            from .cost_cache import CostCache
+            osig = self._op_sig_memo.get(op.name)
+            if osig is None:
+                from .op_measure import op_signature
+                osig = self._op_sig_memo[op.name] = op_signature(op, 1)
+            dkey = CostCache.entry_key(
+                osig, sig,
+                self._cfg_sig + (op.name in self._measured_set,))
+            c = self._disk.get(self._fingerprint, dkey)
+        if c is None:
+            c = self.measured_adjust(op, s,
+                                     op_cost(op, s, self.mesh, self.mm))
+            self.stats["cost_computes"] += 1
+            if dkey is not None:
+                self._disk.put(self._fingerprint, dkey, c)
+        else:
+            self.stats["cost_disk_hits"] += 1
+        self._cache[key] = c
+        return c
 
     def measured_adjust(self, op, s, c: OpCost) -> OpCost:
         """Replace analytic fwd/bwd with measured seconds for grounded
@@ -295,6 +455,7 @@ class Simulator:
         calibrated fixed dispatch cost (measure_step_overhead) is added
         once per step — strategy-independent, so it never changes the
         ranking, only absolute accuracy."""
+        self.stats["full_sims"] += 1
         step_time, penalty = self._simulate_raw(strategy, dot_path)
         return step_time * self.time_scale + penalty + self.step_overhead
 
@@ -344,9 +505,7 @@ class Simulator:
             v = max(1, getattr(self.model.config,
                                "pipeline_virtual_stages", 1))
             S_req = self.model.config.pipeline_stages * v
-            cache = getattr(self, "_balanced_cache", None)
-            if cache is None:
-                cache = self._balanced_cache = {}
+            cache = self._balanced_cache
             # keyed by (S, v): the same stage count can be viable under
             # one interleaving factor and not another (the pipe axis
             # carries S/v devices), and the search sweeps v
@@ -372,9 +531,7 @@ class Simulator:
                getattr(cfg, "pipeline_microbatches", 4),
                getattr(cfg, "pipeline_schedule", "gpipe"),
                vstages)
-        cache = getattr(self, "_staged_cost_cache", None)
-        if cache is None:
-            cache = self._staged_cost_cache = {}
+        cache = self._staged_cost_cache
         if key in cache:  # the annealing loop revisits candidates
             pc, syncs, mem = cache[key]
         else:
@@ -441,6 +598,20 @@ class Simulator:
         stage_of = self._staged_assignment(strategy)
         if stage_of is not None:
             return self._simulate_staged(strategy, stage_of, dot_path)
+        built = self._build_graph(strategy)
+        step_time = built.graph.simulate()
+        if dot_path:
+            built.graph.export_dot(dot_path)
+        return step_time, self.mm.memory_penalty(built.total_mem)
+
+    def _build_graph(self, strategy: Strategy) -> "_BuiltGraph":
+        """Build the (non-staged) task graph for `strategy`. Comm and
+        grad-sync tasks are ALWAYS materialized, zero-duration when the
+        cost is zero — numerically identical to skipping them (the
+        zero-duration pass-through in TaskGraph.simulate), but it keeps
+        the task-graph STRUCTURE independent of the axis maps, which is
+        what lets simulate_delta reuse one scheduled template across
+        rewrite/propagate moves and only re-cost the changed ops."""
         g = TaskGraph()
         fwd_tasks: Dict[str, SimTask] = {}
 
@@ -459,7 +630,7 @@ class Simulator:
             for m in grp[1:]:
                 c = c.merge(costs[m])
             unit_cost[grp[-1]] = c
-        unit_order = [g[-1] for g in groups]
+        unit_order = [g_[-1] for g_ in groups]
 
         # compute-resource assignment: mesh-uniform SPMD units share one
         # "compute" stream; a device-placed unit (OpStrategy.device_ids)
@@ -484,6 +655,7 @@ class Simulator:
         expanded = {u for u in unit_order
                     if unit_cost[u].pipeline is not None and u in singleton}
         pipe_fwd_exit: Dict[str, List[List[SimTask]]] = {}
+        slots: Dict[str, Dict[str, SimTask]] = {}
 
         # forward chain
         for u in unit_order:
@@ -494,10 +666,10 @@ class Simulator:
                     g, u, c.pipeline, deps, pipe_fwd_exit)
                 total_mem += c.mem
                 continue
-            if c.fwd_comm > 0:
-                comm = g.add(f"{u}:fwd_comm", c.fwd_comm, "comm", deps)
-                deps = deps + [comm]
+            comm = g.add(f"{u}:fwd_comm", c.fwd_comm, "comm", deps)
+            deps = deps + [comm]
             fwd_tasks[u] = g.add(f"{u}:fwd", c.fwd, res_for(u), deps)
+            slots[u] = {"fwd_comm": comm, "fwd": fwd_tasks[u]}
             total_mem += c.mem
 
         # backward chain (reverse graph)
@@ -513,17 +685,18 @@ class Simulator:
                 bwd_tasks[u] = self._expand_pipeline_bwd(
                     g, u, c.pipeline, deps, pipe_fwd_exit[u])
             else:
-                if c.bwd_comm > 0:
-                    comm = g.add(f"{u}:bwd_comm", c.bwd_comm, "comm", deps)
-                    deps = deps + [comm]
+                comm = g.add(f"{u}:bwd_comm", c.bwd_comm, "comm", deps)
+                deps = deps + [comm]
                 bwd_tasks[u] = g.add(f"{u}:bwd", c.bwd + c.update,
                                      res_for(u), deps)
-            if c.sync > 0:
-                # grad all-reduce may overlap the rest of backward
-                # (reference overlap flag, simulator.cc:393-497)
-                sync_deps = [bwd_tasks[u]]
-                st = g.add(f"{u}:grad_sync", c.sync, "comm", sync_deps)
-                sync_tasks.append(st)
+                slots[u]["bwd_comm"] = comm
+                slots[u]["bwd"] = bwd_tasks[u]
+            # grad all-reduce may overlap the rest of backward
+            # (reference overlap flag, simulator.cc:393-497)
+            st = g.add(f"{u}:grad_sync", c.sync, "comm", [bwd_tasks[u]])
+            sync_tasks.append(st)
+            if u in slots:
+                slots[u]["sync"] = st
 
         if not self.overlap and sync_tasks:
             # serialize syncs after all backward work: model by chaining
@@ -531,10 +704,178 @@ class Simulator:
             for st in sync_tasks:
                 st.deps.append(last_bwd)
 
-        step_time = g.simulate()
-        if dot_path:
-            g.export_dot(dot_path)
-        return step_time, self.mm.memory_penalty(total_mem)
+        return _BuiltGraph(graph=g, total_mem=total_mem, costs=costs,
+                           slots=slots, expanded=expanded, placed=placed)
+
+    # ---------------- delta simulation ----------------
+    def delta_rebase(self, strategy: Strategy) -> bool:
+        """(Re)build the delta template from `strategy` — the scheduled
+        task graph subsequent simulate_delta calls splice into. Returns
+        False (template cleared) when the delta path cannot represent
+        this strategy: fused searches (unit partition moves with the
+        axis maps), staged/pinned pipelines, or device-placed ops
+        (per-device resource lists change with the assignment)."""
+        self._delta = None
+        cfg = getattr(self.model, "config", None)
+        if not getattr(cfg, "search_delta_sim", True):
+            return False
+        if getattr(cfg, "perform_fusion", False):
+            return False
+        # cheap pre-checks before paying for a graph build: placed ops
+        # get per-device resource lists (structure tracks the
+        # assignment), and _anneal_chain re-rebases after every
+        # accepted structural move — a placed-heavy walk would
+        # otherwise pay a wasted full build per accepted move
+        if any(strategy.for_op(op.name).device_ids
+               for op in self.model.ops):
+            return False
+        if self._staged_assignment(strategy) is not None:
+            return False
+        built = self._build_graph(strategy)
+        if built.placed:  # unreachable given the pre-check; defensive
+            return False
+        tasks = built.graph.tasks
+        index = {id(task): i for i, task in enumerate(tasks)}
+        n = len(tasks)
+        t = _DeltaTemplate()
+        t.durations = [task.duration for task in tasks]
+        t.ndeps0 = [len(task.deps) for task in tasks]
+        children: List[List[int]] = [[] for _ in range(n)]
+        for i, task in enumerate(tasks):
+            for d in task.deps:
+                children[index[id(d)]].append(i)
+        t.children = [tuple(c) for c in children]
+        t.roots = tuple(i for i, task in enumerate(tasks)
+                        if not task.deps)
+        res_ids: Dict[object, int] = {}
+        res = []
+        for task in tasks:
+            key = (tuple(task.resource)
+                   if isinstance(task.resource, list) else task.resource)
+            if key not in res_ids:
+                res_ids[key] = len(res_ids)
+            res.append(res_ids[key])
+        t.res = res
+        t.n_res = len(res_ids)
+        t.op_slots = {u: tuple(index[id(d[sn])] for sn in _SLOT_NAMES)
+                      for u, d in built.slots.items()}
+        t.op_sig = {op.name: _axis_sig(strategy.for_op(op.name))
+                    for op in self.model.ops}
+        t.op_class = {name: built.costs[name].pipeline is not None
+                      for name in t.op_sig}
+        t.op_mem = {name: built.costs[name].mem for name in t.op_sig}
+        t.op_order = tuple(op.name for op in self.model.ops)
+        self._delta = t
+        return True
+
+    def simulate_delta(self, strategy: Strategy,
+                       changed_ops) -> Optional[_DeltaToken]:
+        """Delta re-simulation of `strategy`, which must differ from the
+        template's base only in `changed_ops`: re-cost just those ops
+        (cache-served for revisited candidates), splice the durations
+        into the cached scheduled graph, and replay the event loop over
+        the flat arrays. Returns None when the move changes task-graph
+        STRUCTURE (op enters/leaves pipeline expansion or device
+        placement) — the caller falls back to a full simulate() and
+        delta_rebase(). The returned token's mutations are already
+        applied; call delta_reject(token) to roll them back when the
+        move is rejected (accepting needs no call)."""
+        t = self._delta
+        if t is None:
+            return None
+        updates = []
+        for name in changed_ops:
+            op = self._ops_by_name.get(name)
+            if op is None:
+                continue
+            s = strategy.for_op(name)
+            sig = _axis_sig(s)
+            if sig == t.op_sig.get(name):
+                continue  # no-op move (picked the current candidate)
+            if name not in t.op_slots or s.device_ids:
+                # pipeline-expanded unit or a device-placement rewrite:
+                # the template's task structure no longer matches
+                self.stats["delta_fallbacks"] += 1
+                return None
+            c = self._op_cost_for(op, s, sig)
+            if (c.pipeline is not None) != t.op_class[name]:
+                self.stats["delta_fallbacks"] += 1
+                return None
+            updates.append((name, sig, c))
+        undo = []
+        d = t.durations
+        for name, sig, c in updates:
+            i_fc, i_f, i_bc, i_b, i_s = t.op_slots[name]
+            undo.append((name, t.op_sig[name], t.op_mem[name],
+                         (d[i_fc], d[i_f], d[i_bc], d[i_b], d[i_s])))
+            d[i_fc] = c.fwd_comm
+            d[i_f] = c.fwd
+            d[i_bc] = c.bwd_comm
+            d[i_b] = c.bwd + c.update
+            d[i_s] = c.sync
+            t.op_sig[name] = sig
+            t.op_mem[name] = c.mem
+        makespan = self._replay(t)
+        total_mem = 0.0
+        om = t.op_mem
+        for name in t.op_order:  # same accumulation order as
+            total_mem += om[name]  # _build_graph -> bit-equal penalty
+        self.stats["delta_sims"] += 1
+        return _DeltaToken(
+            cost=(makespan * self.time_scale
+                  + self.mm.memory_penalty(total_mem)
+                  + self.step_overhead),
+            undo=undo)
+
+    def delta_reject(self, tok: _DeltaToken) -> None:
+        """Roll the template back to its pre-simulate_delta state."""
+        t = self._delta
+        if t is None:
+            return
+        d = t.durations
+        for name, sig, mem, durs in tok.undo:
+            i_fc, i_f, i_bc, i_b, i_s = t.op_slots[name]
+            d[i_fc], d[i_f], d[i_bc], d[i_b], d[i_s] = durs
+            t.op_sig[name] = sig
+            t.op_mem[name] = mem
+
+    def _replay(self, t: _DeltaTemplate) -> float:
+        """Array-form of TaskGraph.simulate over the cached template:
+        identical pop order (ready-time heap, creation-order counter
+        tie-break) and identical zero-duration transparency, so the
+        returned makespan is bit-equal to a full rebuild-and-simulate
+        of the same strategy — without allocating a single SimTask."""
+        heappush = heapq.heappush
+        heappop = heapq.heappop
+        durations = t.durations
+        children = t.children
+        res = t.res
+        ndeps = t.ndeps0[:]
+        ready = [0.0] * len(durations)
+        free = [0.0] * t.n_res
+        q = [(0.0, i, idx) for i, idx in enumerate(t.roots)]
+        counter = len(q)
+        makespan = 0.0
+        while q:
+            r, _, i = heappop(q)
+            dur = durations[i]
+            if dur == 0.0:
+                f = r
+            else:
+                k = res[i]
+                fr = free[k]
+                f = (fr if fr > r else r) + dur
+                free[k] = f
+                if f > makespan:
+                    makespan = f
+            for ch in children[i]:
+                if f > ready[ch]:
+                    ready[ch] = f
+                ndeps[ch] -= 1
+                if ndeps[ch] == 0:
+                    heappush(q, (ready[ch], counter, ch))
+                    counter += 1
+        return makespan
 
     def _expand_pipeline_fwd(self, g, u, pc, ext_deps, pipe_fwd_exit):
         """Emit the GPipe forward: microbatch m flows stage 0..S-1, one

@@ -106,6 +106,52 @@ def serve_report(stats: dict) -> str:
     return "\n".join(lines)
 
 
+def search_report(stats: dict) -> str:
+    """Render one strategy search's instrumentation (optimize stashes
+    it on model.search_stats; tools/search_bench.py records the same
+    dict): proposals/sec, the delta-vs-full simulation split, drift
+    re-syncs, op-cost cache hit rates (in-memory + the persistent
+    store), and the memoized 1F1B schedule-table LRU stats."""
+    lines = []
+    props = stats.get("proposals", 0)
+    wall = stats.get("wall_s", 0.0)
+    lines.append(
+        f"search: {props} proposals in {wall*1e3:.1f} ms "
+        f"({stats.get('proposals_per_sec', 0.0):,.0f} proposals/s, "
+        f"{stats.get('chains', 1)} chain(s))")
+    full = stats.get("full_sims", 0)
+    delta = stats.get("delta_sims", 0)
+    total = full + delta
+    if total:
+        lines.append(
+            f"simulations: {delta} delta / {full} full "
+            f"({delta / total:.1%} delta), "
+            f"{stats.get('delta_fallbacks', 0)} structural fallbacks, "
+            f"{stats.get('drift_resyncs', 0)} drift re-syncs")
+    mem = stats.get("cost_mem_hits", 0)
+    disk = stats.get("cost_disk_hits", 0)
+    comp = stats.get("cost_computes", 0)
+    looked = mem + disk + comp
+    if looked:
+        lines.append(
+            f"op-cost cache: {mem} memory + {disk} disk hits / "
+            f"{comp} computes ({(mem + disk) / looked:.1%} hit rate)")
+    dc = stats.get("disk_cache")
+    if dc:
+        lines.append(
+            f"persistent store: {dc.get('entries', 0)} entries "
+            f"(fingerprint {stats.get('fingerprint', '?')}), "
+            f"{dc.get('hits', 0)} hits / {dc.get('misses', 0)} misses "
+            f"this process")
+    st = stats.get("schedule_tables")
+    if st:
+        lines.append(
+            f"schedule tables (lru {st.get('currsize', 0)}/"
+            f"{st.get('maxsize', 0)}): {st.get('hits', 0)} hits / "
+            f"{st.get('misses', 0)} misses")
+    return "\n".join(lines)
+
+
 def time_train_steps(model, batch, steps: int = 20, warmup: int = 3
                      ) -> float:
     """Mean seconds per training step, with device sync via a scalar

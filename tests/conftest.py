@@ -11,6 +11,16 @@ os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
                            + os.environ.get("XLA_FLAGS", ""))
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Isolate every per-machine measurement/cost cache (calibration,
+# op_measure, the persistent search cost cache) from the developer's
+# real ~/.cache/flexflow_tpu: tests must neither read stale entries a
+# previous checkout left there nor mutate user-level state.
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "FLEXFLOW_TPU_CACHE",
+    tempfile.mkdtemp(prefix="flexflow_tpu_test_cache_"))
+
 import jax  # noqa: E402
 
 # env var alone is overridden by the image's sitecustomize; force it.

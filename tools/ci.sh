@@ -33,6 +33,11 @@ then
   fail=1
 fi
 
+echo "--- 1c. search-bench smoke (delta-sim speedup + equivalence gate)"
+# fails if the delta path's speedup over full simulation is < 2x or if
+# delta/full makespans diverge (tools/search_bench.py --smoke)
+env JAX_PLATFORMS=cpu python tools/search_bench.py --smoke || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \

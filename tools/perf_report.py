@@ -90,7 +90,26 @@ def build_table(bench):
             f"older than the latest commits) predate current code — "
             f"`tools/tpu_session.sh` refreshes both the JSON and this "
             f"table.")
+    note += search_line()
     return "\n".join(lines), note
+
+
+def search_line() -> str:
+    """Strategy-search throughput sentence from BENCH_search.json,
+    keyed to the machine fingerprint of the shared cost cache
+    (search/cost_cache.py) — the committed numbers are attributable to
+    one machine + cost-model state without re-measuring anything
+    (tools/search_bench.py refreshes the JSON)."""
+    try:
+        with open(os.path.join(ROOT, "BENCH_search.json")) as f:
+            b = json.load(f)
+        return (f" Strategy search: "
+                f"{b['proposals_per_sec_delta']:,.0f} proposals/s with "
+                f"delta simulation vs {b['proposals_per_sec_full']:,.0f} "
+                f"full ({b['speedup']:.1f}x, `BENCH_search.json`, "
+                f"fingerprint `{b.get('fingerprint', 'n/a')}`).")
+    except (OSError, json.JSONDecodeError, KeyError):
+        return ""
 
 
 def main():
