@@ -228,11 +228,30 @@ class FFConfig:
     kv_num_pages: int = 257
     # continuous-batching scheduler caps (serve/scheduler.py): at most
     # serve_max_seqs sequences hold decode slots at once (this is also
-    # the static decode-batch width the engine compiles ONCE), and one
-    # scheduler step admits at most serve_prefill_budget prompt tokens
-    # of new prefill work (FCFS).
+    # the decode-lane reserve of the engine's single mixed step), and
+    # one scheduler step computes at most serve_prefill_budget prompt
+    # tokens of prefill work (FCFS; long prompts chunk across steps).
     serve_max_seqs: int = 8
     serve_prefill_budget: int = 512
+    # chunked prefill (serve/engine.py): pack prompt chunks from any
+    # number of requests together with every running decode token into
+    # ONE fixed-shape program of serve_prefill_budget + serve_max_seqs
+    # lanes — zero per-bucket recompiles, decode never stalls behind a
+    # long prompt. --no-chunked-prefill falls back to the per-bucket
+    # prefill + full-width decode pair.
+    serve_chunked_prefill: bool = True
+    # prefix caching (serve/kv_cache.py): completed KV pages are
+    # content-hashed and shared copy-free across sequences via per-page
+    # refcounts, so a prompt whose prefix is already resident skips
+    # those tokens at prefill. Requires chunked prefill (the legacy
+    # prefill program re-scatters every position). --no-prefix-cache.
+    serve_prefix_cache: bool = True
+    # admission watermark (fraction of the page pool that must stay
+    # reclaimable after admitting a request's first chunk): with
+    # on-demand page allocation the scheduler admits against ACTUAL
+    # residency, and this headroom keeps admissions from thrashing the
+    # preemption path the moment running sequences grow.
+    serve_admit_watermark: float = 0.02
 
     # synthetic input when no dataset is provided (reference: config.h:131)
     synthetic_input: bool = False
@@ -297,6 +316,10 @@ class FFConfig:
             raise ValueError(
                 f"serve_prefill_budget must be >= 1, got "
                 f"{self.serve_prefill_budget}")
+        if not 0.0 <= self.serve_admit_watermark < 1.0:
+            raise ValueError(
+                f"serve_admit_watermark must be in [0, 1), got "
+                f"{self.serve_admit_watermark}")
         if self.pipeline_virtual_stages > 1 \
                 and self.pipeline_schedule != "1f1b":
             raise ValueError(
@@ -346,6 +369,7 @@ class FFConfig:
         "--kv-num-pages": ("kv_num_pages", int),
         "--serve-max-seqs": ("serve_max_seqs", int),
         "--serve-prefill-budget": ("serve_prefill_budget", int),
+        "--serve-admit-watermark": ("serve_admit_watermark", float),
     }
     _BOOL_FLAGS = {
         "--profiling": "profiling",
@@ -370,6 +394,8 @@ class FFConfig:
         "--no-sibling-conv-fusion": "sibling_conv_fusion",
         "--no-delta-sim": "search_delta_sim",
         "--no-cost-cache": "search_cost_cache",
+        "--no-chunked-prefill": "serve_chunked_prefill",
+        "--no-prefix-cache": "serve_prefix_cache",
     }
 
     def parse_args(self, argv: Sequence[str]) -> None:

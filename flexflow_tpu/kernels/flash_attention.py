@@ -289,29 +289,42 @@ def flash_profitable(b: int, h: int, sq: int, sk: int, d: int) -> bool:
     return (d % 128 == 0 and sk >= 1024) or score_bytes > 2**31
 
 
-# ------------------------------------------------- paged decode attention
+# ------------------------------------------------- paged attention (serve)
 #
-# The serving decode path (flexflow_tpu/serve): ONE query token per
-# sequence attends to that sequence's whole K/V history, which lives in
-# fixed-size PAGES addressed through a per-sequence page table
-# (serve/kv_cache.py — the "Ragged Paged Attention" layout, PAPERS.md).
-# Two implementations with identical semantics:
+# The serving path (flexflow_tpu/serve): query tokens attend to their
+# sequence's K/V history, which lives in fixed-size PAGES addressed
+# through a per-sequence page table (serve/kv_cache.py — the "Ragged
+# Paged Attention" layout, PAPERS.md). Two entry points over the same
+# math:
+#
+#   * paged_attention_decode — ONE query token per sequence (the
+#     classic decode step): rows of the page table are sequences.
+#   * paged_attention_ragged — one query token per LANE, where a lane
+#     is any (sequence, position) pair: a chunked-prefill step packs
+#     prompt chunks from several sequences plus every running decode
+#     token into one call. Lanes pick their sequence's page-table row
+#     through a slot index and mask at their own position+1, so a
+#     prefill token at position p sees exactly keys 0..p even though
+#     later chunk tokens' K/V are already scattered into the pages.
+#
+# Each has two implementations with identical semantics:
 #
 #   * _paged_decode_jnp — gather pages with jnp.take, masked online-free
 #     softmax in f32. XLA lowers the gather to dynamic-gather; for
-#     single-query decode the op is HBM-bound either way, so this is
+#     single-query lanes the op is HBM-bound either way, so this is
 #     also a credible TPU path, and it is the reference the Pallas
-#     kernel is tested against bit-for-bit on CPU.
-#   * _paged_decode_pallas — scalar-prefetch kernel: the page table
-#     rides in SMEM ahead of the grid so each (sequence, page) grid
-#     step DMAs exactly one K and one V page picked by
-#     table[seq, page]; online max/sum rescaling accumulates across a
-#     sequence's pages in VMEM scratch, and the output is written on
-#     the sequence's last grid step. Never materializes the gathered
+#     kernels are tested against bit-for-bit on CPU.
+#   * _paged_decode_pallas / _paged_ragged_pallas — scalar-prefetch
+#     kernels: the page table (and, for ragged, the lane->slot map and
+#     lane lengths) rides in SMEM ahead of the grid so each
+#     (lane, page) grid step DMAs exactly one K and one V page picked
+#     by table[slot[lane], page]; online max/sum rescaling accumulates
+#     across a lane's pages in VMEM scratch, and the output is written
+#     on the lane's last grid step. Never materializes the gathered
 #     (B, max_len, H, D) K/V that the jnp path pays for.
 #
-# paged_attention_decode dispatches: Pallas on TPU (or interpret=True),
-# jnp elsewhere — the CPU-fallback story for the whole serve package.
+# Both dispatch: Pallas on TPU (or interpret=True), jnp elsewhere — the
+# CPU-fallback story for the whole serve package.
 
 
 def _paged_decode_jnp(q, k_pages, v_pages, page_table, seq_lens, scale):
@@ -343,6 +356,37 @@ def _paged_decode_jnp(q, k_pages, v_pages, page_table, seq_lens, scale):
     return (o / l).astype(q.dtype)
 
 
+def _paged_online_page(q, k, v, length, j, m_ref, l_ref, acc_ref, *,
+                       page_size, scale):
+    """One page of one lane's online-softmax accumulation — the body
+    shared by the decode and ragged kernels (they differ only in how
+    the lane's length and page-table row are selected)."""
+    h, _ = q.shape
+    # scores for this page: (H, ps), f32 accumulate on the MXU
+    s = jax.lax.dot_general(
+        q, k, (((1,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32) * scale
+    # mask positions past the lane's visible length (padding pages are
+    # the sink page; their scores die here)
+    pos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, (h, page_size),
+                                                   1)
+    s = jnp.where(pos < length, s, -jnp.inf)
+
+    m_prev = m_ref[:]               # (H, 1)
+    l_prev = l_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)          # (H, ps); fully-masked rows -> 0
+    alpha = jnp.exp(m_prev - m_new)
+    m_ref[:] = m_new
+    l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    # p stays f32 and v upcasts, matching _paged_decode_jnp exactly —
+    # the implementations must not diverge for bf16 KV pages
+    pv = jax.lax.dot_general(       # (H, D): p (H,ps) . v (ps,H,D) per-head
+        p, v.astype(jnp.float32), (((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha + pv
+
+
 def _paged_decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
                          m_ref, l_ref, acc_ref, *, page_size, pages_per_seq,
                          scale):
@@ -357,33 +401,33 @@ def _paged_decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]                    # (H, D)
-    k = k_ref[0]                    # (ps, H, D)
-    v = v_ref[0]
-    h, d = q.shape
-    # scores for this page: (H, ps), f32 accumulate on the MXU
-    s = jax.lax.dot_general(
-        q, k, (((1,), (2,)), ((0,), (1,))),
-        preferred_element_type=jnp.float32) * scale
-    # mask positions past the sequence length (padding pages are the
-    # sink page; their scores die here)
-    pos = j * page_size + jax.lax.broadcasted_iota(jnp.int32, (h, page_size),
-                                                   1)
-    s = jnp.where(pos < sl_ref[b], s, -jnp.inf)
+    _paged_online_page(q_ref[0], k_ref[0], v_ref[0], sl_ref[b], j,
+                       m_ref, l_ref, acc_ref, page_size=page_size,
+                       scale=scale)
 
-    m_prev = m_ref[:]               # (H, 1)
-    l_prev = l_ref[:]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    p = jnp.exp(s - m_new)          # (H, ps); fully-masked rows -> 0
-    alpha = jnp.exp(m_prev - m_new)
-    m_ref[:] = m_new
-    l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-    # p stays f32 and v upcasts, matching _paged_decode_jnp exactly —
-    # the two implementations must not diverge for bf16 KV pages
-    pv = jax.lax.dot_general(       # (H, D): p (H,ps) . v (ps,H,D) per-head
-        p, v.astype(jnp.float32), (((1,), (0,)), ((0,), (1,))),
-        preferred_element_type=jnp.float32)
-    acc_ref[:] = acc_ref[:] * alpha + pv
+    @pl.when(j == pages_per_seq - 1)
+    def _emit():
+        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+
+
+def _paged_ragged_kernel(pt_ref, ls_ref, ll_ref, q_ref, k_ref, v_ref,
+                         o_ref, m_ref, l_ref, acc_ref, *, page_size,
+                         pages_per_seq, scale):
+    """Grid (T, pages_per_seq) over LANES: lane t's pages come from row
+    ls_ref[t] of the table (several lanes of one sequence share a row)
+    and its causal visibility is its own ll_ref[t] = position + 1."""
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    _paged_online_page(q_ref[0], k_ref[0], v_ref[0], ll_ref[t], j,
+                       m_ref, l_ref, acc_ref, page_size=page_size,
+                       scale=scale)
 
     @pl.when(j == pages_per_seq - 1)
     def _emit():
@@ -451,6 +495,77 @@ def paged_attention_decode(q, k_pages, v_pages, page_table, seq_lens, *,
         return _paged_decode_pallas(q, k_pages, v_pages, page_table,
                                     seq_lens, scale, interpret)
     return _paged_decode_jnp(q, k_pages, v_pages, page_table, seq_lens,
+                             scale)
+
+
+def _paged_ragged_pallas(q, k_pages, v_pages, page_tables, lane_slots,
+                         lane_lens, scale, interpret):
+    if not _HAS_PLTPU:
+        raise NotImplementedError("pallas TPU backend unavailable")
+    t, h, d = q.shape
+    ps = k_pages.shape[1]
+    pp = page_tables.shape[1]
+    kern = functools.partial(_paged_ragged_kernel, page_size=ps,
+                             pages_per_seq=pp, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # page_tables, lane_slots, lane_lens
+        grid=(t, pp),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda t, j, pt, ls, ll: (t, 0, 0)),
+            pl.BlockSpec((1, ps, h, d),
+                         lambda t, j, pt, ls, ll: (pt[ls[t], j], 0, 0, 0)),
+            pl.BlockSpec((1, ps, h, d),
+                         lambda t, j, pt, ls, ll: (pt[ls[t], j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda t, j, pt, ls, ll: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),   # running max
+            pltpu.VMEM((h, 1), jnp.float32),   # running sum
+            pltpu.VMEM((h, d), jnp.float32),   # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, h, d), q.dtype),
+        interpret=interpret,
+    )(page_tables, lane_slots, lane_lens, q, k_pages, v_pages)
+
+
+def paged_attention_ragged(q, k_pages, v_pages, page_tables, lane_slots,
+                           lane_lens, *, scale=None, use_pallas=None,
+                           interpret=False):
+    """Ragged batched attention through page tables — the chunked
+    prefill/mixed-step kernel (serve/engine.py).
+
+    q (T, H, D) — one query token per LANE, where lanes mix prompt-chunk
+    tokens from any number of sequences with single decode tokens;
+    k_pages/v_pages (num_pages, page_size, H, D); page_tables
+    (max_seqs, pages_per_seq) int32 physical page ids (0 =
+    sink/padding); lane_slots (T,) int32 selects each lane's page-table
+    row (lanes of the same sequence share a row); lane_lens (T,) int32
+    the lane's visible tokens — position + 1 for a prefill token at
+    `position`, so causality inside a chunk is exact even though the
+    whole chunk's K/V is scattered before attention runs. Every
+    lane_lens entry must be >= 1 (see paged_attention_decode). Returns
+    (T, H, D).
+
+    The jnp fallback gathers each lane's table row and reuses the
+    decode math verbatim, so a 1-lane-per-sequence call is bit-for-bit
+    `paged_attention_decode`, and the op order matches the contiguous
+    full-prefill reference exactly (tested in tests/test_serve_v2.py).
+    use_pallas: None = auto (Pallas on TPU), True = force (combine with
+    interpret=True off TPU), False = always jnp.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_pallas is None:
+        use_pallas = (interpret or (_HAS_PLTPU
+                                    and jax.default_backend() == "tpu"))
+    if use_pallas:
+        return _paged_ragged_pallas(q, k_pages, v_pages, page_tables,
+                                    lane_slots, lane_lens, scale, interpret)
+    lane_tables = jnp.take(page_tables, lane_slots, axis=0)  # (T, pp)
+    return _paged_decode_jnp(q, k_pages, v_pages, lane_tables, lane_lens,
                              scale)
 
 

@@ -2,21 +2,27 @@
 
 The training half of the framework compiles an op graph into one jitted
 SPMD step; this package opens the inference half: a block-paged KV-cache
-(:mod:`kv_cache`), a continuous-batching scheduler (:mod:`scheduler`),
-and a :class:`ServeEngine` (:mod:`engine`) that wraps a built LM into
-jitted prefill/decode steps with static padded shapes so XLA compiles
-each bucket exactly once.
+with refcounted prefix caching (:mod:`kv_cache`), a continuous-batching
+scheduler with chunked prefill, watermark admission and preemption
+(:mod:`scheduler`), and a :class:`ServeEngine` (:mod:`engine`) that
+wraps a built LM into ONE fixed-shape mixed prefill+decode step so XLA
+compiles a single serving program, ever.
 """
 
-from .kv_cache import KVCacheConfig, PagedKVCache
-from .scheduler import ContinuousBatchingScheduler, Request, RequestState
+from .kv_cache import KVCacheConfig, PagedKVCache, prefix_page_keys
+from .scheduler import (ChunkPlan, ContinuousBatchingScheduler, Request,
+                        RequestState, SampleParams, StepPlan)
 from .engine import ServeEngine
 
 __all__ = [
     "KVCacheConfig",
     "PagedKVCache",
+    "prefix_page_keys",
+    "ChunkPlan",
     "ContinuousBatchingScheduler",
     "Request",
     "RequestState",
+    "SampleParams",
+    "StepPlan",
     "ServeEngine",
 ]

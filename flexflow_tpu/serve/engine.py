@@ -1,22 +1,32 @@
-"""ServeEngine: jitted prefill/decode steps over a paged KV-cache.
+"""ServeEngine: one jitted MIXED step over a paged KV-cache.
 
 Wraps an LM built by models/transformer.build_transformer_lm into the
-two functions autoregressive serving actually runs:
+serving hot path. The default (chunked-prefill) engine runs ONE program:
 
-  prefill — one sequence's whole prompt in one pass: full causal
-    attention (the MXU-friendly shape), K/V scattered into the
-    sequence's pages, logits of the LAST real position returned.
-  decode  — ONE token for EVERY running sequence as a single batch:
-    single-query attention through the page tables
-    (kernels/flash_attention.paged_attention_decode), new K/V written
-    in-place at each sequence's tail.
+  mixed — a fixed-width batch of `serve_prefill_budget + serve_max_seqs`
+    LANES, each lane one (sequence, position) query token. Prompt
+    chunks from any number of requests and the single decode token of
+    every running sequence pack into the same step: K/V for all lanes
+    scatters into each sequence's pages, then every lane attends
+    through its page-table row masked at its own position + 1
+    (kernels/flash_attention.paged_attention_ragged), so causality is
+    exact and decode lanes never stall behind a long prompt. Logits
+    reduce to a greedy argmax plus a static top-k head (for seeded
+    temperature / top-k sampling) before leaving the device.
 
-Static shapes are the whole game on TPU: decode always runs at the
-full slot width (max_seqs lanes; empty lanes aim at the sink page), and
-prompts pad to power-of-two token BUCKETS, so XLA compiles one decode
-program plus one prefill program per bucket — ever. After
-`warmup()` a serving process never recompiles (generate() can assert
-this via `compile_counts()`), which is what keeps p99 latency flat.
+Static shapes are the whole game on TPU: the mixed step has ONE
+geometry, so XLA compiles ONE serving program — ever. After `warmup()` a
+serving process never recompiles (generate() can assert this via
+`compile_counts()`), which is what keeps p99 latency flat. The PR 1
+per-bucket prefill + full-width decode pair is retained behind
+`serve_chunked_prefill=False` (FFConfig) as the legacy path.
+
+The engine owns a PERSISTENT PagedKVCache and device page arrays:
+prefix pages committed by one generate() call are matchable by the
+next, so a shared system preamble is computed once per process, not
+once per batch. Caches flow functionally: the jitted steps take the
+page arrays donated and return the updated ones, so the update is
+in-place on device and the host never holds two copies.
 
 The engine reads weights straight out of the compiled FFModel's
 TrainState and re-implements the block math as pure functions — the
@@ -24,12 +34,9 @@ graph executor has no notion of carried state, and threading a cache
 through it would force every op to learn about sequence position. The
 ops' numerics are mirrored exactly (LayerNorm f32 statistics, f32
 matmul accumulation), so `generate_reference` (naive no-cache
-re-forward each step) produces identical tokens — the parity test.
-
-Caches flow functionally: generate() owns (k_pages, v_pages) for its
-lifetime and threads them through the jitted steps with donated
-buffers, so the update is in-place on device and the host never holds
-two copies.
+re-forward each step) produces identical greedy tokens — the parity
+test, which holds through prefix-cache hits, chunked prefill, and
+preemption/resume.
 """
 
 from __future__ import annotations
@@ -42,9 +49,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import CompMode
-from ..kernels.flash_attention import paged_attention_decode
+from ..kernels.flash_attention import (paged_attention_decode,
+                                       paged_attention_ragged)
 from .kv_cache import KVCacheConfig, PagedKVCache
-from .scheduler import ContinuousBatchingScheduler, Request
+from .scheduler import (ChunkPlan, ContinuousBatchingScheduler, Request,
+                        SampleParams)
 
 
 def _ln(p, x, eps):
@@ -74,11 +83,22 @@ class ServeEngine:
     model must be compiled (any comp_mode); if not, it is compiled here
     in INFERENCE mode (no optimizer slots). All serving knobs come from
     the model's FFConfig (kv_page_size / kv_num_pages / serve_max_seqs /
-    serve_prefill_budget).
+    serve_prefill_budget / serve_chunked_prefill / serve_prefix_cache /
+    serve_admit_watermark); `chunked_prefill` / `prefix_cache` override
+    the config (tools that A/B the optimisations build two engines over
+    one model).
     """
 
+    # static top-k head width: sampling draws from the top
+    # min(TOPK_CAP, vocab) logits of a lane, so the sampled stream
+    # leaves the device at fixed shape and the zero-recompile contract
+    # survives sampling. top_k > this cap is rejected at generate().
+    TOPK_CAP = 64
+
     def __init__(self, model, *, max_seq_len: Optional[int] = None,
-                 use_pallas: Optional[bool] = None, interpret: bool = False):
+                 use_pallas: Optional[bool] = None, interpret: bool = False,
+                 chunked_prefill: Optional[bool] = None,
+                 prefix_cache: Optional[bool] = None):
         if model.state is None:
             model.compile(comp_mode=CompMode.INFERENCE)
         self.model = model
@@ -97,8 +117,29 @@ class ServeEngine:
             num_heads=self.num_heads, head_dim=self.head_dim,
             max_seq_len=max_seq_len)
         self.cache_cfg.validate()
-        # prompt-length buckets: powers of two from one page up to the
-        # page-table ceiling — each bucket is one prefill compilation
+        cfg = self.config
+        self.chunked_prefill = bool(
+            getattr(cfg, "serve_chunked_prefill", True)
+            if chunked_prefill is None else chunked_prefill)
+        self.prefix_cache = bool(
+            getattr(cfg, "serve_prefix_cache", True)
+            if prefix_cache is None else prefix_cache) \
+            and self.chunked_prefill
+        self.prefill_budget = int(getattr(cfg, "serve_prefill_budget", 512))
+        self.admit_watermark = float(
+            getattr(cfg, "serve_admit_watermark", 0.02))
+        # the one mixed-step geometry: every prefill-budget token plus
+        # one decode lane per slot always fits
+        self.mixed_width = self.prefill_budget + self.cache_cfg.max_seqs
+        self.topk_cap = min(self.TOPK_CAP, self.vocab_size)
+        # persistent across generate() calls: the prefix cache only
+        # pays off if committed pages outlive the batch that wrote them
+        self.cache = PagedKVCache(self.cache_cfg,
+                                  prefix_cache=self.prefix_cache)
+        self._k_pages = None
+        self._v_pages = None
+        # prompt-length buckets (legacy path + generate_reference):
+        # powers of two from one page up to the page-table ceiling
         cap = self.cache_cfg.pages_per_seq * self.cache_cfg.page_size
         b = max(self.cache_cfg.page_size, 16)
         self.buckets = []
@@ -106,6 +147,7 @@ class ServeEngine:
             self.buckets.append(b)
             b *= 2
         self.buckets.append(cap)
+        self._mixed_jit = jax.jit(self._mixed_impl, donate_argnums=(1, 2))
         self._prefill_jit = jax.jit(self._prefill_impl,
                                     donate_argnums=(1, 2))
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2))
@@ -114,7 +156,8 @@ class ServeEngine:
         # compile counter (jit._cache_size is a private API) — a new
         # signature IS a new XLA program under jit
         self._shapes_seen: Dict[str, set] = {"prefill": set(),
-                                             "decode": set()}
+                                             "decode": set(),
+                                             "mixed": set()}
         self.last_stats: Optional[dict] = None
 
     def _call_counted(self, name, fn, *args):
@@ -185,9 +228,9 @@ class ServeEngine:
         logits of position length-1 plus the (possibly updated)
         caches. `kv = (k_pages, v_pages, pt_row)` scatters each
         layer's K/V into the sequence's pages on the way through
-        (prefill); kv=None is the pure no-cache forward (the naive
-        reference) — ONE implementation so the parity oracle and the
-        serving path can never drift apart."""
+        (legacy prefill); kv=None is the pure no-cache forward (the
+        naive reference) — ONE implementation so the parity oracle and
+        the legacy serving path can never drift apart."""
         ps = self.cache_cfg.page_size
         s = tokens.shape[1]
         positions = jnp.arange(s, dtype=jnp.int32)[None, :]
@@ -217,7 +260,47 @@ class ServeEngine:
         last = jnp.take(logits[0], length - 1, axis=0)    # (V,)
         return last, (None if kv is None else (k_pages, v_pages))
 
-    # ---------------- prefill ------------------------------------------
+    # ---------------- the mixed step (chunked prefill + decode) --------
+    def _mixed_impl(self, params, k_pages, v_pages, tokens, positions,
+                    write_pages, write_offs, page_tables, lane_slots,
+                    lane_lens):
+        """ONE serving step over `mixed_width` LANES. Per lane (all
+        (T,) int32, HOST-built): the token to embed, its position, the
+        physical (page, offset) its K/V lands in (inactive lanes aim at
+        the sink page 0), the page-table row it reads
+        (lane_slots -> page_tables (max_seqs, pages_per_seq)) and its
+        visible length (position + 1; inactive lanes clamp to 1 so the
+        masked softmax stays NaN-free). All lanes' K/V is scattered
+        per layer BEFORE attention, so chunk tokens of one sequence see
+        each other causally and decode lanes see every prefix page —
+        including pages another request's chunk computes in this very
+        step (the intra-step prefix-sharing contract,
+        serve/scheduler.py). Inactive lanes compute garbage the host
+        never reads. Returns (greedy (T,), top-k values (T, K), top-k
+        ids (T, K), k_pages, v_pages) — the static top-k head feeds
+        host-side seeded sampling without shipping (T, vocab) logits."""
+        x = self._embed(params, tokens, positions)        # (T, E)
+        scale = 1.0 / np.sqrt(self.head_dim)
+        for i in range(self.num_layers):
+            p = params[f"layer{i}_attn"]
+            h = _ln(params[f"layer{i}_ln1"], x, self.ln_eps) \
+                if self.layer_norm else x
+            q, k, v = self._attn_qkv(p, h)                # (T, H, D)
+            k_pages = k_pages.at[i, write_pages, write_offs].set(k)
+            v_pages = v_pages.at[i, write_pages, write_offs].set(v)
+            o = paged_attention_ragged(
+                q, k_pages[i], v_pages[i], page_tables, lane_slots,
+                lane_lens, scale=scale, use_pallas=self._use_pallas,
+                interpret=self._interpret)
+            x = self._attn_out(p, o, x)
+            x = self._ffn(params, i, x)
+        logits = self._head(params, x)                    # (T, V)
+        topv, topi = jax.lax.top_k(logits, self.topk_cap)
+        return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                topv.astype(jnp.float32), topi.astype(jnp.int32),
+                k_pages, v_pages)
+
+    # ---------------- legacy prefill -----------------------------------
     def _prefill_impl(self, params, k_pages, v_pages, tokens, length,
                       pt_row):
         """tokens (1, S) padded to a bucket; length scalar int32 (real
@@ -225,14 +308,14 @@ class ServeEngine:
         table. Returns (last-position logits (V,), k_pages, v_pages).
 
         Padded positions scatter their K/V through page-table entries
-        normally: entries past the reserved range are 0 (the sink), and
-        padded offsets inside a reserved page are overwritten by decode
+        normally: entries past the mapped range are 0 (the sink), and
+        padded offsets inside a mapped page are overwritten by decode
         before the length mask ever exposes them."""
         last, (k_pages, v_pages) = self._forward_tokens(
             params, tokens, length, kv=(k_pages, v_pages, pt_row))
         return last, k_pages, v_pages
 
-    # ---------------- decode -------------------------------------------
+    # ---------------- legacy decode ------------------------------------
     def _decode_impl(self, params, k_pages, v_pages, tokens, positions,
                      write_pages, write_offs, page_tables, seq_lens):
         """One token for every slot lane. tokens/positions (B,) int32;
@@ -243,7 +326,8 @@ class ServeEngine:
         (B, pages_per_seq); seq_lens (B,) INCLUDING the token being
         decoded (its K/V is written here, then attended — position i
         sees keys 0..i). Non-decoding lanes compute garbage the host
-        never reads. Returns (next_tokens (B,), k_pages, v_pages)."""
+        never reads. Returns (next_tokens (B,), top-k values, top-k
+        ids, k_pages, v_pages)."""
         x = self._embed(params, tokens, positions)        # (B, E)
         pages, offs = write_pages, write_offs
         scale = 1.0 / np.sqrt(self.head_dim)
@@ -261,8 +345,10 @@ class ServeEngine:
             x = self._attn_out(p, o, x)
             x = self._ffn(params, i, x)
         logits = self._head(params, x)                    # (B, V)
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
-            k_pages, v_pages
+        topv, topi = jax.lax.top_k(logits, self.topk_cap)
+        return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                topv.astype(jnp.float32), topi.astype(jnp.int32),
+                k_pages, v_pages)
 
     # ---------------- naive no-cache reference -------------------------
     def _forward_logits(self, params, tokens, length):
@@ -283,123 +369,167 @@ class ServeEngine:
 
     def compile_counts(self) -> Dict[str, int]:
         """Compiled-program count per serving function. After warmup()
-        these must never grow — the zero-recompile serving contract.
-        Uses jit's compilation-cache size when the (private) API
-        exists, else the engine's own count of distinct argument-shape
-        signatures (each distinct signature is one XLA program), so the
-        contract check can never go vacuous on a jax without
-        _cache_size."""
+        these must never grow — the zero-recompile serving contract
+        (the chunked engine's whole hot path is the single `mixed`
+        program). Uses jit's compilation-cache size when the (private)
+        API exists, else the engine's own count of distinct
+        argument-shape signatures (each distinct signature is one XLA
+        program), so the contract check can never go vacuous on a jax
+        without _cache_size."""
         def n(f, name):
             try:
                 return int(f._cache_size())
             except AttributeError:  # jit cache API moved across versions
                 return len(self._shapes_seen[name])
         return {"prefill": n(self._prefill_jit, "prefill"),
-                "decode": n(self._decode_jit, "decode")}
+                "decode": n(self._decode_jit, "decode"),
+                "mixed": n(self._mixed_jit, "mixed")}
+
+    def _device_pages(self):
+        if self._k_pages is None:
+            self._k_pages, self._v_pages = self.cache.alloc_device_cache()
+        return self._k_pages, self._v_pages
 
     def warmup(self) -> Dict[str, int]:
-        """Compile every prefill bucket and the decode step once, on
-        throwaway inputs. Returns compile_counts() afterwards."""
+        """Compile the active path's programs once, on throwaway inputs
+        (all writes aim at the sink page). Returns compile_counts()."""
         c = self.cache_cfg
-        kp, vp = PagedKVCache(c).alloc_device_cache()
-        pt_row = jnp.zeros((c.pages_per_seq,), jnp.int32)
-        for b in self.buckets:
-            toks = jnp.zeros((1, b), jnp.int32)
-            _, kp, vp = self._call_counted(
-                "prefill", self._prefill_jit, self.params, kp, vp, toks,
-                jnp.int32(1), pt_row)
-        toks = jnp.zeros((c.max_seqs,), jnp.int32)
-        pos = jnp.zeros((c.max_seqs,), jnp.int32)
-        pts = jnp.zeros((c.max_seqs, c.pages_per_seq), jnp.int32)
-        sls = jnp.ones((c.max_seqs,), jnp.int32)
-        self._call_counted("decode", self._decode_jit, self.params, kp,
-                           vp, toks, pos, toks, pos, pts, sls)
+        kp, vp = self._device_pages()
+        if self.chunked_prefill:
+            t = self.mixed_width
+            z = jnp.zeros((t,), jnp.int32)
+            pts = jnp.zeros((c.max_seqs, c.pages_per_seq), jnp.int32)
+            _, _, _, kp, vp = self._call_counted(
+                "mixed", self._mixed_jit, self.params, kp, vp, z, z, z, z,
+                pts, z, jnp.ones((t,), jnp.int32))
+        else:
+            pt_row = jnp.zeros((c.pages_per_seq,), jnp.int32)
+            for b in self.buckets:
+                toks = jnp.zeros((1, b), jnp.int32)
+                _, kp, vp = self._call_counted(
+                    "prefill", self._prefill_jit, self.params, kp, vp,
+                    toks, jnp.int32(1), pt_row)
+            toks = jnp.zeros((c.max_seqs,), jnp.int32)
+            pos = jnp.zeros((c.max_seqs,), jnp.int32)
+            pts = jnp.zeros((c.max_seqs, c.pages_per_seq), jnp.int32)
+            sls = jnp.ones((c.max_seqs,), jnp.int32)
+            _, _, _, kp, vp = self._call_counted(
+                "decode", self._decode_jit, self.params, kp, vp, toks,
+                pos, toks, pos, pts, sls)
+        self._k_pages, self._v_pages = kp, vp
         return self.compile_counts()
+
+    # ---------------- sampling -----------------------------------------
+    @staticmethod
+    def _sample_params(temperature, top_k, seed, n, cap):
+        """Normalize scalar-or-per-request sampling args into one
+        Optional[SampleParams] per request."""
+        def seq(x):
+            if x is None or np.isscalar(x):
+                return [x] * n
+            if len(x) != n:
+                raise ValueError(
+                    f"per-request sampling arg has {len(x)} entries "
+                    f"for {n} prompts")
+            return list(x)
+        out = []
+        for t, k in zip(seq(temperature), seq(top_k)):
+            if t is None or float(t) <= 0.0:
+                if t is not None and float(t) < 0.0:
+                    raise ValueError(f"temperature must be >= 0, got {t}")
+                out.append(None)
+                continue
+            if k is not None and not (1 <= int(k) <= cap):
+                raise ValueError(
+                    f"top_k must be in [1, {cap}] (the engine's static "
+                    f"top-k head), got {k}")
+            out.append(SampleParams(temperature=float(t),
+                                    top_k=None if k is None else int(k),
+                                    seed=int(seed)))
+        return out
+
+    def _pick_token(self, req: Request, greedy: int, topv, topi) -> int:
+        """The emitted token for a lane: greedy argmax, or a seeded
+        draw from the lane's top-k logits. The RNG is stateless per
+        (seed, rid, token-index), so a fixed seed reproduces a stream
+        exactly and preemption/resume replays nothing."""
+        sp = req.sample
+        if sp is None:
+            return int(greedy)
+        k = sp.top_k if sp.top_k is not None else self.topk_cap
+        v = np.asarray(topv[:k], np.float64) / sp.temperature
+        v -= v.max()
+        p = np.exp(v)
+        p /= p.sum()
+        rng = np.random.default_rng([sp.seed, req.rid,
+                                     len(req.out_tokens)])
+        return int(topi[int(rng.choice(k, p=p))])
 
     # ---------------- the serving loop ---------------------------------
     def generate(self, prompts: Sequence[Sequence[int]],
-                 max_new_tokens, eos_token: Optional[int] = None
+                 max_new_tokens, eos_token: Optional[int] = None,
+                 temperature=None, top_k=None, sample_seed: int = 0
                  ) -> List[List[int]]:
-        """Greedy-decode a ragged batch under continuous batching.
-        `max_new_tokens` is an int or a per-prompt sequence. Returns
-        the generated tokens (prompt excluded) per prompt, in order.
-        Per-request latency and per-token timings land in
-        `self.last_stats` (render with utils/profiling.serve_report)."""
+        """Decode a ragged batch under continuous batching.
+        `max_new_tokens` is an int or a per-prompt sequence; greedy by
+        default, per-request seeded temperature/top-k sampling when
+        `temperature` is given (scalar or per-prompt; 0 = greedy).
+        Returns the generated tokens (prompt excluded) per prompt, in
+        order. Per-request latency, prefix-cache/preemption/utilization
+        counters, and per-token timings land in `self.last_stats`
+        (render with utils/profiling.serve_report)."""
         c = self.cache_cfg
-        cache = PagedKVCache(c)
+        cache = self.cache
+        if cache.free_slots != c.max_seqs:
+            raise RuntimeError(
+                "engine cache has live slots — a previous generate() "
+                "aborted mid-flight; build a fresh ServeEngine")
         sched = ContinuousBatchingScheduler(
-            cache, prefill_token_budget=int(
-                getattr(self.config, "serve_prefill_budget", 512)))
+            cache, prefill_token_budget=self.prefill_budget,
+            chunked_prefill=self.chunked_prefill,
+            admit_watermark=self.admit_watermark)
         if isinstance(max_new_tokens, int):
             max_new_tokens = [max_new_tokens] * len(prompts)
         if len(max_new_tokens) != len(prompts):
             raise ValueError(
                 f"max_new_tokens has {len(max_new_tokens)} entries for "
                 f"{len(prompts)} prompts")
+        samples = self._sample_params(temperature, top_k, sample_seed,
+                                      len(prompts), self.topk_cap)
         reqs: List[Request] = []
         t0 = time.perf_counter()
-        for prompt, mnt in zip(prompts, max_new_tokens):
-            r = sched.submit(prompt, mnt, eos_token=eos_token)
+        for prompt, mnt, sp in zip(prompts, max_new_tokens, samples):
+            r = sched.submit(prompt, mnt, eos_token=eos_token, sample=sp)
             r.t_submit = time.perf_counter()
             reqs.append(r)
-        k_pages, v_pages = cache.alloc_device_cache()
-        decode_steps = 0
-        decode_times: List[float] = []   # seconds per decode step
-        decode_widths: List[int] = []    # active lanes per decode step
-        prefill_times: List[Tuple[int, float]] = []  # (bucket, seconds)
+        kp, vp = self._device_pages()
+        steps = 0
+        decode_times: List[float] = []   # seconds per step with decodes
+        decode_widths: List[int] = []    # decode lanes per such step
+        prefill_times: List[Tuple[int, float]] = []  # (lanes, seconds)
+        util: List[float] = []           # resident-page fraction per step
 
-        while sched.has_work():
-            plan = sched.schedule()
-            for req in plan.prefills:
-                b = self.bucket_for(len(req.prompt))
-                toks = np.zeros((1, b), np.int32)
-                toks[0, :len(req.prompt)] = req.prompt
-                tp = time.perf_counter()
-                last, k_pages, v_pages = self._call_counted(
-                    "prefill", self._prefill_jit,
-                    self.params, k_pages, v_pages, jnp.asarray(toks),
-                    jnp.int32(len(req.prompt)),
-                    jnp.asarray(cache.page_tables[req.slot]))
-                tok = int(jnp.argmax(last))
-                prefill_times.append((b, time.perf_counter() - tp))
-                req.out_tokens.append(tok)
+        def emit(chunk: ChunkPlan, greedy, topv, topi) -> None:
+            req = chunk.req
+            tok = self._pick_token(req, greedy, topv, topi)
+            req.out_tokens.append(tok)
+            if len(req.out_tokens) == 1:
                 req.t_first_token = time.perf_counter()
-                if req.is_done():
-                    req.t_finish = req.t_first_token
-                    sched.finish(req)
-            if plan.decodes:
-                tokens = np.zeros((c.max_seqs,), np.int32)
-                positions = np.zeros((c.max_seqs,), np.int32)
-                write_pages = np.zeros((c.max_seqs,), np.int32)  # sink
-                write_offs = np.zeros((c.max_seqs,), np.int32)
-                for req in plan.decodes:
-                    # the new token's K/V slot: append BEFORE the step
-                    # so seq_lens includes it (self-attention sees it)
-                    pos = cache.append_token(req.slot)
-                    positions[req.slot] = pos
-                    tokens[req.slot] = req.out_tokens[-1]
-                    write_pages[req.slot] = cache.page_tables[
-                        req.slot, pos // c.page_size]
-                    write_offs[req.slot] = pos % c.page_size
-                seq_lens = np.maximum(cache.seq_lens, 1)  # empty lanes:
-                # >= 1 valid (sink) key so the masked softmax stays NaN-free
-                tp = time.perf_counter()
-                nxt, k_pages, v_pages = self._call_counted(
-                    "decode", self._decode_jit,
-                    self.params, k_pages, v_pages, jnp.asarray(tokens),
-                    jnp.asarray(positions), jnp.asarray(write_pages),
-                    jnp.asarray(write_offs), jnp.asarray(cache.page_tables),
-                    jnp.asarray(seq_lens))
-                nxt = np.asarray(nxt)    # ONE device->host fetch per step
-                now = time.perf_counter()
-                decode_times.append(now - tp)
-                decode_widths.append(len(plan.decodes))
-                decode_steps += 1
-                for req in plan.decodes:
-                    req.out_tokens.append(int(nxt[req.slot]))
-                    if req.is_done():
-                        req.t_finish = time.perf_counter()
-                        sched.finish(req)
+            if req.is_done():
+                req.t_finish = time.perf_counter()
+                sched.finish(req)
+
+        if self.chunked_prefill:
+            kp, vp = self._run_chunked(sched, cache, kp, vp, emit,
+                                       decode_times, decode_widths,
+                                       prefill_times, util)
+            steps = len(util)
+        else:
+            kp, vp = self._run_legacy(sched, cache, kp, vp, emit,
+                                      decode_times, decode_widths,
+                                      prefill_times, util)
+            steps = len(util)
+        self._k_pages, self._v_pages = kp, vp
         cache.check_invariants()
         assert cache.free_pages == c.usable_pages, "pages leaked"
         total_new = sum(len(r.out_tokens) for r in reqs)
@@ -408,19 +538,152 @@ class ServeEngine:
             "requests": [
                 {"rid": r.rid, "prompt_tokens": len(r.prompt),
                  "new_tokens": len(r.out_tokens),
+                 "preemptions": r.preemptions,
                  "ttft_s": r.t_first_token - r.t_submit,
                  "latency_s": r.t_finish - r.t_submit}
                 for r in reqs],
+            "mode": "chunked" if self.chunked_prefill else "legacy",
             "wall_s": wall,
             "total_new_tokens": total_new,
             "tokens_per_sec": total_new / wall if wall > 0 else 0.0,
-            "decode_steps": decode_steps,
+            "steps": steps,
+            "decode_steps": len(decode_times),
             "decode_step_times_s": decode_times,
             "decode_widths": decode_widths,
             "prefill_times_s": prefill_times,
             "compile_counts": self.compile_counts(),
+            # prefix cache / chunked prefill / preemption instrumentation
+            "prompt_tokens_total": sched.stats["prompt_tokens"],
+            "prefill_tokens_computed": sched.stats["prefill_lane_tokens"],
+            "prefix_hit_tokens": sched.stats["prefix_hit_tokens"],
+            "preemptions": sched.stats["preemptions"],
+            "page_util_mean": float(np.mean(util)) if util else 0.0,
+            "page_util_max": float(np.max(util)) if util else 0.0,
+            "cache": dict(cache.stats),   # engine-lifetime counters
         }
         return [list(r.out_tokens) for r in reqs]
+
+    def _run_chunked(self, sched, cache, kp, vp, emit, decode_times,
+                     decode_widths, prefill_times, util):
+        """The mixed-step loop: every iteration packs this step's
+        chunks into the fixed `mixed_width` lanes and runs ONE program."""
+        c = self.cache_cfg
+        t_w = self.mixed_width
+        ps = c.page_size
+        while sched.has_work():
+            plan = sched.schedule()
+            assert plan.chunks, "scheduler made no progress"
+            tokens = np.zeros((t_w,), np.int32)
+            positions = np.zeros((t_w,), np.int32)
+            write_pages = np.zeros((t_w,), np.int32)   # sink by default
+            write_offs = np.zeros((t_w,), np.int32)
+            lane_slots = np.zeros((t_w,), np.int32)
+            lane_lens = np.ones((t_w,), np.int32)      # NaN-free padding
+            lane = 0
+            emitters: List[Tuple[ChunkPlan, int]] = []
+            for ch in plan.chunks:
+                ctx = ch.req.context
+                row = cache.page_tables[ch.req.slot]
+                for pos in range(ch.start, ch.end):
+                    tokens[lane] = ctx[pos]
+                    positions[lane] = pos
+                    write_pages[lane] = row[pos // ps]
+                    write_offs[lane] = pos % ps
+                    lane_slots[lane] = ch.req.slot
+                    lane_lens[lane] = pos + 1
+                    lane += 1
+                if ch.emits:
+                    emitters.append((ch, lane - 1))
+            assert lane <= t_w, (
+                f"scheduler packed {lane} lanes into a {t_w}-lane step")
+            tp = time.perf_counter()
+            greedy, topv, topi, kp, vp = self._call_counted(
+                "mixed", self._mixed_jit, self.params, kp, vp,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(write_pages), jnp.asarray(write_offs),
+                jnp.asarray(cache.page_tables), jnp.asarray(lane_slots),
+                jnp.asarray(lane_lens))
+            greedy = np.asarray(greedy)
+            topv = np.asarray(topv)
+            topi = np.asarray(topi)
+            dt = time.perf_counter() - tp
+            if plan.num_decode_lanes:
+                decode_times.append(dt)
+                decode_widths.append(plan.num_decode_lanes)
+            if plan.num_prefill_lanes:
+                prefill_times.append((plan.num_prefill_lanes, dt))
+            util.append(1.0 - cache.free_pages / c.usable_pages)
+            # bookkeeping FIRST (page commits hash the context as it
+            # was when the chunk ran), emission second
+            for ch in plan.chunks:
+                sched.complete_chunk(ch)
+            for ch, ln in emitters:
+                emit(ch, greedy[ln], topv[ln], topi[ln])
+        return kp, vp
+
+    def _run_legacy(self, sched, cache, kp, vp, emit, decode_times,
+                    decode_widths, prefill_times, util):
+        """The PR 1 two-program loop (serve_chunked_prefill=False):
+        per-request bucketed prefill, then one full-width decode —
+        kept as the A/B baseline and the bucketed-prefill fallback."""
+        c = self.cache_cfg
+        ps = c.page_size
+        while sched.has_work():
+            plan = sched.schedule()
+            assert plan.chunks, "scheduler made no progress"
+            pre = [ch for ch in plan.chunks if not ch.is_decode]
+            dec = [ch for ch in plan.chunks if ch.is_decode]
+            for ch in pre:
+                req = ch.req
+                ctx = req.context
+                b = self.bucket_for(len(ctx))
+                toks = np.zeros((1, b), np.int32)
+                toks[0, :len(ctx)] = ctx
+                tp = time.perf_counter()
+                last, kp, vp = self._call_counted(
+                    "prefill", self._prefill_jit, self.params, kp, vp,
+                    jnp.asarray(toks), jnp.int32(len(ctx)),
+                    jnp.asarray(cache.page_tables[req.slot]))
+                logits = np.asarray(last)
+                prefill_times.append((b, time.perf_counter() - tp))
+                sched.complete_chunk(ch)
+                order = np.argsort(logits)[::-1][:self.topk_cap]
+                # np.argmax, not order[0]: argsort's descending tie
+                # order differs from argmax's first-wins (the parity
+                # contract with generate_reference is argmax's)
+                emit(ch, int(np.argmax(logits)), logits[order], order)
+            if dec:
+                tokens = np.zeros((c.max_seqs,), np.int32)
+                positions = np.zeros((c.max_seqs,), np.int32)
+                write_pages = np.zeros((c.max_seqs,), np.int32)  # sink
+                write_offs = np.zeros((c.max_seqs,), np.int32)
+                # the decode step must see the new token (position i
+                # attends keys 0..i), so lengths include it up front
+                seq_lens = np.maximum(np.asarray(cache.seq_lens), 1)
+                for ch in dec:
+                    s, pos = ch.req.slot, ch.start
+                    tokens[s] = ch.req.context[pos]
+                    positions[s] = pos
+                    write_pages[s] = cache.page_tables[s, pos // ps]
+                    write_offs[s] = pos % ps
+                    seq_lens[s] = ch.end
+                tp = time.perf_counter()
+                nxt, topv, topi, kp, vp = self._call_counted(
+                    "decode", self._decode_jit, self.params, kp, vp,
+                    jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(write_pages), jnp.asarray(write_offs),
+                    jnp.asarray(cache.page_tables), jnp.asarray(seq_lens))
+                nxt = np.asarray(nxt)    # ONE device->host fetch per step
+                topv = np.asarray(topv)
+                topi = np.asarray(topi)
+                decode_times.append(time.perf_counter() - tp)
+                decode_widths.append(len(dec))
+                for ch in dec:
+                    sched.complete_chunk(ch)
+                    emit(ch, nxt[ch.req.slot], topv[ch.req.slot],
+                         topi[ch.req.slot])
+            util.append(1.0 - cache.free_pages / c.usable_pages)
+        return kp, vp
 
     def generate_reference(self, prompts: Sequence[Sequence[int]],
                            max_new_tokens,

@@ -1,4 +1,4 @@
-"""Block-paged KV-cache manager.
+"""Block-paged KV-cache manager with prefix caching.
 
 The device cache is a fixed pool of PAGES — (page_size, heads, head_dim)
 K and V blocks per layer — and each sequence owns a PAGE TABLE mapping
@@ -13,25 +13,69 @@ bounded by TOTAL resident tokens, not max_seqs * max_len. Freeing a
 finished sequence returns whole pages to the pool — reuse is
 defrag-free because pages are fixed-size and position-independent.
 
+Three properties layered on top of the PR 1 allocator:
+
+  * Per-page REFCOUNTS: a page can be mapped by several slots at once.
+    The K/V of a token block depends only on the token content and its
+    position, so two sequences with the same prompt prefix can read the
+    same physical pages. A page returns to circulation only when its
+    refcount hits 0.
+  * PREFIX HASHING: every COMPLETED page (all page_size positions
+    written with real K/V) can be registered under a chain hash of its
+    token content — key_i = H(key_{i-1} || tokens[i*ps:(i+1)*ps]) — so
+    `match_prefix` finds the longest resident run of pages for a new
+    prompt in O(pages). Partial (tail) pages are never shared: they are
+    still being written by their owner. A hashed page whose refcount
+    drops to 0 is NOT freed — it parks in an LRU of reclaimable cached
+    pages, still matchable, and is evicted (hash dropped) only when the
+    allocator runs dry. `free_pages` therefore counts reclaimable
+    capacity: truly-free pages plus the evictable LRU.
+  * ON-DEMAND ALLOCATION: slots claim pages as their sequence actually
+    grows (`ensure_capacity` / `append_token` allocate when a page
+    boundary is crossed) instead of reserving prompt+max_new up front.
+    Effective batch size is bounded by actual residency; the scheduler
+    pairs this with a preemption path for the rare pool-exhausted step.
+
 Page 0 is reserved as the write SINK: padding lanes of the static-shape
-prefill/decode steps (positions past a prompt's real length, inactive
-decode slots) scatter their K/V there through page-table entries of 0,
-so the jitted steps never need a masked scatter. Reads are masked by
-sequence length, so sink contents are never observed.
+steps scatter their K/V there through page-table entries of 0, so the
+jitted steps never need a masked scatter. Reads are masked by sequence
+length, so sink contents are never observed.
 
 Host/device split: this class owns only HOST bookkeeping (free list,
-page tables, lengths) as numpy arrays the scheduler mutates freely; the
-device arrays are created once by `alloc_device_cache()` and flow
-functionally through the engine's jitted steps (donated in, returned
-out) — the manager never touches device memory.
+refcounts, hash registry, page tables, lengths) as plain numpy/dicts the
+scheduler mutates freely; the device arrays are created once by
+`alloc_device_cache()` and flow functionally through the engine's jitted
+steps (donated in, returned out) — the manager never touches device
+memory.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import List
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Sequence
 
 import numpy as np
+
+
+def prefix_page_keys(tokens: Sequence[int], page_size: int,
+                     num_pages: int, *, start: int = 0,
+                     prev: bytes = b"") -> List[bytes]:
+    """Chain hashes for FULL pages [start, num_pages) of `tokens`:
+    key_i = sha256(key_{i-1} || block_i_bytes). Position-dependence is
+    implicit in the chain (block i's key commits to every token before
+    it), so equal keys mean equal (content, position) — the sharing
+    precondition. Callers extending an existing chain pass `start` and
+    the last known key as `prev`, so per-sequence hashing stays O(pages)
+    instead of O(pages^2) across incremental extensions."""
+    keys: List[bytes] = []
+    for i in range(start, num_pages):
+        block = np.asarray(tokens[i * page_size:(i + 1) * page_size],
+                           dtype=np.int32)
+        prev = hashlib.sha256(prev + block.tobytes()).digest()
+        keys.append(prev)
+    return keys
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,7 +126,7 @@ class KVCacheConfig:
 
 
 class PagedKVCache:
-    """Host-side page allocator + per-slot page tables.
+    """Host-side page allocator + per-slot page tables + prefix cache.
 
     Slots are the static decode-batch lanes (0..max_seqs-1); the
     scheduler binds a running request to a slot and this class binds the
@@ -91,89 +135,206 @@ class PagedKVCache:
 
       page_tables  (max_seqs, pages_per_seq) int32, 0 = sink/unmapped
       seq_lens     (max_seqs,) int32, 0 = slot empty
+
+    Every usable page is in exactly one of three states:
+      free    — unhashed, in `_free` (LIFO: warmest reuse first)
+      cached  — hashed, refcount 0, in the `_lru` (matchable, evictable)
+      mapped  — refcount > 0 (referenced by >= 1 slot's table)
     """
 
-    def __init__(self, cfg: KVCacheConfig):
+    def __init__(self, cfg: KVCacheConfig, prefix_cache: bool = True):
         cfg.validate()
         self.cfg = cfg
-        # LIFO free list: most-recently-freed pages are reused first
-        # (their cache lines are warmest); page 0 never enters the pool.
+        self.prefix_enabled = bool(prefix_cache)
         self._free: List[int] = list(range(cfg.num_pages - 1, 0, -1))
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self._ref = np.zeros((cfg.num_pages,), dtype=np.int64)
+        self._hash_of_page: Dict[int, bytes] = {}
+        self._page_of_hash: Dict[bytes, int] = {}
         self.page_tables = np.zeros((cfg.max_seqs, cfg.pages_per_seq),
                                     dtype=np.int32)
         self.seq_lens = np.zeros((cfg.max_seqs,), dtype=np.int32)
         self._slot_free = list(range(cfg.max_seqs - 1, -1, -1))
+        # serving metrics, merged into ServeEngine.last_stats
+        self.stats = {"prefix_hit_pages": 0, "prefix_evictions": 0,
+                      "pages_committed": 0, "shared_attaches": 0,
+                      "max_page_refs": 0}
 
     # ---------------- capacity queries (scheduler admission) ----------
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        """RECLAIMABLE pages: truly free plus cached-but-unreferenced
+        (the LRU is evicted on demand by allocation)."""
+        return len(self._free) + len(self._lru)
 
     @property
     def free_slots(self) -> int:
         return len(self._slot_free)
 
-    def pages_needed(self, total_tokens: int) -> int:
-        """Pages a sequence of `total_tokens` (prompt + all new tokens)
-        will occupy — the scheduler reserves this worst case at
-        admission so a running sequence can never strand mid-decode with
-        an empty pool (no preemption path)."""
-        return -(-total_tokens // self.cfg.page_size)
+    def pages_for(self, tokens: int) -> int:
+        return -(-tokens // self.cfg.page_size)
 
-    def can_admit(self, total_tokens: int) -> bool:
-        return (self.free_slots > 0
-                and total_tokens <= self.cfg.max_seq_len
-                and self.pages_needed(total_tokens) <= self.free_pages)
+    def mapped_pages(self, slot: int) -> int:
+        return int(np.count_nonzero(self.page_tables[slot]))
+
+    def mapped_tokens(self, slot: int) -> int:
+        """Token capacity already backed by this slot's pages."""
+        return self.mapped_pages(slot) * self.cfg.page_size
+
+    def ref(self, page: int) -> int:
+        return int(self._ref[page])
+
+    # ---------------- prefix cache ------------------------------------
+    def match_prefix(self, keys: Sequence[bytes]) -> List[int]:
+        """Longest run of resident pages whose chain keys match `keys`
+        from the start. Returned pages are NOT reserved — the caller
+        must `attach_prefix` them before any allocation can evict the
+        refcount-0 ones out of the LRU."""
+        pages: List[int] = []
+        if not self.prefix_enabled:
+            return pages
+        for key in keys:
+            p = self._page_of_hash.get(key)
+            if p is None:
+                break
+            pages.append(p)
+        return pages
+
+    def commit_page(self, slot: int, page_idx: int, key: bytes) -> bool:
+        """Register a COMPLETED page of `slot` under its content chain
+        key, making it matchable by future prompts. No-op when hashing
+        is off, the page is already registered, or another page already
+        owns the key (first writer wins; deduping the loser is not
+        worth a device copy). Returns True when registered."""
+        if not self.prefix_enabled:
+            return False
+        page = int(self.page_tables[slot, page_idx])
+        if page == 0:
+            raise RuntimeError(
+                f"commit_page on unmapped page {page_idx} of slot {slot}")
+        if page in self._hash_of_page or key in self._page_of_hash:
+            return False
+        self._hash_of_page[page] = key
+        self._page_of_hash[key] = page
+        self.stats["pages_committed"] += 1
+        return True
+
+    def _unregister(self, page: int) -> None:
+        key = self._hash_of_page.pop(page, None)
+        if key is not None:
+            del self._page_of_hash[key]
+
+    def _take_page(self) -> int:
+        """A writable page: the free list first, then evict the
+        least-recently-parked cached page (dropping its hash)."""
+        if self._free:
+            return self._free.pop()
+        if self._lru:
+            page, _ = self._lru.popitem(last=False)
+            self._unregister(page)
+            self.stats["prefix_evictions"] += 1
+            return page
+        raise RuntimeError(
+            "page pool exhausted (scheduler must check free_pages and "
+            "preempt before allocating)")
 
     # ---------------- slot lifecycle ----------------------------------
-    def alloc_slot(self, prompt_len: int, reserve_tokens: int) -> int:
-        """Claim a decode slot and map pages for `reserve_tokens` total
-        tokens (prompt + max new). Returns the slot id. The prompt is
-        considered resident immediately (seq_len = prompt_len); decode
-        then advances the length one token at a time through
-        :meth:`append_token`."""
-        if prompt_len < 1:
-            raise ValueError("prompt must be at least 1 token")
-        if prompt_len > reserve_tokens:
+    def alloc_slot(self) -> int:
+        """Claim an empty decode slot. Pages arrive separately via
+        attach_prefix (shared) and ensure_capacity (fresh)."""
+        if not self._slot_free:
+            raise RuntimeError("no free slot (scheduler must check "
+                               "free_slots first)")
+        return self._slot_free.pop()
+
+    def attach_prefix(self, slot: int, pages: Sequence[int],
+                      ntokens: int) -> None:
+        """Map already-resident prefix pages into an empty slot and mark
+        their `ntokens` tokens resident without any compute. Bumps each
+        page's refcount (pulling refcount-0 pages out of the LRU)."""
+        if self.seq_lens[slot] != 0 or self.mapped_pages(slot) != 0:
+            raise RuntimeError(f"attach_prefix on non-empty slot {slot}")
+        if ntokens != len(pages) * self.cfg.page_size:
             raise ValueError(
-                f"reserve_tokens ({reserve_tokens}) must cover the "
-                f"prompt ({prompt_len})")
-        if not self.can_admit(reserve_tokens):
+                f"prefix of {ntokens} tokens does not fill "
+                f"{len(pages)} pages exactly (only whole pages share)")
+        for i, p in enumerate(pages):
+            p = int(p)
+            if self._ref[p] == 0:
+                if p not in self._lru:
+                    raise RuntimeError(
+                        f"page {p} has refcount 0 but is not cached")
+                del self._lru[p]
+            else:
+                self.stats["shared_attaches"] += 1
+            self._ref[p] += 1
+            self.stats["max_page_refs"] = max(self.stats["max_page_refs"],
+                                              int(self._ref[p]))
+            self.page_tables[slot, i] = p
+        self.stats["prefix_hit_pages"] += len(pages)
+        self.seq_lens[slot] = ntokens
+
+    def ensure_capacity(self, slot: int, total_tokens: int) -> int:
+        """Allocate fresh (refcount-1, unhashed) pages so the slot can
+        hold `total_tokens`. Returns the number of pages allocated.
+        The caller (scheduler) must have verified `pages_to_extend`
+        against `free_pages` — running dry here is a scheduling bug."""
+        if total_tokens > self.cfg.pages_per_seq * self.cfg.page_size:
+            raise ValueError(
+                f"{total_tokens} tokens exceeds the page-table ceiling")
+        have = self.mapped_pages(slot)
+        need = self.pages_for(total_tokens)
+        for i in range(have, need):
+            page = self._take_page()
+            self._ref[page] = 1
+            self.page_tables[slot, i] = page
+        return max(0, need - have)
+
+    def pages_to_extend(self, slot: int, total_tokens: int) -> int:
+        return max(0, self.pages_for(total_tokens) - self.mapped_pages(slot))
+
+    def advance(self, slot: int, new_len: int) -> None:
+        """Mark tokens up to `new_len` resident (a completed prefill
+        chunk / decode write). Pages must already be mapped."""
+        if new_len < int(self.seq_lens[slot]):
+            raise ValueError(
+                f"advance moved slot {slot} backwards "
+                f"({self.seq_lens[slot]} -> {new_len})")
+        if self.pages_for(new_len) > self.mapped_pages(slot):
             raise RuntimeError(
-                f"admission bug: alloc_slot for {reserve_tokens} tokens "
-                f"with {self.free_pages} pages / {self.free_slots} slots "
-                f"free (scheduler must check can_admit first)")
-        slot = self._slot_free.pop()
-        n = self.pages_needed(reserve_tokens)
-        for i in range(n):
-            self.page_tables[slot, i] = self._free.pop()
-        self.seq_lens[slot] = prompt_len
-        return slot
+                f"slot {slot} advanced to {new_len} tokens past its "
+                f"{self.mapped_pages(slot)} mapped pages")
+        self.seq_lens[slot] = new_len
 
     def append_token(self, slot: int) -> int:
-        """Advance the slot's length by one decoded token; returns the
-        new token's position. Pages were reserved at admission, so this
-        never allocates."""
+        """Advance the slot's length by one decoded token, allocating a
+        page on demand when the position crosses a page boundary;
+        returns the new token's position."""
         if self.seq_lens[slot] == 0:
             raise RuntimeError(f"append_token on empty slot {slot}")
         pos = int(self.seq_lens[slot])
-        page_idx = pos // self.cfg.page_size
-        if self.page_tables[slot, page_idx] == 0:
-            raise RuntimeError(
-                f"slot {slot} ran past its reserved pages at position "
-                f"{pos} (admission reserved too few)")
+        self.ensure_capacity(slot, pos + 1)
         self.seq_lens[slot] = pos + 1
         return pos
 
     def free_slot(self, slot: int) -> None:
-        """Return the slot's pages to the pool and clear its table —
-        the eviction path the scheduler runs the moment a sequence
-        finishes, which is what lets the waiting queue backfill."""
+        """Release the slot: every mapped page's refcount drops; pages
+        reaching 0 go back to the free list — or, if content-hashed, to
+        the reclaimable LRU so a future prompt can still match them.
+        This is both the finished-sequence eviction path and the
+        preemption path (a preempted sequence's prefix stays matchable,
+        which is what makes preemption cheap to undo)."""
         for i in range(self.cfg.pages_per_seq):
             p = int(self.page_tables[slot, i])
-            if p != 0:
-                self._free.append(p)
-                self.page_tables[slot, i] = 0
+            if p == 0:
+                continue
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                if p in self._hash_of_page:
+                    self._lru[p] = None   # most-recently parked
+                else:
+                    self._free.append(p)
+            self.page_tables[slot, i] = 0
         self.seq_lens[slot] = 0
         self._slot_free.append(slot)
 
@@ -192,17 +353,45 @@ class PagedKVCache:
 
     # ---------------- invariant checks (tests) ------------------------
     def check_invariants(self) -> None:
-        """Property-style asserts: every page is either free, mapped to
-        exactly one slot, or the sink; lengths fit mapped pages."""
-        mapped = [int(p) for row in self.page_tables for p in row if p != 0]
-        assert len(mapped) == len(set(mapped)), "page mapped twice"
-        assert 0 not in mapped, "sink page mapped to a slot"
-        assert not (set(mapped) & set(self._free)), "page both mapped+free"
-        assert len(mapped) + len(self._free) == self.cfg.usable_pages, (
-            f"page leak: {self.cfg.usable_pages - len(mapped) - len(self._free)}"
-            f" pages unaccounted for")
-        for s in range(self.cfg.max_seqs):
-            n_mapped = int(np.count_nonzero(self.page_tables[s]))
-            assert int(self.seq_lens[s]) <= n_mapped * self.cfg.page_size, (
+        """Property-style asserts: refcounts equal the number of table
+        references, the free/cached/mapped states partition the pool,
+        no page leaks or double-frees, tables are contiguous prefixes,
+        and the hash registry is a consistent bijection."""
+        c = self.cfg
+        table_refs: Dict[int, int] = {}
+        for s in range(c.max_seqs):
+            row = self.page_tables[s]
+            nz = np.flatnonzero(row)
+            n_mapped = len(nz)
+            assert np.array_equal(nz, np.arange(n_mapped)), (
+                f"slot {s} page table is not a contiguous prefix: {row}")
+            assert int(self.seq_lens[s]) <= n_mapped * c.page_size, (
                 f"slot {s} length {self.seq_lens[s]} exceeds its "
                 f"{n_mapped} mapped pages")
+            for p in row[:n_mapped]:
+                table_refs[int(p)] = table_refs.get(int(p), 0) + 1
+        assert 0 not in table_refs, "sink page mapped to a slot"
+        free, lru = set(self._free), set(self._lru)
+        assert len(free) == len(self._free), "free list has duplicates"
+        assert not (free & lru), "page both free and cached"
+        for p in range(1, c.num_pages):
+            r = int(self._ref[p])
+            assert r == table_refs.get(p, 0), (
+                f"page {p} refcount {r} != {table_refs.get(p, 0)} "
+                f"table references")
+            states = (p in free) + (p in lru) + (r > 0)
+            assert states == 1, (
+                f"page {p} in {states} states (free={p in free}, "
+                f"cached={p in lru}, refs={r})")
+            if p in lru:
+                assert p in self._hash_of_page, f"cached page {p} unhashed"
+        assert len(table_refs) + len(free) + len(lru) == c.usable_pages, (
+            "page leak: states do not partition the pool")
+        assert len(self._hash_of_page) == len(self._page_of_hash), (
+            "hash registry is not a bijection")
+        for page, key in self._hash_of_page.items():
+            assert self._page_of_hash.get(key) == page, (
+                f"hash registry maps page {page} inconsistently")
+        if not self.prefix_enabled:
+            assert not self._hash_of_page and not self._lru, (
+                "prefix cache disabled but registry non-empty")

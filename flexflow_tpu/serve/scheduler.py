@@ -1,28 +1,43 @@
-"""Continuous-batching scheduler.
+"""Continuous-batching scheduler with chunked prefill and preemption.
 
-Policy (the "continuous batching" of Orca / vLLM, re-cut for TPU static
-shapes — see docs/serving.md):
+Policy (the "continuous batching" of Orca / vLLM plus Sarathi-style
+chunked prefill, re-cut for TPU static shapes — see docs/serving.md):
 
-  * FCFS admission: waiting requests are admitted in arrival order,
-    never reordered, as long as (a) a decode slot is free, (b) the
-    KV-cache can reserve the request's WORST-CASE pages (prompt +
-    max_new_tokens — no preemption path exists, so a running sequence
-    must never be able to strand the pool), and (c) this step's
-    admitted prompt tokens stay under `prefill_token_budget` (bounds
-    the latency hit decode lanes take while prefills run).
-  * Prefill/decode interleaving: every scheduler step first admits
-    prefills under the budget, then decodes ALL running sequences as
-    one batch. A long queue therefore never starves decode, and fresh
-    capacity never idles waiting for the batch to drain.
-  * Eviction + backfill: the moment a sequence finishes, its slot and
-    pages are freed — the NEXT schedule() call immediately admits from
-    the waiting queue into the vacated capacity. The batch composition
-    changes between steps, not between full batches (the whole point
-    of continuous batching vs. static batching).
+  * Everything is a CHUNK. Each step, every running request gets a
+    chunk of positions [num_computed, end) to compute: a decoding
+    request's chunk is its single next token, a prefilling request's
+    chunk is up to `prefill_token_budget` prompt tokens. Decode chunks
+    never wait on prefill chunks — they ride in the same fixed-shape
+    engine step — so a long prompt never stalls running decodes, and
+    a prompt longer than the budget simply prefills across several
+    steps (no per-bucket programs, no oversized-prompt special case).
+  * FCFS admission under a WATERMARK, not a worst-case reservation:
+    a request is admitted when a slot is free, the prefill budget has
+    room, and the pool can supply its first chunk's pages while
+    keeping `admit_watermark` of the pool reclaimable. Pages for the
+    rest of the sequence are allocated on demand as it grows.
+  * PREFIX CACHING at admission: the prompt's full token blocks are
+    chain-hashed and matched against resident pages (including pages
+    other chunks in this very step will compute — intra-step sharing
+    is sound because the engine scatters all chunk K/V before any lane
+    attends). Matched tokens are marked computed without running.
+  * PREEMPTION instead of reservation: if a step cannot supply a page
+    for a chunk, the youngest running request (highest rid — the one
+    FCFS would have admitted last) is evicted back to the FRONT of the
+    waiting queue and its pages released. Its completed pages stay in
+    the prefix cache, so on re-admission it matches most of its own
+    history and recomputes only the tail — preemption costs one page
+    walk, not a full re-prefill.
+  * Head-of-line blocking is deliberate: when the oldest waiting
+    request doesn't fit, admission stops rather than scanning past it,
+    so no request can be starved by a stream of smaller latecomers.
+    A forced-progress escape admits the head with a shrunken chunk when
+    nothing at all is running (the watermark must not deadlock an
+    empty engine).
 
 The scheduler is pure host-side bookkeeping over the PagedKVCache; the
 engine owns all device work. Splitting it this way keeps the policy
-testable as plain Python (tests/test_serve.py property asserts) and
+testable as plain Python (tests/test_serve*.py property asserts) and
 keeps the jitted steps free of data-dependent shapes.
 """
 
@@ -33,13 +48,26 @@ import enum
 from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence
 
-from .kv_cache import PagedKVCache
+from .kv_cache import PagedKVCache, prefix_page_keys
 
 
 class RequestState(enum.Enum):
     WAITING = "waiting"
-    RUNNING = "running"   # prefilled; holds a decode slot
+    RUNNING = "running"   # holds a decode slot (prefilling or decoding)
     FINISHED = "finished"
+
+
+@dataclasses.dataclass(frozen=True)
+class SampleParams:
+    """Per-request sampling. temperature <= 0 means greedy; top_k
+    restricts sampling to the k highest logits (None = the engine's
+    static top-k cap). The (seed, rid, token-index) triple seeds every
+    draw, so a fixed seed reproduces a stream exactly — including
+    across a preemption, which replays no RNG state."""
+
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    seed: int = 0
 
 
 @dataclasses.dataclass
@@ -51,10 +79,16 @@ class Request:
     prompt: List[int]
     max_new_tokens: int
     eos_token: Optional[int] = None
+    sample: Optional[SampleParams] = None
 
     state: RequestState = RequestState.WAITING
     slot: int = -1
     out_tokens: List[int] = dataclasses.field(default_factory=list)
+    # tokens whose K/V is resident (prefix-cache hits + computed chunks)
+    num_computed: int = 0
+    preemptions: int = 0
+    _page_keys: List[bytes] = dataclasses.field(default_factory=list,
+                                                repr=False)
     # serving metrics (utils/profiling.serve_report): wall-clock stamps
     t_submit: float = 0.0
     t_first_token: float = 0.0
@@ -64,6 +98,14 @@ class Request:
     def total_tokens(self) -> int:
         return len(self.prompt) + self.max_new_tokens
 
+    @property
+    def context(self) -> List[int]:
+        """Every token whose K/V the engine may need: the prompt plus
+        all generated tokens. A freshly-preempted request resumes by
+        re-prefilling THIS (its generated work is not redone, only its
+        K/V), which is why it lives here and not on the engine."""
+        return self.prompt + self.out_tokens
+
     def is_done(self) -> bool:
         if len(self.out_tokens) >= self.max_new_tokens:
             return True
@@ -72,33 +114,79 @@ class Request:
 
 
 @dataclasses.dataclass
-class StepPlan:
-    """What one engine iteration executes: the prompts to prefill now
-    (each lands in its own freshly-bound slot) and the running set to
-    decode one token for."""
+class ChunkPlan:
+    """One request's work in one engine step: compute K/V (and logits)
+    for context positions [start, end). When `end` reaches the full
+    context length the chunk's last lane EMITS the next token — that is
+    both the final prefill chunk of a prompt and every decode step
+    (a decode is just a 1-token chunk that reaches the end)."""
 
-    prefills: List[Request]
-    decodes: List[Request]
+    req: Request
+    start: int
+    end: int
+    is_decode: bool   # an actively-generating request's 1-token chunk
+
+    @property
+    def emits(self) -> bool:
+        return self.end == len(self.req.context)
+
+
+@dataclasses.dataclass
+class StepPlan:
+    """What one engine iteration executes."""
+
+    chunks: List[ChunkPlan]
+    admitted: List[Request]
+    preempted: List[Request]
+
+    @property
+    def prefills(self) -> List[Request]:
+        return [c.req for c in self.chunks if not c.is_decode]
+
+    @property
+    def decodes(self) -> List[Request]:
+        return [c.req for c in self.chunks if c.is_decode]
+
+    @property
+    def num_prefill_lanes(self) -> int:
+        return sum(c.end - c.start for c in self.chunks if not c.is_decode)
+
+    @property
+    def num_decode_lanes(self) -> int:
+        return sum(1 for c in self.chunks if c.is_decode)
 
 
 class ContinuousBatchingScheduler:
     def __init__(self, cache: PagedKVCache,
-                 prefill_token_budget: int = 512):
+                 prefill_token_budget: int = 512,
+                 chunked_prefill: bool = True,
+                 admit_watermark: float = 0.02):
         self.cache = cache
         self.prefill_token_budget = int(prefill_token_budget)
+        self.chunked_prefill = bool(chunked_prefill)
+        # prefix sharing needs chunked prefill: the legacy per-bucket
+        # program recomputes and RE-SCATTERS every prompt position, which
+        # would clobber shared pages other sequences are reading
+        self.prefix_cache = cache.prefix_enabled and self.chunked_prefill
+        self.watermark_pages = int(admit_watermark
+                                   * cache.cfg.usable_pages)
         self.waiting: Deque[Request] = deque()
         self.running: Dict[int, Request] = {}  # slot -> request
         self._next_rid = 0
+        self.stats = {"prefix_hit_tokens": 0, "prompt_tokens": 0,
+                      "prefill_lane_tokens": 0, "decode_lane_tokens": 0,
+                      "preemptions": 0}
 
     # ---------------- submission --------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
-               eos_token: Optional[int] = None) -> Request:
+               eos_token: Optional[int] = None,
+               sample: Optional[SampleParams] = None) -> Request:
         if len(prompt) < 1:
             raise ValueError("empty prompt")
         if int(max_new_tokens) < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1 (got {max_new_tokens}): "
-                f"prefill always emits the first token")
+                f"the final prefill chunk always emits the first token")
         total = len(prompt) + int(max_new_tokens)
         if total > self.cache.cfg.max_seq_len:
             raise ValueError(
@@ -106,46 +194,186 @@ class ContinuousBatchingScheduler:
                 f"{self.cache.cfg.max_seq_len}")
         req = Request(rid=self._next_rid, prompt=list(prompt),
                       max_new_tokens=int(max_new_tokens),
-                      eos_token=eos_token)
+                      eos_token=eos_token, sample=sample)
         self._next_rid += 1
         self.waiting.append(req)
+        self.stats["prompt_tokens"] += len(prompt)
         return req
 
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
 
+    # ---------------- prefix keys -------------------------------------
+    def _keys_for(self, req: Request, npages: int) -> List[bytes]:
+        """The request's chain keys for its first `npages` full pages,
+        extended INCREMENTALLY from the last cached key (hashing is
+        O(pages) per sequence, not O(pages^2) across chunk steps) and
+        kept across preemptions (the context tokens a key commits to
+        never change)."""
+        keys = req._page_keys
+        if len(keys) < npages:
+            keys.extend(prefix_page_keys(
+                req.context, self.cache.cfg.page_size, npages,
+                start=len(keys), prev=keys[-1] if keys else b""))
+        return keys[:npages]
+
     # ---------------- the policy --------------------------------------
     def schedule(self) -> StepPlan:
-        """One step's plan. Admits FCFS under the token budget, then
-        decodes everything running. Head-of-line blocking is
-        deliberate: when the oldest waiting request doesn't fit we stop
-        admitting rather than scan past it, so no request can be
-        starved by a stream of smaller latecomers."""
-        prefills: List[Request] = []
+        """Plan one step. Continues running requests first (decodes are
+        guaranteed lanes; prefill continuations share the budget FCFS),
+        preempting youngest-first on page pressure, then admits from
+        the waiting queue under the budget + watermark."""
+        ps = self.cache.cfg.page_size
+        cache = self.cache
+        chunks: List[ChunkPlan] = []
+        admitted: List[Request] = []
+        preempted: List[Request] = []
         budget = self.prefill_token_budget
-        while self.waiting:
+        # chain key -> physical page for FULL pages some chunk planned
+        # THIS step will compute: later admissions in the same step may
+        # share them (the engine scatters all chunk K/V before any lane
+        # attends, so intra-step sharing observes computed values)
+        pending: Dict[bytes, int] = {}
+
+        def note_pending(req: Request, start: int, end: int) -> None:
+            if not self.prefix_cache:
+                return
+            keys = self._keys_for(req, end // ps)
+            for idx in range(start // ps, end // ps):
+                pending.setdefault(keys[idx],
+                                   int(cache.page_tables[req.slot, idx]))
+
+        # ---- 1. running requests, FCFS (oldest first) ----
+        order = sorted(self.running.values(), key=lambda r: r.rid)
+        i = 0
+        while i < len(order):
+            req = order[i]
+            ctx_len = len(req.context)
+            remaining = ctx_len - req.num_computed
+            assert remaining >= 1, f"request {req.rid} over-computed"
+            is_decode = remaining == 1 and bool(req.out_tokens)
+            want = 1 if is_decode else min(budget, remaining)
+            if want == 0:           # prefill budget spent this step
+                i += 1
+                continue
+            end = req.num_computed + want
+            # shrink to the pages actually available before preempting
+            fit = cache.mapped_tokens(req.slot) + cache.free_pages * ps
+            end = min(end, fit)
+            if end <= req.num_computed:
+                # not even one token's page: evict the youngest running
+                victim = order.pop()   # always at an index >= i
+                self._preempt(victim)
+                preempted.append(victim)
+                continue               # retry req (unless req WAS victim)
+            cache.ensure_capacity(req.slot, end)
+            chunks.append(ChunkPlan(req, req.num_computed, end, is_decode))
+            note_pending(req, req.num_computed, end)
+            if not is_decode:
+                budget -= end - req.num_computed
+            i += 1
+
+        # ---- 2. admissions, FCFS with head-of-line blocking ----
+        while self.waiting and cache.free_slots > 0:
             req = self.waiting[0]
-            # the FIRST admission of a step ignores the budget so a
-            # prompt longer than the whole budget still gets served
-            # (alone in its step) instead of deadlocking the queue
-            if prefills and len(req.prompt) > budget:
+            # forced-progress escape: with nothing running and nothing
+            # planned, the watermark/page checks must not deadlock —
+            # admit the head with however small a chunk fits
+            forced = not chunks and not self.running
+            if budget <= 0:
                 break
-            if not self.cache.can_admit(req.total_tokens):
-                break
+            ctx = req.context
+            ctx_len = len(ctx)
+            cached_pages: List[int] = []
+            if self.prefix_cache:
+                # never match the final token's page: at least one lane
+                # must run to produce the next-token logits, and a
+                # partial tail page is never shared anyway
+                keys = self._keys_for(req, (ctx_len - 1) // ps)
+                cached_pages = cache.match_prefix(keys)
+                k = len(cached_pages)
+                while k < len(keys) and keys[k] in pending:
+                    cached_pages.append(pending[keys[k]])
+                    k += 1
+            cached_len = len(cached_pages) * ps
+            end = min(ctx_len, cached_len + budget)
+            if not self.chunked_prefill:
+                # legacy whole-prompt prefill: one bucket program per
+                # request; the first admission of a step ignores the
+                # budget so an over-budget prompt still gets served
+                if end < ctx_len and any(not c.is_decode for c in chunks):
+                    break
+                end = ctx_len
+            # matched pages sitting at refcount 0 come OUT of the
+            # reclaimable count the moment we attach them
+            lru_cached = sum(1 for p in cached_pages if cache.ref(p) == 0)
+            need = cache.pages_for(end) - len(cached_pages)
+            if forced:
+                avail = (cache.free_pages - lru_cached) * ps
+                if self.chunked_prefill:
+                    end = min(end, cached_len + avail)
+                if end <= cached_len or cached_len + avail < end:
+                    raise RuntimeError(
+                        "page pool too small for the oldest waiting "
+                        "request's first chunk")
+            elif need + lru_cached + self.watermark_pages > cache.free_pages:
+                break   # head-of-line: nothing admits past the head
             self.waiting.popleft()
-            req.slot = self.cache.alloc_slot(len(req.prompt),
-                                             req.total_tokens)
+            slot = cache.alloc_slot()
+            req.slot = slot
             req.state = RequestState.RUNNING
-            self.running[req.slot] = req
-            budget -= len(req.prompt)
-            prefills.append(req)
-        decodes = [self.running[s] for s in sorted(self.running)
-                   if self.running[s] not in prefills]
-        return StepPlan(prefills=prefills, decodes=decodes)
+            if cached_pages:
+                cache.attach_prefix(slot, cached_pages, cached_len)
+                self.stats["prefix_hit_tokens"] += cached_len
+            req.num_computed = cached_len
+            cache.ensure_capacity(slot, end)
+            self.running[slot] = req
+            chunks.append(ChunkPlan(req, cached_len, end, False))
+            note_pending(req, cached_len, end)
+            admitted.append(req)
+            budget -= end - cached_len
+
+        plan = StepPlan(chunks=chunks, admitted=admitted,
+                        preempted=preempted)
+        self.stats["prefill_lane_tokens"] += plan.num_prefill_lanes
+        self.stats["decode_lane_tokens"] += plan.num_decode_lanes
+        return plan
+
+    def _preempt(self, victim: Request) -> None:
+        """Evict a running request back to the FRONT of the waiting
+        queue (it is the youngest running, so rid order — FCFS priority
+        — is preserved). Its pages are released; the content-hashed
+        ones stay matchable, so re-admission restores most of its
+        history from the prefix cache instead of recomputing it."""
+        del self.running[victim.slot]
+        self.cache.free_slot(victim.slot)
+        victim.slot = -1
+        victim.state = RequestState.WAITING
+        victim.num_computed = 0
+        victim.preemptions += 1
+        self.stats["preemptions"] += 1
+        self.waiting.appendleft(victim)
+
+    def complete_chunk(self, chunk: ChunkPlan) -> None:
+        """Bookkeeping after the engine computed a chunk: the tokens
+        are now resident, and every page the chunk COMPLETED is
+        registered in the prefix cache (full pages only — the tail is
+        still being written). The engine emits the chunk's token (if
+        `chunk.emits`) after this call."""
+        req = chunk.req
+        self.cache.advance(req.slot, chunk.end)
+        req.num_computed = chunk.end
+        if self.prefix_cache:
+            ps = self.cache.cfg.page_size
+            keys = self._keys_for(req, chunk.end // ps)
+            for idx in range(chunk.start // ps, chunk.end // ps):
+                self.cache.commit_page(req.slot, idx, keys[idx])
 
     def finish(self, req: Request) -> None:
-        """Evict a finished sequence: free its slot's pages back to the
-        pool so the next schedule() backfills from the waiting queue."""
+        """Evict a finished sequence: its slot's pages drop a refcount —
+        unshared, unhashed ones return to the pool; hashed ones park in
+        the prefix cache's LRU — so the next schedule() backfills from
+        the waiting queue."""
         assert req.state == RequestState.RUNNING, req.state
         req.state = RequestState.FINISHED
         del self.running[req.slot]

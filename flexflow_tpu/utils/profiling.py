@@ -99,10 +99,34 @@ def serve_report(stats: dict) -> str:
         lines.append(
             f"per-token decode latency: p50={pct[50]*1e3:.3f} ms "
             f"p99={pct[99]*1e3:.3f} ms")
+    # prefix cache / chunked prefill / preemption instrumentation
+    # (absent from pre-v2 stats dicts — every line is key-guarded)
+    pt = stats.get("prompt_tokens_total")
+    if pt is not None:
+        comp = stats.get("prefill_tokens_computed", 0)
+        hit = stats.get("prefix_hit_tokens", 0)
+        red = pt / comp if comp else float("inf")
+        lines.append(
+            f"prefill: computed {comp} of {pt} prompt tokens "
+            f"({hit} prefix-cache hits, {red:.2f}x reduction)")
+    if "preemptions" in stats or "page_util_mean" in stats:
+        lines.append(
+            f"pages: utilization mean={stats.get('page_util_mean', 0.0):.1%}"
+            f" max={stats.get('page_util_max', 0.0):.1%}, "
+            f"{stats.get('preemptions', 0)} preemptions")
+    cache = stats.get("cache")
+    if cache:
+        lines.append(
+            f"prefix cache (engine lifetime): "
+            f"{cache.get('prefix_hit_pages', 0)} page hits / "
+            f"{cache.get('pages_committed', 0)} committed, "
+            f"{cache.get('shared_attaches', 0)} shared attaches "
+            f"(max refs {cache.get('max_page_refs', 0)}), "
+            f"{cache.get('prefix_evictions', 0)} evictions")
     cc = stats.get("compile_counts")
     if cc:
-        lines.append(f"compiled programs: prefill={cc.get('prefill')} "
-                     f"decode={cc.get('decode')}")
+        progs = " ".join(f"{k}={v}" for k, v in cc.items() if v)
+        lines.append(f"compiled programs: {progs or 'none'}")
     return "\n".join(lines)
 
 

@@ -114,46 +114,75 @@ def test_kv_cache_alloc_free_cycle():
                         max_seq_len=16)
     cache = PagedKVCache(cfg)
     assert cache.free_pages == 8
-    s0 = cache.alloc_slot(prompt_len=5, reserve_tokens=7)  # 2 pages
-    s1 = cache.alloc_slot(prompt_len=3, reserve_tokens=12)  # 3 pages
+    s0 = cache.alloc_slot()
+    cache.ensure_capacity(s0, 5)       # 2 pages, on demand
+    cache.advance(s0, 5)
+    s1 = cache.alloc_slot()
+    cache.ensure_capacity(s1, 3)       # 1 page — no worst-case reserve
+    cache.advance(s1, 3)
     cache.check_invariants()
-    assert cache.free_pages == 3
-    assert not cache.can_admit(16)  # would need 4 pages / no slot
-    # append across a page boundary uses the reserved page
+    assert cache.free_pages == 5
+    assert cache.free_slots == 0
+    # append across a page boundary allocates exactly when crossed
     assert cache.append_token(s0) == 5
     assert cache.append_token(s0) == 6
+    assert cache.append_token(s0) == 7
+    assert cache.free_pages == 5       # page 2 still has room
+    assert cache.append_token(s0) == 8  # crosses into a third page
+    assert cache.free_pages == 4
     cache.check_invariants()
     cache.free_slot(s0)
     cache.check_invariants()
-    assert cache.free_pages == 5
+    assert cache.free_pages == 7
     cache.free_slot(s1)
     assert cache.free_pages == 8
     assert cache.free_slots == 2
 
 
-def test_kv_cache_rejects_overflow():
+def test_kv_cache_exhaustion_recovers():
     cfg = KVCacheConfig(num_layers=1, num_heads=2, head_dim=4,
                         page_size=4, num_pages=5, max_seqs=2,
                         max_seq_len=16)
     cache = PagedKVCache(cfg)
-    s = cache.alloc_slot(prompt_len=4, reserve_tokens=4)  # exactly 1 page
-    with pytest.raises(RuntimeError):  # past the reserved page
-        cache.append_token(s)
-    with pytest.raises(RuntimeError):  # admission not checked
-        cache.alloc_slot(prompt_len=1, reserve_tokens=999)
+    s0 = cache.alloc_slot()
+    cache.ensure_capacity(s0, 16)      # the whole pool (4 pages)
+    cache.advance(s0, 16)
+    s1 = cache.alloc_slot()
+    with pytest.raises(RuntimeError):  # pool dry: scheduler must preempt
+        cache.ensure_capacity(s1, 1)
+    with pytest.raises(ValueError):    # past the page-table ceiling
+        cache.ensure_capacity(s0, 17)
+    cache.free_slot(s0)                # frees admit again
+    cache.check_invariants()
+    assert cache.ensure_capacity(s1, 4) == 1
+    cache.free_slot(s1)
+    assert cache.free_pages == cfg.usable_pages
 
 
 # --------------------------------------------------------- scheduler
+def _drive_step(sched, cache, plan):
+    """What the engine does with a plan, minus the device work:
+    bookkeeping first (complete_chunk), then emissions."""
+    for ch in plan.chunks:
+        sched.complete_chunk(ch)
+    for ch in plan.chunks:
+        if ch.emits:
+            ch.req.out_tokens.append(0)
+            if ch.req.is_done():
+                sched.finish(ch.req)
+
+
 def test_scheduler_invariants_random_workload():
     """Drive the scheduler host-side (no device work): FCFS admission
-    under the token budget, eviction + backfill, and page accounting
-    hold for every step of a randomized ragged workload."""
+    under the token budget, chunked prefill progress, eviction +
+    backfill, and page accounting hold for every step of a randomized
+    ragged workload."""
     rng = np.random.RandomState(7)
     cfg = KVCacheConfig(num_layers=1, num_heads=2, head_dim=4,
                         page_size=4, num_pages=33, max_seqs=3,
                         max_seq_len=32)
     cache = PagedKVCache(cfg)
-    budget = 24
+    budget = 12
     sched = ContinuousBatchingScheduler(cache, prefill_token_budget=budget)
     reqs = [sched.submit(list(rng.randint(0, 50, size=rng.randint(1, 20))),
                          int(rng.randint(1, 12)))
@@ -162,29 +191,23 @@ def test_scheduler_invariants_random_workload():
     steps = 0
     while sched.has_work():
         steps += 1
-        assert steps < 1000, "scheduler wedged"
+        assert steps < 2000, "scheduler wedged"
         plan = sched.schedule()
-        # token budget: admitted prompt tokens <= budget, except the
-        # single-oversized-prompt escape (then it's admitted alone)
-        ptoks = sum(len(r.prompt) for r in plan.prefills)
-        if ptoks > budget:
-            assert len(plan.prefills) == 1
-        admitted_order += [r.rid for r in plan.prefills]
-        for r in plan.prefills:  # "prefill": emit the first token
-            r.out_tokens.append(0)
-            if r.is_done():
-                sched.finish(r)
-        for r in plan.decodes:   # "decode": one token each
-            cache.append_token(r.slot)
-            r.out_tokens.append(0)
-            if r.is_done():
-                sched.finish(r)
+        assert plan.chunks, "a step with work must plan chunks"
+        # chunked prefill: prefill lanes never exceed the budget, and
+        # decode lanes (one per running sequence) never wait on them
+        assert plan.num_prefill_lanes <= budget
+        assert plan.num_decode_lanes <= cfg.max_seqs
+        admitted_order += [r.rid for r in plan.admitted]
+        _drive_step(sched, cache, plan)
         cache.check_invariants()
     # queue drained, every request ran to completion, FCFS order held
+    # (this pool never fills, so no preemption re-admissions)
     assert not sched.waiting and not sched.running
+    assert sched.stats["preemptions"] == 0
     assert admitted_order == sorted(admitted_order)
     assert all(len(r.out_tokens) == r.max_new_tokens for r in reqs)
-    # eviction returned every page
+    # eviction returned every page (hashed ones park reclaimable)
     assert cache.free_pages == cfg.usable_pages
     assert cache.free_slots == cfg.max_seqs
 
@@ -252,4 +275,5 @@ def test_serve_report_renders(lm_engine):
     lm_engine.generate([[1, 2, 3], [4]], 4)
     rep = serve_report(lm_engine.last_stats)
     assert "tok/s" in rep and "p99" in rep
-    assert "prefill=3" in rep  # 3 buckets compiled, ever
+    assert "mixed=1" in rep  # ONE serving program compiled, ever
+    assert "prefix" in rep and "preemptions" in rep

@@ -1,7 +1,8 @@
-"""Paged decode attention, COMPILED on-chip (the CPU suite only ever
-runs the jnp fallback and the interpret-mode kernel; Mosaic-compiled
-behavior is proven here), plus an end-to-end ServeEngine generate with
-the Pallas decode path against the CPU-identical jnp fallback tokens.
+"""Paged decode + ragged attention, COMPILED on-chip (the CPU suite
+only ever runs the jnp fallback and the interpret-mode kernels;
+Mosaic-compiled behavior is proven here), plus an end-to-end
+ServeEngine generate with the Pallas serving path against the
+CPU-identical jnp fallback tokens.
 """
 
 import numpy as np
@@ -13,6 +14,7 @@ import jax.numpy as jnp
 from flexflow_tpu.kernels.flash_attention import (
     _paged_decode_jnp,
     paged_attention_decode,
+    paged_attention_ragged,
 )
 
 
@@ -38,6 +40,30 @@ def test_paged_decode_mosaic_matches_jnp(batch):
     ref = _paged_decode_jnp(q, kp, vp, table, lens, scale=q.shape[-1] ** -0.5)
     out = jax.jit(lambda *a: paged_attention_decode(
         *a, use_pallas=True))(q, kp, vp, table, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("batch", [1, 4])
+def test_paged_ragged_mosaic_matches_jnp(batch):
+    """The mixed-step kernel (chunked prefill): several lanes per
+    sequence at ragged positions, slot indirection in SMEM."""
+    rng = np.random.RandomState(77 + batch)
+    q1, kp, vp, table, lens = _ragged(batch, 7 + batch)
+    h, d = q1.shape[1], q1.shape[2]
+    slots, poss = [], []
+    for s, L in enumerate(np.asarray(lens)):
+        for p in sorted({int(L) - 1,
+                         *(int(x) for x in rng.randint(0, int(L), 3))}):
+            slots.append(s)
+            poss.append(p)
+    slots = jnp.asarray(np.asarray(slots, np.int32))
+    lane_lens = jnp.asarray(np.asarray(poss, np.int32) + 1)
+    q = jnp.asarray(rng.randn(len(poss), h, d).astype(np.float32))
+    ref = paged_attention_ragged(q, kp, vp, table, slots, lane_lens,
+                                 use_pallas=False)
+    out = jax.jit(lambda *a: paged_attention_ragged(
+        *a, use_pallas=True))(q, kp, vp, table, slots, lane_lens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
 

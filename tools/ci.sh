@@ -38,6 +38,13 @@ echo "--- 1c. search-bench smoke (delta-sim speedup + equivalence gate)"
 # delta/full makespans diverge (tools/search_bench.py --smoke)
 env JAX_PLATFORMS=cpu python tools/search_bench.py --smoke || fail=1
 
+echo "--- 1d. serve-bench smoke (zero recompiles + prefix-cache gate)"
+# fails if serving compiles anything after warmup, if prefix-cached
+# outputs diverge from generate_reference, or if the shared-prefix
+# workload's prefill-token reduction is < 2x (tools/serve_bench.py)
+env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke \
+    -o /tmp/ci_bench_serve.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \
