@@ -74,7 +74,16 @@ class FFConfig:
     weight_decay: float = 0.0001
     seed: int = 0
 
-    # numerics
+    # numerics — the mixed-precision policy (core/precision.py):
+    # `param_dtype` is the MASTER storage dtype of float parameters and
+    # optimizer state (f32 by default — the loss-scaling-free bf16
+    # recipe keeps f32 masters); `compute_dtype` is the dtype
+    # params/activations are cast to INSIDE the jitted step (bf16 runs
+    # the MXU at ~2x f32 rate and halves HBM/ICI bytes). Softmax/LSE,
+    # losses, metrics, BN/LN statistics and reduction accumulators stay
+    # f32 regardless (preferred_element_type — the flash-attention
+    # convention). The strategy-search cost stack prices both dtypes
+    # (search/machine_model.py, search/cost_model.py).
     compute_dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -278,6 +287,13 @@ class FFConfig:
         """Reject silently-ignorable values (conv_layout falls back to
         NCHW on any non-"NHWC" string, which would be an undetectable
         perf misconfiguration). Called from __post_init__ and compile."""
+        # normalize the precision policy to jnp dtypes (CLI hands us
+        # strings like "bfloat16"); reject non-float dtypes loudly — an
+        # int compute_dtype would silently break every cast site
+        from .core.precision import resolve_dtype
+        self.compute_dtype = resolve_dtype(self.compute_dtype,
+                                           "compute_dtype")
+        self.param_dtype = resolve_dtype(self.param_dtype, "param_dtype")
         if self.conv_layout not in ("NCHW", "NHWC"):
             raise ValueError(
                 f"conv_layout must be 'NCHW' or 'NHWC', got "
@@ -357,6 +373,8 @@ class FFConfig:
         "--machine-model-file": ("machine_model_file", str),
         "--taskgraph": ("taskgraph_file", str),
         "--seed": ("seed", int),
+        "--compute-dtype": ("compute_dtype", str),
+        "--param-dtype": ("param_dtype", str),
         "--conv-layout": ("conv_layout", str),
         "--measure-ops": ("measure_top_ops", int),
         "--moe-dispatch": ("moe_dispatch", str),

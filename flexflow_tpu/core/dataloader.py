@@ -30,6 +30,15 @@ def host_to_device(host, mesh, dtype=None) -> jax.Array:
     if mesh is not None and jax.process_count() > 1:
         h = np.asarray(host, dtype=dtype)
         return place_process_local(h, batch_sharding(mesh, h.ndim))
+    if mesh is not None and not isinstance(host, jax.Array):
+        # single-host sharded path: cast on HOST and device_put once,
+        # straight to the sharding — `jnp.asarray` first would
+        # materialize the batch on the default device and then copy it
+        # a second time into the sharded layout (double transfer +
+        # double buffering, every step)
+        h = np.asarray(host) if dtype is None \
+            else np.asarray(host, dtype=jnp.dtype(dtype))
+        return jax.device_put(h, batch_sharding(mesh, h.ndim))
     arr = jnp.asarray(host, dtype=dtype)
     if mesh is not None:
         arr = jax.device_put(arr, batch_sharding(mesh, arr.ndim))

@@ -28,6 +28,7 @@ from ..tensor import Tensor
 from . import initializers as I
 from . import losses as L
 from . import metrics as M
+from . import precision as MP
 from .optimizers import Optimizer
 from ..parallel.pconfig import Strategy
 from ..parallel.sharding import (
@@ -103,6 +104,16 @@ class Executor:
         self.metric_names = list(metric_names or [])
         self.mesh = mesh
         self.strategy = strategy or Strategy()
+        # mixed-precision policy (core/precision.py): float params and
+        # optimizer state live in param_dtype (f32 masters by default);
+        # when compute_dtype != f32 the step casts params + float
+        # activations down on the way in (forward_values) and computes
+        # the loss/metrics on f32-upcast logits. compute_dtype == f32
+        # is the no-cast fast path — builder-level bf16 models
+        # (dtype=jnp.bfloat16 activations) keep their exact numerics.
+        self.compute_dtype = jnp.dtype(self.config.compute_dtype)
+        self.param_dtype = jnp.dtype(self.config.param_dtype)
+        self._mp_active = MP.policy_active(self.config)
         self._train_step = None
         self._train_step_multi = None
         self._train_step_accum = None
@@ -221,6 +232,12 @@ class Executor:
                                       fan_out=spec.fan_out)
                     else:
                         arr = init_fn(key, spec.shape, spec.dtype)
+                    # master storage dtype: f32-declared float weights
+                    # store at param_dtype; an EXPLICIT non-f32 spec
+                    # dtype (a builder's bf16 table) wins over the knob
+                    if (self.param_dtype != jnp.float32
+                            and jnp.dtype(spec.dtype) == jnp.float32):
+                        arr = arr.astype(self.param_dtype)
                     if self.mesh is not None:
                         sh = weight_sharding(
                             spec,
@@ -325,11 +342,22 @@ class Executor:
                       training: bool, rng, seq_length: int = -1):
         """Topological walk of the graph; returns (tensor-values map,
         new_states)."""
+        # mixed precision: master params (param_dtype) and float inputs
+        # cast to compute_dtype HERE, inside whatever function is being
+        # differentiated — the cast's transpose upcasts cotangents, so
+        # gradients leave the bf16 region in the master dtype. Labels
+        # are not inputs and never pass through this cast.
+        if self._mp_active:
+            params = MP.cast_floats(params, self.compute_dtype)
         values: Dict[int, jax.Array] = {}
         for t in self.model.input_tensors:
             if t.name not in inputs:
                 raise KeyError(f"missing input {t.name!r}; have {list(inputs)}")
-            values[t.uid] = inputs[t.name]
+            v = inputs[t.name]
+            if self._mp_active and MP.is_float_array(v) \
+                    and v.dtype != self.compute_dtype:
+                v = v.astype(self.compute_dtype)
+            values[t.uid] = v
         new_states: Dict[str, Dict[str, jax.Array]] = {}
         aux_losses = []
         # pre-sliced outputs of merged sibling convs, keyed by the
@@ -407,6 +435,17 @@ class Executor:
                     for t, s in zip(op.outputs, shardings)]
                 ys = [jax.lax.with_sharding_constraint(y, s)
                       for y, s in zip(ys, shardings)]
+            if self._mp_active:
+                # keep the VALUE stream at compute_dtype: ops that pin
+                # their output dtype (Embedding's out_dtype defaults
+                # f32) would otherwise silently upcast everything
+                # downstream of them back to f32. State/aux outputs
+                # (BN statistics, MoE aux loss) are NOT values and
+                # stay f32.
+                ys = [y.astype(self.compute_dtype)
+                      if MP.is_float_array(y)
+                      and y.dtype != self.compute_dtype else y
+                      for y in ys]
             for t, y in zip(op.outputs, ys):
                 values[t.uid] = y
             if ctx.state_out:
@@ -430,6 +469,11 @@ class Executor:
         values, new_states = self.forward_values(
             params, states, batch, training, rng, seq_length)
         logits = values[self.model.final_tensor.uid]
+        if self._mp_active and MP.is_float_array(logits):
+            # losses and metrics score f32-upcast logits — the one
+            # policy-exempt region (precision.py): a bf16 NLL would
+            # round away exactly the signal the parity gate measures
+            logits = logits.astype(jnp.float32)
         loss = jnp.asarray(0.0, jnp.float32)
         if self.loss_fn is not None and "label" in batch:
             loss = self.loss_fn(logits, batch["label"])
@@ -884,8 +928,18 @@ class Executor:
     def declared_input_dtypes(self) -> Dict[str, Any]:
         """Target device dtype per input name — THE dtype-resolution rule
         for batches (shard_batch, shard_batch_stacked, and fit()'s
-        prefetch loader all share it so every path casts identically)."""
-        return {t.name: t.dtype for t in self.model.input_tensors}
+        prefetch loader all share it so every path casts identically).
+        Under an active compute_dtype policy float inputs declare the
+        COMPUTE dtype, so the dataloader casts in the host->device
+        transfer (half the transfer bytes) and the in-step cast is a
+        no-op."""
+        out: Dict[str, Any] = {}
+        for t in self.model.input_tensors:
+            dt = t.dtype
+            if self._mp_active and jnp.issubdtype(dt, jnp.floating):
+                dt = self.compute_dtype
+            out[t.name] = dt
+        return out
 
     def shard_batch(self, batch: Dict[str, np.ndarray]):
         """Place a host batch on device(s), sharded over the data axis —
@@ -922,7 +976,17 @@ class Executor:
                     host, batch_sharding(self.mesh, host.ndim))
                 continue
             # single-pass conversion: asarray+astype would materialize
-            # the batch twice on device per step
+            # the batch twice on device per step; likewise a host batch
+            # bound for a mesh is cast on HOST and device_put ONCE
+            # straight to the sharding (jnp.asarray first would land it
+            # on the default device and copy it again — the
+            # host_to_device double-materialization, core/dataloader.py)
+            if self.mesh is not None and not isinstance(v, jax.Array):
+                host = np.asarray(v) if want is None \
+                    else np.asarray(v, dtype=jnp.dtype(want))
+                out[k] = jax.device_put(
+                    host, batch_sharding(self.mesh, host.ndim))
+                continue
             arr = jnp.asarray(v, dtype=want) if want is not None \
                 else jnp.asarray(v)
             if self.mesh is not None:

@@ -1,0 +1,71 @@
+"""Mixed-precision policy helpers.
+
+The policy (FFConfig.compute_dtype / param_dtype) is the loss-scaling-
+free bf16 recipe TPUs are built for: float parameters and optimizer
+state live in `param_dtype` (f32 master weights by default), and the
+jitted step casts params + float activations to `compute_dtype` on the
+way in — bf16 matmuls ride the MXU at ~2x the f32 rate while halving
+HBM and collective bytes. Gradients flow back through the cast (the
+cast's transpose upcasts cotangents), so the optimizer applies f32
+updates to f32 masters and bf16's ~8-bit mantissa never accumulates
+into the weights. What stays f32 inside the step: softmax/logsumexp,
+losses, metrics, BN/LN statistics, and matmul accumulators
+(`preferred_element_type` — the flash-attention convention; bf16 needs
+no loss scaling because its exponent range equals f32's).
+
+No reference analog: FlexFlow trains f32 end-to-end (DATA_TYPE floats,
+include/config.h). This module is deliberately tiny and dependency-free
+(config.py imports it during validation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# dtypes accepted as a step compute/param dtype. f64 excluded: jax
+# demotes it without jax_enable_x64 and the cost model has no peak for
+# it; f16 included for GPU-backend experiments (bf16 is the TPU dtype).
+_FLOAT_DTYPES = ("float32", "bfloat16", "float16")
+
+
+def resolve_dtype(value, knob: str = "dtype"):
+    """Normalize a user-supplied dtype (string, np/jnp dtype, or type)
+    to a jnp.dtype, rejecting anything outside the float policy set."""
+    try:
+        dt = jnp.dtype(value)
+    except TypeError as e:
+        raise ValueError(f"{knob}: unparseable dtype {value!r}") from e
+    if dt.name not in _FLOAT_DTYPES:
+        raise ValueError(
+            f"{knob} must be one of {_FLOAT_DTYPES}, got {dt.name!r}")
+    return dt
+
+
+def policy_active(config) -> bool:
+    """True when the step must cast (compute_dtype != f32). The f32
+    default is the no-op fast path: models that opt into bf16 via
+    builder `dtype=` arguments (activation-dtype mixed precision) keep
+    their exact pre-policy numerics."""
+    return jnp.dtype(getattr(config, "compute_dtype", jnp.float32)) \
+        != jnp.float32
+
+
+def is_float_array(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def cast_floats(tree, dtype):
+    """Cast every floating leaf of a pytree to `dtype` (non-float
+    leaves — int indices, bool masks — pass through untouched). Inside
+    a differentiated function the cast is autodiff-transparent: its
+    transpose casts cotangents back up, which is exactly how bf16
+    gradients land in the f32 master update."""
+    dtype = jnp.dtype(dtype)
+
+    def cast(x):
+        if is_float_array(x) and x.dtype != dtype:
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)

@@ -20,7 +20,7 @@ def build_transformer_lm(config: Optional[FFConfig] = None,
                          vocab_size: int = 256, max_seq_len: int = 128,
                          batch_size: int = None, hidden: int = 256,
                          num_heads: int = 4, num_layers: int = 2,
-                         ff_dim: int = 512, dtype=jnp.float32,
+                         ff_dim: int = 512, dtype=None,
                          mesh=None, strategy=None,
                          layer_norm: bool = True) -> FFModel:
     """Causal decoder LM — the serving counterpart of the encoder
@@ -34,6 +34,12 @@ def build_transformer_lm(config: Optional[FFConfig] = None,
     ordinary executor; serving bypasses the graph for the cached decode
     path but the parameters are the same arrays)."""
     cfg = config or FFConfig()
+    if dtype is None:
+        # the serving activation dtype follows the config's precision
+        # policy: a bf16 compute_dtype serves bf16 activations (the
+        # ServeEngine mirrors whatever tok_embed emits, so the greedy
+        # exactness oracle holds at the engine's own precision)
+        dtype = jnp.dtype(cfg.compute_dtype)
     bs = batch_size or cfg.batch_size
     ff = FFModel(cfg, mesh=mesh, strategy=strategy)
     tokens = ff.create_tensor((bs, max_seq_len), dtype=jnp.int32,

@@ -125,6 +125,18 @@ class Reduce(Op):
 
     def forward(self, params, xs, ctx: OpContext):
         (x,) = xs
+        from ..core.precision import policy_active
+        if self.mode in ("mean", "sum") and x.dtype != jnp.float32 \
+                and jnp.issubdtype(x.dtype, jnp.floating) \
+                and policy_active(self.model.config):
+            # f32 reduction accumulator (mixed-precision policy): a
+            # long bf16 sum drifts by O(n * eps); max needs no
+            # accumulator. Output returns to the activation dtype.
+            # Policy-gated like Softmax above — builder-level bf16
+            # under the f32 default keeps exact pre-policy numerics.
+            return [self._FNS[self.mode](
+                x, axis=self.axis, keepdims=self.keepdims,
+                dtype=jnp.float32).astype(x.dtype)]
         return [self._FNS[self.mode](x, axis=self.axis,
                                      keepdims=self.keepdims)]
 
@@ -213,6 +225,19 @@ class Softmax(PassthroughAxesMixin, Op):
 
     def forward(self, params, xs, ctx: OpContext):
         (x,) = xs
+        from ..core.precision import policy_active
+        if x.dtype != jnp.float32 \
+                and jnp.issubdtype(x.dtype, jnp.floating) \
+                and policy_active(self.model.config):
+            # max/exp/sum statistics in f32 (the mixed-precision policy
+            # and the flash-attention convention): a bf16 sum over the
+            # class dim loses exactly the normalization the loss reads.
+            # Output returns to the activation dtype. Gated on the
+            # POLICY, not the input dtype alone: builder-level bf16
+            # models under the f32 default keep their exact pre-policy
+            # numerics (the compatibility promise in core/precision.py).
+            return [jax.nn.softmax(x.astype(jnp.float32),
+                                   axis=self.axis).astype(x.dtype)]
         return [jax.nn.softmax(x, axis=self.axis)]
 
     def flops(self) -> float:

@@ -444,10 +444,20 @@ def write_op_weights(spec: PackSpec, packed, op_name: str,
 # wire (inter-stage hop buffer)
 # --------------------------------------------------------------------------
 
-def _wire_layouts(plan: StagePlan):
+def _wire_layouts(plan: StagePlan, model=None):
     """Per-cut flat layout and per-dtype max hop width. The wire is one
     {dtype: (W,)} buffer: every device sends/receives the same shapes
-    (SPMD), each interprets its own cut's layout."""
+    (SPMD), each interprets its own cut's layout.
+
+    Under an active compute_dtype policy (core/precision.py) FLOAT cut
+    tensors ride the wire at the compute dtype: stage activations are
+    already compute-dtype inside the stage, and an f32 wire would both
+    double the hop bytes and silently upcast the downstream stage's
+    whole compute (ops follow their input dtype)."""
+    from ..core import precision as MP
+    wire_dt = None
+    if model is not None and MP.policy_active(model.config):
+        wire_dt = np.dtype(model.config.compute_dtype).name
     layouts = []
     widths: Dict[str, int] = {}
     for cut in plan.cuts:
@@ -455,6 +465,9 @@ def _wire_layouts(plan: StagePlan):
         offsets: Dict[str, int] = {}
         for t in cut:
             dt = np.dtype(t.dtype).name
+            if wire_dt is not None and jnp.issubdtype(jnp.dtype(dt),
+                                                      jnp.floating):
+                dt = wire_dt
             size = int(np.prod(t.shape[1:]))  # per-sample; dim0 = batch
             off = offsets.get(dt, 0)
             lay.append((t.uid, dt, off, size, tuple(t.shape[1:])))
@@ -489,6 +502,16 @@ def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
     S = plan.num_stages
     final_t = model.final_tensor
     name_of_input = {t.name: t.uid for t in model.input_tensors}
+    # mixed-precision policy: stage weights unpack from their (f32)
+    # master rows and are cast to compute_dtype per tick, INSIDE the
+    # (possibly vjp'd) stage body — cotangents upcast at the cast, so
+    # 1F1B's explicit per-stage gradients and GPipe's autodiff
+    # transpose both accumulate into f32 packed rows. Float microbatch
+    # inputs cast the same way; the wire already carries compute-dtype
+    # activations (_wire_layouts).
+    from ..core import precision as MP
+    mp_dtype = (jnp.dtype(model.config.compute_dtype)
+                if MP.policy_active(model.config) else None)
 
     def run_stage(s: int, row: Dict[str, jax.Array],
                   wire_in: Dict[str, jax.Array],
@@ -511,6 +534,9 @@ def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
                     state_row: Dict[str, jax.Array]):
         values: Dict[int, jax.Array] = {}
         for name, v in mb_in.items():
+            if mp_dtype is not None and MP.is_float_array(v) \
+                    and v.dtype != mp_dtype:
+                v = v.astype(mp_dtype)
             values[name_of_input[name]] = v
         if s > 0:
             for uid, dt, off, size, shape in layouts[s - 1]:
@@ -518,6 +544,8 @@ def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
                     wire_in[dt], off * mb_local, size * mb_local)
                 values[uid] = flat.reshape((mb_local,) + shape)
         params_s = unpack_stage(pack, row, s)
+        if mp_dtype is not None:
+            params_s = MP.cast_floats(params_s, mp_dtype)
         states_s = (unpack_stage(state_pack, state_row, s)
                     if state_pack is not None else {})
         state_updates: Dict[str, Dict[str, jax.Array]] = {}
@@ -532,6 +560,13 @@ def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
                 mesh=None, op_strategy=None)
             xs = [values[t.uid] for t in op.inputs]
             ys = op.forward(params_s.get(op.name, {}), xs, ctx)
+            if mp_dtype is not None:
+                # value stream stays compute-dtype (dtype-pinning ops
+                # like Embedding would upcast the rest of the stage —
+                # mirror of the base executor's walk)
+                ys = [y.astype(mp_dtype)
+                      if MP.is_float_array(y) and y.dtype != mp_dtype
+                      else y for y in ys]
             for t, y in zip(op.outputs, ys):
                 values[t.uid] = y
             if ctx.aux_loss is not None:
@@ -551,7 +586,10 @@ def _make_stage_runner(plan: StagePlan, pack: PackSpec, model, layouts,
                     values[uid].reshape(-1).astype(wire_out[dt].dtype),
                     off * mb_local, axis=0)
         if s == S - 1:
-            final = values[final_t.uid]
+            # declared dtype, not the compute dtype: every lax.switch
+            # branch must return identical types, and the non-final
+            # stages emit final_t.dtype zeros
+            final = values[final_t.uid].astype(final_t.dtype)
         else:
             final = jnp.zeros((mb_local,) + tuple(final_t.shape[1:]),
                               dtype=final_t.dtype)
@@ -606,7 +644,7 @@ def pipeline_logits(plan: StagePlan, pack: PackSpec, packed,
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
     mb = B // M
-    layouts, widths = _wire_layouts(plan)
+    layouts, widths = _wire_layouts(plan, model)
 
     # (B, ...) -> (M, mb, ...)
     inputs_mb = {k: v.reshape((M, mb) + v.shape[1:])
@@ -930,9 +968,11 @@ def pipeline_1f1b_grads(plan: StagePlan, pack: PackSpec, packed,
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
     mb = B // M
-    layouts, widths = _wire_layouts(plan)
+    layouts, widths = _wire_layouts(plan, model)
     for dt in widths:
-        if not np.issubdtype(np.dtype(dt), np.floating):
+        # jnp.issubdtype, not np: ml_dtypes' bfloat16 is floating but
+        # plain numpy's issubdtype does not know its hierarchy
+        if not jnp.issubdtype(jnp.dtype(dt), jnp.floating):
             raise NotImplementedError(
                 f"1F1B: non-float tensor (dtype {dt}) crosses a stage "
                 f"boundary; cotangent wires need float dtypes — use "
@@ -1228,7 +1268,7 @@ def pipeline_logits_interleaved(plan: StagePlan, pack: PackSpec, packed,
     if B % M != 0:
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
     mb = B // M
-    layouts, widths = _wire_layouts(plan)
+    layouts, widths = _wire_layouts(plan, model)
 
     inputs_mb = {k: v_.reshape((M, mb) + v_.shape[1:])
                  for k, v_ in inputs.items()}

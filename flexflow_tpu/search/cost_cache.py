@@ -58,11 +58,18 @@ def _pricing_source_hash() -> str:
     return _PRICING_SRC_HASH
 
 
-def machine_fingerprint(mm, mesh=None) -> str:
+def machine_fingerprint(mm, mesh=None, precision=None) -> str:
     """Stable short hash of everything the cost formulas read from the
     machine model + mesh (plus the pricing code itself). Shared by the
     cost cache, sim_validation and perf_report so committed numbers are
-    attributable to one machine state without re-measuring it."""
+    attributable to one machine state without re-measuring it.
+
+    `precision` is the (compute_dtype, param_dtype) policy the costs
+    were priced under (cost_model.op_precision): a dtype flip changes
+    every byte/flops figure, so entries cached for f32 pricing must
+    MISS for a bf16 search (and vice versa) — regression-tested in
+    tests/test_mixed_precision.py. Per-dtype efficiency factors
+    ("matmul:float32") ride the efficiency dict already hashed here."""
     from .cost_model import COST_MODEL_VERSION
     spec = {f.name: getattr(mm.spec, f.name, None)
             for f in dataclasses.fields(mm.spec)}
@@ -72,10 +79,14 @@ def machine_fingerprint(mm, mesh=None) -> str:
         "spec": {k: (list(v) if isinstance(v, tuple) else v)
                  for k, v in spec.items()},
         "efficiency": dict(sorted(mm.efficiency.items())),
+        "dtype_flops_scale": dict(sorted(
+            getattr(mm, "dtype_flops_scale", {}).items())),
         "dcn_axes": list(mm.dcn_axes),
         "axis_topology": {k: list(v)
                           for k, v in sorted(mm.axis_topology.items())},
         "mesh": (sorted(mesh.shape.items()) if mesh is not None else None),
+        "precision": (list(str(p) for p in precision)
+                      if precision is not None else None),
     }
     raw = json.dumps(blob, sort_keys=True, default=str)
     return hashlib.sha256(raw.encode()).hexdigest()[:16]

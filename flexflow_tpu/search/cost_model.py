@@ -11,7 +11,9 @@ efficiency factors against real jitted ops once per machine.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
 
 from ..op import Op
 from ..parallel.pconfig import OpStrategy
@@ -19,8 +21,10 @@ from .machine_model import TPUMachineModel
 
 # bump when any cost formula changes: part of the persistent cost-cache
 # fingerprint (search/cost_cache.py), so stale entries computed by an
-# older pricing model can never resurrect into a newer search
-COST_MODEL_VERSION = 1
+# older pricing model can never resurrect into a newer search.
+# v2: dtype-aware pricing — flops at the compute dtype's MXU rate,
+# bytes from actual itemsize (FFConfig.compute_dtype/param_dtype).
+COST_MODEL_VERSION = 2
 
 BWD_FLOP_FACTOR = 2.0  # dX and dW GEMMs ≈ 2x fwd (reference bwd = 2 GEMMs)
 # per-op-type overrides: attention bwd recomputes probabilities from the
@@ -93,6 +97,34 @@ class OpCost:
                       pipeline=self.pipeline or other.pipeline)
 
 
+def op_precision(op: Op) -> Tuple[str, float, float]:
+    """(compute dtype name, compute itemsize, param itemsize) of the
+    op's model — the precision policy the EXECUTOR will run
+    (FFConfig.compute_dtype/param_dtype), so the search prices the step
+    that actually executes. Weight specs are f32-declared throughout
+    (builder bf16 is an ACTIVATION dtype), so scaling weight bytes by
+    itemsize/4 is exact."""
+    cfg = getattr(getattr(op, "model", None), "config", None)
+    cd = jnp.dtype(getattr(cfg, "compute_dtype", jnp.float32)
+                   if cfg is not None else jnp.float32)
+    pd = jnp.dtype(getattr(cfg, "param_dtype", jnp.float32)
+                   if cfg is not None else jnp.float32)
+    return cd.name, float(cd.itemsize), float(pd.itemsize)
+
+
+def _float_tensor_bytes(tensors, itemsize: float) -> float:
+    """Bytes moved for a tensor list under a compute itemsize: float
+    tensors stream at the compute dtype, integer tensors (embedding
+    indices) keep their own width."""
+    total = 0.0
+    for t in tensors:
+        if jnp.issubdtype(t.dtype, jnp.floating):
+            total += t.num_elements * itemsize
+        else:
+            total += t.size_bytes()
+    return total
+
+
 def _axis_size(strategy: OpStrategy, mesh, logical_axis) -> int:
     ax = strategy.mesh_axis_for(logical_axis)
     if not isinstance(ax, str):
@@ -128,9 +160,20 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
             ) -> OpCost:
     shards = compute_shards(op, strategy, mesh)
     flops = op.flops()
-    act_bytes = sum(t.size_bytes() for t in op.outputs)
-    in_bytes = sum(t.size_bytes() for t in op.inputs)
-    w_bytes = op.weight_bytes()
+    # --- precision policy (FFConfig.compute_dtype/param_dtype): float
+    # activations stream (and collectives carry) compute-dtype bytes;
+    # master weights + gradients stream param-dtype bytes (the cast
+    # boundary upcasts cotangents before they reach the update); MXU
+    # flops price at the compute dtype's per-dtype peak. This is the
+    # dominant TPU perf lever (bf16 ≈ 2x rate, half the bytes) and the
+    # whole point of making the search dtype-aware.
+    cd_name, c_item, p_item = op_precision(op)
+    cs = c_item / 4.0   # compute-dtype scale vs the f32-declared bytes
+    ps = p_item / 4.0   # param-dtype scale
+    act_bytes = _float_tensor_bytes(op.outputs, c_item)
+    in_bytes = _float_tensor_bytes(op.inputs, c_item)
+    w_bytes = op.weight_bytes()     # master (f32-declared) basis
+    w_compute = w_bytes * cs        # the cast copies fwd/bwd stream
     is_mm = op.op_type in MATMUL_OPS
     # conv has its own MEASURED MXU fraction (measure.py
     # measure_conv_efficiency — the analog of the reference's per-shape
@@ -160,12 +203,15 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
     # vocab/batch ratio (10^3-10^5 for DLRM) and misrank strategies.
     # The same traffic numbers feed the device-placement branch below,
     # so placed and mesh-sharded candidates compete on equal pricing.
-    sync_bytes = w_bytes
+    sync_bytes = w_bytes * ps       # grads sync at the param dtype
     sync_data_sharded = False  # dense grads are replicated across dp
-    fwd_bytes = bwd_bytes = act_bytes + in_bytes + w_bytes
+    fwd_bytes = bwd_bytes = act_bytes + in_bytes + w_compute
     if op.op_type in ("embedding", "distributed_embedding"):
-        rows_bytes = 4.0 * op.out_dim * sum(
-            t.num_elements for t in op.inputs)
+        # forward gathers rows at the compute dtype; backward's row
+        # gradients land at the param dtype (scatter into the master)
+        n_idx = sum(t.num_elements for t in op.inputs)
+        rows_bytes = c_item * op.out_dim * n_idx
+        grad_rows_bytes = p_item * op.out_dim * n_idx
         cfg = op.model.config
         input_uids = {t.uid for t in op.model.input_tensors}
         # mirror the EXECUTOR's eligibility gate (executor.py
@@ -181,7 +227,7 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
                 mode == "lazy"
                 and getattr(cfg, "sparse_embedding_lazy", False)))
             and all(t.uid in input_uids for t in op.inputs))
-        grad_bytes = rows_bytes if sparse_updates else w_bytes
+        grad_bytes = grad_rows_bytes if sparse_updates else w_bytes * ps
         fwd_bytes = act_bytes + in_bytes + rows_bytes
         bwd_bytes = act_bytes + in_bytes + grad_bytes
         sync_bytes = grad_bytes
@@ -231,22 +277,23 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
             n_total = max(1, int(mesh.size))
             w_bytes *= n_total * kmax / len(devices)
         n = max(1, int(mesh.size))
-        fwd = mm.compute_time(flops / k, fwd_bytes / k, is_mm, kind=kind)
+        fwd = mm.compute_time(flops / k, fwd_bytes / k, is_mm, kind=kind,
+                              dtype=cd_name)
         if op.op_type in ("embedding", "distributed_embedding"):
             bwd = mm.compute_time(flops / k, bwd_bytes / k, is_mm,
-                                  kind=kind)
+                                  kind=kind, dtype=cd_name)
         else:
             bwd = BWD_FACTOR_BY_TYPE.get(op.op_type,
                                          BWD_FLOP_FACTOR) * fwd
         if n > k:
             fwd_comm = mm.all_gather(act_bytes, n)
             bwd_comm = mm.all_gather(act_bytes, n)
-        mem = (w_bytes * (1.0 + optimizer_state_mult) + act_bytes * 2) \
+        mem = (w_bytes * (ps + optimizer_state_mult) + act_bytes * 2) \
             * k / n
         # dense updates sweep the (NORMALIZED) table bytes — sync_bytes
         # was captured before the padded-slot normalization above and
         # would overprice a live placed op by slots/ntab
-        upd_basis = sync_bytes if emb_sparse_updates else w_bytes
+        upd_basis = sync_bytes if emb_sparse_updates else w_bytes * ps
         upd = (upd_basis * (2.0 + 2.0 * optimizer_state_mult) / k
                / (mm.spec.hbm_bandwidth * mm.efficiency["elementwise"])
                if w_bytes > 0 else 0.0)
@@ -254,10 +301,10 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
                       bwd_comm=bwd_comm, sync=0.0, mem=mem, update=upd)
 
     fwd = mm.compute_time(flops / shards, fwd_bytes / shards, is_mm,
-                          kind=kind)
+                          kind=kind, dtype=cd_name)
     if op.op_type in ("embedding", "distributed_embedding"):
         bwd = mm.compute_time(flops / shards, bwd_bytes / shards, is_mm,
-                              kind=kind)
+                              kind=kind, dtype=cd_name)
     else:
         bwd = BWD_FACTOR_BY_TYPE.get(op.op_type, BWD_FLOP_FACTOR) * fwd
 
@@ -322,7 +369,8 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
 
     # --- EP: dispatch + combine all-to-alls of the capacity buffers
     if ep > 1 and op.op_type == "moe_ffn":
-        disp_bytes = (op.num_experts * op.capacity * op.in_dim * 4) / dp
+        disp_bytes = (op.num_experts * op.capacity * op.in_dim
+                      * c_item) / dp
         fwd_comm += 2 * mm.all_to_all(disp_bytes / ep, ep, ep_ax)
         bwd_comm += 2 * mm.all_to_all(disp_bytes / ep, ep, ep_ax)
 
@@ -377,10 +425,12 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
             payload /= dp
         sync = mm.all_reduce(payload, dp, _axis_name(strategy, "sample"))
 
-    # --- memory: weights (+ optimizer state) + activations per device
+    # --- memory: master weights at param_dtype + optimizer state
+    # (f32 slots, counted on the declared-bytes basis) + compute-dtype
+    # activations per device
     w_per_dev = w_bytes / max(1, eff_tp * ep * pp * vocab * table)
     act_per_dev = act_bytes / shards
-    mem = w_per_dev * (1.0 + optimizer_state_mult) + act_per_dev * 2
+    mem = w_per_dev * (ps + optimizer_state_mult) + act_per_dev * 2
 
     # --- optimizer update: the reference's update tasks carry
     # run_time=0 ("assume update takes no time", simulator.cc:420) —
@@ -419,6 +469,14 @@ def staged_pipeline_cost(model, mesh, mm: TPUMachineModel,
     M = max(1, int(microbatches))
     ndata = mesh.shape.get("data", 1)
     local = OpStrategy({"sample": "data"})  # data split only
+    # precision policy, applied like op_cost does: compute-dtype
+    # activation bytes (stash + wire), param-dtype master weights,
+    # f32-basis optimizer slots, param-dtype grad sync — a staged bf16
+    # candidate must not be memory-penalized on f32 bytes while the
+    # non-staged strategies it competes with are priced at bf16
+    _, c_item, p_item = op_precision(model.ops[0]) if model.ops \
+        else ("float32", 4.0, 4.0)
+    ps = p_item / 4.0
     fwd_stages, bwd_stages, syncs, mems = [], [], [], []
     for s, ops in enumerate(plan.stages):
         f = b = sync_bytes = w_bytes = act_bytes = 0.0
@@ -431,19 +489,22 @@ def staged_pipeline_cost(model, mesh, mm: TPUMachineModel,
             # applies one optimizer step per dispatch
             b += (c.bwd + c.update) / M
             w = op.weight_bytes()
-            sync_bytes += w
+            sync_bytes += w * ps
             w_bytes += w
-            act_bytes += sum(t.size_bytes() for t in op.outputs) / ndata
+            act_bytes += _float_tensor_bytes(op.outputs,
+                                             c_item) / ndata
         fwd_stages.append(f)
         bwd_stages.append(b)
         syncs.append(mm.all_reduce(sync_bytes, ndata, "data")
                      if ndata > 1 and sync_bytes > 0 else 0.0)
         peak = M if schedule != "1f1b" else min(S - s, M)
-        mems.append(w_bytes * (1.0 + optimizer_state_mult)
+        mems.append(w_bytes * (ps + optimizer_state_mult)
                     + act_bytes / M * max(1, peak) * 2)
     hops = []
+    # the inter-stage wire carries float activations at the compute
+    # dtype (graph_pipeline._wire_layouts) — price the hops the same
     for cut in plan.cuts:
-        cut_bytes = sum(t.size_bytes() for t in cut) / M / ndata
+        cut_bytes = _float_tensor_bytes(cut, c_item) / M / ndata
         hops.append(mm.ppermute(cut_bytes, "pipe"))
     pc = PipelineCost(
         stages=S, microbatches=M,

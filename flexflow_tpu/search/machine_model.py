@@ -34,6 +34,13 @@ class TPUMachineModel:
         "elementwise": 0.8,  # HBM-bound ops (fraction of peak HBM bw)
         "collective": 0.75,  # fraction of peak ICI bw
     })
+    # per-dtype MXU rate relative to spec.peak_flops (which is the
+    # bf16 basis — TPU datasheets quote bf16): f32 matmuls run at half
+    # the bf16 rate (one MXU pass per f32 operand pair vs packed bf16),
+    # f16 matches bf16. Overridable per machine file / calibration.
+    dtype_flops_scale: Dict[str, float] = dataclasses.field(
+        default_factory=lambda: {
+            "bfloat16": 1.0, "float16": 1.0, "float32": 0.5})
     # mesh axes that ride DCN instead of ICI (multi-host `data` axis)
     dcn_axes: tuple = ()
     # mesh axis -> tuple of physical torus dims it spans (from
@@ -54,16 +61,37 @@ class TPUMachineModel:
         return len(dims), max(dims)
 
     # ---- compute ----
+    def peak_flops_for(self, dtype: Optional[str] = None) -> float:
+        """Peak MXU rate for a compute dtype. None keeps the raw
+        spec.peak_flops (bf16 basis) — the pre-precision-policy
+        behavior callers outside op_cost still rely on."""
+        if dtype is None:
+            return self.spec.peak_flops
+        return self.spec.peak_flops * self.dtype_flops_scale.get(
+            str(dtype), 1.0)
+
+    def _eff(self, key: str, dtype: Optional[str]) -> float:
+        """Per-family efficiency with an optional per-dtype override:
+        "matmul:float32" (written by measure.calibrate's per-dtype
+        pass) beats the family factor "matmul"."""
+        base = self.efficiency.get(key, self.efficiency["matmul"])
+        if dtype is None:
+            return base
+        return self.efficiency.get(f"{key}:{dtype}", base)
+
     def compute_time(self, flops: float, bytes_moved: float,
                      is_matmul: bool = True,
-                     kind: Optional[str] = None) -> float:
+                     kind: Optional[str] = None,
+                     dtype: Optional[str] = None) -> float:
         """Roofline: max of MXU time and HBM time. `kind` selects a
         measured per-family MXU efficiency ("conv" today); default is
-        the big-GEMM factor."""
-        eff = self.efficiency["matmul"]
-        if kind is not None:
-            eff = self.efficiency.get(kind, eff)
-        t_flops = flops / (self.spec.peak_flops * eff)
+        the big-GEMM factor. `dtype` prices the op at that compute
+        dtype's peak rate and (when calibrated) its measured per-dtype
+        efficiency — the cost-model half of the mixed-precision policy
+        (callers scale `bytes_moved` by the dtype itemsize themselves,
+        cost_model.op_cost)."""
+        eff = self._eff(kind if kind is not None else "matmul", dtype)
+        t_flops = flops / (self.peak_flops_for(dtype) * eff)
         t_mem = bytes_moved / (self.spec.hbm_bandwidth
                                * self.efficiency["elementwise"])
         return max(t_flops, t_mem)

@@ -29,17 +29,18 @@ def _sync(x) -> float:
 
 
 def measure_matmul_efficiency(mm: TPUMachineModel, n: int = 8192,
-                              repeats: int = 30) -> float:
+                              repeats: int = 30, dtype=None) -> float:
     # repeats must be large enough that total device time >> one
     # host<->device round trip (remote tunnels add ~100ms per sync)
     import jax
     import jax.numpy as jnp
-    x = jnp.ones((n, n), jnp.bfloat16)
+    dtype = jnp.dtype(dtype if dtype is not None else jnp.bfloat16)
+    x = jnp.ones((n, n), dtype)
 
     @jax.jit
     def f(a):
         return jnp.dot(a, a, preferred_element_type=jnp.float32).astype(
-            jnp.bfloat16)
+            dtype)
 
     y = f(x)
     _sync(y)
@@ -49,7 +50,10 @@ def measure_matmul_efficiency(mm: TPUMachineModel, n: int = 8192,
     _sync(y)
     dt = (time.perf_counter() - t0) / repeats
     achieved = 2.0 * n ** 3 / dt
-    return min(1.0, achieved / mm.spec.peak_flops)
+    # achieved fraction of THAT dtype's peak (peak_flops_for), so the
+    # factor composes with the per-dtype rate instead of double-
+    # counting it (machine_model.compute_time)
+    return min(1.0, achieved / mm.peak_flops_for(dtype.name))
 
 
 def measure_conv_efficiency(mm: TPUMachineModel, repeats: int = 20
@@ -149,6 +153,15 @@ def calibrate(mm: TPUMachineModel, save_path: Optional[str] = None
     defeat re-measurement forever)."""
     try:
         mm.efficiency["matmul"] = max(0.05, measure_matmul_efficiency(mm))
+        # per-dtype calibration: f32 GEMMs achieve a DIFFERENT fraction
+        # of their (halved) peak than bf16 does of its own — the
+        # "matmul:<dtype>" keys override the family factor when
+        # compute_time prices that dtype (mixed-precision cost model).
+        # bf16's factor IS the family default (TPU datasheet basis).
+        import jax.numpy as _jnp
+        mm.efficiency["matmul:float32"] = max(
+            0.05, measure_matmul_efficiency(mm, dtype=_jnp.float32))
+        mm.efficiency["matmul:bfloat16"] = mm.efficiency["matmul"]
         mm.efficiency["conv"] = max(0.05, measure_conv_efficiency(mm))
         mm.efficiency["elementwise"] = max(
             0.05, measure_elementwise_efficiency(mm))

@@ -228,11 +228,24 @@ class Simulator:
             from .cost_cache import CostCache, machine_fingerprint
             self._disk = CostCache.open(
                 getattr(cfg, "cost_cache_file", None) or None)
-            self._fingerprint = machine_fingerprint(self.mm, mesh)
+            self._fingerprint = machine_fingerprint(
+                self.mm, mesh, precision=self._precision())
         self._op_sig_memo: Dict[str, str] = {}
         self._cfg_sig = self._compute_cfg_sig()
         # per-op measured grounding (FFConfig.measure_top_ops)
         self._measured_set: set = self._choose_measured_ops()
+
+    def _precision(self):
+        """(compute_dtype, param_dtype) names of the model's policy —
+        folded into the machine fingerprint so cached costs priced
+        under one precision can never serve a search under another."""
+        import jax.numpy as jnp
+        cfg = getattr(self.model, "config", None)
+        if cfg is None:
+            return ("float32", "float32")
+        return (jnp.dtype(getattr(cfg, "compute_dtype",
+                                  jnp.float32)).name,
+                jnp.dtype(getattr(cfg, "param_dtype", jnp.float32)).name)
 
     def _compute_cfg_sig(self) -> tuple:
         """Config/optimizer facts op_cost reads beyond the op + strategy
@@ -248,7 +261,7 @@ class Simulator:
                 mode = None
         return (bool(getattr(cfg, "sparse_embedding_updates", True)),
                 bool(getattr(cfg, "sparse_embedding_lazy", False)),
-                str(mode))
+                str(mode)) + self._precision()
 
     def invalidate(self) -> None:
         """Drop every derived cache (op costs, fused units, staged
@@ -265,7 +278,8 @@ class Simulator:
         self._cfg_sig = self._compute_cfg_sig()
         if self._disk is not None:
             from .cost_cache import machine_fingerprint
-            self._fingerprint = machine_fingerprint(self.mm, self.mesh)
+            self._fingerprint = machine_fingerprint(
+                self.mm, self.mesh, precision=self._precision())
         self._measured_set = self._choose_measured_ops()
 
     def flush_cost_cache(self) -> None:

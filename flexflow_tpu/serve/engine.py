@@ -189,13 +189,22 @@ class ServeEngine:
         self.head_dim = attn0.head_dim
         self.hidden = attn0.embed_dim
         self.ln_eps = ops["layer0_ln1"].eps if self.layer_norm else 1e-5
+        # serving activation dtype = whatever the LM graph's embeddings
+        # emit (build_transformer_lm wires FFConfig.compute_dtype here):
+        # every block below follows its input dtype, so a bf16 LM
+        # serves bf16 end-to-end — and generate_reference embeds
+        # through the SAME cast, so the greedy parity oracle holds at
+        # the engine's own precision. KV pages keep their configured
+        # (f32) dtype: bf16 K/V upcasts exactly, so cached and
+        # recomputed attention stay bit-identical.
+        self.act_dtype = jnp.dtype(ops["tok_embed"].out_dtype)
         self.params = model.state.params  # live references, not copies
 
     # ---------------- pure block math ----------------------------------
     def _embed(self, params, tokens, positions):
         te = jnp.take(params["tok_embed"]["kernel"], tokens, axis=0)
         pe = jnp.take(params["pos_embed"]["kernel"], positions, axis=0)
-        return (te + pe).astype(jnp.float32)
+        return (te + pe).astype(self.act_dtype)
 
     def _attn_qkv(self, p, h):
         """h (..., E) -> q, k, v (..., H, D)."""
@@ -252,8 +261,17 @@ class ServeEngine:
             logits = jnp.einsum("bihd,bjhd->bhij", q, k,
                                 preferred_element_type=jnp.float32) * scale
             logits = jnp.where(causal, logits, -jnp.inf)
-            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-            o = jnp.einsum("bhij,bjhd->bihd", probs, v)
+            # probs STAY f32 through the p.v product — the paged
+            # kernels' convention (_paged_online_page: "p stays f32 and
+            # v upcasts") — so a bf16 engine's reference forward and
+            # its paged path diverge only at f32 epsilon, not at bf16
+            # prob-rounding scale (which flips greedy argmaxes). For
+            # f32 engines this is bit-identical to rounding probs.
+            probs = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bhij,bjhd->bihd", probs,
+                           v.astype(jnp.float32),
+                           preferred_element_type=jnp.float32
+                           ).astype(x.dtype)
             x = self._attn_out(p, o, x)
             x = self._ffn(params, i, x)
         logits = self._head(params, x)                    # (1, S, V)

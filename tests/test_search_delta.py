@@ -172,7 +172,12 @@ def test_cost_cache_roundtrip_and_fingerprint(tmp_path):
     assert sim1.stats["cost_computes"] > 0
     sim1.flush_cost_cache()
     data = json.load(open(path))
-    fp = machine_fingerprint(sim1.mm, mesh)
+    # fingerprints carry the precision policy since the mixed-precision
+    # cost model (cost_model COST_MODEL_VERSION 2): external callers
+    # pass the simulator's resolved (compute, param) dtypes
+    fp = machine_fingerprint(sim1.mm, mesh,
+                             precision=sim1._precision())
+    assert fp == sim1._fingerprint
     assert fp in data and len(data[fp]) > 0
 
     # same machine state: a fresh simulator prices from disk, computing

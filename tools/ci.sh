@@ -45,6 +45,14 @@ echo "--- 1d. serve-bench smoke (zero recompiles + prefix-cache gate)"
 env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke \
     -o /tmp/ci_bench_serve.json || fail=1
 
+echo "--- 1e. mixed-precision smoke (bf16 makespan + parity gate)"
+# fails if the simulated bf16 step-makespan reduction on the TPU
+# machine model is < 1.3x (transformer or DLRM), if the bf16 loss
+# curve drifts from f32 past tolerance, or if the cost-cache
+# fingerprint fails to separate precision policies (tools/mp_bench.py)
+env JAX_PLATFORMS=cpu python tools/mp_bench.py --smoke \
+    -o /tmp/ci_bench_mp.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \

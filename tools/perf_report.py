@@ -91,6 +91,7 @@ def build_table(bench):
             f"`tools/tpu_session.sh` refreshes both the JSON and this "
             f"table.")
     note += search_line()
+    note += mp_line()
     return "\n".join(lines), note
 
 
@@ -108,6 +109,27 @@ def search_line() -> str:
                 f"delta simulation vs {b['proposals_per_sec_full']:,.0f} "
                 f"full ({b['speedup']:.1f}x, `BENCH_search.json`, "
                 f"fingerprint `{b.get('fingerprint', 'n/a')}`).")
+    except (OSError, json.JSONDecodeError, KeyError):
+        return ""
+
+
+def mp_line() -> str:
+    """Mixed-precision sentence from BENCH_mp.json (tools/mp_bench.py):
+    the simulator-priced bf16-vs-f32 step-makespan reductions and, when
+    a TPU was attached at capture time, the wall-clock speedup."""
+    try:
+        with open(os.path.join(ROOT, "BENCH_mp.json")) as f:
+            b = json.load(f)
+        s = b["simulated"]
+        line = (f" Mixed precision (bf16 compute, f32 masters): "
+                f"{s['transformer']['reduction']:.2f}x simulated "
+                f"step-makespan reduction on the transformer, "
+                f"{s['dlrm']['reduction']:.2f}x on DLRM")
+        wall = b.get("wallclock")
+        if wall:
+            line += (f"; {wall['speedup']:.2f}x wall-clock "
+                     f"({wall['bfloat16']['tokens_per_sec']:,.0f} tok/s)")
+        return line + " (`BENCH_mp.json`)."
     except (OSError, json.JSONDecodeError, KeyError):
         return ""
 

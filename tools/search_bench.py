@@ -156,7 +156,8 @@ def main():
         "delta_vs_full_max_rel_err": max_rel,
         "delta_stats": {k: v for k, v in delta_stats.items()
                         if isinstance(v, (int, float))},
-        "fingerprint": machine_fingerprint(sim.mm, mesh),
+        "fingerprint": machine_fingerprint(sim.mm, mesh,
+                                           precision=sim._precision()),
     }
     print(search_report(delta_stats))
     print(f"full: {pps_full:,.0f} proposals/s | "
