@@ -261,6 +261,18 @@ class FFConfig:
     # residency, and this headroom keeps admissions from thrashing the
     # preemption path the moment running sequences grow.
     serve_admit_watermark: float = 0.02
+    # speculative decoding (serve/speculative.py): a host-side drafter
+    # (prompt-lookup n-gram by default) proposes up to serve_spec_tokens
+    # continuation tokens per decoding sequence per step; the mixed
+    # program verifies them in spare lanes and the host keeps the
+    # longest matching prefix — greedy outputs stay token-identical to
+    # sequential decode. Draft length adapts per request from a
+    # windowed acceptance rate (0 = auto-disabled on adversarial
+    # text). Draft lanes compete with prefill chunks for
+    # serve_prefill_budget; decode lanes never starve.
+    # --spec-tokens N / --no-spec-decode.
+    serve_spec_decode: bool = True
+    serve_spec_tokens: int = 4
 
     # synthetic input when no dataset is provided (reference: config.h:131)
     synthetic_input: bool = False
@@ -336,6 +348,10 @@ class FFConfig:
             raise ValueError(
                 f"serve_admit_watermark must be in [0, 1), got "
                 f"{self.serve_admit_watermark}")
+        if self.serve_spec_tokens < 0:
+            raise ValueError(
+                f"serve_spec_tokens must be >= 0 (0 disables "
+                f"speculative decoding), got {self.serve_spec_tokens}")
         if self.pipeline_virtual_stages > 1 \
                 and self.pipeline_schedule != "1f1b":
             raise ValueError(
@@ -388,6 +404,7 @@ class FFConfig:
         "--serve-max-seqs": ("serve_max_seqs", int),
         "--serve-prefill-budget": ("serve_prefill_budget", int),
         "--serve-admit-watermark": ("serve_admit_watermark", float),
+        "--spec-tokens": ("serve_spec_tokens", int),
     }
     _BOOL_FLAGS = {
         "--profiling": "profiling",
@@ -414,6 +431,7 @@ class FFConfig:
         "--no-cost-cache": "search_cost_cache",
         "--no-chunked-prefill": "serve_chunked_prefill",
         "--no-prefix-cache": "serve_prefix_cache",
+        "--no-spec-decode": "serve_spec_decode",
     }
 
     def parse_args(self, argv: Sequence[str]) -> None:

@@ -99,12 +99,15 @@ class DataLoaderSet:
     on a C++ background thread (csrc/dataloader.cc), double-buffered so
     host gather overlaps device dispatch — the prefetch analog of the
     reference's next_batch index-launched copies
-    (flexflow_dataloader.cc:649-740)."""
+    (flexflow_dataloader.cc:649-740). The pure-Python path gets the
+    same overlap from a Python worker thread (`_iter_prefetch`,
+    `prefetch=False` opts out)."""
 
     def __init__(self, arrays: Dict[str, np.ndarray], batch_size: int,
                  mesh=None, shuffle: bool = True, seed: int = 0,
                  use_native: Optional[bool] = None,
-                 dtypes: Optional[Dict] = None):
+                 dtypes: Optional[Dict] = None,
+                 prefetch: bool = True):
         n = {len(v) for v in arrays.values()}
         assert len(n) == 1, "all arrays must have equal sample counts"
         # one shared shuffled order: shuffle once here, not per-loader
@@ -120,6 +123,12 @@ class DataLoaderSet:
         }
         self.shuffle = shuffle
         self.batch_size = batch_size
+        # pure-Python path overlap (parity with the native loader): a
+        # background thread runs the per-batch row gathers one/two
+        # batches ahead while the main thread does the host->device
+        # transfer of the current one. prefetch=False is the escape
+        # hatch (debugging, or hosts where a second thread hurts).
+        self.prefetch = bool(prefetch)
         self._native = None
         if use_native is not False:
             from .. import native
@@ -181,6 +190,8 @@ class DataLoaderSet:
                 yield {k: host_to_device(np.array(v, copy=True), self.mesh,
                                          self.dtypes.get(k))
                        for k, v in batch.items()}
+        elif self.prefetch and self.num_batches > 1:
+            yield from self._iter_prefetch(order)
         else:
             # iterator-LOCAL slicing: the shared loaders' cursors are
             # left untouched, so overlapping epoch iterators (or direct
@@ -190,6 +201,60 @@ class DataLoaderSet:
                 sel = order[i * bs:(i + 1) * bs]
                 yield {k: host_to_device(l.data[sel], self.mesh, l.dtype)
                        for k, l in self.loaders.items()}
+
+    def _iter_prefetch(self, order: np.ndarray
+                       ) -> Iterator[Dict[str, jax.Array]]:
+        """Double-buffered pure-Python epoch: a background thread runs
+        the fancy-indexed row gathers (the host-side cost of a batch)
+        up to two batches ahead of the main thread's host->device
+        transfers — the same gather/transfer overlap the native loader
+        gets from its C++ worker (csrc/dataloader.cc), minus the shared
+        buffer (each gather is a fresh array, so nothing here can alias
+        a batch the consumer still holds). Batch ORDER and CONTENT are
+        byte-identical to the synchronous path: the worker walks the
+        same `order` slices, and the bounded queue only changes WHEN a
+        gather runs, not what it reads."""
+        import queue
+        import threading
+        bs = self.batch_size
+        q: "queue.Queue" = queue.Queue(maxsize=2)   # the double buffer
+        stop = threading.Event()
+
+        def gather() -> None:
+            try:
+                for i in range(self.num_batches):
+                    if stop.is_set():
+                        return
+                    sel = order[i * bs:(i + 1) * bs]
+                    q.put({k: l.data[sel]
+                           for k, l in self.loaders.items()})
+                q.put(None)                          # end of epoch
+            except BaseException as e:               # surface in consumer
+                q.put(e)
+
+        worker = threading.Thread(target=gather, daemon=True,
+                                  name="ff-dataloader-prefetch")
+        worker.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield {k: host_to_device(v, self.mesh,
+                                         self.dtypes.get(k))
+                       for k, v in item.items()}
+        finally:
+            # abandoned iterator (break / exception): unblock a worker
+            # parked on the full queue, then reap it
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            worker.join(timeout=5.0)
 
 
 def synthetic_inputs(model, n_samples: int, seed: int = 0,

@@ -4,7 +4,8 @@ The training half of the framework compiles an op graph into one jitted
 SPMD step; this package opens the inference half: a block-paged KV-cache
 with refcounted prefix caching (:mod:`kv_cache`), a continuous-batching
 scheduler with chunked prefill, watermark admission and preemption
-(:mod:`scheduler`), and a :class:`ServeEngine` (:mod:`engine`) that
+(:mod:`scheduler`), host-side drafting for verified speculative decode
+(:mod:`speculative`), and a :class:`ServeEngine` (:mod:`engine`) that
 wraps a built LM into ONE fixed-shape mixed prefill+decode step so XLA
 compiles a single serving program, ever.
 """
@@ -12,6 +13,7 @@ compiles a single serving program, ever.
 from .kv_cache import KVCacheConfig, PagedKVCache, prefix_page_keys
 from .scheduler import (ChunkPlan, ContinuousBatchingScheduler, Request,
                         RequestState, SampleParams, StepPlan)
+from .speculative import DraftControl, Drafter, PromptLookupDrafter
 from .engine import ServeEngine
 
 __all__ = [
@@ -24,5 +26,8 @@ __all__ = [
     "RequestState",
     "SampleParams",
     "StepPlan",
+    "DraftControl",
+    "Drafter",
+    "PromptLookupDrafter",
     "ServeEngine",
 ]

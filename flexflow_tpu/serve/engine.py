@@ -21,6 +21,15 @@ serving process never recompiles (generate() can assert this via
 per-bucket prefill + full-width decode pair is retained behind
 `serve_chunked_prefill=False` (FFConfig) as the legacy path.
 
+Speculative decoding (serve/speculative.py, docs/serving.md) spends
+spare prefill-budget lanes of the SAME program: a host-side drafter
+appends up to `serve_spec_tokens` proposed tokens after a sequence's
+decode lane, verification keeps the longest prefix matching what the
+model would have emitted anyway (plus the correction/bonus token that
+told us so), and rejected tokens' pages roll back — several tokens per
+dispatch on repetitive text, token-identical output always, zero new
+program shapes.
+
 The engine owns a PERSISTENT PagedKVCache and device page arrays:
 prefix pages committed by one generate() call are matchable by the
 next, so a shared system preamble is computed once per process, not
@@ -54,6 +63,44 @@ from ..kernels.flash_attention import (paged_attention_decode,
 from .kv_cache import KVCacheConfig, PagedKVCache
 from .scheduler import (ChunkPlan, ContinuousBatchingScheduler, Request,
                         SampleParams)
+
+
+class _CompileEvents:
+    """Process-wide counter of ACTUAL XLA backend compiles, fed by
+    jax.monitoring's public event stream (the
+    '/jax/core/compile/backend_compile_duration' event fires once per
+    backend compile and never on a jit-cache hit).
+
+    This exists because the zero-recompile serving gate must not go
+    vacuous: jit's `_cache_size` is a private API that has moved across
+    jax versions, and a gate comparing "?" == "?" passes while the
+    engine silently recompiles every step. The engine snapshots this
+    counter around each jitted call and attributes any increment to
+    that serving function — monkeypatch-free, and it catches even a
+    same-signature recompile (e.g. a dropped jit cache) that a
+    distinct-shape count would miss. Single listener per process;
+    serving calls are not concurrent, so the around-call diff is
+    race-free."""
+
+    count = 0
+    _installed: Optional[bool] = None
+
+    @classmethod
+    def install(cls) -> bool:
+        if cls._installed is None:
+            try:
+                from jax import monitoring
+                monitoring.register_event_duration_secs_listener(
+                    cls._on_event)
+                cls._installed = True
+            except Exception:   # monitoring API absent on this jax
+                cls._installed = False
+        return cls._installed
+
+    @staticmethod
+    def _on_event(event: str, duration: float, **kwargs) -> None:
+        if event == "/jax/core/compile/backend_compile_duration":
+            _CompileEvents.count += 1
 
 
 def _ln(p, x, eps):
@@ -98,7 +145,9 @@ class ServeEngine:
     def __init__(self, model, *, max_seq_len: Optional[int] = None,
                  use_pallas: Optional[bool] = None, interpret: bool = False,
                  chunked_prefill: Optional[bool] = None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 spec_tokens: Optional[int] = None,
+                 drafter=None):
         if model.state is None:
             model.compile(comp_mode=CompMode.INFERENCE)
         self.model = model
@@ -128,6 +177,16 @@ class ServeEngine:
         self.prefill_budget = int(getattr(cfg, "serve_prefill_budget", 512))
         self.admit_watermark = float(
             getattr(cfg, "serve_admit_watermark", 0.02))
+        # speculative decoding (serve/speculative.py): max drafted
+        # tokens per sequence per step. Needs the mixed program (draft
+        # lanes are chunk lanes); 0 disables and the engine is
+        # bit-for-bit the non-speculative one. `spec_tokens`/`drafter`
+        # override the config for A/B benches and draft-LM plugins.
+        if spec_tokens is None:
+            spec_tokens = int(getattr(cfg, "serve_spec_tokens", 4)) \
+                if getattr(cfg, "serve_spec_decode", True) else 0
+        self.spec_tokens = int(spec_tokens) if self.chunked_prefill else 0
+        self.drafter = drafter
         # the one mixed-step geometry: every prefill-budget token plus
         # one decode lane per slot always fits
         self.mixed_width = self.prefill_budget + self.cache_cfg.max_seqs
@@ -139,8 +198,12 @@ class ServeEngine:
         self._k_pages = None
         self._v_pages = None
         # prompt-length buckets (legacy path + generate_reference):
-        # powers of two from one page up to the page-table ceiling
-        cap = self.cache_cfg.pages_per_seq * self.cache_cfg.page_size
+        # powers of two from one page up to the serveable length. The
+        # page-table ceiling rounds UP to whole pages, but a bucket
+        # wider than max_seq_len would forward positions the model
+        # never learned (and no admissible request can need)
+        cap = min(self.cache_cfg.pages_per_seq * self.cache_cfg.page_size,
+                  self.cache_cfg.max_seq_len)
         b = max(self.cache_cfg.page_size, 16)
         self.buckets = []
         while b < cap:
@@ -152,9 +215,15 @@ class ServeEngine:
                                     donate_argnums=(1, 2))
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2))
         self._forward_jit = jax.jit(self._forward_logits)  # naive reference
-        # shape signatures seen per serving function: the version-proof
-        # compile counter (jit._cache_size is a private API) — a new
-        # signature IS a new XLA program under jit
+        # per-function compile accounting: `_compiles` counts calls
+        # that triggered at least one real XLA backend compile
+        # (jax.monitoring events, see _CompileEvents); `_shapes_seen`
+        # counts distinct argument-shape signatures (each IS one
+        # program under jit) as the belt-and-braces floor on a jax
+        # without the monitoring API
+        self._events_ok = _CompileEvents.install()
+        self._compiles: Dict[str, int] = {"prefill": 0, "decode": 0,
+                                          "mixed": 0}
         self._shapes_seen: Dict[str, set] = {"prefill": set(),
                                              "decode": set(),
                                              "mixed": set()}
@@ -164,7 +233,14 @@ class ServeEngine:
         self._shapes_seen[name].add(tuple(
             (tuple(a.shape), str(a.dtype)) for a in args
             if hasattr(a, "shape")))
-        return fn(*args)
+        before = _CompileEvents.count
+        out = fn(*args)
+        # jit compiles synchronously at dispatch (only execution is
+        # async), so any backend-compile event between the snapshots
+        # belongs to THIS call
+        if _CompileEvents.count > before:
+            self._compiles[name] += 1
+        return out
 
     # ---------------- model introspection -----------------------------
     def _read_arch(self, model) -> None:
@@ -202,8 +278,17 @@ class ServeEngine:
 
     # ---------------- pure block math ----------------------------------
     def _embed(self, params, tokens, positions):
-        te = jnp.take(params["tok_embed"]["kernel"], tokens, axis=0)
-        pe = jnp.take(params["pos_embed"]["kernel"], positions, axis=0)
+        # mode="clip": padded lanes/positions past the learned tables
+        # must read SOME finite row — they are masked or never read
+        # back, but jnp.take's "fill" OOB default yields NaN, and a
+        # NaN K/V poisons every lane that softmax-weights it (0 * NaN
+        # = NaN survives the causal mask's zeroed probability). Bit
+        # for bit identical for all in-range indices. (The same OOB
+        # trap as ops/embedding's flat slot-offset gather, PR 2.)
+        te = jnp.take(params["tok_embed"]["kernel"], tokens, axis=0,
+                      mode="clip")
+        pe = jnp.take(params["pos_embed"]["kernel"], positions, axis=0,
+                      mode="clip")
         return (te + pe).astype(self.act_dtype)
 
     def _attn_qkv(self, p, h):
@@ -389,19 +474,17 @@ class ServeEngine:
         """Compiled-program count per serving function. After warmup()
         these must never grow — the zero-recompile serving contract
         (the chunked engine's whole hot path is the single `mixed`
-        program). Uses jit's compilation-cache size when the (private)
-        API exists, else the engine's own count of distinct
-        argument-shape signatures (each distinct signature is one XLA
-        program), so the contract check can never go vacuous on a jax
-        without _cache_size."""
-        def n(f, name):
-            try:
-                return int(f._cache_size())
-            except AttributeError:  # jit cache API moved across versions
-                return len(self._shapes_seen[name])
-        return {"prefill": n(self._prefill_jit, "prefill"),
-                "decode": n(self._decode_jit, "decode"),
-                "mixed": n(self._mixed_jit, "mixed")}
+        program). Counted from jax.monitoring's backend-compile events
+        snapshotted around every jitted call (_CompileEvents) — real
+        compiles, not a private jit-cache API that moves across
+        versions — with the engine's distinct argument-shape-signature
+        count as the floor (each distinct signature is one XLA program;
+        the floor is what keeps the gate honest on a jax without the
+        monitoring module). The event count additionally catches a
+        SAME-signature recompile the shape count cannot see."""
+        return {name: max(self._compiles[name],
+                          len(self._shapes_seen[name]))
+                for name in ("prefill", "decode", "mixed")}
 
     def _device_pages(self):
         if self._k_pages is None:
@@ -505,7 +588,8 @@ class ServeEngine:
         sched = ContinuousBatchingScheduler(
             cache, prefill_token_budget=self.prefill_budget,
             chunked_prefill=self.chunked_prefill,
-            admit_watermark=self.admit_watermark)
+            admit_watermark=self.admit_watermark,
+            spec_tokens=self.spec_tokens, drafter=self.drafter)
         if isinstance(max_new_tokens, int):
             max_new_tokens = [max_new_tokens] * len(prompts)
         if len(max_new_tokens) != len(prompts):
@@ -537,10 +621,45 @@ class ServeEngine:
                 req.t_finish = time.perf_counter()
                 sched.finish(req)
 
+        def emit_spec(chunk: ChunkPlan, lane0: int, greedy, topv,
+                      topi) -> int:
+            """Verify a speculative decode chunk and emit its step's
+            tokens: walk lanes lane0..lane0+k (the context token and
+            the k drafts), picking each lane's token exactly as
+            sequential decode would — lane j's logits are valid
+            BECAUSE every earlier pick matched the draft that fed lane
+            j+1 — and stop at the first mismatch (that pick IS the
+            corrected token), at EOS / max_new, or after the bonus
+            token when every draft held. Then the scheduler commits
+            the verified prefix and rolls the rejected tail's pages
+            back. Returns the number of tokens emitted (1 when k=0 —
+            the plain decode step, bit for bit)."""
+            req = chunk.req
+            k = len(chunk.draft_tokens)
+            matched = emitted = 0
+            for j in range(k + 1):
+                ln = lane0 + j
+                tok = self._pick_token(req, greedy[ln], topv[ln],
+                                       topi[ln])
+                # (no t_first_token stamp: only decode chunks
+                # speculate, and a decoding request already emitted)
+                req.out_tokens.append(tok)
+                emitted += 1
+                ok = j < k and tok == chunk.draft_tokens[j]
+                if ok:
+                    matched += 1
+                if req.is_done() or not ok:
+                    break
+            sched.complete_spec_chunk(chunk, matched)
+            if req.is_done():
+                req.t_finish = time.perf_counter()
+                sched.finish(req)
+            return emitted
+
         if self.chunked_prefill:
             kp, vp = self._run_chunked(sched, cache, kp, vp, emit,
-                                       decode_times, decode_widths,
-                                       prefill_times, util)
+                                       emit_spec, decode_times,
+                                       decode_widths, prefill_times, util)
             steps = len(util)
         else:
             kp, vp = self._run_legacy(sched, cache, kp, vp, emit,
@@ -575,16 +694,38 @@ class ServeEngine:
             "prefill_tokens_computed": sched.stats["prefill_lane_tokens"],
             "prefix_hit_tokens": sched.stats["prefix_hit_tokens"],
             "preemptions": sched.stats["preemptions"],
+            # speculative decoding instrumentation: decode_tokens are
+            # the tokens decode chunks emitted, decode lane-steps the
+            # times a sequence occupied a decode lane — their ratio is
+            # per-sequence steps per token, exactly 1.0 without
+            # speculation and < 1.0 when accepted drafts advance a
+            # sequence several tokens per dispatched step
+            "spec_tokens": self.spec_tokens,
+            "spec_drafted_tokens": sched.stats["spec_drafted_tokens"],
+            "spec_accepted_tokens": sched.stats["spec_accepted_tokens"],
+            "spec_acceptance": (
+                sched.stats["spec_accepted_tokens"]
+                / sched.stats["spec_drafted_tokens"]
+                if sched.stats["spec_drafted_tokens"] else 0.0),
+            "decode_tokens": int(sum(decode_widths)),
+            "steps_per_decode_token": (
+                sched.stats["decode_lane_tokens"] / sum(decode_widths)
+                if decode_widths else 0.0),
             "page_util_mean": float(np.mean(util)) if util else 0.0,
             "page_util_max": float(np.max(util)) if util else 0.0,
             "cache": dict(cache.stats),   # engine-lifetime counters
         }
         return [list(r.out_tokens) for r in reqs]
 
-    def _run_chunked(self, sched, cache, kp, vp, emit, decode_times,
-                     decode_widths, prefill_times, util):
+    def _run_chunked(self, sched, cache, kp, vp, emit, emit_spec,
+                     decode_times, decode_widths, prefill_times, util):
         """The mixed-step loop: every iteration packs this step's
-        chunks into the fixed `mixed_width` lanes and runs ONE program."""
+        chunks into the fixed `mixed_width` lanes and runs ONE program.
+        Draft lanes pack right after their chunk's context lanes, so a
+        speculative decode chunk occupies 1 + k CONSECUTIVE lanes —
+        each lane's K/V scatters before any lane attends (the mixed
+        step's contract), which is exactly what makes lane j's logits
+        the true next-token distribution given the drafts before it."""
         c = self.cache_cfg
         t_w = self.mixed_width
         ps = c.page_size
@@ -599,6 +740,7 @@ class ServeEngine:
             lane_lens = np.ones((t_w,), np.int32)      # NaN-free padding
             lane = 0
             emitters: List[Tuple[ChunkPlan, int]] = []
+            spec_emitters: List[Tuple[ChunkPlan, int]] = []
             for ch in plan.chunks:
                 ctx = ch.req.context
                 row = cache.page_tables[ch.req.slot]
@@ -610,7 +752,18 @@ class ServeEngine:
                     lane_slots[lane] = ch.req.slot
                     lane_lens[lane] = pos + 1
                     lane += 1
-                if ch.emits:
+                if ch.draft_tokens:
+                    spec_emitters.append((ch, lane - 1))
+                    for j, d in enumerate(ch.draft_tokens):
+                        pos = ch.end + j
+                        tokens[lane] = d
+                        positions[lane] = pos
+                        write_pages[lane] = row[pos // ps]
+                        write_offs[lane] = pos % ps
+                        lane_slots[lane] = ch.req.slot
+                        lane_lens[lane] = pos + 1
+                        lane += 1
+                elif ch.emits:
                     emitters.append((ch, lane - 1))
             assert lane <= t_w, (
                 f"scheduler packed {lane} lanes into a {t_w}-lane step")
@@ -625,18 +778,29 @@ class ServeEngine:
             topv = np.asarray(topv)
             topi = np.asarray(topi)
             dt = time.perf_counter() - tp
-            if plan.num_decode_lanes:
-                decode_times.append(dt)
-                decode_widths.append(plan.num_decode_lanes)
-            if plan.num_prefill_lanes:
-                prefill_times.append((plan.num_prefill_lanes, dt))
             util.append(1.0 - cache.free_pages / c.usable_pages)
             # bookkeeping FIRST (page commits hash the context as it
-            # was when the chunk ran), emission second
+            # was when the chunk ran), emission second; speculative
+            # chunks verify LAST — their residency bookkeeping is a
+            # function of the tokens they emit
             for ch in plan.chunks:
-                sched.complete_chunk(ch)
+                if not ch.draft_tokens:
+                    sched.complete_chunk(ch)
+            dec_tokens = 0
             for ch, ln in emitters:
                 emit(ch, greedy[ln], topv[ln], topi[ln])
+                if ch.is_decode:
+                    dec_tokens += 1
+            for ch, ln in spec_emitters:
+                dec_tokens += emit_spec(ch, ln, greedy, topv, topi)
+            if plan.num_decode_lanes:
+                decode_times.append(dt)
+                # width = tokens this step's decode chunks EMITTED
+                # (speculation makes it exceed the decode-lane count),
+                # the denominator of per-token decode latency
+                decode_widths.append(dec_tokens)
+            if plan.num_prefill_lanes:
+                prefill_times.append((plan.num_prefill_lanes, dt))
         return kp, vp
 
     def _run_legacy(self, sched, cache, kp, vp, emit, decode_times,

@@ -158,7 +158,7 @@ class PagedKVCache:
         # serving metrics, merged into ServeEngine.last_stats
         self.stats = {"prefix_hit_pages": 0, "prefix_evictions": 0,
                       "pages_committed": 0, "shared_attaches": 0,
-                      "max_page_refs": 0}
+                      "max_page_refs": 0, "rollback_pages": 0}
 
     # ---------------- capacity queries (scheduler admission) ----------
     @property
@@ -317,6 +317,66 @@ class PagedKVCache:
         self.seq_lens[slot] = pos + 1
         return pos
 
+    def rollback(self, slot: int, new_len: int) -> int:
+        """Rewind the slot to `new_len` resident tokens and unmap every
+        page wholly past the new boundary. Returns the pages released.
+
+        This is the speculative-decoding undo: rejected draft tokens
+        have already scattered K/V into pages the scheduler mapped
+        ahead (ensure_capacity), and once verification truncates the
+        sequence those tail pages hold garbage. Positions inside the
+        kept pages need no cleanup — reads are masked by seq_lens and
+        the slots are overwritten when the sequence actually reaches
+        them — but whole pages past `pages_for(new_len)` must leave
+        the table so the pool's accounting stays exact.
+
+        A released page is NEVER parked in the prefix LRU, and any
+        hash it carries is dropped when its refcount reaches 0: its
+        content is no longer vouched for by a resident sequence, so a
+        post-rollback tail page must not be prefix-matchable (the
+        check_invariants hashed-page-coverage rule). In the engine's
+        flow these pages are always fresh refcount-1 unhashed
+        allocations — commit_page only ever registers fully VERIFIED
+        pages — but the method is defensive about shared/hashed ones
+        so direct users cannot corrupt the registry."""
+        if new_len < 0:
+            raise ValueError(f"rollback to negative length {new_len}")
+        ps = self.cfg.page_size
+        if new_len < int(self.seq_lens[slot]):
+            self.seq_lens[slot] = new_len
+        released = 0
+        for i in range(self.pages_for(new_len), self.cfg.pages_per_seq):
+            p = int(self.page_tables[slot, i])
+            if p == 0:
+                break  # tables are contiguous prefixes
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._unregister(p)
+                self._free.append(p)
+            elif not self._vouched(p):
+                self._unregister(p)   # surviving owners rolled back too
+            self.page_tables[slot, i] = 0
+            released += 1
+        # the boundary page stays mapped when new_len cuts into it, but
+        # a hash on it now overclaims (the registry key vouches for the
+        # FULL page) — drop it unless another sequence still covers it
+        if new_len % ps:
+            p = int(self.page_tables[slot, new_len // ps])
+            if p != 0 and p in self._hash_of_page and not self._vouched(p):
+                self._unregister(p)
+        self.stats["rollback_pages"] += released
+        return released
+
+    def _vouched(self, page: int) -> bool:
+        """True when some slot's RESIDENT (seq_lens-covered) full pages
+        include `page` — the condition for its content hash to stay in
+        the registry (check_invariants' hashed-page coverage rule)."""
+        for s in range(self.cfg.max_seqs):
+            full = int(self.seq_lens[s]) // self.cfg.page_size
+            if page in (int(p) for p in self.page_tables[s, :full]):
+                return True
+        return False
+
     def free_slot(self, slot: int) -> None:
         """Release the slot: every mapped page's refcount drops; pages
         reaching 0 go back to the free list — or, if content-hashed, to
@@ -392,6 +452,21 @@ class PagedKVCache:
         for page, key in self._hash_of_page.items():
             assert self._page_of_hash.get(key) == page, (
                 f"hash registry maps page {page} inconsistently")
+        # a hashed (prefix-matchable) page must be VOUCHED for: either
+        # parked in the LRU (its last owner completed it before
+        # freeing) or fully covered by some slot's resident length. A
+        # mapped page past any coverage — a speculative tail, or a
+        # rolled-back region — holds unverified K/V and being matchable
+        # would hand garbage to a future prompt (the rollback contract).
+        covered_pages = set()
+        for s in range(c.max_seqs):
+            full = int(self.seq_lens[s]) // c.page_size
+            covered_pages.update(int(p) for p in self.page_tables[s, :full])
+        for page in self._hash_of_page:
+            assert page in self._lru or page in covered_pages, (
+                f"hashed page {page} is neither parked nor fully "
+                f"covered by a resident sequence (rolled-back or "
+                f"speculative pages must not be prefix-matchable)")
         if not self.prefix_enabled:
             assert not self._hash_of_page and not self._lru, (
                 "prefix cache disabled but registry non-empty")

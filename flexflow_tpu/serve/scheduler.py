@@ -49,6 +49,7 @@ from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence
 
 from .kv_cache import PagedKVCache, prefix_page_keys
+from .speculative import DraftControl, Drafter, PromptLookupDrafter
 
 
 class RequestState(enum.Enum):
@@ -87,6 +88,9 @@ class Request:
     # tokens whose K/V is resident (prefix-cache hits + computed chunks)
     num_computed: int = 0
     preemptions: int = 0
+    # adaptive draft-length state (speculative decoding); None when the
+    # request is ineligible (non-deterministic sampling) or spec is off
+    spec: Optional[DraftControl] = None
     _page_keys: List[bytes] = dataclasses.field(default_factory=list,
                                                 repr=False)
     # serving metrics (utils/profiling.serve_report): wall-clock stamps
@@ -125,6 +129,12 @@ class ChunkPlan:
     start: int
     end: int
     is_decode: bool   # an actively-generating request's 1-token chunk
+    # speculative continuation: drafted tokens for positions
+    # [end, end + len(draft_tokens)) packed as extra lanes AFTER the
+    # context lanes. Their K/V scatters like any lane's, but nothing is
+    # resident until verification accepts a prefix (complete_spec_chunk)
+    # and the remainder rolls back. Only decode chunks draft.
+    draft_tokens: List[int] = dataclasses.field(default_factory=list)
 
     @property
     def emits(self) -> bool:
@@ -160,7 +170,9 @@ class ContinuousBatchingScheduler:
     def __init__(self, cache: PagedKVCache,
                  prefill_token_budget: int = 512,
                  chunked_prefill: bool = True,
-                 admit_watermark: float = 0.02):
+                 admit_watermark: float = 0.02,
+                 spec_tokens: int = 0,
+                 drafter: Optional[Drafter] = None):
         self.cache = cache
         self.prefill_token_budget = int(prefill_token_budget)
         self.chunked_prefill = bool(chunked_prefill)
@@ -168,6 +180,11 @@ class ContinuousBatchingScheduler:
         # program recomputes and RE-SCATTERS every prompt position, which
         # would clobber shared pages other sequences are reading
         self.prefix_cache = cache.prefix_enabled and self.chunked_prefill
+        # speculative decoding also needs the mixed program: the legacy
+        # decode step has exactly one lane per slot, nowhere to verify
+        self.spec_tokens = int(spec_tokens) if self.chunked_prefill else 0
+        self.drafter = drafter if drafter is not None \
+            else (PromptLookupDrafter() if self.spec_tokens > 0 else None)
         self.watermark_pages = int(admit_watermark
                                    * cache.cfg.usable_pages)
         self.waiting: Deque[Request] = deque()
@@ -175,7 +192,8 @@ class ContinuousBatchingScheduler:
         self._next_rid = 0
         self.stats = {"prefix_hit_tokens": 0, "prompt_tokens": 0,
                       "prefill_lane_tokens": 0, "decode_lane_tokens": 0,
-                      "preemptions": 0}
+                      "preemptions": 0, "spec_drafted_tokens": 0,
+                      "spec_accepted_tokens": 0}
 
     # ---------------- submission --------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
@@ -195,6 +213,11 @@ class ContinuousBatchingScheduler:
         req = Request(rid=self._next_rid, prompt=list(prompt),
                       max_new_tokens=int(max_new_tokens),
                       eos_token=eos_token, sample=sample)
+        # speculation needs a deterministic per-lane pick to verify
+        # against: greedy, or top_k=1 sampling (the already-drawn sample
+        # is always the top-1 logit). Other sampling decodes with k=0.
+        if self.spec_tokens > 0 and (sample is None or sample.top_k == 1):
+            req.spec = DraftControl(self.spec_tokens)
         self._next_rid += 1
         self.waiting.append(req)
         self.stats["prompt_tokens"] += len(prompt)
@@ -267,7 +290,29 @@ class ContinuousBatchingScheduler:
                 preempted.append(victim)
                 continue               # retry req (unless req WAS victim)
             cache.ensure_capacity(req.slot, end)
-            chunks.append(ChunkPlan(req, req.num_computed, end, is_decode))
+            draft: List[int] = []
+            if is_decode and req.spec is not None and budget > 0:
+                # drafts ride in PREFILL-budget lanes (the decode lane
+                # itself is from the guaranteed max_seqs reserve, so
+                # decode never starves) and draw pages like any growth —
+                # but they only SHRINK under pressure, never preempt: a
+                # draft is an optimization, not owed work. Capped so the
+                # step cannot emit past max_new_tokens (each accepted
+                # draft plus the bonus token is one emission).
+                k = min(req.spec.next_k(), budget,
+                        req.max_new_tokens - len(req.out_tokens) - 1,
+                        cache.mapped_tokens(req.slot)
+                        + cache.free_pages * ps - end)
+                if k > 0:
+                    # clamp: the budget/page/length math above assumed
+                    # at most k, and a plugged-in drafter's contract is
+                    # "UP TO k" — never trust it with the allocator
+                    draft = list(self.drafter.draft(req.context, k))[:k]
+                if draft:
+                    cache.ensure_capacity(req.slot, end + len(draft))
+                    budget -= len(draft)
+            chunks.append(ChunkPlan(req, req.num_computed, end, is_decode,
+                                    draft_tokens=draft))
             note_pending(req, req.num_computed, end)
             if not is_decode:
                 budget -= end - req.num_computed
@@ -360,6 +405,9 @@ class ContinuousBatchingScheduler:
         registered in the prefix cache (full pages only — the tail is
         still being written). The engine emits the chunk's token (if
         `chunk.emits`) after this call."""
+        assert not chunk.draft_tokens, (
+            "speculative chunks complete via complete_spec_chunk "
+            "(their residency depends on verification)")
         req = chunk.req
         self.cache.advance(req.slot, chunk.end)
         req.num_computed = chunk.end
@@ -367,6 +415,35 @@ class ContinuousBatchingScheduler:
             ps = self.cache.cfg.page_size
             keys = self._keys_for(req, chunk.end // ps)
             for idx in range(chunk.start // ps, chunk.end // ps):
+                self.cache.commit_page(req.slot, idx, keys[idx])
+
+    def complete_spec_chunk(self, chunk: ChunkPlan, accepted: int) -> None:
+        """Bookkeeping after the engine VERIFIED a speculative decode
+        chunk: the chunk's context token plus the `accepted`-token
+        prefix of its drafts are resident (their K/V was computed with
+        exactly the tokens the model emitted, so it is bit-identical to
+        what sequential decode would have written); everything past
+        them — rejected drafts and the pages mapped ahead for them —
+        rolls back. Must be called AFTER the engine appended the
+        emitted tokens to the request (prefix keys hash the context,
+        which now covers every verified position); only fully-verified
+        pages are committed, so a rolled-back page can never enter the
+        registry."""
+        assert chunk.is_decode, "only decode chunks speculate"
+        assert 0 <= accepted <= len(chunk.draft_tokens)
+        req = chunk.req
+        verified = chunk.end + accepted
+        self.cache.advance(req.slot, verified)
+        self.cache.rollback(req.slot, verified)
+        req.num_computed = verified
+        self.stats["spec_drafted_tokens"] += len(chunk.draft_tokens)
+        self.stats["spec_accepted_tokens"] += accepted
+        if req.spec is not None:
+            req.spec.record(len(chunk.draft_tokens), accepted)
+        if self.prefix_cache:
+            ps = self.cache.cfg.page_size
+            keys = self._keys_for(req, verified // ps)
+            for idx in range(chunk.start // ps, verified // ps):
                 self.cache.commit_page(req.slot, idx, keys[idx])
 
     def finish(self, req: Request) -> None:

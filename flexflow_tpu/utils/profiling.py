@@ -109,6 +109,18 @@ def serve_report(stats: dict) -> str:
         lines.append(
             f"prefill: computed {comp} of {pt} prompt tokens "
             f"({hit} prefix-cache hits, {red:.2f}x reduction)")
+    # speculative decoding: drafted/accepted and the per-sequence
+    # steps-per-token (1.0 = sequential decode; lower = accepted
+    # drafts advanced sequences several tokens per dispatched step)
+    drafted = stats.get("spec_drafted_tokens")
+    if drafted is not None and stats.get("spec_tokens", 0) > 0:
+        acc = stats.get("spec_accepted_tokens", 0)
+        rate = stats.get("spec_acceptance", 0.0)
+        spt = stats.get("steps_per_decode_token", 0.0)
+        lines.append(
+            f"speculation: drafted {drafted}, accepted {acc} "
+            f"({rate:.1%} acceptance), "
+            f"{spt:.2f} steps/token")
     if "preemptions" in stats or "page_util_mean" in stats:
         lines.append(
             f"pages: utilization mean={stats.get('page_util_mean', 0.0):.1%}"
@@ -122,7 +134,8 @@ def serve_report(stats: dict) -> str:
             f"{cache.get('pages_committed', 0)} committed, "
             f"{cache.get('shared_attaches', 0)} shared attaches "
             f"(max refs {cache.get('max_page_refs', 0)}), "
-            f"{cache.get('prefix_evictions', 0)} evictions")
+            f"{cache.get('prefix_evictions', 0)} evictions, "
+            f"{cache.get('rollback_pages', 0)} rolled-back pages")
     cc = stats.get("compile_counts")
     if cc:
         progs = " ".join(f"{k}={v}" for k, v in cc.items() if v)

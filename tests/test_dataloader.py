@@ -1,0 +1,49 @@
+"""Pure-Python DataLoaderSet prefetch: the background-thread
+double-buffered epoch iterator must be a pure overlap optimization.
+
+Lives in its own (fast-profile) module: test_data_checkpoint.py is a
+SLOW_MODULES member (orbax round trips), and the prefetch path needs
+coverage in the default CI gate — a threading bug there would corrupt
+every pure-Python training run.
+"""
+
+import numpy as np
+
+from flexflow_tpu.core.dataloader import DataLoaderSet
+
+
+def test_dataloader_prefetch_epochs_order_identical():
+    """Every epoch's batch ORDER and CONTENT equal the synchronous
+    (prefetch=False escape hatch) path's, across multiple shuffled
+    epochs, including iterators abandoned early."""
+    rng = np.random.RandomState(4)
+    x = rng.randn(54, 3).astype(np.float32)   # 54/16: a ragged tail
+    y = np.arange(54).astype(np.int32)
+    pre = DataLoaderSet({"input": x, "label": y}, batch_size=16,
+                        shuffle=True, seed=9, use_native=False)
+    syn = DataLoaderSet({"input": x, "label": y}, batch_size=16,
+                        shuffle=True, seed=9, use_native=False,
+                        prefetch=False)
+    assert pre.prefetch and not syn.prefetch
+    for _ in range(3):
+        got_pre = list(pre)
+        got_syn = list(syn)
+        assert len(got_pre) == len(got_syn) == pre.num_batches
+        for a, b in zip(got_pre, got_syn):
+            np.testing.assert_array_equal(np.asarray(a["input"]),
+                                          np.asarray(b["input"]))
+            np.testing.assert_array_equal(np.asarray(a["label"]),
+                                          np.asarray(b["label"]))
+    # an abandoned iterator must not wedge the worker or later epochs
+    it = iter(pre)
+    next(it)
+    del it
+    assert len(list(pre)) == pre.num_batches
+    # explicit-order epochs (the fit() path) agree too
+    order = np.random.RandomState(11).permutation(54)
+    a = [np.asarray(b["label"])
+         for b in pre.iter_with_order(order)]
+    b = [np.asarray(bb["label"])
+         for bb in syn.iter_with_order(order)]
+    for xa, xb in zip(a, b):
+        np.testing.assert_array_equal(xa, xb)

@@ -244,6 +244,96 @@ def test_kv_pool_stress_property():
     assert cache.stats["prefix_evictions"] >= 0  # counter sane
 
 
+def test_kv_pool_stress_with_rollback():
+    """The stress property test with SPECULATION in the traffic:
+    random drafts ride on decode chunks, a simulated verifier accepts
+    random prefixes (so complete_spec_chunk advances + rolls back every
+    step), and gratuitous ensure_capacity/rollback pairs are
+    interleaved — refcount partition, hash bijection and the
+    hashed-page coverage rule (no rolled-back page is
+    prefix-matchable) must hold at every quiescent point."""
+    from flexflow_tpu.serve import Drafter
+
+    rng = np.random.RandomState(23)
+
+    class RandomDrafter(Drafter):
+        def draft(self, tokens, k):
+            n = int(rng.randint(0, k + 1))
+            return [int(t) for t in rng.randint(0, 9, size=n)]
+
+    cfg = KVCacheConfig(num_layers=1, num_heads=2, head_dim=4,
+                        page_size=4, num_pages=17, max_seqs=4,
+                        max_seq_len=40)
+    cache = PagedKVCache(cfg)
+    sched = ContinuousBatchingScheduler(cache, prefill_token_budget=16,
+                                        spec_tokens=3,
+                                        drafter=RandomDrafter())
+    prefixes = [list(rng.randint(0, 9, size=12)) for _ in range(3)]
+    reqs = []
+    steps = 0
+    while sched.has_work() or len(reqs) < 40:
+        steps += 1
+        assert steps < 5000, "stress driver wedged"
+        if len(reqs) < 40 and rng.rand() < 0.4:
+            pre = prefixes[rng.randint(len(prefixes))]
+            prompt = pre + list(rng.randint(0, 9,
+                                            size=rng.randint(1, 8)))
+            reqs.append(sched.submit(prompt, int(rng.randint(1, 14))))
+        if not sched.has_work():
+            continue
+        plan = sched.schedule()
+        assert plan.chunks
+        for ch in plan.chunks:
+            if not ch.draft_tokens:
+                sched.complete_chunk(ch)
+        for ch in plan.chunks:
+            if ch.draft_tokens:
+                # simulated verification: the engine's emit_spec rules
+                req, k = ch.req, len(ch.draft_tokens)
+                matched = 0
+                for j in range(k + 1):
+                    if j < k and rng.rand() < 0.6:
+                        tok = ch.draft_tokens[j]
+                    else:
+                        tok = int(rng.randint(0, 9))
+                    req.out_tokens.append(tok)
+                    ok = j < k and tok == ch.draft_tokens[j]
+                    if ok:
+                        matched += 1
+                    if req.is_done() or not ok:
+                        break
+                sched.complete_spec_chunk(ch, matched)
+                if req.is_done():
+                    sched.finish(req)
+            elif ch.emits:
+                ch.req.out_tokens.append(int(rng.randint(0, 9)))
+                if ch.req.is_done():
+                    sched.finish(ch.req)
+        # gratuitous speculative mapping rolled straight back: a
+        # no-op for residency, never for the allocator's books
+        if sched.running and rng.rand() < 0.3:
+            req = list(sched.running.values())[
+                rng.randint(len(sched.running))]
+            cur = int(cache.seq_lens[req.slot])
+            if cur > 0:
+                room = cfg.pages_per_seq * cfg.page_size
+                ahead = min(cur + int(rng.randint(1, 6)), room)
+                if cache.pages_to_extend(req.slot, ahead) \
+                        <= len(cache._free) + len(cache._lru):
+                    cache.ensure_capacity(req.slot, ahead)
+                    cache.rollback(req.slot, max(cur, req.num_computed))
+        cache.check_invariants()
+    assert all(len(r.out_tokens) >= r.max_new_tokens
+               or (r.eos_token is not None) for r in reqs)
+    assert cache.free_pages == cfg.usable_pages
+    assert cache.free_slots == cfg.max_seqs
+    assert sched.stats["spec_drafted_tokens"] > 0
+    assert sched.stats["spec_accepted_tokens"] > 0
+    assert cache.stats["rollback_pages"] > 0
+    assert sched.stats["preemptions"] > 0
+    assert sched.stats["prefix_hit_tokens"] > 0
+
+
 def test_scheduler_many_slots_fast_partition():
     """Satellite regression for the O(n^2) membership scan: with many
     slots the prefill/decode partition must stay correct (sets, not
@@ -400,6 +490,34 @@ def test_legacy_path_exact(lm):
     out = eng.generate(prompts, max_new)
     assert eng.compile_counts() == before
     assert out == eng.generate_reference(prompts, max_new)
+
+
+def test_unaligned_max_seq_len_reference_not_nan_poisoned():
+    """Regression: with max_seq_len NOT page-aligned (40 over 16-token
+    pages) the bucket ladder used to round up past the learned
+    positions (48 > 40), and jnp.take's "fill" OOB default made the
+    padded position rows NaN — which poisoned every attended lane
+    through 0 * NaN in the p.v product, so generate_reference emitted
+    argmax-of-all-NaN (token 0) while the paged engine was right.
+    Buckets now cap at max_seq_len and embeds clip, so decoding right
+    up to the cap stays exact."""
+    from flexflow_tpu.models.transformer import build_transformer_lm
+    cfg = FFConfig(batch_size=1, kv_page_size=16, kv_num_pages=25,
+                   serve_max_seqs=2, serve_prefill_budget=16)
+    ff = build_transformer_lm(cfg, vocab_size=61, max_seq_len=40,
+                              hidden=32, num_heads=4, num_layers=2,
+                              ff_dim=64)
+    from flexflow_tpu.serve import ServeEngine
+    eng = ServeEngine(ff)
+    assert eng.buckets[-1] == 40
+    eng.warmup()
+    rng = np.random.RandomState(31)
+    prompts = [list(rng.randint(1, 61, size=16)),
+               list(rng.randint(1, 61, size=7))]
+    out = eng.generate(prompts, [24, 33])   # both reach the 40 cap
+    ref = eng.generate_reference(prompts, [24, 33])
+    assert out == ref
+    assert [len(o) for o in out] == [24, 33]  # ran to the cap, no eos
 
 
 # --------------------------------------------------------- sampling

@@ -42,7 +42,7 @@ echo "--- 1d. serve-bench smoke (zero recompiles + prefix-cache gate)"
 # fails if serving compiles anything after warmup, if prefix-cached
 # outputs diverge from generate_reference, or if the shared-prefix
 # workload's prefill-token reduction is < 2x (tools/serve_bench.py)
-env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke \
+env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload base \
     -o /tmp/ci_bench_serve.json || fail=1
 
 echo "--- 1e. mixed-precision smoke (bf16 makespan + parity gate)"
@@ -52,6 +52,14 @@ echo "--- 1e. mixed-precision smoke (bf16 makespan + parity gate)"
 # fingerprint fails to separate precision policies (tools/mp_bench.py)
 env JAX_PLATFORMS=cpu python tools/mp_bench.py --smoke \
     -o /tmp/ci_bench_mp.json || fail=1
+
+echo "--- 1f. speculative-decode smoke (step-reduction + exactness gate)"
+# fails if the repetitive-text workload's decode-step reduction is
+# < 1.5x, if speculative (or baseline) outputs diverge from
+# generate_reference, or if anything compiles after warmup
+# (tools/serve_bench.py --workload spec)
+env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload spec \
+    -o /tmp/ci_bench_serve_spec.json || fail=1
 
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"

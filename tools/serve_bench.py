@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Offline serving throughput microbench (flexflow_tpu.serve).
 
-Two workloads through ServeEngine under continuous batching:
+Three workloads through ServeEngine under continuous batching:
 
   * random   — synthetic ragged prompts; reports aggregate tokens/sec
     plus p50/p99 per-token decode latency (the PR 1 headline numbers).
@@ -11,15 +11,25 @@ Two workloads through ServeEngine under continuous batching:
     prefill as the prefill-token reduction (prompt tokens submitted /
     prefill tokens actually computed), with outputs asserted identical
     to the no-cache greedy reference.
+  * repetitive-decode — speculative decoding's target regime: an LM
+    whose greedy continuation is highly repetitive (built from the
+    bench model by an "echo" weight surgery, see _make_echo_lm — the
+    constructed analog of the shared-prefix workload's constructed
+    sharing). Measures serve_decode_step_reduction: decode steps the
+    non-speculative engine dispatches / decode steps the speculative
+    engine dispatches for the SAME (asserted token-identical) outputs.
+
+Select with --workload {all,base,spec} (base = the first two).
 
 Emits one BENCH-convention JSON line per workload ({"metric", "value",
 "unit", "extra"}) to stdout and (by default) BENCH_serve.json next to
 the other BENCH_*.json artifacts.
 
-`--smoke` is the CI gate (tools/ci.sh step 1d): a small model, hard
-asserts on (a) ZERO recompiles after warmup, (b) prefix-cache exactness
-vs generate_reference, (c) >= 2x prefill-token reduction on the
-shared-prefix workload.
+`--smoke` is the CI gate (tools/ci.sh steps 1d/1f): a small model,
+hard asserts on (a) ZERO recompiles after warmup, (b) exactness vs
+generate_reference, (c) >= 2x prefill-token reduction on the
+shared-prefix workload (step 1d, --workload base), (d) >= 1.5x decode
+step reduction on the repetitive workload (step 1f, --workload spec).
 
 Runs anywhere: on CPU hosts the serve path uses the jnp gather
 fallback of the paged-attention kernels (force it with --cpu), on TPU
@@ -27,7 +37,8 @@ the Pallas kernels. Usage:
 
     python tools/serve_bench.py                       # defaults
     python tools/serve_bench.py --requests 32 --max-new 64 --cpu
-    python tools/serve_bench.py --smoke               # the CI gate
+    python tools/serve_bench.py --smoke               # the CI gates
+    python tools/serve_bench.py --smoke --workload spec   # 1f only
 """
 
 from __future__ import annotations
@@ -41,13 +52,54 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _make_echo_lm(cfg, args):
+    """A copy of the bench LM surgically rewired so greedy decode
+    echoes the trailing token: attention/FFN residual writers zeroed
+    (the stream is exactly tok+pos embeddings), position embeddings
+    damped, and the head tied to the token embeddings — near-orthogonal
+    random embeddings make each token its own argmax. Its continuation
+    is the maximally repetitive text prompt-lookup drafting targets,
+    giving the decode-step-reduction gate a DETERMINISTIC workload
+    instead of hoping a random LM's greedy stream falls into a cycle
+    (the same constructed-favorable-case trick as the shared-prefix
+    workload)."""
+    import jax.numpy as jnp
+    from flexflow_tpu.config import CompMode
+    from flexflow_tpu.models.transformer import build_transformer_lm
+    ff = build_transformer_lm(
+        cfg, vocab_size=args.vocab, max_seq_len=args.max_seq_len,
+        hidden=args.hidden, num_heads=args.heads, num_layers=args.layers,
+        ff_dim=4 * args.hidden)
+    ff.compile(comp_mode=CompMode.INFERENCE)
+    p = ff.state.params
+    for i in range(args.layers):
+        attn = p[f"layer{i}_attn"]
+        attn["wo"] = jnp.zeros_like(attn["wo"])
+        if "bo" in attn:
+            attn["bo"] = jnp.zeros_like(attn["bo"])
+        ff2 = p[f"layer{i}_ff2"]
+        ff2["kernel"] = jnp.zeros_like(ff2["kernel"])
+        if "bias" in ff2:
+            ff2["bias"] = jnp.zeros_like(ff2["bias"])
+    p["pos_embed"]["kernel"] = p["pos_embed"]["kernel"] * 0.15
+    p["lm_head"]["kernel"] = 4.0 * p["tok_embed"]["kernel"].T
+    if "bias" in p["lm_head"]:
+        p["lm_head"]["bias"] = jnp.zeros_like(p["lm_head"]["bias"])
+    return ff
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true",
                     help="force JAX_PLATFORMS=cpu before importing jax")
     ap.add_argument("--smoke", action="store_true",
                     help="small CI gate: assert zero recompiles, "
-                    "prefix exactness, and >= 2x prefill reduction")
+                    "exactness, >= 2x prefill reduction (base) and "
+                    ">= 1.5x decode step reduction (spec)")
+    ap.add_argument("--workload", choices=("all", "base", "spec"),
+                    default="all",
+                    help="base = random + shared-prefix (ci.sh 1d), "
+                    "spec = repetitive speculative decode (ci.sh 1f)")
     ap.add_argument("--requests", type=int, default=16)
     ap.add_argument("--max-new", type=int, default=32)
     ap.add_argument("--vocab", type=int, default=512)
@@ -98,7 +150,6 @@ def main() -> int:
         cfg, vocab_size=args.vocab, max_seq_len=args.max_seq_len,
         hidden=args.hidden, num_heads=args.heads, num_layers=args.layers,
         ff_dim=4 * args.hidden)
-    eng = ServeEngine(ff)
 
     rng = np.random.RandomState(args.seed)
     max_prompt = args.max_seq_len - args.max_new
@@ -106,116 +157,208 @@ def main() -> int:
         ap.error(f"--max-seq-len ({args.max_seq_len}) must exceed "
                  f"--max-new ({args.max_new}) by at least 8 to leave "
                  f"room for prompts")
-
-    t0 = time.perf_counter()
-    counts = eng.warmup()
-    warm_s = time.perf_counter() - t0
     records = []
+    gates = []
 
-    # ---- workload 1: random ragged prompts (throughput) --------------
-    prompts = [list(rng.randint(1, args.vocab,
-                                size=rng.randint(4, max_prompt + 1)))
-               for _ in range(args.requests)]
-    t0 = time.perf_counter()
-    out = eng.generate(prompts, args.max_new)
-    wall = time.perf_counter() - t0
-    stats = eng.last_stats
-    print(serve_report(stats), file=sys.stderr)
-    assert all(len(o) > 0 for o in out)
+    if args.workload in ("all", "base"):
+        eng = ServeEngine(ff)
+        t0 = time.perf_counter()
+        counts = eng.warmup()
+        warm_s = time.perf_counter() - t0
 
-    pct = serve_percentiles(stats)
-    records.append({
-        "metric": "serve_decode_tokens_per_sec",
-        "value": round(stats["tokens_per_sec"], 2),
-        "unit": "tokens/s",
-        "extra": {
-            "platform": jax.default_backend(),
-            "requests": args.requests,
-            "max_new_tokens": args.max_new,
-            "total_new_tokens": stats["total_new_tokens"],
-            "decode_steps": stats["decode_steps"],
-            "mean_decode_width": round(
-                float(np.mean(stats["decode_widths"]))
-                if stats["decode_widths"] else 0.0, 2),
-            "per_token_latency_ms_p50": round(pct[50] * 1e3, 4),
-            "per_token_latency_ms_p99": round(pct[99] * 1e3, 4),
-            "preemptions": stats["preemptions"],
-            "page_util_max": round(stats["page_util_max"], 4),
-            "warmup_s": round(warm_s, 2),
-            "wall_s": round(wall, 2),
-            "compile_counts": stats["compile_counts"],
-            "model": {"vocab": args.vocab, "hidden": args.hidden,
-                      "layers": args.layers, "heads": args.heads,
-                      "max_seq_len": args.max_seq_len,
-                      "page_size": args.page_size,
-                      "max_seqs": args.max_seqs},
-        },
-    })
+        # ---- workload 1: random ragged prompts (throughput) ----------
+        prompts = [list(rng.randint(1, args.vocab,
+                                    size=rng.randint(4, max_prompt + 1)))
+                   for _ in range(args.requests)]
+        t0 = time.perf_counter()
+        out = eng.generate(prompts, args.max_new)
+        wall = time.perf_counter() - t0
+        stats = eng.last_stats
+        print(serve_report(stats), file=sys.stderr)
+        assert all(len(o) > 0 for o in out)
 
-    # ---- workload 2: shared prefix (the prefix-cache win) ------------
-    # a FRESH engine so workload 1's committed pages cannot inflate the
-    # hit rate: every hit below comes from sharing inside this workload
-    eng2 = ServeEngine(ff)
-    eng2.warmup()
-    prefix_len = args.prefix_len or max_prompt // 2
-    tail = max(4, args.page_size // 2)
-    prefix = list(rng.randint(1, args.vocab, size=prefix_len))
-    sprompts = [prefix + list(rng.randint(1, args.vocab, size=tail))
-                for _ in range(args.requests)]
-    before = eng2.compile_counts()
-    t0 = time.perf_counter()
-    sout = eng2.generate(sprompts, args.max_new)
-    swall = time.perf_counter() - t0
-    sstats = eng2.last_stats
-    print(serve_report(sstats), file=sys.stderr)
-    computed = sstats["prefill_tokens_computed"]
-    submitted = sstats["prompt_tokens_total"]
-    reduction = submitted / computed if computed else float("inf")
+        pct = serve_percentiles(stats)
+        records.append({
+            "metric": "serve_decode_tokens_per_sec",
+            "value": round(stats["tokens_per_sec"], 2),
+            "unit": "tokens/s",
+            "extra": {
+                "platform": jax.default_backend(),
+                "requests": args.requests,
+                "max_new_tokens": args.max_new,
+                "total_new_tokens": stats["total_new_tokens"],
+                "decode_steps": stats["decode_steps"],
+                "mean_decode_width": round(
+                    float(np.mean(stats["decode_widths"]))
+                    if stats["decode_widths"] else 0.0, 2),
+                "per_token_latency_ms_p50": round(pct[50] * 1e3, 4),
+                "per_token_latency_ms_p99": round(pct[99] * 1e3, 4),
+                "preemptions": stats["preemptions"],
+                "page_util_max": round(stats["page_util_max"], 4),
+                "spec_acceptance": round(stats["spec_acceptance"], 4),
+                "warmup_s": round(warm_s, 2),
+                "wall_s": round(wall, 2),
+                "compile_counts": stats["compile_counts"],
+                "model": {"vocab": args.vocab, "hidden": args.hidden,
+                          "layers": args.layers, "heads": args.heads,
+                          "max_seq_len": args.max_seq_len,
+                          "page_size": args.page_size,
+                          "max_seqs": args.max_seqs},
+            },
+        })
 
-    # the serving CORRECTNESS contracts hold on every run: no program
-    # compiled after warmup, and the prefix-cached outputs are exactly
-    # the no-cache greedy reference
-    assert eng2.compile_counts() == before, (
-        f"serving recompiled: {before} -> {eng2.compile_counts()}")
-    ref = eng2.generate_reference(sprompts, args.max_new)
-    assert sout == ref, "prefix-cached outputs diverged from reference"
-    # the >= 2x reduction is a property of the DEFAULT shared-prefix
-    # shapes, so it hard-gates only under --smoke (CI); a custom
-    # --prefix-len/--requests sweep should report, not crash
-    if reduction < 2.0:
-        msg = (f"prefix caching only cut prefill tokens {reduction:.2f}x "
-               f"({computed}/{submitted}) — expected >= 2x on shared "
-               f"prefixes")
-        assert not args.smoke, msg
-        print(f"WARNING: {msg}", file=sys.stderr)
+        # ---- workload 2: shared prefix (the prefix-cache win) --------
+        # a FRESH engine so workload 1's committed pages cannot inflate
+        # the hit rate: every hit below comes from sharing inside this
+        # workload
+        eng2 = ServeEngine(ff)
+        eng2.warmup()
+        prefix_len = args.prefix_len or max_prompt // 2
+        tail = max(4, args.page_size // 2)
+        prefix = list(rng.randint(1, args.vocab, size=prefix_len))
+        sprompts = [prefix + list(rng.randint(1, args.vocab, size=tail))
+                    for _ in range(args.requests)]
+        before = eng2.compile_counts()
+        t0 = time.perf_counter()
+        sout = eng2.generate(sprompts, args.max_new)
+        swall = time.perf_counter() - t0
+        sstats = eng2.last_stats
+        print(serve_report(sstats), file=sys.stderr)
+        computed = sstats["prefill_tokens_computed"]
+        submitted = sstats["prompt_tokens_total"]
+        reduction = submitted / computed if computed else float("inf")
 
-    records.append({
-        "metric": "serve_prefill_token_reduction",
-        "value": round(reduction, 2),
-        "unit": "x",
-        "extra": {
-            "platform": jax.default_backend(),
-            "requests": args.requests,
-            "prefix_len": prefix_len,
-            "tail_len": tail,
-            "prompt_tokens_submitted": submitted,
-            "prefill_tokens_computed": computed,
-            "prefix_hit_tokens": sstats["prefix_hit_tokens"],
-            "tokens_per_sec": round(sstats["tokens_per_sec"], 2),
-            "outputs_match_reference": True,
-            "wall_s": round(swall, 2),
-            "compile_counts": sstats["compile_counts"],
-        },
-    })
+        # the serving CORRECTNESS contracts hold on every run: no
+        # program compiled after warmup, and the prefix-cached (and,
+        # by default, speculative) outputs are exactly the no-cache
+        # greedy reference
+        assert eng2.compile_counts() == before, (
+            f"serving recompiled: {before} -> {eng2.compile_counts()}")
+        ref = eng2.generate_reference(sprompts, args.max_new)
+        assert sout == ref, "prefix-cached outputs diverged from reference"
+        # the >= 2x reduction is a property of the DEFAULT shared-prefix
+        # shapes, so it hard-gates only under --smoke (CI); a custom
+        # --prefix-len/--requests sweep should report, not crash
+        if reduction < 2.0:
+            msg = (f"prefix caching only cut prefill tokens "
+                   f"{reduction:.2f}x ({computed}/{submitted}) — "
+                   f"expected >= 2x on shared prefixes")
+            assert not args.smoke, msg
+            print(f"WARNING: {msg}", file=sys.stderr)
+        gates.append(f"prefill_reduction={reduction:.2f}x "
+                     f"compile_counts={counts}")
 
-    lines = [json.dumps(r) for r in records]
-    print("\n".join(lines))
+        records.append({
+            "metric": "serve_prefill_token_reduction",
+            "value": round(reduction, 2),
+            "unit": "x",
+            "extra": {
+                "platform": jax.default_backend(),
+                "requests": args.requests,
+                "prefix_len": prefix_len,
+                "tail_len": tail,
+                "prompt_tokens_submitted": submitted,
+                "prefill_tokens_computed": computed,
+                "prefix_hit_tokens": sstats["prefix_hit_tokens"],
+                "tokens_per_sec": round(sstats["tokens_per_sec"], 2),
+                "outputs_match_reference": True,
+                "wall_s": round(swall, 2),
+                "compile_counts": sstats["compile_counts"],
+            },
+        })
+
+    if args.workload in ("all", "spec"):
+        # ---- workload 3: repetitive decode (speculative decoding) ----
+        # one echo LM, two engines over its params: speculative (k=8)
+        # vs non-speculative baseline. The win is decode STEPS — every
+        # decode step is one dispatch of the same fixed-shape mixed
+        # program, so steps_base / steps_spec is the dispatch-count
+        # reduction for token-identical outputs.
+        spec_k = 8
+        prompt_hi = 17          # spec prompts draw from [4, prompt_hi)
+        spec_new = min(max(24, args.max_new),
+                       args.max_seq_len - prompt_hi)
+        if spec_new < 8:
+            ap.error(f"--max-seq-len ({args.max_seq_len}) leaves no "
+                     f"room for the repetitive-decode workload "
+                     f"(needs prompt + >= 8 new tokens)")
+        ff_echo = _make_echo_lm(cfg, args)
+        eng_s = ServeEngine(ff_echo, spec_tokens=spec_k)
+        eng_s.warmup()
+        eng_b = ServeEngine(ff_echo, spec_tokens=0)
+        eng_b.warmup()
+        rprompts = [list(rng.randint(1, args.vocab,
+                                     size=rng.randint(4, prompt_hi)))
+                    for _ in range(args.requests)]
+        before = eng_s.compile_counts()
+        t0 = time.perf_counter()
+        rout = eng_s.generate(rprompts, spec_new)
+        rwall = time.perf_counter() - t0
+        rstats = eng_s.last_stats
+        print(serve_report(rstats), file=sys.stderr)
+        bout = eng_b.generate(rprompts, spec_new)
+        bsteps = eng_b.last_stats["decode_steps"]
+        ssteps = rstats["decode_steps"]
+        step_red = bsteps / ssteps if ssteps else float("inf")
+
+        assert eng_s.compile_counts() == before, (
+            f"speculative serving recompiled: "
+            f"{before} -> {eng_s.compile_counts()}")
+        ref = eng_s.generate_reference(rprompts, spec_new)
+        assert rout == ref, "speculative outputs diverged from reference"
+        assert bout == ref, "baseline outputs diverged from reference"
+        # >= 1.5x is a property of the constructed repetitive workload
+        # (echo LM + prompt-lookup drafting), hard-gated under --smoke
+        if step_red < 1.5:
+            msg = (f"speculative decoding only cut decode steps "
+                   f"{step_red:.2f}x ({bsteps}/{ssteps}) — expected "
+                   f">= 1.5x on repetitive text")
+            assert not args.smoke, msg
+            print(f"WARNING: {msg}", file=sys.stderr)
+        gates.append(f"decode_step_reduction={step_red:.2f}x "
+                     f"compile_counts={eng_s.compile_counts()}")
+
+        records.append({
+            "metric": "serve_decode_step_reduction",
+            "value": round(step_red, 2),
+            "unit": "x",
+            "extra": {
+                "platform": jax.default_backend(),
+                "requests": args.requests,
+                "max_new_tokens": spec_new,
+                "spec_tokens": spec_k,
+                "decode_steps_baseline": bsteps,
+                "decode_steps_speculative": ssteps,
+                "spec_drafted_tokens": rstats["spec_drafted_tokens"],
+                "spec_accepted_tokens": rstats["spec_accepted_tokens"],
+                "spec_acceptance": round(rstats["spec_acceptance"], 4),
+                "steps_per_decode_token": round(
+                    rstats["steps_per_decode_token"], 4),
+                "outputs_match_reference": True,
+                "wall_s": round(rwall, 2),
+                "compile_counts": rstats["compile_counts"],
+            },
+        })
+
+    print("\n".join(json.dumps(r) for r in records))
     if args.out:
+        # merge by metric: a partial --workload run must refresh ITS
+        # lines without deleting the other workloads' records from the
+        # artifact (BENCH_serve.json is committed; clobbering it with a
+        # subset would silently drop metrics)
+        merged = {r["metric"]: r for r in records}
+        if os.path.exists(args.out):
+            try:
+                with open(args.out) as f:
+                    old = [json.loads(ln) for ln in f if ln.strip()]
+                merged = {**{r["metric"]: r for r in old}, **merged}
+            except (OSError, ValueError, KeyError):
+                pass   # unreadable artifact: rewrite with this run's
         with open(args.out, "w") as f:
-            f.write("\n".join(lines) + "\n")
+            f.write("\n".join(json.dumps(r)
+                              for r in merged.values()) + "\n")
     if args.smoke:
-        print(f"serve smoke OK: reduction={reduction:.2f}x, "
-              f"compile_counts={counts}", file=sys.stderr)
+        print(f"serve smoke OK: {'; '.join(gates)}", file=sys.stderr)
     return 0
 
 
