@@ -273,6 +273,35 @@ class FFConfig:
     # --spec-tokens N / --no-spec-decode.
     serve_spec_decode: bool = True
     serve_spec_tokens: int = 4
+    # ---- robustness (utils/faults.py, docs/robustness.md) ----
+    # deterministic fault injection: a spec string like
+    # "serve.mixed:transient@2,5;serve.page_pressure:exhaust:0.5@3-9"
+    # arms seeded failures at marked sites (engine dispatch, scheduler
+    # page pressure, checkpoint commit) so chaos tests replay exactly.
+    # None = no injection (also settable via FLEXFLOW_TPU_FAULTS).
+    fault_spec: Optional[str] = None
+    # default per-request wall-clock deadline in seconds for
+    # ServeEngine.generate (0 = none): a request that has not finished
+    # when its deadline passes is aborted at the next chunk boundary
+    # with outcome "deadline_expired", its pages reclaimed.
+    serve_request_deadline: float = 0.0
+    # bounded retry-with-backoff around the engine's jitted dispatch
+    # for TransientError (injected or tunnel hiccup): up to
+    # serve_max_retries re-dispatches, sleeping
+    # serve_retry_backoff_s * 2^attempt between them.
+    serve_max_retries: int = 3
+    serve_retry_backoff_s: float = 0.02
+    # graceful-degradation ladder under page pressure
+    # (serve/scheduler.py): rung 1 sheds speculation, rung 2 stops
+    # prefix-matching + shrinks the parked LRU, rung 3 tightens the
+    # admission watermark (floored at 8% of the pool), rung 4 rejects
+    # (structured RejectedRequest)
+    # what can never fit. --no-degrade-ladder freezes rung 0 behavior.
+    serve_degrade_ladder: bool = True
+    # opt-in online-serving rung-4 policy: reject the waiting head
+    # after this many consecutive stalled admission attempts at rung
+    # >= 3 (0 = never reject for stalling; offline batches wait).
+    serve_reject_stalls: int = 0
 
     # synthetic input when no dataset is provided (reference: config.h:131)
     synthetic_input: bool = False
@@ -352,6 +381,27 @@ class FFConfig:
             raise ValueError(
                 f"serve_spec_tokens must be >= 0 (0 disables "
                 f"speculative decoding), got {self.serve_spec_tokens}")
+        if self.serve_request_deadline < 0:
+            raise ValueError(
+                f"serve_request_deadline must be >= 0 (0 = none), got "
+                f"{self.serve_request_deadline}")
+        if self.serve_max_retries < 0:
+            raise ValueError(
+                f"serve_max_retries must be >= 0, got "
+                f"{self.serve_max_retries}")
+        if self.serve_retry_backoff_s < 0:
+            raise ValueError(
+                f"serve_retry_backoff_s must be >= 0, got "
+                f"{self.serve_retry_backoff_s}")
+        if self.serve_reject_stalls < 0:
+            raise ValueError(
+                f"serve_reject_stalls must be >= 0 (0 = never), got "
+                f"{self.serve_reject_stalls}")
+        if self.fault_spec:
+            # parse eagerly so a typo'd spec fails at config time, not
+            # silently mid-chaos-run
+            from .utils.faults import FaultSpec
+            FaultSpec(self.fault_spec)
         if self.pipeline_virtual_stages > 1 \
                 and self.pipeline_schedule != "1f1b":
             raise ValueError(
@@ -405,6 +455,11 @@ class FFConfig:
         "--serve-prefill-budget": ("serve_prefill_budget", int),
         "--serve-admit-watermark": ("serve_admit_watermark", float),
         "--spec-tokens": ("serve_spec_tokens", int),
+        "--fault-spec": ("fault_spec", str),
+        "--request-deadline": ("serve_request_deadline", float),
+        "--serve-max-retries": ("serve_max_retries", int),
+        "--serve-retry-backoff": ("serve_retry_backoff_s", float),
+        "--serve-reject-stalls": ("serve_reject_stalls", int),
     }
     _BOOL_FLAGS = {
         "--profiling": "profiling",
@@ -432,6 +487,7 @@ class FFConfig:
         "--no-chunked-prefill": "serve_chunked_prefill",
         "--no-prefix-cache": "serve_prefix_cache",
         "--no-spec-decode": "serve_spec_decode",
+        "--no-degrade-ladder": "serve_degrade_ladder",
     }
 
     def parse_args(self, argv: Sequence[str]) -> None:

@@ -159,6 +159,52 @@ class DataLoaderSet:
     def reset(self) -> None:
         self._set_order(self._epoch_order())
 
+    # ---------------- crash-safe loader state --------------------------
+    def state_dict(self) -> dict:
+        """Resumable shuffle-stream state: the shared order rng — the
+        only stream that decides future epochs' permutations. The
+        granularity is deliberately the EPOCH: a permutation already
+        drawn for an in-progress epoch was consumed from the rng before
+        this snapshot and is not recoverable from it, so save at epoch
+        boundaries (mid-epoch resume replays the epoch from its start —
+        the same contract as fit's checkpoint replay)."""
+        s = self._order_rng.get_state()
+        return {"rng": [s[0], np.asarray(s[1]).tolist(), int(s[2]),
+                        int(s[3]), float(s[4])]}
+
+    def load_state_dict(self, state: dict) -> None:
+        # parse EVERYTHING before mutating anything: a malformed file
+        # must leave the loader untouched (the load_state contract),
+        # not half-applied with the rng already overwritten
+        s = state["rng"]
+        rng_state = (s[0], np.asarray(s[1], dtype=np.uint32), int(s[2]),
+                     int(s[3]), float(s[4]))
+        self._order_rng.set_state(rng_state)
+
+    def save_state(self, path: str) -> None:
+        """Checkpoint the loader state ATOMICALLY (temp then
+        os.replace, core/checkpoint.atomic_write_json): a kill at any
+        instant leaves either the previous complete state file or the
+        new one, never a truncation — the same crash contract as
+        save_checkpoint, so a restarted run replays the exact
+        epoch-level shuffle stream of an uninterrupted one (see
+        state_dict for the epoch granularity)."""
+        from .checkpoint import atomic_write_json
+        atomic_write_json(path, self.state_dict(),
+                          fault_site="loader.commit")
+
+    def load_state(self, path: str) -> bool:
+        """Restore from save_state's file; False (state untouched) when
+        the file is absent or unreadable."""
+        import json
+        try:
+            with open(path) as f:
+                state = json.load(f)
+            self.load_state_dict(state)
+        except (OSError, ValueError, KeyError, TypeError):
+            return False
+        return True
+
     def close(self) -> None:
         """Release the native worker thread + double buffers (no-op on
         the Python path). Safe to call more than once."""

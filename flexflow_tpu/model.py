@@ -767,16 +767,42 @@ class FFModel:
         ckptr = None  # one async checkpointer reused across the run
         if checkpoint_dir:
             from .core.checkpoint import restore_model, save_checkpoint
+            # the name filter also skips uncommitted crash leftovers:
+            # save_checkpoint stages into `epoch_N.tmp` / `epoch_N.old`
+            # and only an atomic promote produces a bare `epoch_N`, so
+            # a kill-mid-save run resumes from the newest COMMITTED
+            # epoch (docs/robustness.md). A promote killed inside its
+            # rename window strands the committed dir at `.old` —
+            # recover those first so the scan can see them.
+            if os.path.isdir(checkpoint_dir):
+                from .core.checkpoint import recover_promoted
+                for d in os.listdir(checkpoint_dir):
+                    if d.startswith("epoch_") and d.endswith(".old"):
+                        recover_promoted(
+                            os.path.join(checkpoint_dir, d[:-len(".old")]))
             done = sorted(
                 int(d[len("epoch_"):]) for d in (
                     os.listdir(checkpoint_dir)
                     if os.path.isdir(checkpoint_dir) else [])
                 if d.startswith("epoch_")
                 and d[len("epoch_"):].isdigit())
-            if done:
-                start_epoch = done[-1] + 1
-                restore_model(self, os.path.join(checkpoint_dir,
-                                                 f"epoch_{done[-1]}"))
+            while done:
+                # a committed dir can still be damaged out-of-band
+                # (disk fault, manual edit): fall back epoch by epoch
+                # rather than failing the whole run
+                try:
+                    restore_model(self, os.path.join(
+                        checkpoint_dir, f"epoch_{done[-1]}"))
+                    start_epoch = done[-1] + 1
+                    break
+                except Exception as e:
+                    import warnings
+                    warnings.warn(
+                        f"checkpoint epoch_{done[-1]} unreadable "
+                        f"({type(e).__name__}: {e}); falling back to "
+                        f"the previous epoch")
+                    done.pop()
+            if start_epoch:
                 # replay ONLY the missing prefix of the shuffle stream so
                 # resumed epochs see the permutations the uninterrupted
                 # run would have (a same-object continuation has already

@@ -142,12 +142,27 @@ class CostCache:
         try:
             with open(self.path) as f:
                 data = json.load(f)
-            if isinstance(data, dict):
-                self._data = {fp: dict(entries)
-                              for fp, entries in data.items()
-                              if isinstance(entries, dict)}
-        except (OSError, json.JSONDecodeError):
-            pass  # absent/corrupt cache = empty cache
+        except FileNotFoundError:
+            return             # no cache yet — the common first run
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+            # a corrupted / truncated store (crash mid-write on an old
+            # build, disk fault, manual edit) must never crash a
+            # search: warn, start empty, and let the next flush()
+            # REBUILD the file wholesale (see flush's corrupt-merge
+            # path). The cache is a pure accelerator — losing it costs
+            # re-derivation, never correctness.
+            import warnings
+            warnings.warn(
+                f"cost cache {self.path} is unreadable "
+                f"({type(e).__name__}: {e}); rebuilding it from scratch")
+            self._dirty = True   # next flush overwrites the wreck
+            return
+        if isinstance(data, dict):
+            # row-level validation happens in get() (len check); here
+            # just drop structurally-foreign subtrees
+            self._data = {fp: dict(entries)
+                          for fp, entries in data.items()
+                          if isinstance(entries, dict)}
 
     def get(self, fingerprint: str, key: str):
         from .cost_model import OpCost
@@ -184,15 +199,29 @@ class CostCache:
                     with open(self.path) as f:
                         on_disk = json.load(f)
                     if isinstance(on_disk, dict):
-                        merged = on_disk
-                except (OSError, json.JSONDecodeError):
+                        merged = {fp: e for fp, e in on_disk.items()
+                                  if isinstance(e, dict)}
+                except FileNotFoundError:
                     pass
+                except (OSError, json.JSONDecodeError,
+                        UnicodeDecodeError):
+                    # corrupt on-disk store: do not merge garbage —
+                    # this flush rewrites it wholesale from the
+                    # in-memory entries (the rebuild _ensure_loaded
+                    # promised)
+                    import warnings
+                    warnings.warn(
+                        f"cost cache {self.path} was corrupt at flush; "
+                        f"overwriting with this process's entries")
                 for fp, entries in self._data.items():
                     merged.setdefault(fp, {}).update(entries)
-                tmp = self.path + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump(merged, f)
-                os.replace(tmp, self.path)
+                # the shared temp-then-os.replace primitive: a kill
+                # mid-flush leaves the previous complete store, never
+                # a truncation (and "cache.commit" is a stageable
+                # chaos kill point like ckpt.commit/loader.commit)
+                from ..core.checkpoint import atomic_write_json
+                atomic_write_json(self.path, merged,
+                                  fault_site="cache.commit")
                 self._dirty = False
             except OSError:
                 pass

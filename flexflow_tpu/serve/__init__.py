@@ -11,7 +11,8 @@ compiles a single serving program, ever.
 """
 
 from .kv_cache import KVCacheConfig, PagedKVCache, prefix_page_keys
-from .scheduler import (ChunkPlan, ContinuousBatchingScheduler, Request,
+from .scheduler import (ChunkPlan, ContinuousBatchingScheduler,
+                        RejectedRequest, Request, RequestOutcome,
                         RequestState, SampleParams, StepPlan)
 from .speculative import DraftControl, Drafter, PromptLookupDrafter
 from .engine import ServeEngine
@@ -22,7 +23,9 @@ __all__ = [
     "prefix_page_keys",
     "ChunkPlan",
     "ContinuousBatchingScheduler",
+    "RejectedRequest",
     "Request",
+    "RequestOutcome",
     "RequestState",
     "SampleParams",
     "StepPlan",

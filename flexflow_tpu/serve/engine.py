@@ -60,9 +60,10 @@ import numpy as np
 from ..config import CompMode
 from ..kernels.flash_attention import (paged_attention_decode,
                                        paged_attention_ragged)
+from ..utils.faults import FaultInjector, TransientError, injector_for
 from .kv_cache import KVCacheConfig, PagedKVCache
 from .scheduler import (ChunkPlan, ContinuousBatchingScheduler, Request,
-                        SampleParams)
+                        RequestOutcome, RequestState, SampleParams)
 
 
 class _CompileEvents:
@@ -147,7 +148,7 @@ class ServeEngine:
                  chunked_prefill: Optional[bool] = None,
                  prefix_cache: Optional[bool] = None,
                  spec_tokens: Optional[int] = None,
-                 drafter=None):
+                 drafter=None, faults: Optional[FaultInjector] = None):
         if model.state is None:
             model.compile(comp_mode=CompMode.INFERENCE)
         self.model = model
@@ -177,6 +178,23 @@ class ServeEngine:
         self.prefill_budget = int(getattr(cfg, "serve_prefill_budget", 512))
         self.admit_watermark = float(
             getattr(cfg, "serve_admit_watermark", 0.02))
+        # robustness (docs/robustness.md): deterministic fault injection
+        # (config-scoped when FFConfig.fault_spec is set), bounded
+        # retry-with-backoff around jitted dispatch, per-request
+        # deadlines, host-side cancellation, and the scheduler's
+        # degradation ladder
+        self.faults = faults if faults is not None else injector_for(cfg)
+        self.max_retries = int(getattr(cfg, "serve_max_retries", 3))
+        self.retry_backoff = float(
+            getattr(cfg, "serve_retry_backoff_s", 0.02))
+        self.default_deadline = float(
+            getattr(cfg, "serve_request_deadline", 0.0))
+        self.degrade_ladder = bool(
+            getattr(cfg, "serve_degrade_ladder", True))
+        self.reject_stalls = int(getattr(cfg, "serve_reject_stalls", 0))
+        self._retries = 0           # engine-lifetime retried dispatches
+        self._cancels: set = set()  # rids cancel() marked, swept at
+        self._active: Dict[int, Request] = {}   # chunk boundaries
         # speculative decoding (serve/speculative.py): max drafted
         # tokens per sequence per step. Needs the mixed program (draft
         # lanes are chunk lanes); 0 disables and the engine is
@@ -233,8 +251,31 @@ class ServeEngine:
         self._shapes_seen[name].add(tuple(
             (tuple(a.shape), str(a.dtype)) for a in args
             if hasattr(a, "shape")))
-        before = _CompileEvents.count
-        out = fn(*args)
+        attempt = 0
+        while True:
+            before = _CompileEvents.count
+            try:
+                # fault-injection site: serve.mixed / serve.prefill /
+                # serve.decode, fired at the dispatch boundary (BEFORE
+                # the jitted call, so donated buffers are untouched
+                # when an injected fault raises)
+                self.faults.fire(f"serve.{name}")
+                out = fn(*args)
+                break
+            except TransientError:
+                # bounded retry-with-backoff: transient dispatch faults
+                # (injected chaos, a flaky device tunnel) are absorbed
+                # here instead of failing the batch. Only retry while
+                # the donated page arrays are still live — a dispatch
+                # that consumed them before dying cannot be redone.
+                attempt += 1
+                if attempt > self.max_retries or any(
+                        a.is_deleted() for a in args
+                        if hasattr(a, "is_deleted")):
+                    raise
+                self._retries += 1
+                if self.retry_backoff:
+                    time.sleep(self.retry_backoff * (2 ** (attempt - 1)))
         # jit compiles synchronously at dispatch (only execution is
         # async), so any backend-compile event between the snapshots
         # belongs to THIS call
@@ -566,11 +607,70 @@ class ServeEngine:
                                      len(req.out_tokens)])
         return int(topi[int(rng.choice(k, p=p))])
 
+    # ---------------- robustness --------------------------------------
+    def cancel(self, rid: int) -> bool:
+        """Host-side cancellation: mark request `rid` of the in-flight
+        generate() for abort at the next chunk boundary (its pages and
+        prefix-registry pins reclaim through the normal refcount
+        machinery). Safe to call from another thread or from an
+        `on_step` callback; returns False when no such request is
+        active (already finished, or a stale rid)."""
+        req = self._active.get(rid)
+        if req is None or req.state == RequestState.FINISHED:
+            return False
+        self._cancels.add(rid)
+        return True
+
+    def _sweep_aborts(self, sched) -> None:
+        """Chunk-boundary sweep: apply pending cancels and expire
+        deadlines. Runs at the top of every serving step, BEFORE the
+        scheduler plans — so no aborted request can have a chunk in
+        flight, and its slot/pages are free for this very step's
+        admissions."""
+        now = time.perf_counter()
+        live = list(sched.running.values()) + list(sched.waiting)
+        for req in live:
+            if req.rid in self._cancels:
+                if sched.abort(req, RequestOutcome.CANCELLED):
+                    req.t_finish = now
+            elif req.t_deadline and now >= req.t_deadline:
+                if sched.abort(req, RequestOutcome.DEADLINE_EXPIRED):
+                    req.t_finish = now
+
+    def _fail_inflight(self, sched, reqs: Sequence[Request]) -> None:
+        """Crash containment (replacing the PR-3-era hard brick): a
+        mid-batch exception fails ONLY the in-flight requests — every
+        live slot releases through the refcount machinery, the prefix
+        registry is dropped (the device arrays its content lived in
+        are stale, or consumed by the dispatch that died), and the
+        page pools are reallocated lazily if donation ate them. The
+        exception still propagates to the caller, but the NEXT
+        generate() serves normally on a pool that check_invariants
+        vouches for."""
+        now = time.perf_counter()
+        for req in reqs:
+            if req.state != RequestState.FINISHED:
+                if sched.abort(req, RequestOutcome.FAILED):
+                    req.t_finish = now
+        self._reset_pool_state()
+
+    def _reset_pool_state(self) -> None:
+        """Shared tail of both recovery paths (_fail_inflight and the
+        orphaned-slot self-heal): the prefix registry vouches for
+        content in device arrays an interrupted batch lost (or donation
+        consumed), so drop it wholesale, and reallocate the page pools
+        lazily when the interrupted dispatch ate them."""
+        self.cache.clear_prefix()
+        if self._k_pages is not None and \
+                getattr(self._k_pages, "is_deleted", lambda: False)():
+            self._k_pages = self._v_pages = None  # realloc on next use
+        self.cache.check_invariants()
+
     # ---------------- the serving loop ---------------------------------
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens, eos_token: Optional[int] = None,
-                 temperature=None, top_k=None, sample_seed: int = 0
-                 ) -> List[List[int]]:
+                 temperature=None, top_k=None, sample_seed: int = 0,
+                 deadline_s=None, on_step=None) -> List[List[int]]:
         """Decode a ragged batch under continuous batching.
         `max_new_tokens` is an int or a per-prompt sequence; greedy by
         default, per-request seeded temperature/top-k sampling when
@@ -578,18 +678,39 @@ class ServeEngine:
         Returns the generated tokens (prompt excluded) per prompt, in
         order. Per-request latency, prefix-cache/preemption/utilization
         counters, and per-token timings land in `self.last_stats`
-        (render with utils/profiling.serve_report)."""
+        (render with utils/profiling.serve_report).
+
+        Robustness: `deadline_s` (scalar or per-prompt; falls back to
+        FFConfig.serve_request_deadline; 0/None = none) bounds each
+        request's wall time from submission — expiry aborts it at a
+        chunk boundary with outcome "deadline_expired" and its partial
+        tokens are returned. `cancel(rid)` (rids are
+        `last_stats["requests"][i]["rid"]`, assigned in prompt order)
+        aborts a request the same way. `on_step(step_index)` is called
+        after every engine step — the hook chaos tests drive cancels
+        and invariant checks from. A mid-batch exception fails only
+        the in-flight requests and the engine keeps serving
+        (_fail_inflight)."""
         c = self.cache_cfg
         cache = self.cache
         if cache.free_slots != c.max_seqs:
-            raise RuntimeError(
-                "engine cache has live slots — a previous generate() "
-                "aborted mid-flight; build a fresh ServeEngine")
+            # a previous batch died WITHOUT _fail_inflight running (a
+            # BaseException like KeyboardInterrupt mid-loop, or a user
+            # driving the scheduler directly): reclaim the orphaned
+            # slots/pages AND reset the pool state — the registry may
+            # vouch for arrays the dead batch lost, and donation may
+            # have consumed the pools — then keep serving. The
+            # PR-3-era answer ("build a fresh ServeEngine") threw away
+            # a warm compiled program for a recoverable host state.
+            cache.release_all()
+            self._reset_pool_state()
         sched = ContinuousBatchingScheduler(
             cache, prefill_token_budget=self.prefill_budget,
             chunked_prefill=self.chunked_prefill,
             admit_watermark=self.admit_watermark,
-            spec_tokens=self.spec_tokens, drafter=self.drafter)
+            spec_tokens=self.spec_tokens, drafter=self.drafter,
+            faults=self.faults, degrade_ladder=self.degrade_ladder,
+            reject_stalls=self.reject_stalls)
         if isinstance(max_new_tokens, int):
             max_new_tokens = [max_new_tokens] * len(prompts)
         if len(max_new_tokens) != len(prompts):
@@ -598,12 +719,25 @@ class ServeEngine:
                 f"{len(prompts)} prompts")
         samples = self._sample_params(temperature, top_k, sample_seed,
                                       len(prompts), self.topk_cap)
+        if deadline_s is None and self.default_deadline > 0:
+            deadline_s = self.default_deadline
+        if deadline_s is not None and np.isscalar(deadline_s):
+            deadline_s = [deadline_s] * len(prompts)
+        if deadline_s is not None and len(deadline_s) != len(prompts):
+            raise ValueError(
+                f"deadline_s has {len(deadline_s)} entries for "
+                f"{len(prompts)} prompts")
         reqs: List[Request] = []
         t0 = time.perf_counter()
-        for prompt, mnt, sp in zip(prompts, max_new_tokens, samples):
+        for i, (prompt, mnt, sp) in enumerate(
+                zip(prompts, max_new_tokens, samples)):
             r = sched.submit(prompt, mnt, eos_token=eos_token, sample=sp)
             r.t_submit = time.perf_counter()
+            if deadline_s is not None and deadline_s[i] \
+                    and float(deadline_s[i]) > 0:
+                r.t_deadline = r.t_submit + float(deadline_s[i])
             reqs.append(r)
+            self._active[r.rid] = r
         kp, vp = self._device_pages()
         steps = 0
         decode_times: List[float] = []   # seconds per step with decodes
@@ -656,16 +790,24 @@ class ServeEngine:
                 sched.finish(req)
             return emitted
 
-        if self.chunked_prefill:
-            kp, vp = self._run_chunked(sched, cache, kp, vp, emit,
-                                       emit_spec, decode_times,
-                                       decode_widths, prefill_times, util)
+        retries0 = self._retries
+        try:
+            if self.chunked_prefill:
+                kp, vp = self._run_chunked(sched, cache, kp, vp, emit,
+                                           emit_spec, decode_times,
+                                           decode_widths, prefill_times,
+                                           util, on_step)
+            else:
+                kp, vp = self._run_legacy(sched, cache, kp, vp, emit,
+                                          decode_times, decode_widths,
+                                          prefill_times, util, on_step)
             steps = len(util)
-        else:
-            kp, vp = self._run_legacy(sched, cache, kp, vp, emit,
-                                      decode_times, decode_widths,
-                                      prefill_times, util)
-            steps = len(util)
+        except Exception:
+            self._fail_inflight(sched, reqs)
+            raise
+        finally:
+            self._active.clear()
+            self._cancels.clear()
         self._k_pages, self._v_pages = kp, vp
         cache.check_invariants()
         assert cache.free_pages == c.usable_pages, "pages leaked"
@@ -676,8 +818,11 @@ class ServeEngine:
                 {"rid": r.rid, "prompt_tokens": len(r.prompt),
                  "new_tokens": len(r.out_tokens),
                  "preemptions": r.preemptions,
-                 "ttft_s": r.t_first_token - r.t_submit,
-                 "latency_s": r.t_finish - r.t_submit}
+                 "outcome": r.outcome,
+                 "ttft_s": (r.t_first_token - r.t_submit
+                            if r.t_first_token else None),
+                 "latency_s": (r.t_finish - r.t_submit
+                               if r.t_finish else None)}
                 for r in reqs],
             "mode": "chunked" if self.chunked_prefill else "legacy",
             "wall_s": wall,
@@ -713,12 +858,25 @@ class ServeEngine:
                 if decode_widths else 0.0),
             "page_util_mean": float(np.mean(util)) if util else 0.0,
             "page_util_max": float(np.max(util)) if util else 0.0,
+            # robustness instrumentation (docs/robustness.md): abort /
+            # deadline / rejection outcomes, retried dispatches, and
+            # how far up the degradation ladder this batch climbed
+            "cancelled": sched.stats["cancelled"],
+            "deadline_expired": sched.stats["deadline_expired"],
+            "rejected": sched.stats["rejected"],
+            "rejected_requests": [(rr.rid, rr.reason)
+                                  for rr in sched.rejected_requests],
+            "retries": self._retries - retries0,
+            "degradation_rung_max": sched.stats["degradation_rung_max"],
+            "rung_steps": list(sched.stats["rung_steps"]),
+            "spec_shed_steps": sched.stats["spec_shed_steps"],
             "cache": dict(cache.stats),   # engine-lifetime counters
         }
         return [list(r.out_tokens) for r in reqs]
 
     def _run_chunked(self, sched, cache, kp, vp, emit, emit_spec,
-                     decode_times, decode_widths, prefill_times, util):
+                     decode_times, decode_widths, prefill_times, util,
+                     on_step=None):
         """The mixed-step loop: every iteration packs this step's
         chunks into the fixed `mixed_width` lanes and runs ONE program.
         Draft lanes pack right after their chunk's context lanes, so a
@@ -730,8 +888,18 @@ class ServeEngine:
         t_w = self.mixed_width
         ps = c.page_size
         while sched.has_work():
+            # chunk boundary: cancels and expired deadlines leave the
+            # system HERE, before any of this step's chunks exist
+            self._sweep_aborts(sched)
+            if not sched.has_work():
+                break
             plan = sched.schedule()
-            assert plan.chunks, "scheduler made no progress"
+            if not plan.chunks:
+                # every waiting request was rejected (rung 4) or the
+                # running set was preempted whole under injected
+                # pressure; the next iteration re-plans (forced
+                # progress guarantees this cannot spin)
+                continue
             tokens = np.zeros((t_w,), np.int32)
             positions = np.zeros((t_w,), np.int32)
             write_pages = np.zeros((t_w,), np.int32)   # sink by default
@@ -801,18 +969,24 @@ class ServeEngine:
                 decode_widths.append(dec_tokens)
             if plan.num_prefill_lanes:
                 prefill_times.append((plan.num_prefill_lanes, dt))
+            if on_step is not None:
+                on_step(len(util) - 1)
         return kp, vp
 
     def _run_legacy(self, sched, cache, kp, vp, emit, decode_times,
-                    decode_widths, prefill_times, util):
+                    decode_widths, prefill_times, util, on_step=None):
         """The PR 1 two-program loop (serve_chunked_prefill=False):
         per-request bucketed prefill, then one full-width decode —
         kept as the A/B baseline and the bucketed-prefill fallback."""
         c = self.cache_cfg
         ps = c.page_size
         while sched.has_work():
+            self._sweep_aborts(sched)
+            if not sched.has_work():
+                break
             plan = sched.schedule()
-            assert plan.chunks, "scheduler made no progress"
+            if not plan.chunks:
+                continue
             pre = [ch for ch in plan.chunks if not ch.is_decode]
             dec = [ch for ch in plan.chunks if ch.is_decode]
             for ch in pre:
@@ -865,6 +1039,8 @@ class ServeEngine:
                     emit(ch, nxt[ch.req.slot], topv[ch.req.slot],
                          topi[ch.req.slot])
             util.append(1.0 - cache.free_pages / c.usable_pages)
+            if on_step is not None:
+                on_step(len(util) - 1)
         return kp, vp
 
     def generate_reference(self, prompts: Sequence[Sequence[int]],

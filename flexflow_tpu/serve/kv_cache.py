@@ -158,7 +158,8 @@ class PagedKVCache:
         # serving metrics, merged into ServeEngine.last_stats
         self.stats = {"prefix_hit_pages": 0, "prefix_evictions": 0,
                       "pages_committed": 0, "shared_attaches": 0,
-                      "max_page_refs": 0, "rollback_pages": 0}
+                      "max_page_refs": 0, "rollback_pages": 0,
+                      "lru_shed_pages": 0, "slots_reclaimed": 0}
 
     # ---------------- capacity queries (scheduler admission) ----------
     @property
@@ -238,7 +239,60 @@ class PagedKVCache:
             "page pool exhausted (scheduler must check free_pages and "
             "preempt before allocating)")
 
+    def clear_prefix(self) -> int:
+        """Drop the ENTIRE prefix registry: every parked LRU page
+        returns to the plain free list and every mapped page loses its
+        hash. The crash-containment action — after a mid-batch engine
+        failure the device arrays the registry's content lived in are
+        stale or consumed, so nothing on them may be vouched for.
+        Returns the number of hashes dropped."""
+        n = len(self._hash_of_page)
+        while self._lru:
+            page, _ = self._lru.popitem(last=False)
+            self._unregister(page)
+            self._free.append(page)
+        for page in list(self._hash_of_page):
+            self._unregister(page)
+        return n
+
+    def shrink_lru(self, keep: int) -> int:
+        """Evict parked (refcount-0, hashed) pages oldest-first until at
+        most `keep` remain, returning them to the plain free list with
+        their hashes dropped. The degradation ladder's rung-2 action:
+        under page pressure a parked page is a liability — a prefix
+        attach would pin it at refcount > 0 right when admissions need
+        every reclaimable page — so the registry stops vouching for
+        them. Returns the number of pages shed."""
+        shed = 0
+        while len(self._lru) > max(0, int(keep)):
+            page, _ = self._lru.popitem(last=False)
+            self._unregister(page)
+            self._free.append(page)
+            shed += 1
+        self.stats["lru_shed_pages"] += shed
+        return shed
+
     # ---------------- slot lifecycle ----------------------------------
+    def release_all(self) -> int:
+        """Free every occupied slot (crash recovery: a serving loop
+        died between allocation and the bookkeeping that would have
+        freed it). Committed full pages park in the prefix LRU exactly
+        as finish-time eviction would leave them — their K/V was fully
+        written before commit_page registered them, so they stay
+        safely matchable. Returns the number of slots reclaimed."""
+        occupied = set(range(self.cfg.max_seqs)) - set(self._slot_free)
+        for s in sorted(occupied):
+            # a mid-write tail page may carry no hash; free_slot already
+            # routes hashed -> LRU, unhashed -> free list. But a hashed
+            # page only PARTIALLY covered by seq_lens (a crash between
+            # advance and commit cannot produce one — commit follows
+            # advance — so this is belt and braces) must not stay
+            # matchable: rollback to the resident length first.
+            self.rollback(s, int(self.seq_lens[s]))
+            self.free_slot(s)
+        self.stats["slots_reclaimed"] += len(occupied)
+        return len(occupied)
+
     def alloc_slot(self) -> int:
         """Claim an empty decode slot. Pages arrive separately via
         attach_prefix (shared) and ensure_capacity (fresh)."""

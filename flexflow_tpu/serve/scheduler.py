@@ -48,6 +48,7 @@ import enum
 from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence
 
+from ..utils.faults import FaultInjector
 from .kv_cache import PagedKVCache, prefix_page_keys
 from .speculative import DraftControl, Drafter, PromptLookupDrafter
 
@@ -56,6 +57,28 @@ class RequestState(enum.Enum):
     WAITING = "waiting"
     RUNNING = "running"   # holds a decode slot (prefilling or decoding)
     FINISHED = "finished"
+
+
+class RequestOutcome:
+    """How a request left the system (Request.outcome). PENDING while
+    in flight; exactly one terminal value afterwards."""
+
+    PENDING = "pending"
+    COMPLETED = "completed"
+    CANCELLED = "cancelled"
+    DEADLINE_EXPIRED = "deadline_expired"
+    REJECTED = "rejected"
+    FAILED = "failed"          # a mid-generate engine exception
+
+
+@dataclasses.dataclass(frozen=True)
+class RejectedRequest:
+    """Structured record of a rung-4 rejection (stats['rejected_requests']):
+    the request was refused service instead of deadlocking the step or
+    raising out of the whole batch."""
+
+    rid: int
+    reason: str
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,6 +111,11 @@ class Request:
     # tokens whose K/V is resident (prefix-cache hits + computed chunks)
     num_computed: int = 0
     preemptions: int = 0
+    # robustness: absolute (perf_counter) deadline, 0 = none; terminal
+    # outcome; consecutive stalled admission attempts at rung >= 3
+    t_deadline: float = 0.0
+    outcome: str = RequestOutcome.PENDING
+    stalled: int = 0
     # adaptive draft-length state (speculative decoding); None when the
     # request is ineligible (non-deterministic sampling) or spec is off
     spec: Optional[DraftControl] = None
@@ -167,13 +195,30 @@ class StepPlan:
 
 
 class ContinuousBatchingScheduler:
+    # graceful-degradation ladder: page-pool utilization (1 - the
+    # reclaimable fraction) at which each rung arms. Rung 1 sheds
+    # speculation (drafts are optimism, not owed work), rung 2 stops
+    # prefix-matching new admissions and sheds the parked LRU (an
+    # attach would pin reclaimable pages), rung 3 tightens the
+    # admission watermark 4x, rung 4 rejects what cannot be served
+    # (structured RejectedRequest instead of a deadlock or a raise).
+    LADDER = (0.85, 0.92, 0.97)
+    RUNG3_WATERMARK_FRAC = 0.08
+
     def __init__(self, cache: PagedKVCache,
                  prefill_token_budget: int = 512,
                  chunked_prefill: bool = True,
                  admit_watermark: float = 0.02,
                  spec_tokens: int = 0,
-                 drafter: Optional[Drafter] = None):
+                 drafter: Optional[Drafter] = None,
+                 faults: Optional[FaultInjector] = None,
+                 degrade_ladder: bool = True,
+                 reject_stalls: int = 0):
         self.cache = cache
+        self.faults = faults if faults is not None else FaultInjector()
+        self.degrade_ladder = bool(degrade_ladder)
+        self.reject_stalls = int(reject_stalls)
+        self.rung = 0
         self.prefill_token_budget = int(prefill_token_budget)
         self.chunked_prefill = bool(chunked_prefill)
         # prefix sharing needs chunked prefill: the legacy per-bucket
@@ -193,7 +238,13 @@ class ContinuousBatchingScheduler:
         self.stats = {"prefix_hit_tokens": 0, "prompt_tokens": 0,
                       "prefill_lane_tokens": 0, "decode_lane_tokens": 0,
                       "preemptions": 0, "spec_drafted_tokens": 0,
-                      "spec_accepted_tokens": 0}
+                      "spec_accepted_tokens": 0,
+                      # robustness counters (serve_report)
+                      "cancelled": 0, "deadline_expired": 0,
+                      "rejected": 0, "failed": 0, "spec_shed_steps": 0,
+                      "degradation_rung_max": 0,
+                      "rung_steps": [0, 0, 0, 0, 0]}
+        self.rejected_requests: List[RejectedRequest] = []
 
     # ---------------- submission --------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
@@ -248,6 +299,28 @@ class ContinuousBatchingScheduler:
         the waiting queue under the budget + watermark."""
         ps = self.cache.cfg.page_size
         cache = self.cache
+        usable = cache.cfg.usable_pages
+        # injected page-pool pressure (chaos tests): hide a fraction of
+        # the reclaimable pool from PLANNING. Allocation still draws
+        # from the real pool, so invariants cannot break — the step
+        # just shrinks/preempts/degrades exactly as real exhaustion
+        # would force it to.
+        squeeze = self.faults.level("serve.page_pressure")
+        hidden = min(usable, int(squeeze * usable))
+
+        def eff_free() -> int:
+            return max(0, cache.free_pages - hidden)
+
+        # degradation rung for THIS step, from planning-visible pressure
+        util = 1.0 - eff_free() / usable
+        self.rung = (sum(util >= t for t in self.LADDER)
+                     if self.degrade_ladder else 0)
+        if self.rung >= 2:
+            cache.shrink_lru(usable // 4)
+        wm = self.watermark_pages
+        if self.rung >= 3:
+            wm = max(wm, int(self.RUNG3_WATERMARK_FRAC * usable) + 1)
+        rejected_before = len(self.rejected_requests)
         chunks: List[ChunkPlan] = []
         admitted: List[Request] = []
         preempted: List[Request] = []
@@ -268,6 +341,7 @@ class ContinuousBatchingScheduler:
 
         # ---- 1. running requests, FCFS (oldest first) ----
         order = sorted(self.running.values(), key=lambda r: r.rid)
+        shed_this_step = False   # spec_shed_steps is per-STEP
         i = 0
         while i < len(order):
             req = order[i]
@@ -281,7 +355,7 @@ class ContinuousBatchingScheduler:
                 continue
             end = req.num_computed + want
             # shrink to the pages actually available before preempting
-            fit = cache.mapped_tokens(req.slot) + cache.free_pages * ps
+            fit = cache.mapped_tokens(req.slot) + eff_free() * ps
             end = min(end, fit)
             if end <= req.num_computed:
                 # not even one token's page: evict the youngest running
@@ -291,7 +365,16 @@ class ContinuousBatchingScheduler:
                 continue               # retry req (unless req WAS victim)
             cache.ensure_capacity(req.slot, end)
             draft: List[int] = []
-            if is_decode and req.spec is not None and budget > 0:
+            if is_decode and req.spec is not None and self.rung >= 1:
+                # ladder rung 1: shed speculation — a draft is
+                # optimism, and under page pressure its mapped-ahead
+                # pages are exactly what admissions are starved of.
+                # Counted once per step, and only when the non-degraded
+                # path would actually have drafted (budget left).
+                if budget > 0 and not shed_this_step:
+                    self.stats["spec_shed_steps"] += 1
+                    shed_this_step = True
+            elif is_decode and req.spec is not None and budget > 0:
                 # drafts ride in PREFILL-budget lanes (the decode lane
                 # itself is from the guaranteed max_seqs reserve, so
                 # decode never starves) and draw pages like any growth —
@@ -302,7 +385,7 @@ class ContinuousBatchingScheduler:
                 k = min(req.spec.next_k(), budget,
                         req.max_new_tokens - len(req.out_tokens) - 1,
                         cache.mapped_tokens(req.slot)
-                        + cache.free_pages * ps - end)
+                        + eff_free() * ps - end)
                 if k > 0:
                     # clamp: the budget/page/length math above assumed
                     # at most k, and a plugged-in drafter's contract is
@@ -330,7 +413,10 @@ class ContinuousBatchingScheduler:
             ctx = req.context
             ctx_len = len(ctx)
             cached_pages: List[int] = []
-            if self.prefix_cache:
+            # ladder rung 2: no prefix matching for new admissions — an
+            # attach pins reclaimable parked pages at refcount > 0
+            # right when the pool needs them back
+            if self.prefix_cache and self.rung < 2:
                 # never match the final token's page: at least one lane
                 # must run to produce the next-token logits, and a
                 # partial tail page is never shared anyway
@@ -354,15 +440,45 @@ class ContinuousBatchingScheduler:
             lru_cached = sum(1 for p in cached_pages if cache.ref(p) == 0)
             need = cache.pages_for(end) - len(cached_pages)
             if forced:
-                avail = (cache.free_pages - lru_cached) * ps
+                avail = (eff_free() - lru_cached) * ps
                 if self.chunked_prefill:
                     end = min(end, cached_len + avail)
                 if end <= cached_len or cached_len + avail < end:
-                    raise RuntimeError(
-                        "page pool too small for the oldest waiting "
-                        "request's first chunk")
-            elif need + lru_cached + self.watermark_pages > cache.free_pages:
-                break   # head-of-line: nothing admits past the head
+                    # ladder rung 4: nothing is running, nothing else is
+                    # planned, and the head STILL cannot get one chunk's
+                    # pages — serving it is impossible at current
+                    # pressure. Reject it (structured outcome) instead
+                    # of raising out of the whole batch, and let the
+                    # next waiting request try. With the ladder
+                    # disabled, the pre-ladder contract (raise) holds.
+                    if not self.degrade_ladder:
+                        raise RuntimeError(
+                            "page pool too small for the oldest waiting "
+                            "request's first chunk")
+                    self._reject(req, "first chunk cannot fit the "
+                                 "reclaimable page pool")
+                    continue
+            elif need + lru_cached + wm > eff_free():
+                # head-of-line: nothing admits past the head. Under the
+                # opt-in online-serving policy, a head that stalls
+                # `reject_stalls` CONSECUTIVE steps at rung >= 3 is
+                # rejected (rung 4) so the queue behind it is not
+                # starved by a request the pool cannot serve soon.
+                # Ordinary low-pressure blocking (waiting out a full
+                # running set) must not pre-charge the counter, so
+                # stalls only count — and only survive — at rung >= 3.
+                if self.rung >= 3:
+                    req.stalled += 1
+                    if self.reject_stalls \
+                            and req.stalled >= self.reject_stalls:
+                        self._reject(
+                            req, f"stalled {req.stalled} admission "
+                            f"attempts at rung {self.rung}")
+                        continue
+                else:
+                    req.stalled = 0
+                break
+            req.stalled = 0
             self.waiting.popleft()
             slot = cache.alloc_slot()
             req.slot = slot
@@ -382,7 +498,51 @@ class ContinuousBatchingScheduler:
                         preempted=preempted)
         self.stats["prefill_lane_tokens"] += plan.num_prefill_lanes
         self.stats["decode_lane_tokens"] += plan.num_decode_lanes
+        # rung_steps is a per-STEP histogram (sums to schedule() calls):
+        # a step that rejected anything counts as rung 4, regardless of
+        # how many requests it refused
+        step_rung = 4 if len(self.rejected_requests) > rejected_before \
+            else self.rung
+        self.stats["rung_steps"][step_rung] += 1
+        self.stats["degradation_rung_max"] = max(
+            self.stats["degradation_rung_max"], step_rung)
         return plan
+
+    def _reject(self, req: Request, reason: str) -> None:
+        """Rung-4 action: refuse service to the WAITING-queue head with
+        a structured outcome instead of deadlocking the step or
+        raising out of the whole batch."""
+        assert self.waiting and self.waiting[0] is req
+        self.waiting.popleft()
+        req.state = RequestState.FINISHED
+        req.outcome = RequestOutcome.REJECTED
+        self.stats["rejected"] += 1
+        self.rejected_requests.append(RejectedRequest(req.rid, reason))
+
+    def abort(self, req: Request, outcome: str) -> bool:
+        """Abort a request at a chunk boundary (host-side cancel, an
+        expired deadline, or a mid-batch engine failure): a RUNNING
+        request's slot and pages release through the same refcount
+        machinery as finish() — committed prefix pages stay matchable,
+        everything else returns to the pool — and a WAITING request
+        simply leaves the queue. Returns False when the request is
+        already finished (abort lost the race with completion)."""
+        if req.state == RequestState.RUNNING:
+            del self.running[req.slot]
+            self.cache.free_slot(req.slot)
+            req.slot = -1
+        elif req.state == RequestState.WAITING:
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                return False
+        else:
+            return False
+        req.state = RequestState.FINISHED
+        req.outcome = outcome
+        if outcome in self.stats:
+            self.stats[outcome] += 1
+        return True
 
     def _preempt(self, victim: Request) -> None:
         """Evict a running request back to the FRONT of the waiting
@@ -453,6 +613,7 @@ class ContinuousBatchingScheduler:
         the waiting queue."""
         assert req.state == RequestState.RUNNING, req.state
         req.state = RequestState.FINISHED
+        req.outcome = RequestOutcome.COMPLETED
         del self.running[req.slot]
         self.cache.free_slot(req.slot)
         req.slot = -1

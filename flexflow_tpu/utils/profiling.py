@@ -82,13 +82,21 @@ def serve_report(stats: dict) -> str:
     produced (the batched-decode amortization IS the number that
     matters for continuous batching)."""
     lines = [f"{'rid':>4s} {'prompt':>7s} {'new':>5s} {'ttft ms':>9s} "
-             f"{'latency ms':>11s} {'tok/s':>8s}"]
+             f"{'latency ms':>11s} {'tok/s':>8s}  {'outcome':s}"]
     for r in stats.get("requests", []):
+        # cancelled/expired/rejected requests may never have reached
+        # first token (ttft None) or termination stamps (latency None)
         lat = r["latency_s"]
-        tps = r["new_tokens"] / lat if lat > 0 else 0.0
-        lines.append(f"{r['rid']:>4d} {r['prompt_tokens']:>7d} "
-                     f"{r['new_tokens']:>5d} {r['ttft_s']*1e3:>9.2f} "
-                     f"{lat*1e3:>11.2f} {tps:>8.1f}")
+        ttft = r["ttft_s"]
+        tps = r["new_tokens"] / lat if lat else 0.0
+        outcome = r.get("outcome", "completed")
+        lines.append(
+            f"{r['rid']:>4d} {r['prompt_tokens']:>7d} "
+            f"{r['new_tokens']:>5d} "
+            + (f"{ttft*1e3:>9.2f} " if ttft is not None else f"{'-':>9s} ")
+            + (f"{lat*1e3:>11.2f} " if lat is not None else f"{'-':>11s} ")
+            + f"{tps:>8.1f}"
+            + (f"  {outcome}" if outcome != "completed" else ""))
     pct = serve_percentiles(stats)
     lines.append(
         f"total: {stats.get('total_new_tokens', 0)} tokens in "
@@ -121,6 +129,23 @@ def serve_report(stats: dict) -> str:
             f"speculation: drafted {drafted}, accepted {acc} "
             f"({rate:.1%} acceptance), "
             f"{spt:.2f} steps/token")
+    # robustness: aborts, retried dispatches, degradation-ladder climb
+    # (absent from pre-robustness stats dicts — key-guarded like the
+    # rest)
+    if any(stats.get(k) for k in ("cancelled", "deadline_expired",
+                                  "rejected", "retries",
+                                  "degradation_rung_max")):
+        rungs = stats.get("rung_steps")
+        lines.append(
+            f"robustness: {stats.get('cancelled', 0)} cancelled, "
+            f"{stats.get('deadline_expired', 0)} deadline-expired, "
+            f"{stats.get('rejected', 0)} rejected, "
+            f"{stats.get('retries', 0)} retried dispatches, "
+            f"degradation rung max "
+            f"{stats.get('degradation_rung_max', 0)}"
+            + (f" (steps/rung {rungs}, "
+               f"{stats.get('spec_shed_steps', 0)} spec sheds)"
+               if rungs else ""))
     if "preemptions" in stats or "page_util_mean" in stats:
         lines.append(
             f"pages: utilization mean={stats.get('page_util_mean', 0.0):.1%}"

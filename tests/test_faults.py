@@ -1,0 +1,617 @@
+"""Fault-tolerance suite (docs/robustness.md).
+
+Layered like the serve suites:
+  * harness — FaultSpec parsing and FaultInjector determinism: a chaos
+    run must replay bit-for-bit from (spec, seed).
+  * serve — injected transient dispatch errors are retried invisibly
+    (outputs stay token-identical to generate_reference, zero
+    recompiles); a fatal mid-batch error fails ONLY the in-flight
+    requests and the engine keeps serving on the same compiled program
+    (the engine.py hard-brick regression); cancels and deadlines abort
+    at chunk boundaries with pages reclaimed; injected page-pool
+    pressure climbs the degradation ladder without ever changing a
+    surviving token.
+  * chaos — a seeded random interleaving of cancels, deadlines,
+    transient faults and page exhaustion; check_invariants after every
+    engine step; survivors exactly equal the reference.
+  * crash-safe state — kill-mid-save leaves no truncated checkpoint
+    visible and a restarted fit resumes to a bit-identical loss
+    trajectory; loader-state and cost-cache files share the
+    temp-then-os.replace contract.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.serve import RequestOutcome, ServeEngine
+from flexflow_tpu.utils import faults
+from flexflow_tpu.utils.faults import (FaultInjector, FaultSpec,
+                                       InjectedFault, SimulatedKill,
+                                       TransientError)
+
+
+# ------------------------------------------------------------- harness
+def test_fault_spec_parsing():
+    spec = FaultSpec("serve.mixed:transient@2,5-7,%4;"
+                     "serve.page_pressure:exhaust:0.6@3+;"
+                     "ckpt.commit:kill@1")
+    assert set(spec.by_site) == {"serve.mixed", "serve.page_pressure",
+                                 "ckpt.commit"}
+    cl = spec.by_site["serve.mixed"][0]
+    assert cl.kind == "transient"
+    hits = [n for n in range(1, 13) if cl.matches(n, None)]
+    assert hits == [2, 4, 5, 6, 7, 8, 12]
+    ex = spec.by_site["serve.page_pressure"][0]
+    assert ex.kind == "exhaust" and ex.value == 0.6
+    assert [n for n in range(1, 6) if ex.matches(n, None)] == [3, 4, 5]
+    assert not FaultSpec("")
+    for bad in ("site@3", "site:bogus@1", "site:fatal@0", "site:fatal",
+                "site:transient@~1.5", "site:transient@5-2"):
+        with pytest.raises(ValueError):
+            FaultSpec(bad)
+
+
+def test_injector_kinds_and_counters():
+    inj = FaultInjector("a:transient@2;b:fatal@1;c:kill@1;"
+                        "p:exhaust:0.5@2")
+    inj.fire("a")                      # hit 1: clean
+    with pytest.raises(TransientError):
+        inj.fire("a")                  # hit 2: fires
+    inj.fire("a")                      # hit 3: clean again
+    assert inj.hits("a") == 3
+    with pytest.raises(InjectedFault):
+        inj.fire("b")
+    # SimulatedKill must NOT be an Exception: `except Exception`
+    # recovery code cannot observe a kill -9
+    with pytest.raises(SimulatedKill):
+        inj.fire("c")
+    assert not issubclass(SimulatedKill, Exception)
+    assert inj.level("p") == 0.0 and inj.level("p") == 0.5
+    assert inj.fired["a"]["transient"] == 1
+    inj.fire("unknown.site")           # spec-less sites are free no-ops
+    assert inj.hits("unknown.site") == 0
+
+
+def test_injector_probability_seeded():
+    a = FaultInjector("s:transient@~0.3", seed=7)
+    b = FaultInjector("s:transient@~0.3", seed=7)
+
+    def pattern(inj):
+        out = []
+        for _ in range(64):
+            try:
+                inj.fire("s")
+                out.append(0)
+            except TransientError:
+                out.append(1)
+        return out
+
+    pa = pattern(a)
+    assert pa == pattern(b), "same (spec, seed) must replay exactly"
+    assert 0 < sum(pa) < 64
+    c = FaultInjector("s:transient@~0.3", seed=8)
+    assert pattern(c) != pa
+
+
+def test_config_validates_fault_spec():
+    FFConfig(fault_spec="serve.mixed:transient@1")   # well-formed: fine
+    with pytest.raises(ValueError):
+        FFConfig(fault_spec="serve.mixed:bogus@1")
+    with pytest.raises(ValueError):
+        FFConfig(serve_max_retries=-1)
+    with pytest.raises(ValueError):
+        FFConfig(serve_request_deadline=-0.5)
+
+
+# ------------------------------------------------------------- serve
+@pytest.fixture(scope="module")
+def lm():
+    from flexflow_tpu.models.transformer import build_transformer_lm
+    cfg = FFConfig(batch_size=1, kv_page_size=8, kv_num_pages=73,
+                   serve_max_seqs=8, serve_prefill_budget=48,
+                   serve_retry_backoff_s=0.0)
+    return build_transformer_lm(cfg, vocab_size=89, max_seq_len=64,
+                                hidden=32, num_heads=4, num_layers=2,
+                                ff_dim=64)
+
+
+@pytest.fixture(scope="module")
+def eng(lm):
+    """A fault-free engine for cancel/deadline tests (aborts must not
+    dirty it — that is part of what the tests assert)."""
+    e = ServeEngine(lm)
+    e.warmup()
+    return e
+
+
+def _prompts(rng, n, vocab=89, lo=4, hi=28):
+    return [list(rng.randint(1, vocab, size=rng.randint(lo, hi)))
+            for _ in range(n)]
+
+
+def _assert_clean(engine):
+    engine.cache.check_invariants()
+    assert engine.cache.free_slots == engine.cache_cfg.max_seqs
+    assert engine.cache.free_pages == engine.cache_cfg.usable_pages
+
+
+def test_transient_dispatch_retried_exact(lm):
+    # warmup is serve.mixed hit 1; hits 3 and 5 fail once each and the
+    # bounded retry (serve_max_retries=3 default) absorbs both
+    e = ServeEngine(lm, faults=FaultInjector("serve.mixed:transient@3,5"))
+    e.warmup()
+    rng = np.random.RandomState(0)
+    prompts = _prompts(rng, 5)
+    before = e.compile_counts()
+    out = e.generate(prompts, 6)
+    assert e.compile_counts() == before, "retries must not recompile"
+    assert out == e.generate_reference(prompts, 6)
+    assert e.last_stats["retries"] == 2
+    assert all(r["outcome"] == RequestOutcome.COMPLETED
+               for r in e.last_stats["requests"])
+    _assert_clean(e)
+
+
+def test_transient_exhausts_retries_then_engine_survives(lm):
+    # hits 2-6 fail: the first generate burns 1 + 3 retries (hits 2-5)
+    # and raises; the next generate hits 6 (fail) then 7 (success) —
+    # the batch after a failure serves normally with one retry
+    e = ServeEngine(lm, faults=FaultInjector("serve.mixed:transient@2-6"))
+    e.warmup()
+    rng = np.random.RandomState(1)
+    prompts = _prompts(rng, 4)
+    with pytest.raises(TransientError):
+        e.generate(prompts, 4)
+    _assert_clean(e)
+    out = e.generate(prompts, 4)
+    assert out == e.generate_reference(prompts, 4)
+    assert e.last_stats["retries"] == 1
+    _assert_clean(e)
+
+
+def test_fatal_midbatch_fails_requests_not_engine(lm):
+    """The engine.py hard-brick regression (ISSUE satellite): an
+    exception mid-generate() must fail only the in-flight requests;
+    the SAME engine then serves a fresh batch token-identical to the
+    reference on the same compiled program."""
+    e = ServeEngine(lm, faults=FaultInjector("serve.mixed:fatal@4"))
+    counts = e.warmup()
+    rng = np.random.RandomState(2)
+    with pytest.raises(InjectedFault):
+        e.generate(_prompts(rng, 6), 8)
+    _assert_clean(e)
+    prompts = _prompts(rng, 6)
+    out = e.generate(prompts, 6)
+    assert out == e.generate_reference(prompts, 6)
+    assert e.compile_counts() == counts, "recovery must not recompile"
+    _assert_clean(e)
+
+
+def test_orphaned_slots_self_heal(eng):
+    """Slots leaked by a crashed driver (or a user poking the cache)
+    are reclaimed at the next generate() instead of the old
+    'build a fresh ServeEngine' RuntimeError."""
+    cache = eng.cache
+    s = cache.alloc_slot()
+    cache.ensure_capacity(s, 20)
+    cache.advance(s, 20)
+    assert cache.free_slots != eng.cache_cfg.max_seqs
+    prompts = [[3, 5, 7, 11], [13, 17]]
+    out = eng.generate(prompts, 5)      # heals, then serves
+    assert out == eng.generate_reference(prompts, 5)
+    assert cache.stats["slots_reclaimed"] >= 1
+    _assert_clean(eng)
+
+
+def test_cancel_mid_generate(eng):
+    rng = np.random.RandomState(3)
+    prompts = _prompts(rng, 4, lo=4, hi=12)
+    ref = eng.generate_reference(prompts, 12)
+    cancelled = {1}
+
+    def on_step(step):
+        if step == 3:
+            assert eng.cancel(1)
+        eng.cache.check_invariants()
+
+    out = eng.generate(prompts, 12, on_step=on_step)
+    st = eng.last_stats
+    for i in range(4):
+        if i in cancelled:
+            n = len(out[i])
+            assert n < 12, "cancel must land before completion"
+            assert out[i] == ref[i][:n], "partial stream must be a " \
+                "prefix of the reference"
+            assert st["requests"][i]["outcome"] == RequestOutcome.CANCELLED
+        else:
+            assert out[i] == ref[i]
+            assert st["requests"][i]["outcome"] == RequestOutcome.COMPLETED
+    assert st["cancelled"] == 1
+    assert eng.cancel(999) is False     # stale rid outside a batch
+    _assert_clean(eng)
+
+
+def test_deadline_expires_structured(eng):
+    rng = np.random.RandomState(4)
+    prompts = _prompts(rng, 3, lo=4, hi=10)
+    ref = eng.generate_reference(prompts, 6)
+    # request 0: immediate expiry (swept before its first chunk);
+    # request 1: no deadline; request 2: generous deadline
+    out = eng.generate(prompts, 6, deadline_s=[1e-9, None, 60.0])
+    st = eng.last_stats
+    assert out[0] == [] and \
+        st["requests"][0]["outcome"] == RequestOutcome.DEADLINE_EXPIRED
+    assert st["requests"][0]["ttft_s"] is None
+    assert out[1] == ref[1] and out[2] == ref[2]
+    assert st["deadline_expired"] == 1
+    # the report renders aborted rows (None ttft/latency) and the
+    # robustness counters
+    from flexflow_tpu.utils.profiling import serve_report
+    rep = serve_report(st)
+    assert "deadline_expired" in rep and "robustness:" in rep
+    _assert_clean(eng)
+
+
+def test_default_deadline_from_config(eng):
+    prev = eng.default_deadline
+    eng.default_deadline = 1e-9
+    try:
+        out = eng.generate([[5, 6, 7], [11, 3]], 4)
+    finally:
+        eng.default_deadline = prev
+    assert out == [[], []]
+    assert eng.last_stats["deadline_expired"] == 2
+    _assert_clean(eng)
+
+
+def test_page_pressure_climbs_ladder_exact(lm):
+    """Injected page-pool exhaustion (70% of the pool hidden from
+    planning) must climb the degradation ladder — shedding speculation
+    and prefix matching — while every surviving token stays identical
+    to the reference."""
+    e = ServeEngine(
+        lm, faults=FaultInjector("serve.page_pressure:exhaust:0.7@1+"))
+    counts = e.warmup()
+    rng = np.random.RandomState(5)
+    prompts = _prompts(rng, 8, lo=8, hi=28)
+    out = e.generate(prompts, 8, on_step=lambda s:
+                     e.cache.check_invariants())
+    assert out == e.generate_reference(prompts, 8)
+    st = e.last_stats
+    assert st["degradation_rung_max"] >= 1
+    assert sum(st["rung_steps"][1:]) > 0
+    assert e.compile_counts() == counts
+    _assert_clean(e)
+
+
+def test_full_exhaustion_rejects_structured(lm):
+    """With the whole pool hidden, requests that cannot get even one
+    chunk's pages are REJECTED (structured outcome) instead of
+    deadlocking the step or raising out of the batch — and the engine
+    serves the next batch normally."""
+    e = ServeEngine(
+        lm, faults=FaultInjector("serve.page_pressure:exhaust:1.0@1"))
+    e.warmup()
+    prompts = [[3, 4, 5], [6, 7]]
+    out = e.generate(prompts, 4)
+    st = e.last_stats
+    assert out == [[], []]
+    assert st["rejected"] == 2
+    assert len(st["rejected_requests"]) == 2
+    assert all(r["outcome"] == RequestOutcome.REJECTED
+               for r in st["requests"])
+    assert st["degradation_rung_max"] == 4
+    _assert_clean(e)
+    # the pressure clause hit only the first scheduling step: normal
+    # service resumes on the very next batch
+    out = e.generate(prompts, 4)
+    assert out == e.generate_reference(prompts, 4)
+    assert e.last_stats["rejected"] == 0
+    _assert_clean(e)
+
+
+def test_ladder_disabled_freezes_rung(lm):
+    e = ServeEngine(
+        lm, faults=FaultInjector("serve.page_pressure:exhaust:0.7@1+"))
+    e.degrade_ladder = False
+    e.warmup()
+    rng = np.random.RandomState(6)
+    prompts = _prompts(rng, 4)
+    out = e.generate(prompts, 5)
+    assert out == e.generate_reference(prompts, 5)
+    assert e.last_stats["degradation_rung_max"] == 0
+    _assert_clean(e)
+
+
+def test_ladder_disabled_keeps_pool_too_small_raise(lm):
+    """--no-degrade-ladder keeps the pre-ladder contract: an
+    unservable head RAISES instead of being silently rejected — and
+    crash containment still leaves the engine serving."""
+    e = ServeEngine(
+        lm, faults=FaultInjector("serve.page_pressure:exhaust:1.0@1"))
+    e.degrade_ladder = False
+    e.warmup()
+    with pytest.raises(RuntimeError, match="page pool too small"):
+        e.generate([[3, 4, 5]], 4)
+    _assert_clean(e)
+    out = e.generate([[3, 4, 5]], 4)        # pressure clause spent
+    assert out == e.generate_reference([[3, 4, 5]], 4)
+    _assert_clean(e)
+
+
+def test_rung_steps_is_per_step_histogram(lm):
+    """rung_steps sums to the number of scheduling steps even when one
+    step rejects several requests (a rejection step counts once, as
+    rung 4)."""
+    e = ServeEngine(
+        lm, faults=FaultInjector("serve.page_pressure:exhaust:1.0@1"))
+    e.warmup()
+    e.generate([[3, 4, 5], [6, 7], [8, 9, 10]], 4)
+    st = e.last_stats
+    assert st["rejected"] == 3
+    assert st["rung_steps"][4] == 1, (
+        "one rejecting step must count once in the histogram")
+    assert sum(st["rung_steps"]) == st["steps"] + 1  # +1: empty-plan step
+    _assert_clean(e)
+
+
+# ------------------------------------------------------------- chaos
+def test_chaos_interleaving_survivors_exact(lm):
+    """The ISSUE's chaos property test: a seeded interleaving of a
+    cancel storm, deadlines, injected transient dispatch errors and
+    page exhaustion. After every engine step check_invariants holds;
+    at the end every completed request is token-identical to the
+    reference, every aborted request's partial stream is a reference
+    prefix, and nothing recompiled."""
+    e = ServeEngine(lm, faults=FaultInjector(
+        "serve.mixed:transient@~0.25;"
+        "serve.page_pressure:exhaust:0.9@%3", seed=11))
+    counts = e.warmup()
+    rng = np.random.RandomState(12)
+    n = 10
+    prompts = _prompts(rng, n, lo=4, hi=24)
+    max_new = [int(rng.randint(4, 14)) for _ in range(n)]
+    ref = e.generate_reference(prompts, max_new)
+    # two immediate deadlines, the rest unbounded
+    deadlines = [None] * n
+    deadlines[2] = 1e-9
+    deadlines[7] = 1e-9
+    # a cancel storm at fixed steps (deterministic given the seed)
+    storm = {2: [1], 4: [5, 6], 7: [9]}
+
+    def on_step(step):
+        for rid in storm.get(step, ()):
+            e.cancel(rid)
+        e.cache.check_invariants()      # after EVERY event
+
+    out = e.generate(prompts, max_new, deadline_s=deadlines,
+                     on_step=on_step)
+    st = e.last_stats
+    assert e.compile_counts() == counts, "chaos must not recompile"
+    aborted = completed = 0
+    for i in range(n):
+        o = st["requests"][i]["outcome"]
+        if o == RequestOutcome.COMPLETED:
+            assert out[i] == ref[i]
+            completed += 1
+        else:
+            assert o in (RequestOutcome.CANCELLED,
+                         RequestOutcome.DEADLINE_EXPIRED,
+                         RequestOutcome.REJECTED)
+            assert out[i] == ref[i][:len(out[i])]
+            aborted += 1
+    assert completed >= 3, "chaos should leave survivors"
+    assert aborted >= 3, "chaos should abort some requests"
+    assert st["retries"] > 0, "transient faults should have fired"
+    assert st["degradation_rung_max"] >= 1
+    _assert_clean(e)
+    # and the same engine serves a clean batch afterwards
+    clean = _prompts(rng, 4)
+    assert e.generate(clean, 4) == e.generate_reference(clean, 4)
+    _assert_clean(e)
+
+
+# ---------------------------------------------------- crash-safe state
+def _ckpt_model(seed=0):
+    from flexflow_tpu import AdamOptimizer, FFModel
+    cfg = FFConfig()
+    cfg.batch_size = 16
+    cfg.seed = seed
+    ff = FFModel(cfg)
+    x = ff.create_tensor((16, 8), name="input")
+    t = ff.dense(x, 16, activation="relu")
+    t = ff.softmax(ff.dense(t, 4))
+    ff.compile(optimizer=AdamOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy",
+               metrics=["accuracy"])
+    return ff
+
+
+def test_kill_mid_checkpoint_resume_bit_exact(tmp_path):
+    """The ISSUE's kill-mid-save satellite: a process killed while
+    committing a checkpoint leaves NO truncated epoch visible; the
+    restarted run resumes from the newest committed epoch and its loss
+    trajectory equals the uninterrupted run's exactly."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.int32)
+    ckdir = str(tmp_path / "ck")
+
+    ff_ref = _ckpt_model()
+    h_ref = ff_ref.fit({"input": x}, y, epochs=4, verbose=False)
+
+    # fit's async saver commits epoch k when epoch k+1's save starts:
+    # ckpt.commit hit 1 promotes epoch_0, hit 2 would promote epoch_1 —
+    # kill there AND on every later commit attempt (a dead process
+    # cannot run fit's finally-block either)
+    with faults.active("ckpt.commit:kill@2+"):
+        with pytest.raises(SimulatedKill):
+            _ckpt_model().fit({"input": x}, y, epochs=4, verbose=False,
+                              checkpoint_dir=ckdir)
+    visible = [d for d in os.listdir(ckdir)
+               if d.startswith("epoch_") and d[len("epoch_"):].isdigit()]
+    assert visible == ["epoch_0"], (
+        f"only fully-committed checkpoints may be visible: {visible}")
+
+    # restart: fresh process, same command — resumes at epoch 1 and
+    # lands exactly where the uninterrupted run does
+    ff_b = _ckpt_model()
+    h_b = ff_b.fit({"input": x}, y, epochs=4, verbose=False,
+                   checkpoint_dir=ckdir)
+    assert [m["epoch"] for m in h_b] == [1, 2, 3]
+    for m_ref, m_b in zip(h_ref[1:], h_b):
+        assert m_b["loss"] == pytest.approx(m_ref["loss"], abs=1e-6)
+    np.testing.assert_allclose(ff_ref.get_weights("dense")["kernel"],
+                               ff_b.get_weights("dense")["kernel"],
+                               atol=1e-6)
+
+
+def test_sync_save_kill_leaves_previous_checkpoint(tmp_path):
+    from flexflow_tpu.core.checkpoint import (restore_checkpoint,
+                                              save_checkpoint)
+    rng = np.random.RandomState(1)
+    batch = {"input": rng.randn(16, 8).astype(np.float32),
+             "label": rng.randint(0, 4, 16).astype(np.int32)}
+    ff = _ckpt_model()
+    ff.train_batch(batch)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, ff.state)
+    w_old = np.asarray(ff.get_weights("dense")["kernel"]).copy()
+    step_old = int(ff.state.step)
+
+    ff.train_batch(batch)
+    with faults.active("ckpt.commit:kill@1"):
+        with pytest.raises(SimulatedKill):
+            save_checkpoint(path, ff.state)
+    # the kill landed between the complete tmp write and the promote:
+    # the OLD checkpoint is still what `path` restores
+    restored = restore_checkpoint(path, ff.state)
+    assert int(restored.step) == step_old
+    np.testing.assert_allclose(
+        np.asarray(restored.params["dense"]["kernel"]), w_old)
+    # a clean re-save commits the new state (and sweeps the stale tmp)
+    save_checkpoint(path, ff.state)
+    restored = restore_checkpoint(path, ff.state)
+    assert int(restored.step) == step_old + 1
+
+
+def test_kill_inside_promote_window_recovers_old(tmp_path):
+    """A kill INSIDE _promote's two-rename window (old checkpoint
+    moved aside, new one not yet swung in) must not lose the previous
+    checkpoint: readers recover it from `.old`."""
+    from flexflow_tpu.core.checkpoint import (restore_checkpoint,
+                                              save_checkpoint)
+    rng = np.random.RandomState(3)
+    batch = {"input": rng.randn(16, 8).astype(np.float32),
+             "label": rng.randint(0, 4, 16).astype(np.int32)}
+    ff = _ckpt_model()
+    ff.train_batch(batch)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, ff.state)
+    step_old = int(ff.state.step)
+    ff.train_batch(batch)
+    # the first save ran outside active(), so this context's injector
+    # sees the re-save's swap as hit 1
+    with faults.active("ckpt.swap:kill@1"):
+        with pytest.raises(SimulatedKill):
+            save_checkpoint(path, ff.state)
+    assert not os.path.isdir(path)            # the window, frozen
+    assert os.path.isdir(path + ".old")
+    restored = restore_checkpoint(path, ff.state)   # recovers .old
+    assert int(restored.step) == step_old
+    assert os.path.isdir(path)
+
+
+def test_fit_resume_skips_corrupt_newest_epoch(tmp_path):
+    """Out-of-band damage to the newest committed epoch must not kill
+    the run: resume warns and falls back to the previous epoch."""
+    rng = np.random.RandomState(2)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.int32)
+    ckdir = tmp_path / "ck"
+    ff = _ckpt_model()
+    ff.fit({"input": x}, y, epochs=2, verbose=False,
+           checkpoint_dir=str(ckdir))
+    # vandalize epoch_1 (committed, then damaged out-of-band)
+    victim = ckdir / "epoch_1"
+    assert victim.is_dir()
+    for root, _, files in os.walk(victim):
+        for f in files:
+            (open(os.path.join(root, f), "wb")).close()   # truncate
+    ff2 = _ckpt_model()
+    with pytest.warns(UserWarning, match="epoch_1 unreadable"):
+        h = ff2.fit({"input": x}, y, epochs=3, verbose=False,
+                    checkpoint_dir=str(ckdir))
+    assert [m["epoch"] for m in h] == [1, 2]
+
+
+def test_loader_state_checkpoint_atomic(tmp_path):
+    from flexflow_tpu.core.dataloader import DataLoaderSet
+    x = np.arange(64, dtype=np.float32).reshape(64, 1)
+    y = np.arange(64, dtype=np.int32)
+    path = str(tmp_path / "loader.json")
+
+    ds = DataLoaderSet({"input": x, "label": y}, batch_size=16,
+                       shuffle=True, seed=3, prefetch=False)
+    list(ds)                         # epoch 0 consumes one permutation
+    ds.save_state(path)
+    epoch1 = [np.asarray(b["label"]).tolist() for b in ds]
+
+    # a clone restored from the state file replays epoch 1 exactly
+    ds2 = DataLoaderSet({"input": x, "label": y}, batch_size=16,
+                        shuffle=True, seed=99, prefetch=False)
+    assert ds2.load_state(path)
+    assert [np.asarray(b["label"]).tolist() for b in ds2] == epoch1
+
+    # kill mid-save: the previous complete state file survives
+    old = open(path).read()
+    with faults.active("loader.commit:kill@1"):
+        with pytest.raises(SimulatedKill):
+            ds.save_state(path)
+    assert open(path).read() == old
+    assert not ds2.load_state(str(tmp_path / "absent.json"))
+
+    # a malformed file must leave the loader UNTOUCHED (parse fully
+    # before applying anything)
+    import json
+    bad = json.loads(old)
+    bad["rng"][2] = "not-an-int"
+    badpath = str(tmp_path / "bad.json")
+    with open(badpath, "w") as f:
+        json.dump(bad, f)
+    before = ds2.state_dict()
+    assert not ds2.load_state(badpath)
+    after = ds2.state_dict()
+    assert after["rng"] == before["rng"], "rejected file mutated the rng"
+
+
+def test_cost_cache_corrupt_load_warns_and_rebuilds(tmp_path):
+    from flexflow_tpu.search.cost_cache import CostCache
+    from flexflow_tpu.search.cost_model import OpCost
+    path = str(tmp_path / "costcache.json")
+    with open(path, "w") as f:
+        f.write('{"fp": {"abc": [1.0, 2.0')      # truncated mid-write
+    cc = CostCache(path)
+    with pytest.warns(UserWarning, match="rebuilding"):
+        assert cc.get("fp", "abc") is None
+    cost = OpCost(fwd=1.0, bwd=2.0, fwd_comm=0.1, bwd_comm=0.2,
+                  sync=0.3, mem=4.0, update=0.5)
+    cc.put("fp", "abc", cost)
+    with pytest.warns(UserWarning, match="corrupt at flush"):
+        cc.flush()                               # rebuilds wholesale
+    cc2 = CostCache(path)
+    got = cc2.get("fp", "abc")
+    assert got is not None and got.fwd == 1.0 and got.update == 0.5
+    # malformed rows inside a parseable store miss instead of crashing
+    import json
+    with open(path) as f:
+        data = json.load(f)
+    data["fp"]["bad"] = [1.0]
+    with open(path, "w") as f:
+        json.dump(data, f)
+    cc3 = CostCache(path)
+    assert cc3.get("fp", "bad") is None
+    assert cc3.get("fp", "abc") is not None

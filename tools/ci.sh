@@ -61,6 +61,17 @@ echo "--- 1f. speculative-decode smoke (step-reduction + exactness gate)"
 env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload spec \
     -o /tmp/ci_bench_serve_spec.json || fail=1
 
+echo "--- 1g. chaos smoke (fault-injected serving gate)"
+# the base workload under a SEEDED fault spec (transient dispatch
+# errors + page-pool exhaustion) plus a cancel/deadline storm: fails
+# unless every surviving request is token-identical to
+# generate_reference, PagedKVCache.check_invariants holds after every
+# step, every page is reclaimed, and nothing compiles after warmup
+# (docs/robustness.md)
+env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload base \
+    --fault-spec 'serve.mixed:transient@3,6,11;serve.page_pressure:exhaust:0.9@4-9' \
+    -o /tmp/ci_bench_serve_chaos.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \

@@ -112,6 +112,12 @@ def main() -> int:
     ap.add_argument("--prefix-len", type=int, default=0,
                     help="shared-prefix workload's common prefix length "
                     "(0 = half the max prompt)")
+    ap.add_argument("--fault-spec", default="",
+                    help="seeded fault-injection spec (utils/faults.py) "
+                    "armed on the random-workload engine; also runs a "
+                    "cancel/deadline storm and gates survivor "
+                    "exactness + invariants + zero recompiles "
+                    "(tools/ci.sh step 1g)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("-o", "--out", default="BENCH_serve.json",
                     help="output JSON path ('' = stdout only)")
@@ -160,8 +166,28 @@ def main() -> int:
     records = []
     gates = []
 
+    injector = None
+    if args.fault_spec:
+        from flexflow_tpu.utils.faults import FaultInjector
+        injector = FaultInjector(args.fault_spec, seed=args.seed)
+
+    def _assert_survivors(out, ref, stats):
+        """The chaos exactness contract: every COMPLETED request is
+        token-identical to the reference; every aborted/rejected one's
+        partial stream is a reference prefix."""
+        survivors = 0
+        for i, r in enumerate(stats["requests"]):
+            if r["outcome"] == "completed":
+                assert out[i] == ref[i], (
+                    f"surviving request {i} diverged from reference")
+                survivors += 1
+            else:
+                assert out[i] == ref[i][:len(out[i])], (
+                    f"aborted request {i} is not a reference prefix")
+        return survivors
+
     if args.workload in ("all", "base"):
-        eng = ServeEngine(ff)
+        eng = ServeEngine(ff, faults=injector)
         t0 = time.perf_counter()
         counts = eng.warmup()
         warm_s = time.perf_counter() - t0
@@ -175,7 +201,14 @@ def main() -> int:
         wall = time.perf_counter() - t0
         stats = eng.last_stats
         print(serve_report(stats), file=sys.stderr)
-        assert all(len(o) > 0 for o in out)
+        if injector is None:
+            assert all(len(o) > 0 for o in out)
+        else:
+            # under injected faults the gate is survivor exactness +
+            # clean invariants, not universal completion
+            _assert_survivors(out, eng.generate_reference(
+                prompts, args.max_new), stats)
+            eng.cache.check_invariants()
 
         pct = serve_percentiles(stats)
         records.append({
@@ -207,10 +240,68 @@ def main() -> int:
             },
         })
 
+        # ---- chaos storm (only with --fault-spec): cancels + deadlines
+        # through the SAME engine the injected faults hit, gating that
+        # the engine is still serving exactly, reclaiming every page,
+        # and never recompiling (tools/ci.sh step 1g)
+        if injector is not None:
+            cprompts = [list(rng.randint(
+                1, args.vocab, size=rng.randint(4, max_prompt + 1)))
+                for _ in range(args.requests)]
+            cref = eng.generate_reference(cprompts, args.max_new)
+            deadlines = [None] * args.requests
+            deadlines[1 % args.requests] = 1e-9      # expires instantly
+            storm = {1: [2 % args.requests], 3: [5 % args.requests]}
+
+            def on_step(step):
+                for rid in storm.get(step, ()):
+                    eng.cancel(rid)
+                eng.cache.check_invariants()         # after every event
+
+            cout = eng.generate(cprompts, args.max_new,
+                                deadline_s=deadlines, on_step=on_step)
+            cstats = eng.last_stats
+            survivors = _assert_survivors(cout, cref, cstats)
+            assert survivors > 0, "chaos storm left no survivors"
+            aborted = (cstats["cancelled"] + cstats["deadline_expired"]
+                       + cstats["rejected"])
+            assert aborted > 0, "chaos storm aborted nothing"
+            assert eng.compile_counts() == counts, (
+                f"chaos recompiled: {counts} -> {eng.compile_counts()}")
+            assert eng.cache.free_pages == \
+                eng.cache_cfg.usable_pages, "chaos leaked pages"
+            retried = stats["retries"] + cstats["retries"]
+            gates.append(
+                f"chaos survivors={survivors} aborted={aborted} "
+                f"retried={retried} "
+                f"rung_max={max(stats['degradation_rung_max'], cstats['degradation_rung_max'])}")
+            records.append({
+                "metric": "serve_chaos_survivor_exactness",
+                "value": 1.0,
+                "unit": "bool",
+                "extra": {
+                    "platform": jax.default_backend(),
+                    "fault_spec": args.fault_spec,
+                    "seed": args.seed,
+                    "survivors": survivors,
+                    "cancelled": cstats["cancelled"],
+                    "deadline_expired": cstats["deadline_expired"],
+                    "rejected": cstats["rejected"],
+                    "retried_dispatches": retried,
+                    "degradation_rung_max": max(
+                        stats["degradation_rung_max"],
+                        cstats["degradation_rung_max"]),
+                    "rung_steps": cstats["rung_steps"],
+                    "outputs_match_reference": True,
+                    "compile_counts": eng.compile_counts(),
+                },
+            })
+
         # ---- workload 2: shared prefix (the prefix-cache win) --------
         # a FRESH engine so workload 1's committed pages cannot inflate
         # the hit rate: every hit below comes from sharing inside this
-        # workload
+        # workload (and the fault injector stays off it — its gates
+        # measure the cache, not the chaos)
         eng2 = ServeEngine(ff)
         eng2.warmup()
         prefix_len = args.prefix_len or max_prompt // 2
