@@ -429,17 +429,24 @@ def try_child(model, preset, steps, timeout, force_cpu=False):
     return None
 
 
-_tpu_probe_result = None
+_tpu_probe_result = None  # (ok: bool, reason: str)
 
 
 def probe_tpu(timeout=120):
-    """Can the ambient (axon/TPU) backend come up at all? Cached across
-    models in an --all sweep. A dead relay hangs jax.devices() forever,
-    so this is a subprocess with a hard timeout."""
+    """Can the ambient (axon/TPU) backend come up at all? Returns
+    (ok, reason) where `reason` distinguishes the failure modes a
+    stale-marked record must explain (round-5 postmortem: BENCH_r*
+    trajectories silently mixed stale TPU and live CPU numbers with no
+    WHY): a probe TIMEOUT means the relay is down or the lease is stuck
+    (jax.devices() hangs forever on a dead relay — hence the
+    subprocess + hard timeout), a fast CPU resolution means the axon
+    plugin failed over instantly (no lease / plugin error), a nonzero
+    rc means backend init crashed outright. Cached across models in an
+    --all sweep; `--probe-timeout` tunes the window."""
     global _tpu_probe_result
     if _tpu_probe_result is not None:
         return _tpu_probe_result
-    log(f"probing TPU backend (timeout {timeout}s)...")
+    log(f"probing TPU backend (timeout {timeout:.0f}s)...")
     try:
         r = subprocess.run(
             [sys.executable, "-c",
@@ -451,25 +458,46 @@ def probe_tpu(timeout=120):
         # the sitecustomize registers platforms "axon,cpu" — a fast axon
         # failure still exits 0 on the CPU fallback, so check the
         # platform actually resolved, not just the return code
-        _tpu_probe_result = (r.returncode == 0 and bool(out)
-                             and not out.startswith("cpu"))
-        if _tpu_probe_result:
-            log(f"TPU backend OK: {out}")
+        if r.returncode != 0:
+            _tpu_probe_result = (
+                False, f"probe rc={r.returncode}: backend init crashed")
+        elif not out or out.startswith("cpu"):
+            _tpu_probe_result = (
+                False, f"backend resolved to {out or 'nothing'!s} "
+                f"(axon fast-fail: no TPU lease / plugin error)")
         else:
-            log(f"TPU backend init failed (got: {out or 'no output'})")
+            _tpu_probe_result = (True, f"ok: {out}")
     except subprocess.TimeoutExpired:
-        log("TPU backend init timed out (relay down or lease stuck)")
-        _tpu_probe_result = False
+        _tpu_probe_result = (
+            False, f"probe timed out after {timeout:.0f}s "
+            f"(relay down or lease stuck)")
+    ok, reason = _tpu_probe_result
+    log(f"TPU backend {'OK' if ok else 'unavailable'}: {reason}")
     return _tpu_probe_result
 
 
-def run_ladder(model, steps, deadline_at, allow_cpu_fallback=True):
+def probe_reason():
+    """The cached probe verdict's reason ('' before any probe ran)."""
+    return _tpu_probe_result[1] if _tpu_probe_result else ""
+
+
+def run_ladder(model, steps, deadline_at, allow_cpu_fallback=True,
+               probe_timeout=120, cpu_only=False):
     """probe -> TPU full (retry) -> TPU small -> CPU tiny; never returns
-    empty-handed while the CPU fallback can run. Returns dict|None."""
+    empty-handed while the CPU fallback can run. Returns dict|None.
+    `cpu_only` skips the probe and the TPU rungs entirely (a deliberate
+    CPU measurement, not a fallback — finalize() keeps it fresh)."""
     remaining = lambda: deadline_at - time.perf_counter()  # noqa: E731
+    if cpu_only:
+        global _tpu_probe_result
+        _tpu_probe_result = (False, "cpu-only requested (--cpu-only)")
+        return try_child(model, "tiny", max(5, steps // 4),
+                         max(30, remaining()), force_cpu=True)
     # reserve time for the guaranteed CPU fallback
     reserve = 150 if allow_cpu_fallback else 0
-    if probe_tpu(min(120, max(30, remaining() - reserve))):
+    ok, _why = probe_tpu(min(probe_timeout,
+                             max(30, remaining() - reserve)))
+    if ok:
         # backend comes up: give full-size runs real budgets, retry once
         # (transient tunnel hiccups), then degrade to the small preset
         attempts = [("full", 420), ("full", 420), ("small", 300)]
@@ -556,12 +584,17 @@ def _bench_all_git_stamp():
     return stamp
 
 
-def finalize(model, res):
+def finalize(model, res, cpu_only=False):
     """Choose the headline JSON line: a fresh TPU measurement wins; a
     CPU fallback (or total failure) is REPLACED by the last committed
-    TPU sweep entry, stale-marked + timestamped, with the fresh CPU
-    number attached as a liveness signal."""
+    TPU sweep entry, stale-marked + timestamped + annotated with WHY
+    the TPU was unreachable (probe_reason: relay down vs lease stuck vs
+    fast axon fail), with the fresh CPU number attached as a liveness
+    signal. Under --cpu-only the CPU number IS the requested
+    measurement and is returned fresh, never stale-replaced."""
     if _is_tpu_result(res):
+        return res
+    if cpu_only:
         return res
     hist = last_committed_tpu(model)
     if hist is None:
@@ -569,6 +602,7 @@ def finalize(model, res):
     hist = dict(hist)
     hist["extra"] = dict(hist.get("extra", {}))
     hist["extra"]["stale"] = True
+    hist["extra"]["stale_reason"] = probe_reason() or "unknown"
     # staleness must survive parsers that ignore `extra`: surface it at
     # top level too
     hist["stale"] = True
@@ -581,8 +615,9 @@ def finalize(model, res):
         }
     else:
         hist["extra"]["cpu_liveness"] = None
-    log(f"{model}: TPU unreachable now; emitting last committed TPU "
-        f"sweep (captured {hist['extra'].get('captured', '?')}) "
+    log(f"{model}: TPU unreachable now "
+        f"({hist['extra']['stale_reason']}); emitting last committed "
+        f"TPU sweep (captured {hist['extra'].get('captured', '?')}) "
         f"stale-marked, CPU liveness attached")
     return hist
 
@@ -590,10 +625,12 @@ def finalize(model, res):
 def merge_bench_all(results):
     """Write bench_all.json without letting a dead tunnel erase history:
     per model, a fresh TPU result overwrites; a CPU fallback/None keeps
-    the existing TPU entry (stale-marked) and records the fallback under
-    extra.cpu_liveness via finalize(). Committed entries for models NOT
-    in this sweep survive untouched (history is merged into, never
-    rebuilt from scratch)."""
+    the existing TPU entry (stale-marked, with the probe's WHY) and
+    records the fallback under extra.cpu_liveness via finalize().
+    Committed entries for models NOT in this sweep survive untouched
+    (history is merged into, never rebuilt from scratch). --cpu-only
+    sweeps never reach this function (main() skips the merge so a
+    deliberate CPU diagnostic cannot overwrite TPU history)."""
     try:
         with open(_bench_all_path()) as f:
             merged = json.load(f)
@@ -617,6 +654,15 @@ def main():
                          "bench_all.json; print the flagship line last")
     ap.add_argument("--deadline", type=float,
                     default=float(os.environ.get("BENCH_DEADLINE_S", 900)))
+    ap.add_argument("--probe-timeout", type=float,
+                    default=float(os.environ.get(
+                        "BENCH_PROBE_TIMEOUT_S", 120)),
+                    help="seconds to wait for the TPU backend probe "
+                         "before declaring the tunnel dead")
+    ap.add_argument("--cpu-only", action="store_true",
+                    help="skip the TPU probe and rungs; measure the "
+                         "tiny preset on CPU deliberately (result is "
+                         "fresh, never stale-replaced)")
     args = ap.parse_args()
 
     if args.child:
@@ -645,19 +691,32 @@ def main():
         results["transformer"] = run_ladder(
             "transformer", args.steps,
             time.perf_counter()
-            + max(400.0, min(700.0, args.deadline * 0.3)))
+            + max(400.0, min(700.0, args.deadline * 0.3)),
+            probe_timeout=args.probe_timeout, cpu_only=args.cpu_only)
         per = max(400.0, (deadline_at - time.perf_counter() - 100)
                   / len(others))
         for m in others:
             results[m] = run_ladder(m, args.steps,
-                                    time.perf_counter() + per)
+                                    time.perf_counter() + per,
+                                    probe_timeout=args.probe_timeout,
+                                    cpu_only=args.cpu_only)
         # exit 0 only when EVERY config measured fresh ON CHIP this
         # run: the session script gates its full-queue-done sentinel on
         # this rc, and bench's internal ladder hides tunnel deaths
         # behind CPU/stale fallbacks (exit-0-if-any-fresh let a
         # mid-sweep tunnel death count as a completed sweep)
-        all_fresh_tpu = all(_is_tpu_result(v) for v in results.values())
-        results = merge_bench_all(results)
+        all_fresh_tpu = all(_is_tpu_result(v) for v in results.values()) \
+            or (args.cpu_only and all(bool(v) for v in results.values()))
+        if args.cpu_only:
+            # a deliberate CPU diagnostic must never overwrite the
+            # committed TPU history that last_committed_tpu / the
+            # stale-replacement ladder depend on
+            log("--cpu-only: not merging into bench_all.json "
+                "(committed TPU history preserved)")
+            results = {m: finalize(m, r, cpu_only=True)
+                       for m, r in results.items()}
+        else:
+            results = merge_bench_all(results)
         log(f"sweep done: { {k: bool(v) for k, v in results.items()} } "
             f"all_fresh_tpu={all_fresh_tpu}")
         flag = results["transformer"]
@@ -669,8 +728,10 @@ def main():
             return 0 if all_fresh_tpu else 1
         return 1
 
-    fresh = run_ladder(args.model, args.steps, deadline_at)
-    res = finalize(args.model, fresh)
+    fresh = run_ladder(args.model, args.steps, deadline_at,
+                       probe_timeout=args.probe_timeout,
+                       cpu_only=args.cpu_only)
+    res = finalize(args.model, fresh, cpu_only=args.cpu_only)
     if res:
         print(json.dumps(res), flush=True)
         return 0 if fresh else 1

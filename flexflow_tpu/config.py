@@ -59,7 +59,7 @@ class FFConfig:
       batchSize -> batch_size, epochs -> epochs, iterations -> iterations,
       numNodes/workersPerNode -> described by the mesh,
       learningRate/weightDecay -> lr/weight_decay (consumed by optimizers),
-      search_budget/search_alpha/search_overlap_backward_update ->
+      search_budget/search_alpha/search_overlap_backward_sync ->
         search_* (consumed by flexflow_tpu.search.mcmc),
       import_strategy_file/export_strategy_file -> strategy I/O,
       enable_sample_parallel/parameter_parallel/attribute_parallel ->
@@ -91,10 +91,36 @@ class FFConfig:
     profiling: bool = False
     log_instance_creation: bool = False
 
+    # ---- async/overlap training runtime (core/overlap.py) ----
+    # bucketed, backward-overlapped gradient sync: the walk's weighted
+    # ops partition into contiguous buckets of ~this many MiB of master
+    # parameters, and each bucket's data-axis gradient all-reduce is
+    # anchored (custom_vjp sync point + optimization_barrier) at the
+    # point in the backward pass where the bucket's grads complete, so
+    # XLA schedules it concurrently with the remaining backward instead
+    # of coalescing one monolithic end-of-backward sync. Gradients are
+    # BIT-identical either way (same reduction set, donation
+    # preserved). 0 = legacy monolithic sync. --grad-bucket-mb.
+    grad_bucket_mb: float = 4.0
+    # pipelined host dispatch (model.fit): keep up to this many train
+    # dispatches in flight before retrieving the oldest step's host
+    # metrics — depth 2 retrieves step N while step N+1 runs on device.
+    # 1 = fully synchronous (block on every step), 0 = unbounded
+    # (epoch-bulk retrieval, device metric handles grow with the
+    # epoch). --train-dispatch-depth.
+    train_dispatch_depth: int = 2
+
     # auto-parallelization (reference: config.h:116-141)
     search_budget: int = 0
     search_alpha: float = 0.05
-    search_overlap_backward_update: bool = False
+    # simulator overlap modeling (reference search_overlap_backward_
+    # update, simulator.cc:393-497): when True (default) gradient-sync
+    # tasks may overlap the remaining backward pass — bucket-granular
+    # when grad_bucket_mb > 0, per-op otherwise; when False every sync
+    # serializes after the whole backward. Folded into the cost-cache
+    # machine fingerprint, so flipping it can never resurrect stale
+    # entries. --no-overlap-sync disables.
+    search_overlap_backward_sync: bool = True
     # delta re-simulation (Simulator.simulate_delta): per proposal,
     # re-cost only the moved op(s) and replay the cached scheduled task
     # graph instead of rebuilding + rescheduling everything — the
@@ -355,6 +381,14 @@ class FFConfig:
             raise ValueError(
                 f"pipeline_virtual_stages must be >= 1, got "
                 f"{self.pipeline_virtual_stages}")
+        if self.grad_bucket_mb < 0:
+            raise ValueError(
+                f"grad_bucket_mb must be >= 0 (0 = monolithic sync), "
+                f"got {self.grad_bucket_mb}")
+        if self.train_dispatch_depth < 0:
+            raise ValueError(
+                f"train_dispatch_depth must be >= 0 (0 = unbounded, "
+                f"1 = synchronous), got {self.train_dispatch_depth}")
         if self.search_chains < 0:
             raise ValueError(
                 f"search_chains must be >= 0 (0 = auto), got "
@@ -439,6 +473,8 @@ class FFConfig:
         "--machine-model-file": ("machine_model_file", str),
         "--taskgraph": ("taskgraph_file", str),
         "--seed": ("seed", int),
+        "--grad-bucket-mb": ("grad_bucket_mb", float),
+        "--train-dispatch-depth": ("train_dispatch_depth", int),
         "--compute-dtype": ("compute_dtype", str),
         "--param-dtype": ("param_dtype", str),
         "--conv-layout": ("conv_layout", str),
@@ -465,7 +501,7 @@ class FFConfig:
         "--profiling": "profiling",
         "--fusion": "perform_fusion",
         "--remat": "remat",
-        "--overlap": "search_overlap_backward_update",
+        "--overlap": "search_overlap_backward_sync",
         "--enable-parameter-parallel": "enable_parameter_parallel",
         "--enable-attribute-parallel": "enable_attribute_parallel",
         "--enable-sample-parallel": "enable_sample_parallel",
@@ -480,6 +516,7 @@ class FFConfig:
         "--sparse-embedding-lazy": "sparse_embedding_lazy",
     }
     _NEG_BOOL_FLAGS = {
+        "--no-overlap-sync": "search_overlap_backward_sync",
         "--no-sparse-embedding": "sparse_embedding_updates",
         "--no-sibling-conv-fusion": "sibling_conv_fusion",
         "--no-delta-sim": "search_delta_sim",

@@ -251,20 +251,35 @@ class DataLoaderSet:
     def _iter_prefetch(self, order: np.ndarray
                        ) -> Iterator[Dict[str, jax.Array]]:
         """Double-buffered pure-Python epoch: a background thread runs
-        the fancy-indexed row gathers (the host-side cost of a batch)
-        up to two batches ahead of the main thread's host->device
-        transfers — the same gather/transfer overlap the native loader
-        gets from its C++ worker (csrc/dataloader.cc), minus the shared
-        buffer (each gather is a fresh array, so nothing here can alias
-        a batch the consumer still holds). Batch ORDER and CONTENT are
-        byte-identical to the synchronous path: the worker walks the
-        same `order` slices, and the bounded queue only changes WHEN a
-        gather runs, not what it reads."""
+        the fancy-indexed row gathers AND (single-process runs) the
+        cast + host->device transfer up to two batches ahead of the
+        main thread — the same gather/transfer overlap the native
+        loader gets from its C++ worker (csrc/dataloader.cc), minus the
+        shared buffer (each gather is a fresh array, so nothing here
+        can alias a batch the consumer still holds).
+
+        Staging on the worker matters because CONSECUTIVE DONATED
+        dispatches synchronize on the CPU/TPU runtime (the next step
+        cannot alias the previous step's output buffer until it
+        exists), so the main thread's dispatch call blocks for most of
+        the device step — host work only overlaps device compute if it
+        happens on another thread. This is the loader half of the
+        async training runtime (core/overlap.py has the dispatch-window
+        half; tools/train_bench.py measures the two together). A
+        multi-process mesh keeps staging on the main thread:
+        place_process_local is a collective-addressing operation the
+        worker must not race.
+
+        Batch ORDER and CONTENT are byte-identical to the synchronous
+        path: the worker walks the same `order` slices through the same
+        host_to_device, and the bounded queue only changes WHEN a batch
+        is staged, not what it reads."""
         import queue
         import threading
         bs = self.batch_size
         q: "queue.Queue" = queue.Queue(maxsize=2)   # the double buffer
         stop = threading.Event()
+        stage_on_worker = jax.process_count() == 1
 
         def gather() -> None:
             try:
@@ -272,8 +287,13 @@ class DataLoaderSet:
                     if stop.is_set():
                         return
                     sel = order[i * bs:(i + 1) * bs]
-                    q.put({k: l.data[sel]
-                           for k, l in self.loaders.items()})
+                    batch = {k: l.data[sel]
+                             for k, l in self.loaders.items()}
+                    if stage_on_worker:
+                        batch = {k: host_to_device(
+                            v, self.mesh, self.dtypes.get(k))
+                            for k, v in batch.items()}
+                    q.put(batch)
                 q.put(None)                          # end of epoch
             except BaseException as e:               # surface in consumer
                 q.put(e)
@@ -288,9 +308,12 @@ class DataLoaderSet:
                     break
                 if isinstance(item, BaseException):
                     raise item
-                yield {k: host_to_device(v, self.mesh,
-                                         self.dtypes.get(k))
-                       for k, v in item.items()}
+                if stage_on_worker:
+                    yield item
+                else:
+                    yield {k: host_to_device(v, self.mesh,
+                                             self.dtypes.get(k))
+                           for k, v in item.items()}
         finally:
             # abandoned iterator (break / exception): unblock a worker
             # parked on the full queue, then reap it

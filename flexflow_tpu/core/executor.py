@@ -117,6 +117,14 @@ class Executor:
         self._train_step = None
         self._train_step_multi = None
         self._train_step_accum = None
+        # bucketed backward-overlapped gradient sync (core/overlap.py):
+        # bucket partition + the custom_vjp sync-point op are cached
+        # against the sparse routing (sparse tables scatter outside the
+        # bucketed reduction) and rebuilt when it changes
+        self._grad_bucket_mb = float(
+            getattr(self.config, "grad_bucket_mb", 0.0) or 0.0)
+        self._grad_buckets_cache = None
+        self._bucket_tagger = None
         # runtime LR multiplier (model.set_learning_rate / keras
         # LearningRateScheduler): passed into every jitted step as a
         # traced scalar, so changing it NEVER recompiles
@@ -464,8 +472,47 @@ class Executor:
                 values[uid] = jnp.transpose(values[uid], (0, 3, 1, 2))
         return values, new_states
 
+    # ---------------- bucketed grad-sync points (core/overlap.py) -----
+    def _grad_buckets(self):
+        """Cached walk-order sync-bucket partition (list of (names,
+        bytes)); [] when grad_bucket_mb is 0 (legacy monolithic)."""
+        if self._grad_buckets_cache is None:
+            from .overlap import grad_buckets
+            self._grad_buckets_cache = grad_buckets(
+                self.model, self._grad_bucket_mb,
+                sparse_ops=set(self._sparse_table_ops()))
+        return self._grad_buckets_cache
+
+    def grad_bucket_info(self) -> Dict[str, Any]:
+        """Bucket layout for profiling.train_report."""
+        buckets = self._grad_buckets()
+        return {"count": len(buckets),
+                "bucket_mb": self._grad_bucket_mb,
+                "bytes": [b for _, b in buckets]}
+
+    def _tag_grad_buckets(self, params):
+        """Thread the bucketed params through the sync-point op so each
+        bucket's gradient all-reduce anchors inside the backward pass at
+        grad-completion (identity on values — grads stay bit-identical;
+        see core/overlap.make_bucket_tagger)."""
+        buckets = self._grad_buckets()
+        if not buckets:
+            return params
+        if self._bucket_tagger is None:
+            from .overlap import make_bucket_tagger
+            self._bucket_tagger = make_bucket_tagger(
+                [names for names, _ in buckets])
+        sub = {n: params[n] for names, _ in buckets for n in names
+               if n in params}
+        if not sub:
+            return params
+        tagged = self._bucket_tagger(sub)
+        return {**params, **tagged}
+
     def _outputs_and_loss(self, params, states, batch, training, rng,
                           seq_length):
+        if training and self._grad_bucket_mb > 0:
+            params = self._tag_grad_buckets(params)
         values, new_states = self.forward_values(
             params, states, batch, training, rng, seq_length)
         logits = values[self.model.final_tensor.uid]
@@ -509,10 +556,13 @@ class Executor:
             if self._sparse_cache_key == key:
                 return self._sparse_ops_cache
             # routing changed post-build: invalidate compiled steps that
-            # baked in the old sparse/dense split
+            # baked in the old sparse/dense split (and the grad-sync
+            # bucket partition, which excludes sparse tables)
             self._train_step = None
             self._train_step_multi = None
             self._train_step_accum = None
+            self._grad_buckets_cache = None
+            self._bucket_tagger = None
         from ..ops.embedding import DistributedEmbedding, Embedding
         out: Dict[str, Op] = {}
         mode = (self.optimizer.sparse_mode() if self.optimizer else None)

@@ -1,0 +1,203 @@
+"""Async/overlap training runtime: bucketed backward-overlapped gradient
+sync + the pipelined host-dispatch window.
+
+The reference FlexFlow's core bet was an async task runtime (Legion)
+that hides communication behind compute; our training path compiled to
+ONE jitted step whose data-parallel gradient all-reduces XLA was free to
+sink into a single combined sync after the whole backward pass. This
+module makes the overlap structural:
+
+* ``grad_buckets`` partitions the walk's weighted ops into contiguous
+  buckets by cumulative master-parameter bytes (``FFConfig.
+  grad_bucket_mb``; 0 = legacy monolithic sync). The SAME partition
+  function feeds the executor's sync points and the simulator's
+  bucket-granular sync tasks, so the MCMC search prices exactly the
+  overlap the executor delivers.
+
+* ``make_bucket_tagger`` builds the sync-point op threaded through the
+  differentiated region: a ``custom_vjp`` identity over the bucketed
+  parameter subtree whose BACKWARD rule walls each bucket's weight
+  cotangents behind an ``optimization_barrier`` the moment they are
+  complete, chaining buckets in backward-completion order through a
+  data token. Forward and backward are identities, so gradients stay
+  BIT-identical to the monolithic path (same reduction set, donation
+  untouched); what changes is the HLO structure XLA schedules: each
+  bucket's data-axis all-reduce is anchored at its bucket boundary
+  inside the backward pass instead of being free to coalesce into one
+  end-of-backward sync, so it runs concurrently with the remaining
+  backward compute.
+
+* ``DispatchWindow`` is the host half: a depth-N in-flight window over
+  dispatched step results (``FFConfig.train_dispatch_depth``) so the
+  fit loop retrieves step N's host-side metrics while step N+1 runs on
+  device — the host never sits in a blocking fetch for the NEWEST
+  dispatch except at epoch/checkpoint boundaries, and device-side
+  metric handles stay bounded instead of accumulating for a whole
+  epoch.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def eligible_sparse_ops(model) -> set:
+    """Names of embedding-family ops the executor routes through the
+    sparse row-update path (mirror of ``Executor._sparse_table_ops``,
+    shared so the simulator's bucket partition matches the executor's
+    without holding an executor). Before compile() assigns an optimizer
+    the set is empty — the conservative (dense) reading the cost model
+    already uses."""
+    from ..ops.embedding import DistributedEmbedding, Embedding
+    cfg = model.config
+    opt = getattr(model, "optimizer", None)
+    mode = None
+    if opt is not None:
+        try:
+            mode = opt.sparse_mode()
+        except Exception:
+            mode = None
+    allowed = mode == "exact" or (
+        mode == "lazy" and getattr(cfg, "sparse_embedding_lazy", False))
+    out = set()
+    if getattr(cfg, "sparse_embedding_updates", True) and allowed:
+        input_uids = {t.uid for t in model.input_tensors}
+        for op in model.ops:
+            if isinstance(op, (Embedding, DistributedEmbedding)) \
+                    and all(t.uid in input_uids for t in op.inputs):
+                out.add(op.name)
+    return out
+
+
+def grad_buckets(model, bucket_mb: float,
+                 sparse_ops: Optional[set] = None
+                 ) -> List[Tuple[List[str], float]]:
+    """Walk-order contiguous gradient-sync buckets.
+
+    Returns ``[(member op names, master-param bytes), ...]`` over the
+    ops that contribute DENSE float gradients to the data-parallel sync
+    (weighted ops minus the sparse-update tables, whose row gradients
+    scatter outside the bucketed reduction). A bucket closes once its
+    cumulative ``op.weight_bytes()`` (the f32-declared master basis —
+    strategy-independent, so executor and simulator always agree)
+    reaches ``bucket_mb`` MiB. ``bucket_mb <= 0`` returns [] (legacy
+    monolithic sync)."""
+    if bucket_mb is None or bucket_mb <= 0:
+        return []
+    if sparse_ops is None:
+        sparse_ops = eligible_sparse_ops(model)
+    limit = float(bucket_mb) * (1 << 20)
+    buckets: List[Tuple[List[str], float]] = []
+    cur: List[str] = []
+    cur_bytes = 0.0
+    for op in model.ops:
+        if op.name in sparse_ops or not op.weight_specs():
+            continue
+        w = float(op.weight_bytes())
+        if w <= 0:
+            continue
+        cur.append(op.name)
+        cur_bytes += w
+        if cur_bytes >= limit:
+            buckets.append((cur, cur_bytes))
+            cur, cur_bytes = [], 0.0
+    if cur:
+        buckets.append((cur, cur_bytes))
+    return buckets
+
+
+def make_bucket_tagger(buckets: Sequence[Sequence[str]]):
+    """Build the per-step gradient sync-point op: ``tag(subtree)`` is an
+    identity over ``{op_name: {weight_name: array}}`` whose backward
+    groups each bucket's cotangents behind an ``optimization_barrier``,
+    chained bucket-to-bucket in backward-completion order (reverse walk
+    order) through a scalar token so XLA can neither merge the buckets'
+    all-reduces into one end-of-backward sync nor reorder them past each
+    other. Values pass through untouched — gradients are bit-identical
+    to the untagged walk."""
+    order = [tuple(b) for b in buckets]
+
+    @jax.custom_vjp
+    def tag(tree):
+        return tree
+
+    def _fwd(tree):
+        return tree, None
+
+    def _bwd(_, ct):
+        out = dict(ct)
+        # the token is DATA-dependent on every earlier (in backward
+        # order) bucket's cotangents: each barrier's outputs depend on
+        # all its inputs, so feeding bucket k's token into bucket k-1's
+        # barrier pins the issue order to grad-completion order.
+        token = jnp.zeros((), jnp.float32)
+        for bucket in reversed(order):
+            names = [n for n in bucket if n in out]
+            if not names:
+                continue
+            sub = {n: out[n] for n in names}
+            sub, token = jax.lax.optimization_barrier((sub, token))
+            out.update(sub)
+        return (out,)
+
+    tag.defvjp(_fwd, _bwd)
+    return tag
+
+
+class DispatchWindow:
+    """Depth-N in-flight window over dispatched train-step results.
+
+    ``push(entry)`` records one dispatch's (device-array) result; once
+    more than ``depth - 1`` results are un-retrieved, the OLDEST is
+    pulled to host (``jax.device_get``) — blocking at most on a step
+    that is already ``depth - 1`` dispatches behind the newest, which
+    the device has typically long finished. So:
+
+      depth 1  -> fully synchronous (fetch right after each dispatch;
+                  the legacy blocking loop, train_bench's sync arm)
+      depth 2  -> retrieve step N while step N+1 runs (the default)
+      depth 0  -> unbounded (never fetch until drain(); the old
+                  epoch-bulk behavior — device handles grow with the
+                  epoch)
+
+    ``drain()`` fetches everything left (epoch/checkpoint boundaries,
+    and the fit loop's finally on a mid-epoch fault) and returns the
+    retrieved entries in push order. ``fetch_waits_s`` records the host
+    time spent blocked in each fetch — the number train_report turns
+    into dispatch-gap statistics."""
+
+    def __init__(self, depth: int):
+        self.depth = max(0, int(depth))
+        self._pending: collections.deque = collections.deque()
+        self._done: List = []
+        self.fetch_waits_s: List[float] = []
+        self.max_in_flight = 0
+
+    def _fetch_oldest(self) -> None:
+        entry = self._pending.popleft()
+        t0 = time.perf_counter()
+        self._done.append(jax.device_get(entry))
+        self.fetch_waits_s.append(time.perf_counter() - t0)
+
+    def push(self, entry) -> None:
+        self._pending.append(entry)
+        if len(self._pending) > self.max_in_flight:
+            self.max_in_flight = len(self._pending)
+        if self.depth > 0:
+            while len(self._pending) > self.depth - 1:
+                self._fetch_oldest()
+
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def drain(self) -> List:
+        while self._pending:
+            self._fetch_oldest()
+        out = self._done
+        self._done = []
+        return out

@@ -82,6 +82,8 @@ class FFModel:
         self.simulator = None  # set by calibrate_simulator()
         self.search_stats = None  # set by search.mcmc.optimize*
         # (profiling.search_report renders it)
+        self.last_train_stats = None  # set by fit()
+        # (profiling.train_report renders it)
         self.label_tensor: Optional[Tensor] = None
         # pretrained weights staged by frontends before compile()
         # (applied after init_state; reference Parameter::set_weights role)
@@ -693,9 +695,7 @@ class FFModel:
         sim = Simulator(
             self, mesh,
             calibrated_machine_model(
-                mesh, machine_file=self.config.machine_model_file),
-            overlap_backward_sync=(
-                self.config.search_overlap_backward_update))
+                mesh, machine_file=self.config.machine_model_file))
         strategy = self.strategy or Strategy()
         predicted = sim.simulate(strategy)
         # warmup (jit compile), then measure; a device->host scalar fetch
@@ -762,6 +762,33 @@ class FFModel:
             self._fit_epochs_drawn += 1
             return rng.permutation(n)
 
+        # pipelined host dispatch (core/overlap.DispatchWindow): up to
+        # `train_dispatch_depth` dispatches stay in flight before the
+        # OLDEST step's metrics are pulled to host, so retrieval of step
+        # N overlaps device execution of step N+1 — the host never
+        # blocks on the newest dispatch except at epoch/checkpoint
+        # boundaries (window drain). Each dispatch is a marked fault
+        # site ("train.dispatch") fired BEFORE the jitted call so an
+        # injected fault never consumes the donated state buffers.
+        from .core.overlap import DispatchWindow
+        from .utils import faults as _faults
+        inj = _faults.injector_for(self.config)
+        win = DispatchWindow(
+            getattr(self.config, "train_dispatch_depth", 2))
+        gaps: List[float] = []   # host time between dispatches (prep)
+        n_dispatches = [0]
+        last_end = [None]
+
+        def _dispatch(fn, *args):
+            t = time.perf_counter()
+            if last_end[0] is not None:
+                gaps.append(t - last_end[0])
+            inj.fire("train.dispatch")
+            out = fn(*args)
+            last_end[0] = time.perf_counter()
+            n_dispatches[0] += 1
+            return out
+
         history = []
         start_epoch = 0
         ckptr = None  # one async checkpointer reused across the run
@@ -816,7 +843,6 @@ class FFModel:
         try:
             for epoch in range(start_epoch, ep):
                 idx = draw_perm() if shuffle else np.arange(n)
-                epoch_metrics = []
                 t0 = time.time()
                 spd = max(1, steps_per_dispatch)
 
@@ -860,33 +886,37 @@ class FFModel:
                     # plain single-step path: no scan-of-1 wrapper, no
                     # per-step np.stack — leaner default dispatch
                     for s in range(steps):
-                        epoch_metrics.append(
-                            (self.train_batch(mk_batch(s)), 1))
+                        win.push(
+                            (_dispatch(self.train_batch, mk_batch(s)),
+                             1))
                     tail = []
                 else:
                     for s0 in range(0, steps - steps % group, group):
                         mbs = [mk_batch(s) for s in range(s0, s0 + group)]
                         if gas > 1:
-                            epoch_metrics.append(
-                                (self.train_batch_accum(mbs), len(mbs)))
+                            win.push((_dispatch(self.train_batch_accum,
+                                                mbs), len(mbs)))
                         else:
-                            epoch_metrics.append(
-                                (self.train_batches(mbs), None))
+                            win.push((_dispatch(self.train_batches,
+                                                mbs), None))
                     tail = list(range(steps - steps % group, steps))
                 if tail and gas > 1:
                     mbs = [mk_batch(s) for s in tail]
-                    epoch_metrics.append(
-                        (self.train_batch_accum(mbs), len(mbs)))
+                    win.push((_dispatch(self.train_batch_accum, mbs),
+                              len(mbs)))
                 else:
                     for s in tail:
-                        epoch_metrics.append(
-                            (self.train_batch(mk_batch(s)), 1))
+                        win.push(
+                            (_dispatch(self.train_batch, mk_batch(s)),
+                             1))
                 # fold metrics on host (reference: UPDATE_METRICS future
-                # fold). One bulk device->host transfer for the whole
-                # epoch — per-scalar float(v) would issue steps*keys tiny
+                # fold). The dispatch window already pulled all but the
+                # last depth-1 entries while later steps ran on device;
+                # the epoch-boundary drain fetches the remainder —
+                # per-scalar float(v) would issue steps*keys tiny
                 # transfers (ruinous through a TPU tunnel); reference
                 # folds through futures too (model.cc:2084-2108).
-                epoch_metrics = jax.device_get(epoch_metrics)
+                epoch_metrics = win.drain()
                 agg = {}
                 loss_terms = 0
                 for m, w in epoch_metrics:
@@ -923,12 +953,53 @@ class FFModel:
                         os.path.join(checkpoint_dir, f"epoch_{epoch}"),
                         self.state, use_async=True, checkpointer=ckptr)
         finally:
+            # drain the window even on a mid-epoch fault: in-flight
+            # dispatches already mutated self.state, so their results
+            # must be consumed (not leaked as device handles) before
+            # the exception propagates
+            in_flight_at_exit = win.pending()
+            try:
+                win.drain()
+            except Exception:
+                pass
+            self.last_train_stats = self._train_stats(
+                win, gaps, n_dispatches[0], in_flight_at_exit)
             if ckptr is not None:  # commit in-flight saves even on
                 ckptr.wait_until_finished()  # Ctrl-C / mid-epoch errors
                 ckptr.close()
             if fit_loader is not None:  # release the native prefetch
                 fit_loader.close()      # thread + double buffers
         return history
+
+    def _train_stats(self, win, gaps, n_dispatches, in_flight_at_exit):
+        """Overlap-runtime instrumentation for one fit() run — rendered
+        by utils/profiling.train_report."""
+        waits = sorted(win.fetch_waits_s)
+        sg = sorted(gaps)
+        buckets = (self.executor.grad_bucket_info()
+                   if hasattr(self.executor, "grad_bucket_info")
+                   else {"count": 0, "bucket_mb": 0.0, "bytes": []})
+        dp = (self.mesh.shape.get("data", 1)
+              if self.mesh is not None else 1)
+        nb = buckets["count"]
+        # structural estimate: every bucket except the last-completing
+        # one can hide its all-reduce behind remaining backward compute
+        est_hidden = (1.0 - 1.0 / nb) if (nb > 1 and dp > 1) else 0.0
+        return {
+            "dispatches": n_dispatches,
+            "dispatch_depth": win.depth,
+            "max_in_flight": win.max_in_flight,
+            "in_flight_at_exit": in_flight_at_exit,
+            "pending_after_drain": win.pending(),
+            "dispatch_gap_s_mean": (sum(sg) / len(sg)) if sg else 0.0,
+            "dispatch_gap_s_p50": sg[len(sg) // 2] if sg else 0.0,
+            "dispatch_gap_s_max": sg[-1] if sg else 0.0,
+            "fetch_wait_s_total": sum(waits),
+            "fetch_wait_s_max": waits[-1] if waits else 0.0,
+            "grad_buckets": buckets,
+            "data_parallel": dp,
+            "est_comm_hidden": est_hidden,
+        }
 
     def evaluate(self, x: Dict[str, np.ndarray], y: np.ndarray,
                  batch_size: Optional[int] = None,

@@ -31,8 +31,11 @@ import os
 import threading
 from typing import Dict, Optional
 
+# row layout of a persisted OpCost; adding a field widens the row, and
+# get()'s length check makes every pre-widening row a clean miss (the
+# COST_MODEL_VERSION bump in the fingerprint retires them anyway)
 _COST_FIELDS = ("fwd", "bwd", "fwd_comm", "bwd_comm", "sync", "mem",
-                "update")
+                "update", "sync_bytes")
 
 
 _PRICING_SRC_HASH: Optional[str] = None
@@ -58,7 +61,8 @@ def _pricing_source_hash() -> str:
     return _PRICING_SRC_HASH
 
 
-def machine_fingerprint(mm, mesh=None, precision=None) -> str:
+def machine_fingerprint(mm, mesh=None, precision=None,
+                        overlap=None) -> str:
     """Stable short hash of everything the cost formulas read from the
     machine model + mesh (plus the pricing code itself). Shared by the
     cost cache, sim_validation and perf_report so committed numbers are
@@ -69,7 +73,14 @@ def machine_fingerprint(mm, mesh=None, precision=None) -> str:
     every byte/flops figure, so entries cached for f32 pricing must
     MISS for a bf16 search (and vice versa) — regression-tested in
     tests/test_mixed_precision.py. Per-dtype efficiency factors
-    ("matmul:float32") ride the efficiency dict already hashed here."""
+    ("matmul:float32") ride the efficiency dict already hashed here.
+
+    `overlap` is the runtime's sync-overlap configuration the simulator
+    priced under — (search_overlap_backward_sync, grad_bucket_mb), see
+    Simulator.overlap_sig(): an overlap flip or a bucket-size change
+    alters every simulated makespan the cached numbers feed, so it must
+    be a guaranteed cache miss (regression-tested in
+    tests/test_overlap.py)."""
     from .cost_model import COST_MODEL_VERSION
     spec = {f.name: getattr(mm.spec, f.name, None)
             for f in dataclasses.fields(mm.spec)}
@@ -87,6 +98,7 @@ def machine_fingerprint(mm, mesh=None, precision=None) -> str:
         "mesh": (sorted(mesh.shape.items()) if mesh is not None else None),
         "precision": (list(str(p) for p in precision)
                       if precision is not None else None),
+        "overlap": (list(overlap) if overlap is not None else None),
     }
     raw = json.dumps(blob, sort_keys=True, default=str)
     return hashlib.sha256(raw.encode()).hexdigest()[:16]
@@ -110,7 +122,7 @@ class CostCache:
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
-        # fingerprint -> {key -> [7 floats]}
+        # fingerprint -> {key -> [len(_COST_FIELDS) floats]}
         self._data: Dict[str, Dict[str, list]] = {}
         self._dirty = False
         self._loaded = False

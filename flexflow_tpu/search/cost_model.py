@@ -24,7 +24,11 @@ from .machine_model import TPUMachineModel
 # older pricing model can never resurrect into a newer search.
 # v2: dtype-aware pricing — flops at the compute dtype's MXU rate,
 # bytes from actual itemsize (FFConfig.compute_dtype/param_dtype).
-COST_MODEL_VERSION = 2
+# v3: overlap-exact sync pricing — OpCost carries sync_bytes (the
+# per-device DP payload) so the simulator can price bucket-granular
+# grad syncs (FFConfig.grad_bucket_mb) with real per-bucket
+# latency+bandwidth instead of one latency term per op.
+COST_MODEL_VERSION = 3
 
 BWD_FLOP_FACTOR = 2.0  # dX and dW GEMMs ≈ 2x fwd (reference bwd = 2 GEMMs)
 # per-op-type overrides: attention bwd recomputes probabilities from the
@@ -77,6 +81,12 @@ class OpCost:
     # kernel time without losing the update term; task builders add
     # bwd + update.
     update: float = 0.0
+    # per-device bytes this op contributes to the DP gradient all-reduce
+    # (the payload behind `sync`); 0 when no data-axis sync exists. The
+    # simulator sums these over a bucket's members to price ONE combined
+    # all-reduce per bucket (grad_bucket_mb) — real per-bucket
+    # latency+bandwidth instead of a latency term per op.
+    sync_bytes: float = 0.0
     # set for pipeline_blocks ops with layer->pipe mapped; fwd/bwd then
     # hold the closed-form GPipe makespan (used by the native engine's
     # one-task-per-op lowering) while the Python simulator replaces them
@@ -94,6 +104,7 @@ class OpCost:
                       bwd_comm=self.bwd_comm + other.bwd_comm,
                       sync=self.sync + other.sync, mem=self.mem + other.mem,
                       update=self.update + other.update,
+                      sync_bytes=self.sync_bytes + other.sync_bytes,
                       pipeline=self.pipeline or other.pipeline)
 
 
@@ -415,6 +426,7 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
     # --- DP gradient sync: all-reduce of each weight's grad over the
     # data axis (the reference's NCCL all-reduce / PS update+prefetch,
     # optimizer_kernel.cu:113-180)
+    payload = 0.0
     if dp > 1 and sync_bytes > 0:
         # weights sharded over model/expert/pipe/vocab/table axes reduce
         # per-device grad bytes proportionally; sparse-updated embedding
@@ -446,7 +458,8 @@ def op_cost(op: Op, strategy: OpStrategy, mesh,
               else update_sweep(eff_tp * ep * pp * vocab * table))
 
     return OpCost(fwd=fwd, bwd=bwd, fwd_comm=fwd_comm, bwd_comm=bwd_comm,
-                  sync=sync, mem=mem, update=update, pipeline=pipeline)
+                  sync=sync, mem=mem, update=update, sync_bytes=payload,
+                  pipeline=pipeline)
 
 
 def staged_pipeline_cost(model, mesh, mm: TPUMachineModel,

@@ -272,8 +272,7 @@ def optimize_with_mesh(model, budget: int = 1000, alpha: float = 0.05,
         sim = Simulator(
             model, mesh,
             calibrated_machine_model(
-                mesh, machine_file=cfg.machine_model_file),
-            overlap_backward_sync=cfg.search_overlap_backward_update)
+                mesh, machine_file=cfg.machine_model_file))
         found, cost, sim, stats = _optimize_impl(
             model, per_budget, alpha, mesh, seed, False, sim, None,
             chains=1)
@@ -521,8 +520,16 @@ def _optimize_impl(model, budget: int, alpha: float, mesh, seed: int,
     sim = simulator or Simulator(
         model, mesh,
         calibrated_machine_model(mesh,
-                                 machine_file=cfg.machine_model_file),
-        overlap_backward_sync=cfg.search_overlap_backward_update)
+                                 machine_file=cfg.machine_model_file))
+    # bucketed grad-sync pricing (grad_bucket_mb) exists only in the
+    # Python event loop — the native table lowers one sync task per op;
+    # anneal in Python so the search prices the overlap the executor
+    # actually delivers (explicit use_native=True keeps the native walk
+    # with its pre-bucket sync model)
+    if (sim.overlap and sim.bucket_mb > 0
+            and int(mesh.shape.get("data", 1)) > 1
+            and use_native is not True):
+        use_native = False
 
     cands = {op.name: candidate_maps(op, mesh, cfg, op_index=i)
              for i, op in enumerate(model.ops)}

@@ -7,7 +7,17 @@ contended resources. On TPU the resources are the (single, SPMD) compute
 stream and the ICI fabric; comm tasks overlap compute exactly as XLA's
 async collectives do, and the DP gradient all-reduce can overlap the
 remaining backward pass (the reference models the same overlap for PS
-update, simulator.cc:393-497, gated by `search_overlap_backward_update`).
+update, simulator.cc:393-497, gated by
+`FFConfig.search_overlap_backward_sync`).
+
+When the runtime's bucketed grad sync is on (FFConfig.grad_bucket_mb >
+0, core/overlap.py), the sync tasks mirror the EXECUTED structure:
+per-op sync tasks go zero-duration and one bucket-granular sync task
+per bucket (same walk-order partition the executor tags) prices ONE
+combined all-reduce of the bucket's summed per-device payload —
+real per-bucket latency+bandwidth from the machine model — depending on
+its members' backward tasks, not the whole backward. The search
+therefore rewards exactly the overlap the executor delivers.
 
 Memory over HBM capacity adds the reference's 1ms/MB penalty
 (simulator.cc:603-628, machine_model.memory_penalty).
@@ -155,6 +165,10 @@ class _BuiltGraph:
     slots: Dict[str, Dict[str, SimTask]]   # op -> component -> task
     expanded: set                          # pipeline-expanded units
     placed: dict                           # device-placed units
+    # bucketed grad sync (grad_bucket_mb > 0): member names per bucket
+    # (walk order) and the bucket sync tasks, [] when off
+    bucket_members: list = dataclasses.field(default_factory=list)
+    bucket_tasks: list = dataclasses.field(default_factory=list)
 
 
 _SLOT_NAMES = ("fwd_comm", "fwd", "bwd_comm", "bwd", "sync")
@@ -172,24 +186,39 @@ class _DeltaTemplate:
 
     __slots__ = ("durations", "children", "ndeps0", "roots", "res",
                  "n_res", "op_slots", "op_sig", "op_class", "op_mem",
-                 "op_order")
+                 "op_order", "op_sync_bytes", "bucket_of",
+                 "bucket_members", "bucket_slot")
 
 
 @dataclasses.dataclass
 class _DeltaToken:
     """Result of one simulate_delta call: the simulated step seconds
-    plus the undo record delta_reject applies when the move loses."""
+    plus the undo record delta_reject applies when the move loses —
+    (per-op splices, bucket-task splices)."""
     cost: float
-    undo: list
+    undo: tuple
 
 
 class Simulator:
     def __init__(self, model, mesh, mm: Optional[TPUMachineModel] = None,
-                 overlap_backward_sync: bool = True):
+                 overlap_backward_sync: Optional[bool] = None):
         self.model = model
         self.mesh = mesh
         self.mm = mm or default_machine_model(mesh)
-        self.overlap = overlap_backward_sync
+        # overlap modeling resolves from the config unless the caller
+        # pins it (legacy constructor-only behavior): the SAME knob the
+        # CLI exposes (--no-overlap-sync) so a flip reaches both the
+        # task-graph shape and the cost-cache fingerprint below
+        self._overlap_arg = overlap_backward_sync
+        cfg = getattr(model, "config", None)
+        self.overlap = (bool(getattr(cfg, "search_overlap_backward_sync",
+                                     True))
+                        if overlap_backward_sync is None
+                        else bool(overlap_backward_sync))
+        # the runtime's bucketed-sync config (core/overlap.py): priced
+        # only under overlap (a serialized monolithic sync has no
+        # buckets to hide)
+        self.bucket_mb = float(getattr(cfg, "grad_bucket_mb", 0.0) or 0.0)
         self._cache: Dict[tuple, OpCost] = {}
         # global multiplier calibrated from one real measured step
         # (calibrate_end_to_end); scales predictions without changing the
@@ -229,11 +258,19 @@ class Simulator:
             self._disk = CostCache.open(
                 getattr(cfg, "cost_cache_file", None) or None)
             self._fingerprint = machine_fingerprint(
-                self.mm, mesh, precision=self._precision())
+                self.mm, mesh, precision=self._precision(),
+                overlap=self.overlap_sig())
         self._op_sig_memo: Dict[str, str] = {}
         self._cfg_sig = self._compute_cfg_sig()
         # per-op measured grounding (FFConfig.measure_top_ops)
         self._measured_set: set = self._choose_measured_ops()
+
+    def overlap_sig(self):
+        """(overlap flag, grad_bucket_mb) — the sync-overlap half of
+        the machine fingerprint (cost_cache.machine_fingerprint); tools
+        stamping fingerprints next to simulated numbers pass this so
+        their stamps match the simulator's cache scope."""
+        return (bool(self.overlap), float(self.bucket_mb))
 
     def _precision(self):
         """(compute_dtype, param_dtype) names of the model's policy —
@@ -276,10 +313,16 @@ class Simulator:
         self._delta = None
         self._op_sig_memo.clear()
         self._cfg_sig = self._compute_cfg_sig()
+        cfg = getattr(self.model, "config", None)
+        if self._overlap_arg is None:
+            self.overlap = bool(getattr(
+                cfg, "search_overlap_backward_sync", True))
+        self.bucket_mb = float(getattr(cfg, "grad_bucket_mb", 0.0) or 0.0)
         if self._disk is not None:
             from .cost_cache import machine_fingerprint
             self._fingerprint = machine_fingerprint(
-                self.mm, self.mesh, precision=self._precision())
+                self.mm, self.mesh, precision=self._precision(),
+                overlap=self.overlap_sig())
         self._measured_set = self._choose_measured_ops()
 
     def flush_cost_cache(self) -> None:
@@ -686,6 +729,46 @@ class Simulator:
             slots[u] = {"fwd_comm": comm, "fwd": fwd_tasks[u]}
             total_mem += c.mem
 
+        # bucketed grad sync (FFConfig.grad_bucket_mb, core/overlap.py):
+        # when the runtime buckets, the simulator prices the SAME
+        # partition — per-op sync tasks go zero-duration (keeping the
+        # 5-slot structure the delta template splices into) and one
+        # bucket task per bucket carries the combined all-reduce of its
+        # members' payloads, depending on the members' backward tasks.
+        # The partition walks UNITS (singleton ops when fusion is off —
+        # then it equals core/overlap.grad_buckets exactly, the
+        # executor's partition) accumulating the dense master bytes of
+        # each unit's member ops; sparse-update tables stay outside
+        # (their row grads scatter, keeping their own sync task), as do
+        # pipeline-expanded and device-placed units. A serialized
+        # (--no-overlap-sync) search keeps the legacy per-op syncs.
+        bucket_members: List[List[str]] = []
+        bucket_set: set = set()
+        if self.overlap and self.bucket_mb > 0:
+            from ..core.overlap import eligible_sparse_ops
+            sparse = eligible_sparse_ops(self.model)
+            members_of = {grp[-1]: grp for grp in groups}
+            limit = float(self.bucket_mb) * (1 << 20)
+            cur: List[str] = []
+            cur_bytes = 0.0
+            for u in unit_order:
+                if u in expanded or u in placed:
+                    continue
+                w = sum(float(self._ops_by_name[m].weight_bytes())
+                        for m in members_of[u]
+                        if m not in sparse
+                        and self._ops_by_name[m].weight_specs())
+                if w <= 0:
+                    continue
+                cur.append(u)
+                cur_bytes += w
+                if cur_bytes >= limit:
+                    bucket_members.append(cur)
+                    cur, cur_bytes = [], 0.0
+            if cur:
+                bucket_members.append(cur)
+            bucket_set = {n for m in bucket_members for n in m}
+
         # backward chain (reverse graph)
         bwd_tasks: Dict[str, SimTask] = {}
         sync_tasks: List[SimTask] = []
@@ -706,11 +789,28 @@ class Simulator:
                 slots[u]["bwd_comm"] = comm
                 slots[u]["bwd"] = bwd_tasks[u]
             # grad all-reduce may overlap the rest of backward
-            # (reference overlap flag, simulator.cc:393-497)
-            st = g.add(f"{u}:grad_sync", c.sync, "comm", [bwd_tasks[u]])
+            # (reference overlap flag, simulator.cc:393-497); bucketed
+            # members sync through their bucket task instead
+            st = g.add(f"{u}:grad_sync",
+                       0.0 if u in bucket_set else c.sync,
+                       "comm", [bwd_tasks[u]])
             sync_tasks.append(st)
             if u in slots:
                 slots[u]["sync"] = st
+
+        bucket_tasks: List[SimTask] = []
+        for k, members in enumerate(bucket_members):
+            payload = 0.0
+            for m in members:   # walk order — the delta path re-sums
+                # UNIT cost, not costs[m]: the zeroed per-unit sync
+                # task covered the whole fused group's payload, so the
+                # bucket must carry the merged sum (identical to the
+                # per-op cost when fusion is off — the delta path,
+                # fusion-disabled, re-sums the same values bit-equally)
+                payload += unit_cost[m].sync_bytes
+            bucket_tasks.append(g.add(
+                f"grad_bucket_sync.{k}", self._bucket_sync_cost(payload),
+                "comm", [bwd_tasks[m] for m in members]))
 
         if not self.overlap and sync_tasks:
             # serialize syncs after all backward work: model by chaining
@@ -719,7 +819,21 @@ class Simulator:
                 st.deps.append(last_bwd)
 
         return _BuiltGraph(graph=g, total_mem=total_mem, costs=costs,
-                           slots=slots, expanded=expanded, placed=placed)
+                           slots=slots, expanded=expanded, placed=placed,
+                           bucket_members=bucket_members,
+                           bucket_tasks=bucket_tasks)
+
+    def _bucket_sync_cost(self, payload_bytes: float) -> float:
+        """One bucket's combined DP all-reduce: the summed per-device
+        payload over the mesh's data axis — one latency term per
+        BUCKET, which is exactly what bucketing buys over per-op
+        syncs."""
+        dp = int(self.mesh.shape.get("data", 1))
+        if dp <= 1 or payload_bytes <= 0:
+            return 0.0
+        return self.mm.all_reduce(
+            payload_bytes, dp, "data" if "data" in self.mesh.shape
+            else None)
 
     # ---------------- delta simulation ----------------
     def delta_rebase(self, strategy: Strategy) -> bool:
@@ -779,6 +893,15 @@ class Simulator:
                       for name in t.op_sig}
         t.op_mem = {name: built.costs[name].mem for name in t.op_sig}
         t.op_order = tuple(op.name for op in self.model.ops)
+        # bucketed grad sync: per-op payloads + bucket membership so a
+        # moved op's bucket re-prices from the SAME member sum the full
+        # build uses (bit-equal), spliced into the bucket task's slot
+        t.op_sync_bytes = {name: built.costs[name].sync_bytes
+                           for name in t.op_sig}
+        t.bucket_members = [tuple(m) for m in built.bucket_members]
+        t.bucket_of = {name: k for k, m in enumerate(t.bucket_members)
+                       for name in m}
+        t.bucket_slot = [index[id(task)] for task in built.bucket_tasks]
         self._delta = t
         return True
 
@@ -818,17 +941,33 @@ class Simulator:
             updates.append((name, sig, c))
         undo = []
         d = t.durations
+        touched_buckets = set()
         for name, sig, c in updates:
             i_fc, i_f, i_bc, i_b, i_s = t.op_slots[name]
             undo.append((name, t.op_sig[name], t.op_mem[name],
+                         t.op_sync_bytes[name],
                          (d[i_fc], d[i_f], d[i_bc], d[i_b], d[i_s])))
             d[i_fc] = c.fwd_comm
             d[i_f] = c.fwd
             d[i_bc] = c.bwd_comm
             d[i_b] = c.bwd + c.update
-            d[i_s] = c.sync
+            b = t.bucket_of.get(name)
+            # bucketed members keep their zero per-op sync slot; their
+            # bucket's task re-prices below from the new payloads
+            d[i_s] = 0.0 if b is not None else c.sync
+            if b is not None:
+                touched_buckets.add(b)
             t.op_sig[name] = sig
             t.op_mem[name] = c.mem
+            t.op_sync_bytes[name] = c.sync_bytes
+        bucket_undo = []
+        for b in sorted(touched_buckets):
+            i_bk = t.bucket_slot[b]
+            bucket_undo.append((i_bk, d[i_bk]))
+            payload = 0.0
+            for m in t.bucket_members[b]:   # same walk-order sum as
+                payload += t.op_sync_bytes[m]  # _build_graph: bit-equal
+            d[i_bk] = self._bucket_sync_cost(payload)
         makespan = self._replay(t)
         total_mem = 0.0
         om = t.op_mem
@@ -839,7 +978,7 @@ class Simulator:
             cost=(makespan * self.time_scale
                   + self.mm.memory_penalty(total_mem)
                   + self.step_overhead),
-            undo=undo)
+            undo=(undo, bucket_undo))
 
     def delta_reject(self, tok: _DeltaToken) -> None:
         """Roll the template back to its pre-simulate_delta state."""
@@ -847,11 +986,15 @@ class Simulator:
         if t is None:
             return
         d = t.durations
-        for name, sig, mem, durs in tok.undo:
+        ops_undo, bucket_undo = tok.undo
+        for name, sig, mem, sync_bytes, durs in ops_undo:
             i_fc, i_f, i_bc, i_b, i_s = t.op_slots[name]
             d[i_fc], d[i_f], d[i_bc], d[i_b], d[i_s] = durs
             t.op_sig[name] = sig
             t.op_mem[name] = mem
+            t.op_sync_bytes[name] = sync_bytes
+        for i_bk, dur in bucket_undo:
+            d[i_bk] = dur
 
     def _replay(self, t: _DeltaTemplate) -> float:
         """Array-form of TaskGraph.simulate over the cached template:

@@ -214,6 +214,42 @@ def search_report(stats: dict) -> str:
     return "\n".join(lines)
 
 
+def train_report(stats: dict) -> str:
+    """Render fit()'s async-runtime instrumentation (model.
+    last_train_stats): per-step dispatch gap (host time between
+    consecutive dispatches — time the device may sit idle when it
+    outruns the host), fetch waits (host blocked retrieving a window
+    entry — device time the host successfully hid behind later
+    dispatches), the grad-sync bucket layout, and the structural
+    estimate of the comm fraction the bucketed backward hides."""
+    if not stats:
+        return "train: no stats recorded"
+    lines = [
+        f"train: {stats.get('dispatches', 0)} dispatches, "
+        f"window depth {stats.get('dispatch_depth', 0)} "
+        f"(max in flight {stats.get('max_in_flight', 0)}, "
+        f"{stats.get('in_flight_at_exit', 0)} drained at exit)"]
+    lines.append(
+        f"dispatch gap: mean={stats.get('dispatch_gap_s_mean', 0.0)*1e3:.3f} ms "
+        f"p50={stats.get('dispatch_gap_s_p50', 0.0)*1e3:.3f} ms "
+        f"max={stats.get('dispatch_gap_s_max', 0.0)*1e3:.3f} ms; "
+        f"fetch wait total={stats.get('fetch_wait_s_total', 0.0)*1e3:.1f} ms "
+        f"(max {stats.get('fetch_wait_s_max', 0.0)*1e3:.3f} ms)")
+    b = stats.get("grad_buckets") or {}
+    if b.get("count"):
+        sizes = " ".join(f"{x/2**20:.2f}" for x in b.get("bytes", []))
+        lines.append(
+            f"grad sync: {b['count']} bucket(s) of "
+            f"[{sizes}] MiB (target {b.get('bucket_mb', 0.0):g} MiB), "
+            f"dp={stats.get('data_parallel', 1)}, "
+            f"est. comm hidden {stats.get('est_comm_hidden', 0.0):.0%}")
+    else:
+        lines.append(
+            f"grad sync: monolithic (grad_bucket_mb=0), "
+            f"dp={stats.get('data_parallel', 1)}")
+    return "\n".join(lines)
+
+
 def time_train_steps(model, batch, steps: int = 20, warmup: int = 3
                      ) -> float:
     """Mean seconds per training step, with device sync via a scalar

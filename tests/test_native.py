@@ -72,6 +72,9 @@ def _search_model(mesh):
     cfg.batch_size = 32
     cfg.enable_parameter_parallel = True
     cfg.enable_attribute_parallel = True
+    # the native table lowers ONE sync task per op (pre-bucket model);
+    # parity against the Python simulator requires the legacy sync
+    cfg.grad_bucket_mb = 0.0
     ff = FFModel(cfg, mesh=mesh)
     x = ff.create_tensor((32, 64), name="input")
     h = ff.dense(x, 256, activation="relu", name="fc1")

@@ -1,0 +1,401 @@
+"""Async/overlap training runtime (core/overlap.py + the simulator's
+bucket-granular sync pricing): bucketed backward-overlapped grad sync
+must be BIT-identical to the monolithic path, the dispatch window must
+drain at epoch end and on mid-epoch faults, delta simulation must stay
+bit-exact with bucketed sync tasks enabled, and a bucket-config change
+must provably invalidate the cost cache."""
+
+import random
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, SGDOptimizer, Strategy, make_mesh
+from flexflow_tpu.core.overlap import (DispatchWindow, grad_buckets,
+                                       make_bucket_tagger)
+from flexflow_tpu.models.dlrm import build_dlrm
+from flexflow_tpu.models.transformer import build_transformer
+from flexflow_tpu.utils import faults
+
+
+def _transformer(bucket_mb, mesh=None, depth=2):
+    cfg = FFConfig(batch_size=8)
+    cfg.grad_bucket_mb = bucket_mb
+    cfg.train_dispatch_depth = depth
+    ff = build_transformer(cfg, batch_size=8, seq_len=16, hidden=32,
+                           num_heads=4, num_layers=2, ff_dim=64,
+                           num_classes=10)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05), mesh=mesh)
+    return ff
+
+
+def _t_batch(rng):
+    return {"input": rng.randn(8, 16, 32).astype(np.float32),
+            "label": rng.randint(0, 10, (8,)).astype(np.int32)}
+
+
+def _dlrm(bucket_mb, mesh=None):
+    cfg = FFConfig(batch_size=16)
+    cfg.grad_bucket_mb = bucket_mb
+    ff = build_dlrm(cfg, batch_size=16, embedding_vocab_sizes=(64,) * 4,
+                    embedding_dim=8, bot_mlp=(16, 8), top_mlp=(16, 1),
+                    stacked_tables=True)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type="mean_squared_error", mesh=mesh)
+    return ff
+
+
+def _d_batch(rng):
+    b = {"dense_features": rng.randn(16, 13).astype(np.float32),
+         "label": (rng.rand(16, 1) > 0.5).astype(np.float32)}
+    for i in range(4):
+        b[f"sparse_{i}"] = rng.randint(0, 64, (16, 1)).astype(np.int32)
+    return b
+
+
+# --------------------------------------------------- bucket partition
+
+def test_bucket_partition_walk_order_and_sizes():
+    ff = _transformer(0.0)
+    buckets = grad_buckets(ff, 0.01)  # 10 KiB -> several buckets
+    assert len(buckets) > 1
+    walk = [op.name for op in ff.ops]
+    flat = [n for names, _ in buckets for n in names]
+    assert flat == [n for n in walk if n in set(flat)]  # walk order
+    limit = 0.01 * (1 << 20)
+    for names, nbytes in buckets[:-1]:  # every bucket but the tail
+        assert nbytes >= limit          # closed at the threshold
+    assert grad_buckets(ff, 0.0) == []  # 0 = legacy monolithic
+
+
+def test_bucket_partition_excludes_sparse_tables():
+    ff = _dlrm(0.001)
+    sparse = set(ff.executor._sparse_table_ops())
+    assert sparse  # DLRM + plain SGD routes tables sparsely
+    members = {n for names, _ in ff.executor._grad_buckets()
+               for n in names}
+    assert members and not (members & sparse)
+
+
+# --------------------------------------------- bit-identical training
+
+@pytest.mark.parametrize("builder,mk", [(_transformer, _t_batch),
+                                        (_dlrm, _d_batch)])
+def test_bucketed_sync_bit_identical_on_mesh(builder, mk, mesh8):
+    """Tentpole contract: bucketed overlapped sync (many tiny buckets,
+    real data-axis psums on the 8-device CPU mesh) trains bit-for-bit
+    the trajectory of the monolithic path."""
+    rng = np.random.RandomState(0)
+    batches = [mk(rng) for _ in range(4)]
+
+    def losses(bucket_mb):
+        ff = builder(bucket_mb, mesh=mesh8)
+        if bucket_mb:
+            assert ff.executor.grad_bucket_info()["count"] > 1
+        return np.array([np.asarray(ff.train_batch(b)["loss"])
+                         for b in batches])
+
+    a = losses(0.0)
+    b = losses(0.002)
+    assert np.array_equal(a, b), (a, b)
+
+
+def test_bucketed_sync_bit_identical_multi_step_and_accum(mesh8):
+    """The sync points ride inside lax.scan bodies too: grouped
+    dispatch (train_batches) and grad accumulation stay bit-identical
+    to their monolithic-sync counterparts."""
+    rng = np.random.RandomState(1)
+    batches = [_t_batch(rng) for _ in range(4)]
+
+    def run(bucket_mb):
+        ff = _transformer(bucket_mb, mesh=mesh8)
+        m1 = ff.train_batches(batches[:2])
+        m2 = ff.train_batch_accum(batches[2:])
+        return (np.asarray(m1["loss"]), np.asarray(m2["loss"]))
+
+    a1, a2 = run(0.0)
+    b1, b2 = run(0.002)
+    assert np.array_equal(a1, b1) and np.array_equal(a2, b2)
+
+
+def test_donation_still_held_with_buckets(mesh8):
+    """The custom_vjp sync points must not break buffer donation: the
+    previous TrainState's buffers are consumed (deleted) by the step,
+    not double-materialized alongside the new state."""
+    ff = _transformer(0.002, mesh=mesh8)
+    old_params = [v for d in ff.state.params.values() for v in d.values()]
+    ff.train_batch(_t_batch(np.random.RandomState(0)))
+    assert all(v.is_deleted() for v in old_params)
+
+
+def test_tagger_identity_forward():
+    """The sync-point op is an identity on values (forward)."""
+    import jax.numpy as jnp
+    tag = make_bucket_tagger([["a"], ["b"]])
+    tree = {"a": {"w": jnp.arange(4.0)}, "b": {"w": jnp.ones((2, 2))}}
+    out = tag(tree)
+    for k in tree:
+        assert np.array_equal(np.asarray(out[k]["w"]),
+                              np.asarray(tree[k]["w"]))
+
+
+# ------------------------------------------------- dispatch window
+
+def test_dispatch_window_depths():
+    fetched = []
+
+    class _Probe:
+        def __init__(self, x):
+            self.x = x
+
+    win = DispatchWindow(2)
+    win.push(_Probe(1))
+    assert win.pending() == 1          # newest stays in flight
+    win.push(_Probe(2))
+    assert win.pending() == 1          # oldest retrieved on push
+    out = win.drain()
+    assert [p.x for p in out] == [1, 2] and win.pending() == 0
+
+    sync = DispatchWindow(1)
+    sync.push(_Probe(3))
+    assert sync.pending() == 0         # fully synchronous
+
+    unbounded = DispatchWindow(0)
+    for i in range(5):
+        unbounded.push(_Probe(i))
+    assert unbounded.pending() == 5    # legacy epoch-bulk
+    assert [p.x for p in unbounded.drain()] == list(range(5))
+
+
+def test_fit_window_drains_at_epoch_end():
+    ff = _transformer(0.002, depth=2)
+    rng = np.random.RandomState(0)
+    x = {"input": rng.randn(48, 16, 32).astype(np.float32)}
+    y = rng.randint(0, 10, (48,)).astype(np.int32)
+    hist = ff.fit(x, y, epochs=2, verbose=False)
+    assert len(hist) == 2
+    st = ff.last_train_stats
+    assert st["dispatches"] == 12 and st["pending_after_drain"] == 0
+    assert st["max_in_flight"] >= 2
+    from flexflow_tpu.utils.profiling import train_report
+    rep = train_report(st)
+    assert "window depth 2" in rep and "bucket" in rep
+
+
+def test_fit_window_drains_on_mid_epoch_fault():
+    """A fault at the train.dispatch site fires BEFORE the jitted call
+    (donated state survives), the window drains in fit's finally, and
+    the model keeps training afterwards."""
+    ff = _transformer(0.002, depth=2)
+    rng = np.random.RandomState(0)
+    x = {"input": rng.randn(48, 16, 32).astype(np.float32)}
+    y = rng.randint(0, 10, (48,)).astype(np.int32)
+    with faults.active("train.dispatch:fatal@3") as inj:
+        with pytest.raises(faults.InjectedFault):
+            ff.fit(x, y, epochs=1, verbose=False)
+        assert inj.fired["train.dispatch"]["fatal"] == 1
+    st = ff.last_train_stats
+    assert st["dispatches"] == 2          # third dispatch never ran
+    assert st["in_flight_at_exit"] == 1   # one result was in flight
+    assert st["pending_after_drain"] == 0
+    # the fault fired pre-dispatch: state buffers are live, fit resumes
+    hist = ff.fit(x, y, epochs=1, verbose=False)
+    assert np.isfinite(hist[0]["loss"])
+
+
+def test_fit_losses_identical_across_depths():
+    rng = np.random.RandomState(0)
+    x = {"input": rng.randn(48, 16, 32).astype(np.float32)}
+    y = rng.randint(0, 10, (48,)).astype(np.int32)
+    got = []
+    for depth in (0, 1, 2):
+        ff = _transformer(4.0, depth=depth)
+        hist = ff.fit(x, y, epochs=2, verbose=False)
+        got.append([h["loss"] for h in hist])
+    assert got[0] == got[1] == got[2]
+
+
+def test_prefetch_loader_stages_identically():
+    """Worker-thread device staging must yield byte-identical batches
+    in the same order as the synchronous path."""
+    from flexflow_tpu.core.dataloader import DataLoaderSet
+    rng = np.random.RandomState(3)
+    data = {"x": rng.randn(64, 7), "label": rng.randint(0, 5, (64,))}
+    order = rng.permutation(64)
+    out = {}
+    for prefetch in (False, True):
+        ds = DataLoaderSet(data, 16, shuffle=False, prefetch=prefetch,
+                           use_native=False,
+                           dtypes={"x": np.float32})
+        out[prefetch] = [{k: np.asarray(v) for k, v in b.items()}
+                         for b in ds.iter_with_order(order)]
+        ds.close()
+    assert len(out[False]) == len(out[True]) == 4
+    for a, b in zip(out[False], out[True]):
+        for k in a:
+            assert a[k].dtype == b[k].dtype
+            assert np.array_equal(a[k], b[k])
+
+
+# ------------------------------------------- simulator: bucket pricing
+
+def _sim_model():
+    cfg = FFConfig(batch_size=8)
+    cfg.enable_parameter_parallel = True
+    cfg.enable_sequence_parallel = True
+    cfg.grad_bucket_mb = 0.01   # several buckets on this tiny model
+    return build_transformer(cfg, batch_size=8, seq_len=32, hidden=64,
+                             num_heads=4, num_layers=2, ff_dim=128,
+                             num_classes=10)
+
+
+def test_simulator_buckets_mirror_runtime_partition():
+    from flexflow_tpu.search.simulator import Simulator
+    ff = _sim_model()
+    mesh = make_mesh((4, 2), ("data", "model"))
+    sim = Simulator(ff, mesh)
+    built = sim._build_graph(Strategy())
+    want = [names for names, _ in grad_buckets(ff, 0.01)]
+    assert [list(m) for m in built.bucket_members] == want
+    assert len(built.bucket_tasks) == len(want) > 1
+    # bucketed members' per-op sync slots are transparent; the bucket
+    # tasks carry the combined all-reduce (nonzero under dp=4)
+    assert all(t.duration > 0 for t in built.bucket_tasks)
+    for names in want:
+        for n in names:
+            assert built.slots[n]["sync"].duration == 0.0
+
+
+def test_simulator_fused_bucket_carries_whole_unit_payload():
+    """Regression: a fused group's bucket task must carry the MERGED
+    unit payload (its zeroed per-unit sync task covered every member),
+    not just the last member's bytes."""
+    from flexflow_tpu.parallel.pconfig import OpStrategy
+    from flexflow_tpu.search.simulator import Simulator
+    from flexflow_tpu.search.cost_model import op_cost
+    cfg = FFConfig(batch_size=16)
+    cfg.perform_fusion = True
+    cfg.grad_bucket_mb = 50.0   # one bucket
+    from flexflow_tpu import FFModel
+    ff = FFModel(cfg)
+    x = ff.create_tensor((16, 64), name="input")
+    h = ff.dense(x, 128, activation="relu", name="fc1")
+    h = ff.dense(h, 128, activation="relu", name="fc2")
+    ff.softmax(ff.dense(h, 8, name="out"), name="sm")
+    mesh = make_mesh((8,), ("data",))
+    sim = Simulator(ff, mesh)
+    strat = Strategy(default=OpStrategy({"sample": "data"}))
+    built = sim._build_graph(strat)
+    assert len(built.bucket_tasks) == 1
+    s = strat.for_op("fc1")
+    want = sum(op_cost(op, s, mesh, sim.mm).sync_bytes
+               for op in ff.ops if op.weight_specs())
+    got = built.bucket_tasks[0].duration
+    assert got == pytest.approx(sim._bucket_sync_cost(want), rel=1e-12)
+
+
+def test_simulator_overlap_flag_and_bucket_change_makespan():
+    """Bucketed overlapped sync must price FASTER than the serialized
+    monolithic path (that is what the MCMC search now rewards), and
+    --no-overlap-sync must serialize."""
+    from flexflow_tpu.search.simulator import Simulator
+    ff = _sim_model()
+    mesh = make_mesh((4, 2), ("data", "model"))
+    bucketed = Simulator(ff, mesh).simulate(Strategy())
+    ff.config.search_overlap_backward_sync = False
+    serial = Simulator(ff, mesh).simulate(Strategy())
+    ff.config.search_overlap_backward_sync = True
+    assert bucketed < serial
+
+
+def test_delta_exact_with_bucketed_syncs():
+    """ISSUE acceptance: simulate_delta stays bit-exact vs full
+    simulation under the new bucket-granular task shape, across random
+    rewrite/propagate walks, including reject/rollback."""
+    from flexflow_tpu.search.mcmc import candidate_maps
+    from flexflow_tpu.search.simulator import Simulator, op_edges
+    ff = _sim_model()
+    mesh = make_mesh((2, 2, 2), ("data", "model", "seq"))
+    sim = Simulator(ff, mesh)
+    assert sim.bucket_mb > 0 and sim.overlap
+    cands = {op.name: candidate_maps(op, mesh, ff.config, i)
+             for i, op in enumerate(ff.ops)}
+    searchable = [op for op in ff.ops if len(cands[op.name]) > 1]
+    _, edges = op_edges(ff)
+    cur = Strategy()
+    for op in ff.ops:
+        cur.set(op.name, cur.for_op(op.name).copy())
+    base_cost = sim.simulate(cur)
+    assert sim.delta_rebase(cur)
+    assert sim._delta.bucket_slot            # buckets in the template
+    rng = random.Random(42)
+    checked = 0
+    for i in range(120):
+        if edges and rng.random() < 0.25:
+            src, dst = rng.choice(edges)
+            m = dict(cur.for_op(src.name).axis_map)
+            name = dst.name
+        else:
+            op = rng.choice(searchable)
+            m = dict(rng.choice(cands[op.name]))
+            name = op.name
+        nxt = cur.copy()
+        nxt.set(name, type(cur.for_op(name))(m))
+        tok = sim.simulate_delta(nxt, (name,))
+        full = sim.simulate(nxt)
+        if tok is None:
+            assert sim.delta_rebase(nxt)
+            cur = nxt
+            continue
+        assert tok.cost == pytest.approx(full, rel=1e-12, abs=1e-18)
+        checked += 1
+        if rng.random() < 0.5:      # reject: template must roll back
+            sim.delta_reject(tok)
+            again = sim.simulate_delta(cur, (name,))
+            assert again is not None
+            assert again.cost == pytest.approx(sim.simulate(cur),
+                                               rel=1e-12, abs=1e-18)
+        else:
+            cur = nxt
+    assert checked >= 60
+    # and the walk ends where full simulation says it should
+    assert sim.simulate(cur) > 0 and base_cost > 0
+
+
+def test_bucket_config_change_invalidates_cost_cache(tmp_path):
+    """ISSUE acceptance: a bucket-config change provably invalidates
+    the cost cache (fingerprint miss), as does an overlap flip."""
+    from flexflow_tpu.search.cost_cache import machine_fingerprint
+    from flexflow_tpu.search.simulator import Simulator
+    ff = _sim_model()
+    mesh = make_mesh((4, 2), ("data", "model"))
+    sim = Simulator(ff, mesh)
+    fp_base = sim._fingerprint
+    assert fp_base == machine_fingerprint(
+        sim.mm, mesh, precision=sim._precision(),
+        overlap=sim.overlap_sig())
+
+    ff.config.grad_bucket_mb = 25.0
+    sim.invalidate()
+    fp_bucket = sim._fingerprint
+    assert fp_bucket != fp_base
+
+    ff.config.search_overlap_backward_sync = False
+    sim.invalidate()
+    fp_serial = sim._fingerprint
+    assert fp_serial not in (fp_base, fp_bucket)
+    ff.config.search_overlap_backward_sync = True
+    ff.config.grad_bucket_mb = 0.01
+
+
+def test_cli_flags():
+    cfg = FFConfig(argv=["--grad-bucket-mb", "16",
+                         "--train-dispatch-depth", "3",
+                         "--no-overlap-sync"])
+    assert cfg.grad_bucket_mb == 16.0
+    assert cfg.train_dispatch_depth == 3
+    assert cfg.search_overlap_backward_sync is False
+    with pytest.raises(ValueError):
+        FFConfig(grad_bucket_mb=-1.0)
+    with pytest.raises(ValueError):
+        FFConfig(train_dispatch_depth=-1)

@@ -173,10 +173,12 @@ def test_cost_cache_roundtrip_and_fingerprint(tmp_path):
     sim1.flush_cost_cache()
     data = json.load(open(path))
     # fingerprints carry the precision policy since the mixed-precision
-    # cost model (cost_model COST_MODEL_VERSION 2): external callers
-    # pass the simulator's resolved (compute, param) dtypes
+    # cost model (cost_model COST_MODEL_VERSION 2) and the sync-overlap
+    # config since the async-runtime one (v3): external callers pass
+    # the simulator's resolved dtypes + overlap signature
     fp = machine_fingerprint(sim1.mm, mesh,
-                             precision=sim1._precision())
+                             precision=sim1._precision(),
+                             overlap=sim1.overlap_sig())
     assert fp == sim1._fingerprint
     assert fp in data and len(data[fp]) > 0
 

@@ -72,6 +72,16 @@ env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload base \
     --fault-spec 'serve.mixed:transient@3,6,11;serve.page_pressure:exhaust:0.9@4-9' \
     -o /tmp/ci_bench_serve_chaos.json || fail=1
 
+echo "--- 1h. train-bench smoke (async runtime >= 1.10x + exactness gate)"
+# fails if the overlapped training runtime (grouped dispatch + depth-2
+# window + bucketed grad sync) is < 1.10x faster per step than the
+# synchronous path on dlrm OR transformer, if the loss trajectories are
+# not bit-identical, if anything compiles after warmup, or if the
+# simulator prices overlapped sync slower than serialized
+# (tools/train_bench.py)
+env JAX_PLATFORMS=cpu python tools/train_bench.py --smoke \
+    -o /tmp/ci_bench_train.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \

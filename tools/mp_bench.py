@@ -101,7 +101,8 @@ def simulated_reductions():
             sim = Simulator(ff, mesh)
             times[dt] = sim.simulate(Strategy())
             fingerprints[dt] = machine_fingerprint(
-                sim.mm, mesh, precision=sim._precision())
+                sim.mm, mesh, precision=sim._precision(),
+                overlap=sim.overlap_sig())
         out[name] = {
             "f32_s": times["float32"],
             "bf16_s": times["bfloat16"],

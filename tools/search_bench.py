@@ -157,7 +157,8 @@ def main():
         "delta_stats": {k: v for k, v in delta_stats.items()
                         if isinstance(v, (int, float))},
         "fingerprint": machine_fingerprint(sim.mm, mesh,
-                                           precision=sim._precision()),
+                                           precision=sim._precision(),
+                                           overlap=sim.overlap_sig()),
     }
     print(search_report(delta_stats))
     print(f"full: {pps_full:,.0f} proposals/s | "
