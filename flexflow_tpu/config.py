@@ -37,6 +37,11 @@ class ParameterSyncType:
     NCCL = "nccl"
 
 
+# KV-page storage formats the serve stack supports (--kv-dtype). The
+# ONE allowlist: serve/kv_cache.py derives its byte accounting from it.
+KV_DTYPES = ("float32", "bfloat16", "int8")
+
+
 @dataclasses.dataclass
 class FFIterationConfig:
     """Per-iteration runtime config (reference: include/config.h:156-161).
@@ -100,8 +105,14 @@ class FFConfig:
     # XLA schedules it concurrently with the remaining backward instead
     # of coalescing one monolithic end-of-backward sync. Gradients are
     # BIT-identical either way (same reduction set, donation
-    # preserved). 0 = legacy monolithic sync. --grad-bucket-mb.
-    grad_bucket_mb: float = 4.0
+    # preserved). 0 = legacy monolithic sync; None (the default) =
+    # AUTO-TUNE from the machine model at compile time
+    # (core/overlap.resolve_bucket_mb: interconnect bandwidth x the
+    # expected backward slice picks the bucket granularity; resolves to
+    # 0 when there is no data axis to sync over). Explicit values are
+    # authoritative, and the RESOLVED value is what the cost-cache
+    # machine fingerprint folds. --grad-bucket-mb.
+    grad_bucket_mb: Optional[float] = None
     # pipelined host dispatch (model.fit): keep up to this many train
     # dispatches in flight before retrieving the oldest step's host
     # metrics — depth 2 retrieves step N while step N+1 runs on device.
@@ -261,6 +272,28 @@ class FFConfig:
     # tokens across all concurrent sequences.
     kv_page_size: int = 16
     kv_num_pages: int = 257
+    # KV-page storage format (serve/kv_cache.py): "float32" (exact),
+    # "bfloat16" (rounds on write; exact for bf16-activation engines),
+    # or "int8" (per-page scale arrays, quantize-on-write /
+    # dequantize-at-read in the ragged kernel). Quantized pages cost
+    # ~1/4 the bytes, so an equal byte budget holds ~2-4x the pages —
+    # the concurrent-sequences-per-chip lever. The serving exactness
+    # gate relaxes for lossy formats to bounded attention-output error
+    # + greedy token parity (tests/test_kv_quant.py). --kv-dtype.
+    kv_dtype: str = "float32"
+    # size the page pool by BYTE budget instead of page count: when
+    # > 0, kv_num_pages derives as 1 + budget // page_bytes(kv_dtype) —
+    # computed from the configured dtype's itemsize (+ scale rows), so
+    # flipping kv_dtype at a fixed budget changes the PAGE COUNT, and
+    # every page-fraction knob (admission watermark, degradation-ladder
+    # rungs) automatically sees the larger effective pool. 0 = use
+    # kv_num_pages directly. --kv-pool-mb.
+    kv_pool_mb: float = 0.0
+    # ragged-attention kv-block shape (kernels/paged_ragged_v2.py): KV
+    # tokens each flattened (lane, kv-block) work item covers (rounded
+    # to whole pages). 0 = the autotune-by-shape table
+    # (choose_block_kv). --serve-attn-block-kv.
+    serve_attn_block_kv: int = 0
     # continuous-batching scheduler caps (serve/scheduler.py): at most
     # serve_max_seqs sequences hold decode slots at once (this is also
     # the decode-lane reserve of the engine's single mixed step), and
@@ -381,10 +414,10 @@ class FFConfig:
             raise ValueError(
                 f"pipeline_virtual_stages must be >= 1, got "
                 f"{self.pipeline_virtual_stages}")
-        if self.grad_bucket_mb < 0:
+        if self.grad_bucket_mb is not None and self.grad_bucket_mb < 0:
             raise ValueError(
-                f"grad_bucket_mb must be >= 0 (0 = monolithic sync), "
-                f"got {self.grad_bucket_mb}")
+                f"grad_bucket_mb must be >= 0 (0 = monolithic sync, "
+                f"unset = auto-tune), got {self.grad_bucket_mb}")
         if self.train_dispatch_depth < 0:
             raise ValueError(
                 f"train_dispatch_depth must be >= 0 (0 = unbounded, "
@@ -400,6 +433,18 @@ class FFConfig:
             raise ValueError(
                 f"kv_num_pages must be >= 2 (page 0 is the serving "
                 f"sink page), got {self.kv_num_pages}")
+        if self.kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, "
+                f"got {self.kv_dtype!r}")
+        if self.kv_pool_mb < 0:
+            raise ValueError(
+                f"kv_pool_mb must be >= 0 (0 = size by kv_num_pages), "
+                f"got {self.kv_pool_mb}")
+        if self.serve_attn_block_kv < 0:
+            raise ValueError(
+                f"serve_attn_block_kv must be >= 0 (0 = autotune), "
+                f"got {self.serve_attn_block_kv}")
         if self.serve_max_seqs < 1:
             raise ValueError(
                 f"serve_max_seqs must be >= 1, got {self.serve_max_seqs}")
@@ -487,6 +532,9 @@ class FFConfig:
         "--pipeline-virtual-stages": ("pipeline_virtual_stages", int),
         "--kv-page-size": ("kv_page_size", int),
         "--kv-num-pages": ("kv_num_pages", int),
+        "--kv-dtype": ("kv_dtype", str),
+        "--kv-pool-mb": ("kv_pool_mb", float),
+        "--serve-attn-block-kv": ("serve_attn_block_kv", int),
         "--serve-max-seqs": ("serve_max_seqs", int),
         "--serve-prefill-budget": ("serve_prefill_budget", int),
         "--serve-admit-watermark": ("serve_admit_watermark", float),

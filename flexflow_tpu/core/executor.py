@@ -120,9 +120,13 @@ class Executor:
         # bucketed backward-overlapped gradient sync (core/overlap.py):
         # bucket partition + the custom_vjp sync-point op are cached
         # against the sparse routing (sparse tables scatter outside the
-        # bucketed reduction) and rebuilt when it changes
-        self._grad_bucket_mb = float(
-            getattr(self.config, "grad_bucket_mb", 0.0) or 0.0)
+        # bucketed reduction) and rebuilt when it changes. An unset
+        # grad_bucket_mb (None) auto-tunes from the machine model for
+        # THIS mesh (resolve_bucket_mb; 0 = monolithic when there is no
+        # data axis to sync over); explicit values are authoritative.
+        from .overlap import resolve_bucket_mb
+        self._grad_bucket_mb = resolve_bucket_mb(self.config, model,
+                                                 mesh=mesh)
         self._grad_buckets_cache = None
         self._bucket_tagger = None
         # runtime LR multiplier (model.set_learning_rate / keras

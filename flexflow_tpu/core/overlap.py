@@ -74,6 +74,87 @@ def eligible_sparse_ops(model) -> set:
     return out
 
 
+# auto_bucket_mb bounds: never fewer than one bucket or more than this
+# many (beyond ~32 the per-bucket launch latency dominates any overlap
+# win), and never a bucket outside [1, 64] MiB (below 1 MiB a v5-class
+# all-reduce is pure latency; above 64 MiB the last bucket's sync can
+# no longer hide behind any remaining backward).
+AUTO_MAX_BUCKETS = 32
+AUTO_MIN_MB = 1.0
+AUTO_MAX_MB = 64.0
+# fraction of the estimated backward time the per-bucket launch
+# latencies may consume before we stop splitting finer
+AUTO_LATENCY_FRACTION = 0.1
+
+
+def auto_bucket_mb(model, mesh=None, machine=None) -> float:
+    """Machine-model-derived gradient-sync bucket size, used when
+    FFConfig.grad_bucket_mb is unset (None = auto).
+
+    The granularity trade is bandwidth-vs-latency: the TOTAL sync bytes
+    and the total backward compute are fixed, so splitting finer only
+    adds per-bucket all-reduce launch latency while anchoring syncs
+    earlier in the backward. We size buckets from the machine model —
+    effectively interconnect bandwidth x the expected backward slice a
+    bucket must hide under: estimate the backward time (2x forward
+    FLOPs at the calibrated MXU rate), allow AUTO_LATENCY_FRACTION of
+    it for per-bucket launch latency (2(a-1) ICI hops per ring
+    all-reduce), split the dense master bytes into that many buckets,
+    and floor each bucket at the interconnect's bandwidth-latency
+    product (a smaller bucket's all-reduce is pure latency — nothing
+    for the backward to overlap). No data axis (or no dense weights)
+    resolves to 0 = monolithic: there is no sync to overlap.
+
+    Deterministic for a given (model, mesh): the executor (real step)
+    and the simulator (search pricing) both resolve through
+    resolve_bucket_mb, so they partition identically and the resolved
+    value — not the None sentinel — folds into the cost-cache machine
+    fingerprint."""
+    data = int(mesh.shape.get("data", 1)) if mesh is not None else 1
+    if data <= 1:
+        return 0.0
+    sparse = eligible_sparse_ops(model)
+    total_bytes = sum(
+        float(op.weight_bytes()) for op in model.ops
+        if op.name not in sparse and op.weight_specs()
+        and op.weight_bytes() > 0)
+    if total_bytes <= 0:
+        return 0.0
+    if machine is None:
+        from ..search.machine_model import default_machine_model
+        machine = default_machine_model(mesh)
+    eff = machine.efficiency.get("matmul", 0.5)
+    t_bwd = 2.0 * sum(float(op.flops()) for op in model.ops) \
+        / max(machine.peak_flops_for(None) * eff, 1.0)
+    per_bucket_lat = 2.0 * (data - 1) * machine.spec.ici_latency
+    n = max(1, min(AUTO_MAX_BUCKETS,
+                   int(AUTO_LATENCY_FRACTION * t_bwd
+                       / max(per_bucket_lat, 1e-12))))
+    bw = machine.spec.ici_bandwidth \
+        * machine.efficiency.get("collective", 0.75)
+    floor_bytes = bw * per_bucket_lat   # bandwidth-latency product
+    bucket_bytes = max(total_bytes / n, floor_bytes)
+    return float(min(max(bucket_bytes / (1 << 20), AUTO_MIN_MB),
+                     AUTO_MAX_MB))
+
+
+def resolve_bucket_mb(config, model, mesh=None, machine=None) -> float:
+    """The ONE resolution point for FFConfig.grad_bucket_mb: explicit
+    values (including 0 = monolithic) are authoritative; None
+    auto-tunes from the machine model (auto_bucket_mb). Both the
+    executor's sync-point partition and the simulator's bucket pricing
+    — and the cost-cache fingerprint — use the value returned here."""
+    raw = getattr(config, "grad_bucket_mb", None)
+    if raw is not None:
+        return float(raw)
+    try:
+        return auto_bucket_mb(model, mesh=mesh, machine=machine)
+    except Exception:
+        # a half-built model (no ops yet) or an exotic mesh must not
+        # break compile — fall back to the legacy monolithic sync
+        return 0.0
+
+
 def grad_buckets(model, bucket_mb: float,
                  sparse_ops: Optional[set] = None
                  ) -> List[Tuple[List[str], float]]:

@@ -531,11 +531,36 @@ def _paged_ragged_pallas(q, k_pages, v_pages, page_tables, lane_slots,
     )(page_tables, lane_slots, lane_lens, q, k_pages, v_pages)
 
 
+def paged_attention_ragged_v1(q, k_pages, v_pages, page_tables,
+                              lane_slots, lane_lens, *, scale=None,
+                              use_pallas=None, interpret=False):
+    """The PR-3 first-cut ragged kernel — grid (T, pages_per_seq), one
+    page per grid step, full masked compute per step. Kept as the
+    bit-equality oracle and A/B baseline for kernel v2
+    (kernels/paged_ragged_v2.py); new code should call
+    paged_attention_ragged, which dispatches v2."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_pallas is None:
+        use_pallas = (interpret or (_HAS_PLTPU
+                                    and jax.default_backend() == "tpu"))
+    if use_pallas:
+        return _paged_ragged_pallas(q, k_pages, v_pages, page_tables,
+                                    lane_slots, lane_lens, scale, interpret)
+    lane_tables = jnp.take(page_tables, lane_slots, axis=0)  # (T, pp)
+    return _paged_decode_jnp(q, k_pages, v_pages, lane_tables, lane_lens,
+                             scale)
+
+
 def paged_attention_ragged(q, k_pages, v_pages, page_tables, lane_slots,
                            lane_lens, *, scale=None, use_pallas=None,
-                           interpret=False):
+                           interpret=False, k_scales=None, v_scales=None,
+                           block_kv=None):
     """Ragged batched attention through page tables — the chunked
-    prefill/mixed-step kernel (serve/engine.py).
+    prefill/mixed-step kernel (serve/engine.py), v2 since PR 8
+    (kernels/paged_ragged_v2.py: one flattened (lane, kv-block) grid
+    with ragged skipping, head packing, and tunable kv-block shapes,
+    per the "Ragged Paged Attention" paper in PAPERS.md).
 
     q (T, H, D) — one query token per LANE, where lanes mix prompt-chunk
     tokens from any number of sequences with single decode tokens;
@@ -549,24 +574,24 @@ def paged_attention_ragged(q, k_pages, v_pages, page_tables, lane_slots,
     lane_lens entry must be >= 1 (see paged_attention_decode). Returns
     (T, H, D).
 
-    The jnp fallback gathers each lane's table row and reuses the
-    decode math verbatim, so a 1-lane-per-sequence call is bit-for-bit
-    `paged_attention_decode`, and the op order matches the contiguous
-    full-prefill reference exactly (tested in tests/test_serve_v2.py).
+    Quantized KV pages: pass int8 k_pages/v_pages with their
+    (num_pages, page_size, H) f32 k_scales/v_scales; the kernel (and
+    the fallback) dequantizes at read (serve/kv_cache.py).
+    block_kv tunes the kv-block shape (FFConfig.serve_attn_block_kv;
+    None = autotune-by-shape table).
+
+    The jnp fallback runs v1's math verbatim, so a 1-lane-per-sequence
+    fp32 call is bit-for-bit `paged_attention_decode`, and the op order
+    matches the contiguous full-prefill reference exactly (tested in
+    tests/test_serve_v2.py; v2-vs-v1 equality in tests/test_kv_quant.py).
     use_pallas: None = auto (Pallas on TPU), True = force (combine with
     interpret=True off TPU), False = always jnp.
     """
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    if use_pallas is None:
-        use_pallas = (interpret or (_HAS_PLTPU
-                                    and jax.default_backend() == "tpu"))
-    if use_pallas:
-        return _paged_ragged_pallas(q, k_pages, v_pages, page_tables,
-                                    lane_slots, lane_lens, scale, interpret)
-    lane_tables = jnp.take(page_tables, lane_slots, axis=0)  # (T, pp)
-    return _paged_decode_jnp(q, k_pages, v_pages, lane_tables, lane_lens,
-                             scale)
+    from .paged_ragged_v2 import paged_attention_ragged_v2
+    return paged_attention_ragged_v2(
+        q, k_pages, v_pages, page_tables, lane_slots, lane_lens,
+        k_scales=k_scales, v_scales=v_scales, scale=scale,
+        block_kv=block_kv, use_pallas=use_pallas, interpret=interpret)
 
 
 def flash_attention_bshd(q, k, v, *, causal=False,

@@ -1,0 +1,388 @@
+"""Ragged paged attention v2 (the "Ragged Paged Attention" TPU design,
+PAPERS.md arxiv 2604.15464) + quantized KV-page support.
+
+The PR-3 kernel (`flash_attention._paged_ragged_pallas`) dispatches a
+(T, pages_per_seq) grid: every lane visits every page-table column, one
+page per grid step, full masked compute at every step. Correct, but
+first-cut — three structural costs the mature design removes:
+
+  * PER-LANE DISPATCH: a lane resident for 1 page still burns
+    pages_per_seq grid steps of full (H, page_size) softmax work; the
+    masking throws the work away but the VPU/MXU already spent it.
+  * ONE PAGE PER STEP: the DMA unit is a single page
+    (page_size, H, D) — typically a few KB — so short blocks bound the
+    kernel on DMA issue overhead, not bandwidth.
+  * UNPACKED HEAD LAYOUT: blocks arrive as (page_size, H, D); for
+    small head_dim (D < 128 lanes) the trailing dim wastes most of
+    every VMEM tile ((8,128) f32 tiling).
+
+This module rebuilds the kernel along the paper's lines:
+
+  * ONE FLATTENED GRID over (lane, kv-block) work items: grid
+    (T * num_kv_blocks,), item w -> lane w // nb, kv-block w % nb. A
+    kv-block covers `block_kv_pages` pages — several page DMAs land per
+    grid step (one BlockSpec per page slot, so Mosaic pipelines them),
+    and the per-lane step count drops pages_per_seq / block_kv_pages x.
+  * RAGGED SKIPPING: a work item whose kv-block starts past its lane's
+    visible length is DEAD — `pl.when` skips its entire accumulation
+    (v1 computed and masked it), and its page index maps clamp to the
+    lane's last live block so no new DMA is issued for dead tail items.
+  * HEAD PACKING for small head_dim: page blocks stream as
+    (page_size, H*D) rows — the layout is already contiguous in HBM, so
+    this is a free reshape that fills 128-lane VMEM tiles where
+    (page_size, H, D) tiling padded D up to 128 — and are unpacked to
+    (page_size, H, D) in-register for the (bit-identical) per-head dots.
+  * TUNABLE KV-BLOCK SHAPES: `block_kv` (tokens per work item; FFConfig
+    serve_attn_block_kv / --serve-attn-block-kv) with an
+    autotune-by-shape table supplying defaults — sized so each step's
+    K+V DMA traffic amortizes issue overhead without exceeding a VMEM
+    budget. Measured entries can be registered (tools/flash_sweep.py
+    style) and override the analytic pick.
+  * QUANTIZED KV PAGES: int8 K/V pages ride with per-page scale arrays
+    (one f32 scale per head per in-page slot — see serve/kv_cache.py
+    for why scales are per-slot, not per-whole-page); the kernel DMAs
+    the int8 block + its scale rows and dequantizes in-register before
+    the (otherwise unchanged) online-softmax accumulation. bf16 pages
+    need no scales (values upcast exactly like v1's bf16 handling).
+
+Numerics contract: the jnp fallback is BIT-IDENTICAL to v1's
+(`flash_attention._paged_decode_jnp`) on fp32 — same gather, same
+dot_general dims, same single-pass softmax — so every existing
+bit-equality oracle (full-prefill per lane, one-lane == decode) holds
+verbatim under v2. The Pallas kernel reuses v1's exact per-page
+accumulation ops (`_paged_online_page` math), so it agrees with the jnp
+path to the same f32 tolerance v1 did; for int8 pages both paths
+dequantize identically, so quantized jnp-vs-Pallas agreement is
+unchanged while the QUANTIZATION error itself is gated by the
+bounded-error + greedy-parity tests (tests/test_kv_quant.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on pure-CPU builds
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    _HAS_PLTPU = False
+
+
+# --------------------------------------------------------- quantization
+INT8_QMAX = 127.0
+
+
+def quantize_kv_rows(x):
+    """Per-row symmetric int8 quantization of K/V vectors.
+
+    x (..., D) float -> (q (..., D) int8, scales (...) f32) with
+    q = round(x / scale), scale = amax(|x|, -1) / 127. An all-zero row
+    gets scale 0 and q 0 (dequant reproduces the zeros exactly) — the
+    sink-page / padding-lane case. Each row quantizes independently of
+    every other token, which is what makes the serving path's
+    quantized content invariant to chunk boundaries, preemption
+    replays, and speculative rollbacks (serve/engine.py)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = amax / INT8_QMAX
+    # rows with scale 0 are all-zero: divide by 1 instead and the
+    # zeros quantize to 0 regardless
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.rint(xf / safe[..., None]), -INT8_QMAX, INT8_QMAX)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(q, scale):
+    """Inverse of quantize_kv_rows: q (..., D) int8 * scale (...) f32
+    broadcast over D. Exactly the in-register dequant the kernel runs."""
+    return q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+
+
+# --------------------------------------------- kv-block shape autotuning
+# Analytic targets for choose_block_kv: each work item should move at
+# least DMA_TARGET_BYTES of K+V so the per-step DMA issue cost is
+# amortized, while the resident K/V (+ scale) blocks stay under
+# VMEM_BUDGET_BYTES (Pallas double-buffers them, hence the /2).
+DMA_TARGET_BYTES = 32 * 1024
+VMEM_BUDGET_BYTES = 512 * 1024
+
+# (page_size, num_heads, head_dim, kv_itemsize, pages_per_seq) ->
+# block_kv tokens. Seeded analytically on first use; measured sweeps
+# (register_block_kv) override — the "autotune-by-shape table".
+_BLOCK_KV_TABLE: Dict[Tuple[int, int, int, int, int], int] = {}
+
+
+def register_block_kv(page_size: int, num_heads: int, head_dim: int,
+                      kv_itemsize: int, pages_per_seq: int,
+                      block_kv: int) -> None:
+    """Pin a measured kv-block shape for a geometry (overrides the
+    analytic default for every later choose_block_kv on that shape)."""
+    _BLOCK_KV_TABLE[(page_size, num_heads, head_dim, kv_itemsize,
+                     pages_per_seq)] = int(block_kv)
+
+
+def choose_block_kv(page_size: int, pages_per_seq: int, num_heads: int,
+                    head_dim: int, kv_itemsize: int = 4) -> int:
+    """KV tokens per work item for a pool geometry: the autotune table
+    entry if one is registered, else the analytic pick — the smallest
+    whole-page multiple whose K+V DMA reaches DMA_TARGET_BYTES, capped
+    by the VMEM budget and the table width. Always a multiple of
+    page_size and >= one page."""
+    key = (page_size, num_heads, head_dim, kv_itemsize, pages_per_seq)
+    got = _BLOCK_KV_TABLE.get(key)
+    if got is not None:
+        return got
+    per_tok = 2 * num_heads * head_dim * kv_itemsize  # K + V
+    if kv_itemsize == 1:  # int8 pages also stream f32 scale rows
+        per_tok += 2 * num_heads * 4
+    want = max(1, -(-DMA_TARGET_BYTES // (per_tok * page_size)))
+    cap = max(1, (VMEM_BUDGET_BYTES // 2) // (per_tok * page_size))
+    ppb = min(max(1, want), cap, pages_per_seq)
+    block = ppb * page_size
+    _BLOCK_KV_TABLE[key] = block
+    return block
+
+
+def ragged_dispatch_passes(num_lanes: int, pages_per_seq: int,
+                           block_kv_pages: int) -> Dict[str, int]:
+    """Grid-step accounting for the serve bench: the v1 kernel runs one
+    grid step per (lane, page); v2 runs one per (lane, kv-block)."""
+    nb = -(-pages_per_seq // max(1, block_kv_pages))
+    return {"v1": num_lanes * pages_per_seq, "v2": num_lanes * nb}
+
+
+# ------------------------------------------------------------ jnp paths
+def _ragged_jnp(q, k_pages, v_pages, page_tables, lane_slots, lane_lens,
+                scale, k_scales=None, v_scales=None):
+    """Vectorized fallback over the flattened ragged layout.
+
+    Gathers each lane's pages (int8 gathers move 1/4 the bytes of f32),
+    dequantizes, and runs EXACTLY v1's math — same dot_general dims,
+    same masked single-pass softmax, same divide-after-matmul — so fp32
+    outputs are bit-identical to `flash_attention._paged_decode_jnp`
+    (the oracle every serve parity test is built on)."""
+    b, h, d = q.shape
+    ps = k_pages.shape[1]
+    lane_tables = jnp.take(page_tables, lane_slots, axis=0)  # (T, pp)
+    pp = lane_tables.shape[1]
+    k = jnp.take(k_pages, lane_tables, axis=0)  # (T, pp, ps, H, D)
+    v = jnp.take(v_pages, lane_tables, axis=0)
+    if k_scales is not None:
+        ks = jnp.take(k_scales, lane_tables, axis=0)  # (T, pp, ps, H)
+        vs = jnp.take(v_scales, lane_tables, axis=0)
+        k = dequantize_kv(k, ks)
+        v = dequantize_kv(v, vs)
+    k = k.reshape(b, pp * ps, h, d)
+    v = v.reshape(b, pp * ps, h, d)
+    s = jax.lax.dot_general(
+        q, k, (((2,), (3,)), ((0, 1), (0, 2))),
+        preferred_element_type=jnp.float32) * scale     # (T, H, pp*ps)
+    pos = jax.lax.broadcasted_iota(jnp.int32, (b, 1, pp * ps), 2)
+    s = jnp.where(pos < lane_lens[:, None, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p, v.astype(jnp.float32), (((2,), (1,)), ((0, 1), (0, 2))),
+        preferred_element_type=jnp.float32)
+    return (o / l).astype(q.dtype)
+
+
+# --------------------------------------------------------- Pallas kernel
+def _online_block(q, k, v, length, kv_base, m_ref, l_ref, acc_ref, *,
+                  scale):
+    """One kv-block of one lane's online-softmax accumulation — v1's
+    `_paged_online_page` ops verbatim (dot dims, f32 stats, p-stays-f32
+    v-upcasts convention) over a (bs, H, D) block instead of a single
+    page, so the f32 agreement with the jnp path carries over."""
+    h = q.shape[0]
+    bs = k.shape[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32) * scale     # (H, bs)
+    pos = kv_base + jax.lax.broadcasted_iota(jnp.int32, (h, bs), 1)
+    s = jnp.where(pos < length, s, -jnp.inf)
+    m_prev = m_ref[:]
+    l_prev = l_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    m_ref[:] = m_new
+    l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p, v.astype(jnp.float32), (((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha + pv
+
+
+def _ragged_v2_kernel(pt_ref, ls_ref, ll_ref, *refs, page_size,
+                      pages_per_seq, num_blocks, block_pages, scale,
+                      quantized):
+    """Flattened-grid kernel body. Grid (T * num_blocks,); work item
+    w covers kv positions [blk * block_pages * ps, ...) of lane
+    w // num_blocks. Page refs arrive head-PACKED as (1, ps, H*D)
+    blocks (plus (1, ps, H) scale blocks when quantized) and are
+    unpacked in-register; dead items (block start past the lane's
+    visible length) skip their whole accumulation."""
+    n_in = 2 * block_pages * (2 if quantized else 1) + 1
+    q_ref = refs[0]
+    kv_refs = refs[1:n_in]
+    o_ref = refs[n_in]
+    m_ref, l_ref, acc_ref = refs[n_in + 1:]
+
+    w = pl.program_id(0)
+    t = w // num_blocks
+    blk = w % num_blocks
+    length = ll_ref[t]
+
+    @pl.when(blk == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    h, d = q_ref.shape[1], q_ref.shape[2]
+    base = blk * block_pages * page_size
+
+    # dead item: this block starts at or past the lane's visible
+    # length (lane_lens >= 1, so block 0 is always live) — skip the
+    # entire accumulation. v1 computed the full masked block here.
+    @pl.when(base < length)
+    def _accumulate():
+        q = q_ref[0]                     # (H, D)
+        for i in range(block_pages):
+            if quantized:
+                kq = kv_refs[4 * i + 0][0]       # (ps, H*D) int8
+                ks = kv_refs[4 * i + 1][0]       # (ps, H) f32
+                vq = kv_refs[4 * i + 2][0]
+                vs = kv_refs[4 * i + 3][0]
+                k = dequantize_kv(kq.reshape(page_size, h, d), ks)
+                v = dequantize_kv(vq.reshape(page_size, h, d), vs)
+            else:
+                k = kv_refs[2 * i + 0][0].reshape(page_size, h, d)
+                v = kv_refs[2 * i + 1][0].reshape(page_size, h, d)
+            _online_block(q, k, v, length, base + i * page_size,
+                          m_ref, l_ref, acc_ref, scale=scale)
+
+    @pl.when(blk == num_blocks - 1)
+    def _emit():
+        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+
+
+def _ragged_v2_pallas(q, k_pages, v_pages, page_tables, lane_slots,
+                      lane_lens, scale, block_kv_pages, interpret,
+                      k_scales=None, v_scales=None):
+    if not _HAS_PLTPU:
+        raise NotImplementedError("pallas TPU backend unavailable")
+    t, h, d = q.shape
+    npages, ps = k_pages.shape[0], k_pages.shape[1]
+    pp = page_tables.shape[1]
+    bp = max(1, min(int(block_kv_pages), pp))
+    nb = -(-pp // bp)
+    quantized = k_scales is not None
+
+    # head packing: page rows stream as (ps, H*D) — contiguous in HBM,
+    # so the reshape is free — and unpack in-register in the kernel
+    kp = k_pages.reshape(npages, ps, h * d)
+    vp = v_pages.reshape(npages, ps, h * d)
+
+    def page_index(i):
+        """Index map for page slot i of each work item: the physical
+        page at table column blk*bp + i of the item's lane, CLAMPED to
+        the lane's last live column — dead tail items re-select a page
+        already resident, so they issue no new DMA (their compute is
+        pl.when-skipped anyway)."""
+        def imap(w, pt, ls, ll):
+            tt = w // nb
+            col = (w % nb) * bp + i
+            # clamp into both the table and the lane's live range so
+            # dead items never demand a fresh (sink) page DMA
+            live_last = jnp.maximum((ll[tt] - 1) // ps, 0)
+            col = jnp.minimum(jnp.minimum(col, pp - 1), live_last)
+            return (pt[ls[tt], col], 0, 0)
+        return imap
+
+    def q_index(w, pt, ls, ll):
+        return (w // nb, 0, 0)
+
+    in_specs = [pl.BlockSpec((1, h, d), q_index)]
+    args = [q]
+    for i in range(bp):
+        imap = page_index(i)
+        in_specs.append(pl.BlockSpec((1, ps, h * d), imap))
+        args.append(kp)
+        if quantized:
+            in_specs.append(pl.BlockSpec((1, ps, h), imap))
+            args.append(k_scales)
+        in_specs.append(pl.BlockSpec((1, ps, h * d), imap))
+        args.append(vp)
+        if quantized:
+            in_specs.append(pl.BlockSpec((1, ps, h), imap))
+            args.append(v_scales)
+    kern = functools.partial(
+        _ragged_v2_kernel, page_size=ps, pages_per_seq=pp,
+        num_blocks=nb, block_pages=bp, scale=scale, quantized=quantized)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # page_tables, lane_slots, lane_lens
+        grid=(t * nb,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, h, d), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),   # running max
+            pltpu.VMEM((h, 1), jnp.float32),   # running sum
+            pltpu.VMEM((h, d), jnp.float32),   # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, h, d), q.dtype),
+        interpret=interpret,
+    )(page_tables, lane_slots, lane_lens, *args)
+
+
+# ------------------------------------------------------------ entry point
+def paged_attention_ragged_v2(q, k_pages, v_pages, page_tables,
+                              lane_slots, lane_lens, *, k_scales=None,
+                              v_scales=None, scale=None, block_kv=None,
+                              use_pallas=None, interpret=False):
+    """Ragged batched attention through page tables — kernel v2.
+
+    Same contract as flash_attention.paged_attention_ragged (q (T,H,D),
+    one query token per lane; page 0 = sink; every lane_lens >= 1) plus:
+
+      k_scales/v_scales — (num_pages, page_size, H) f32 per-page scale
+        arrays for int8 K/V pages (None = unquantized pages; the two
+        must be both present or both absent).
+      block_kv — KV tokens per flattened work item (None = the
+        autotune-by-shape table via choose_block_kv; rounded to whole
+        pages).
+
+    fp32 outputs are bit-identical to v1 on the jnp path (same math);
+    Pallas-vs-jnp agreement is the same f32 tolerance as v1.
+    """
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be given together")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_pallas is None:
+        use_pallas = (interpret or (_HAS_PLTPU
+                                    and jax.default_backend() == "tpu"))
+    if use_pallas:
+        ps = k_pages.shape[1]
+        if block_kv is None:
+            block_kv = choose_block_kv(
+                ps, page_tables.shape[1], q.shape[1], q.shape[2],
+                jnp.dtype(k_pages.dtype).itemsize)
+        return _ragged_v2_pallas(
+            q, k_pages, v_pages, page_tables, lane_slots, lane_lens,
+            scale, max(1, int(block_kv) // ps), interpret,
+            k_scales=k_scales, v_scales=v_scales)
+    return _ragged_jnp(q, k_pages, v_pages, page_tables, lane_slots,
+                       lane_lens, scale, k_scales=k_scales,
+                       v_scales=v_scales)

@@ -217,8 +217,13 @@ class Simulator:
                         else bool(overlap_backward_sync))
         # the runtime's bucketed-sync config (core/overlap.py): priced
         # only under overlap (a serialized monolithic sync has no
-        # buckets to hide)
-        self.bucket_mb = float(getattr(cfg, "grad_bucket_mb", 0.0) or 0.0)
+        # buckets to hide). Resolved through the SAME resolve_bucket_mb
+        # the executor uses (None = auto from the machine model for
+        # this mesh), so the simulator prices the partition the
+        # executor would actually deliver on this mesh and the cost
+        # cache is keyed by the RESOLVED value (overlap_sig).
+        from ..core.overlap import resolve_bucket_mb
+        self.bucket_mb = resolve_bucket_mb(cfg, model, mesh=mesh)
         self._cache: Dict[tuple, OpCost] = {}
         # global multiplier calibrated from one real measured step
         # (calibrate_end_to_end); scales predictions without changing the
@@ -317,7 +322,9 @@ class Simulator:
         if self._overlap_arg is None:
             self.overlap = bool(getattr(
                 cfg, "search_overlap_backward_sync", True))
-        self.bucket_mb = float(getattr(cfg, "grad_bucket_mb", 0.0) or 0.0)
+        from ..core.overlap import resolve_bucket_mb
+        self.bucket_mb = resolve_bucket_mb(cfg, self.model,
+                                           mesh=self.mesh)
         if self._disk is not None:
             from .cost_cache import machine_fingerprint
             self._fingerprint = machine_fingerprint(

@@ -60,6 +60,9 @@ import numpy as np
 from ..config import CompMode
 from ..kernels.flash_attention import (paged_attention_decode,
                                        paged_attention_ragged)
+from ..kernels.paged_ragged_v2 import (choose_block_kv,
+                                       quantize_kv_rows,
+                                       ragged_dispatch_passes)
 from ..utils.faults import FaultInjector, TransientError, injector_for
 from .kv_cache import KVCacheConfig, PagedKVCache
 from .scheduler import (ChunkPlan, ContinuousBatchingScheduler, Request,
@@ -205,6 +208,31 @@ class ServeEngine:
                 if getattr(cfg, "serve_spec_decode", True) else 0
         self.spec_tokens = int(spec_tokens) if self.chunked_prefill else 0
         self.drafter = drafter
+        # KV-page storage format (serve/kv_cache.py, PR 8): lossless
+        # f32 keeps the bit-exactness oracle; bf16 rounds on write
+        # (exact when the engine's activations are already bf16); int8
+        # quantizes on write against per-page scale arrays and the
+        # ragged kernel dequantizes at read. kv_exact records whether
+        # page storage preserves activation values bit-for-bit — the
+        # condition for the token-identical-to-reference gate (lossy
+        # formats gate bounded error + greedy parity instead,
+        # tests/test_kv_quant.py).
+        self.kv_dtype = self.cache_cfg.kv_dtype
+        self.kv_quantized = self.cache_cfg.quantized
+        self.kv_exact = (self.kv_dtype == "float32"
+                         or jnp.dtype(self.kv_dtype) == self.act_dtype)
+        if self.kv_quantized and not self.chunked_prefill:
+            raise ValueError(
+                "kv_dtype='int8' needs the chunked mixed program "
+                "(quantize-on-write lives in the mixed step); the "
+                "legacy bucket-prefill path supports float32/bfloat16")
+        # ragged kernel v2 kv-block shape: explicit knob, else the
+        # autotune-by-shape table (kernels/paged_ragged_v2.py)
+        self.attn_block_kv = int(getattr(cfg, "serve_attn_block_kv", 0)) \
+            or choose_block_kv(self.cache_cfg.page_size,
+                               self.cache_cfg.pages_per_seq,
+                               self.num_heads, self.head_dim,
+                               self.cache_cfg.kv_itemsize)
         # the one mixed-step geometry: every prefill-budget token plus
         # one decode lane per slot always fits
         self.mixed_width = self.prefill_budget + self.cache_cfg.max_seqs
@@ -215,6 +243,8 @@ class ServeEngine:
                                   prefix_cache=self.prefix_cache)
         self._k_pages = None
         self._v_pages = None
+        self._k_scales = None
+        self._v_scales = None
         # prompt-length buckets (legacy path + generate_reference):
         # powers of two from one page up to the serveable length. The
         # page-table ceiling rounds UP to whole pages, but a bucket
@@ -229,6 +259,10 @@ class ServeEngine:
             b *= 2
         self.buckets.append(cap)
         self._mixed_jit = jax.jit(self._mixed_impl, donate_argnums=(1, 2))
+        # quantized pools thread the scale arrays through the same
+        # step, donated alongside the pages
+        self._mixed_q_jit = jax.jit(self._mixed_q_impl,
+                                    donate_argnums=(1, 2, 3, 4))
         self._prefill_jit = jax.jit(self._prefill_impl,
                                     donate_argnums=(1, 2))
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2))
@@ -382,8 +416,10 @@ class ServeEngine:
                 if self.layer_norm else x
             q, k, v = self._attn_qkv(p, h)                # (1, S, H, D)
             if kv is not None:
-                k_pages = k_pages.at[i, pages, offs].set(k[0])
-                v_pages = v_pages.at[i, pages, offs].set(v[0])
+                k_pages = k_pages.at[i, pages, offs].set(
+                    k[0].astype(k_pages.dtype))
+                v_pages = v_pages.at[i, pages, offs].set(
+                    v[0].astype(v_pages.dtype))
             logits = jnp.einsum("bihd,bjhd->bhij", q, k,
                                 preferred_element_type=jnp.float32) * scale
             logits = jnp.where(causal, logits, -jnp.inf)
@@ -423,6 +459,38 @@ class ServeEngine:
         never reads. Returns (greedy (T,), top-k values (T, K), top-k
         ids (T, K), k_pages, v_pages) — the static top-k head feeds
         host-side seeded sampling without shipping (T, vocab) logits."""
+        out, (k_pages, v_pages) = self._mixed_body(
+            params, k_pages, v_pages, None, None, tokens, positions,
+            write_pages, write_offs, page_tables, lane_slots, lane_lens)
+        return (*out, k_pages, v_pages)
+
+    def _mixed_q_impl(self, params, k_pages, v_pages, k_scales, v_scales,
+                      tokens, positions, write_pages, write_offs,
+                      page_tables, lane_slots, lane_lens):
+        """The mixed step over an int8 page pool: identical lane
+        contract, but every lane's K/V row quantizes on write (per-row
+        amax scale into the per-page scale arrays) and the ragged
+        kernel dequantizes at read. Scale arrays are donated and
+        returned like the page arrays."""
+        out, (k_pages, v_pages, k_scales, v_scales) = self._mixed_body(
+            params, k_pages, v_pages, k_scales, v_scales, tokens,
+            positions, write_pages, write_offs, page_tables, lane_slots,
+            lane_lens)
+        return (*out, k_pages, v_pages, k_scales, v_scales)
+
+    def _mixed_body(self, params, k_pages, v_pages, k_scales, v_scales,
+                    tokens, positions, write_pages, write_offs,
+                    page_tables, lane_slots, lane_lens):
+        """Shared mixed-step body. Storage-dtype handling per layer:
+        f32 pages store activation values exactly (the bit-exactness
+        path); bf16 pages round on the scatter (the .at[].set cast);
+        int8 pages quantize each (lane, head) row against its own amax
+        scale BEFORE any lane attends, so what a lane reads back this
+        very step is already the dequantized value — quantized content
+        is therefore invariant to chunk boundaries, preemption
+        replays, and speculative rollbacks (every token's row
+        quantizes independently)."""
+        quantized = k_scales is not None
         x = self._embed(params, tokens, positions)        # (T, E)
         scale = 1.0 / np.sqrt(self.head_dim)
         for i in range(self.num_layers):
@@ -430,19 +498,36 @@ class ServeEngine:
             h = _ln(params[f"layer{i}_ln1"], x, self.ln_eps) \
                 if self.layer_norm else x
             q, k, v = self._attn_qkv(p, h)                # (T, H, D)
-            k_pages = k_pages.at[i, write_pages, write_offs].set(k)
-            v_pages = v_pages.at[i, write_pages, write_offs].set(v)
+            if quantized:
+                kq, ksc = quantize_kv_rows(k)             # int8, (T, H)
+                vq, vsc = quantize_kv_rows(v)
+                k_pages = k_pages.at[i, write_pages, write_offs].set(kq)
+                v_pages = v_pages.at[i, write_pages, write_offs].set(vq)
+                k_scales = k_scales.at[i, write_pages,
+                                       write_offs].set(ksc)
+                v_scales = v_scales.at[i, write_pages,
+                                       write_offs].set(vsc)
+            else:
+                k_pages = k_pages.at[i, write_pages, write_offs].set(
+                    k.astype(k_pages.dtype))
+                v_pages = v_pages.at[i, write_pages, write_offs].set(
+                    v.astype(v_pages.dtype))
             o = paged_attention_ragged(
                 q, k_pages[i], v_pages[i], page_tables, lane_slots,
                 lane_lens, scale=scale, use_pallas=self._use_pallas,
-                interpret=self._interpret)
+                interpret=self._interpret,
+                k_scales=k_scales[i] if quantized else None,
+                v_scales=v_scales[i] if quantized else None,
+                block_kv=self.attn_block_kv)
             x = self._attn_out(p, o, x)
             x = self._ffn(params, i, x)
         logits = self._head(params, x)                    # (T, V)
         topv, topi = jax.lax.top_k(logits, self.topk_cap)
-        return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
-                topv.astype(jnp.float32), topi.astype(jnp.int32),
-                k_pages, v_pages)
+        out = (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+               topv.astype(jnp.float32), topi.astype(jnp.int32))
+        caches = (k_pages, v_pages, k_scales, v_scales) if quantized \
+            else (k_pages, v_pages)
+        return out, caches
 
     # ---------------- legacy prefill -----------------------------------
     def _prefill_impl(self, params, k_pages, v_pages, tokens, length,
@@ -480,8 +565,10 @@ class ServeEngine:
             h = _ln(params[f"layer{i}_ln1"], x, self.ln_eps) \
                 if self.layer_norm else x
             q, k, v = self._attn_qkv(p, h)                # (B, H, D)
-            k_pages = k_pages.at[i, pages, offs].set(k)
-            v_pages = v_pages.at[i, pages, offs].set(v)
+            k_pages = k_pages.at[i, pages, offs].set(
+                k.astype(k_pages.dtype))
+            v_pages = v_pages.at[i, pages, offs].set(
+                v.astype(v_pages.dtype))
             o = paged_attention_decode(
                 q, k_pages[i], v_pages[i], page_tables, seq_lens,
                 scale=scale, use_pallas=self._use_pallas,
@@ -530,7 +617,31 @@ class ServeEngine:
     def _device_pages(self):
         if self._k_pages is None:
             self._k_pages, self._v_pages = self.cache.alloc_device_cache()
+        if self.kv_quantized and self._k_scales is None:
+            self._k_scales, self._v_scales = \
+                self.cache.alloc_scale_arrays()
+            self.cache.register_scale_meta(self._k_scales,
+                                           self._v_scales)
         return self._k_pages, self._v_pages
+
+    def _dispatch_mixed(self, kp, vp, *args):
+        """One mixed-step dispatch through the right jitted program for
+        the pool format, threading (and re-capturing) the donated scale
+        arrays on quantized pools. Returns (greedy, topv, topi, kp, vp);
+        the page AND scale arrays are re-stashed on self each step so a
+        mid-run audit (check_kv_scales from an `on_step` callback, when
+        sequences are actually resident) reads THIS step's content, not
+        the pre-run allocation."""
+        if self.kv_quantized:
+            greedy, topv, topi, kp, vp, ks, vs = self._call_counted(
+                "mixed", self._mixed_q_jit, self.params, kp, vp,
+                self._k_scales, self._v_scales, *args)
+            self._k_scales, self._v_scales = ks, vs
+        else:
+            greedy, topv, topi, kp, vp = self._call_counted(
+                "mixed", self._mixed_jit, self.params, kp, vp, *args)
+        self._k_pages, self._v_pages = kp, vp
+        return greedy, topv, topi, kp, vp
 
     def warmup(self) -> Dict[str, int]:
         """Compile the active path's programs once, on throwaway inputs
@@ -541,9 +652,8 @@ class ServeEngine:
             t = self.mixed_width
             z = jnp.zeros((t,), jnp.int32)
             pts = jnp.zeros((c.max_seqs, c.pages_per_seq), jnp.int32)
-            _, _, _, kp, vp = self._call_counted(
-                "mixed", self._mixed_jit, self.params, kp, vp, z, z, z, z,
-                pts, z, jnp.ones((t,), jnp.int32))
+            _, _, _, kp, vp = self._dispatch_mixed(
+                kp, vp, z, z, z, z, pts, z, jnp.ones((t,), jnp.int32))
         else:
             pt_row = jnp.zeros((c.pages_per_seq,), jnp.int32)
             for b in self.buckets:
@@ -607,6 +717,109 @@ class ServeEngine:
                                      len(req.out_tokens)])
         return int(topi[int(rng.choice(k, p=p))])
 
+    # ---------------- quantized-page verification (tests) -------------
+    def check_kv_scales(self) -> None:
+        """Device-side scale bookkeeping check for int8 pools (the
+        stress tests' companion to PagedKVCache.check_invariants):
+        every audited (page, offset) row must carry finite,
+        non-negative K/V scales, and a zero scale must vouch for an
+        all-zero int8 row (scale 0 is only ever written for an
+        all-zero activation row, so anything else means the scale and
+        its page drifted — e.g. a rollback/preemption interleaving
+        that reused a page slot without rewriting its scale). Audits
+        RESIDENT (slot, position) rows — which only exist mid-run, so
+        the stress tests call this from generate()'s `on_step`
+        callback (_dispatch_mixed re-stashes the live arrays each
+        step) — plus every prefix-cache-parked page: those are
+        complete pages whose content must outlive their writer for a
+        later request to attach, and they are what a post-run call
+        still covers. No-op on lossless pools."""
+        if not self.kv_quantized or self._k_pages is None:
+            return
+        ps = self.cache_cfg.page_size
+        kq = np.asarray(self._k_pages)
+        vq = np.asarray(self._v_pages)
+        ks = np.asarray(self._k_scales)
+        vs = np.asarray(self._v_scales)
+
+        def audit(what: str, page: int, off: int) -> None:
+            for name, s, q in (("k", ks, kq), ("v", vs, vq)):
+                srow = s[:, page, off, :]      # (layers, H)
+                qrow = q[:, page, off, :, :]   # (layers, H, D)
+                assert np.all(np.isfinite(srow)) \
+                    and np.all(srow >= 0), (
+                    f"{name}-scale of {what} (page {page} off {off}) "
+                    f"is not finite/non-negative")
+                dead = srow == 0.0
+                assert np.all(qrow[dead] == 0), (
+                    f"{name}-page row of {what} (page {page} off "
+                    f"{off}) has zero scale but nonzero quantized "
+                    f"content")
+
+        for slot in range(self.cache_cfg.max_seqs):
+            for pos in range(int(self.cache.seq_lens[slot])):
+                audit(f"slot {slot} pos {pos}",
+                      int(self.cache.page_tables[slot, pos // ps]),
+                      pos % ps)
+        for page in self.cache.parked_pages():
+            for off in range(ps):
+                audit("cached page", page, off)
+
+    @staticmethod
+    def first_divergence(a, b) -> Optional[int]:
+        """Index of the first position where token streams a and b
+        differ, or None when one is a prefix of the other (the shared
+        scan of assert_token_parity and the bench's prefix-agreement
+        metric)."""
+        return next((i for i, (x, y) in enumerate(zip(a, b))
+                     if x != y), None)
+
+    def assert_token_parity(self, prompts, out, ref, *, margin=0.05,
+                            min_exact_frac=0.0,
+                            what="outputs") -> int:
+        """The reference-parity gate for generate() outputs (the CI
+        bench and the property tests share this one implementation),
+        dispatched on the pool format. Lossless pools (kv_exact) gate
+        full token identity. Lossy pools (bfloat16/int8 pages) gate
+        the relaxed quantized contract instead: each request either
+        matches the greedy reference token-for-token, or first
+        diverges at a TIE — a position where the reference's own
+        top-logit margin over the engine's pick is inside the
+        quantization error bound. A real quantization-path bug (a
+        mis-indexed scale, a stale page) perturbs logits at O(1) and
+        flips comfortable margins, which this catches; an argmax flip
+        inside the margin is the priced-in cost of lossy pages (after
+        one tie flips, the continuation legitimately diverges, so
+        only the first divergence is comparable). Returns the
+        fully-identical request count."""
+        if self.kv_exact:
+            for i, (o, r) in enumerate(zip(out, ref)):
+                assert list(o) == list(r), (
+                    f"{what}: request {i} diverged from reference")
+            return len(out)
+        exact = 0
+        for pr, o, r in zip(prompts, out, ref):
+            j = self.first_divergence(o, r)
+            if j is None:
+                exact += 1
+                continue
+            ctx = list(pr) + list(r[:j])
+            b = self.bucket_for(len(ctx))
+            arr = np.zeros((1, b), np.int32)
+            arr[0, :len(ctx)] = ctx
+            logits = np.asarray(self._forward_jit(
+                self.params, jnp.asarray(arr), jnp.int32(len(ctx))))
+            gap = float(logits[r[j]] - logits[o[j]])
+            assert 0.0 <= gap <= margin, (
+                f"{what}: lossy KV pages flipped a non-tie token — "
+                f"reference margin {gap:.4f} > {margin} at "
+                f"position {j}")
+        assert exact >= min_exact_frac * len(prompts), (
+            f"{what}: only {exact}/{len(prompts)} requests "
+            f"token-identical — quantization error is not bounded at "
+            f"tie scale")
+        return exact
+
     # ---------------- robustness --------------------------------------
     def cancel(self, rid: int) -> bool:
         """Host-side cancellation: mark request `rid` of the in-flight
@@ -664,6 +877,9 @@ class ServeEngine:
         if self._k_pages is not None and \
                 getattr(self._k_pages, "is_deleted", lambda: False)():
             self._k_pages = self._v_pages = None  # realloc on next use
+        if self._k_scales is not None and \
+                getattr(self._k_scales, "is_deleted", lambda: False)():
+            self._k_scales = self._v_scales = None
         self.cache.check_invariants()
 
     # ---------------- the serving loop ---------------------------------
@@ -813,6 +1029,7 @@ class ServeEngine:
         assert cache.free_pages == c.usable_pages, "pages leaked"
         total_new = sum(len(r.out_tokens) for r in reqs)
         wall = time.perf_counter() - t0
+        peak_util = float(np.max(util)) if util else 0.0
         self.last_stats = {
             "requests": [
                 {"rid": r.rid, "prompt_tokens": len(r.prompt),
@@ -857,7 +1074,7 @@ class ServeEngine:
                 sched.stats["decode_lane_tokens"] / sum(decode_widths)
                 if decode_widths else 0.0),
             "page_util_mean": float(np.mean(util)) if util else 0.0,
-            "page_util_max": float(np.max(util)) if util else 0.0,
+            "page_util_max": peak_util,
             # robustness instrumentation (docs/robustness.md): abort /
             # deadline / rejection outcomes, retried dispatches, and
             # how far up the degradation ladder this batch climbed
@@ -871,6 +1088,23 @@ class ServeEngine:
             "rung_steps": list(sched.stats["rung_steps"]),
             "spec_shed_steps": sched.stats["spec_shed_steps"],
             "cache": dict(cache.stats),   # engine-lifetime counters
+            # KV pool: storage format, itemsize-derived byte accounting,
+            # effective capacity vs f32 pages, and the ragged kernel
+            # v2 work-item accounting (serve_report renders both)
+            "kv_pool": {
+                **cache.pool_report(),
+                # pool_report's occupancy is instantaneous and every
+                # slot is already released here — report the run's
+                # peak residency (what --kv-pool-mb tuning needs)
+                "occupancy": peak_util,
+                "kv_exact": self.kv_exact,
+                "attn_block_kv": self.attn_block_kv,
+                "attn_dispatch_passes": {
+                    k: v * steps for k, v in ragged_dispatch_passes(
+                        self.mixed_width, c.pages_per_seq,
+                        max(1, self.attn_block_kv // c.page_size)
+                    ).items()} if self.chunked_prefill else None,
+            },
         }
         return [list(r.out_tokens) for r in reqs]
 
@@ -936,8 +1170,8 @@ class ServeEngine:
             assert lane <= t_w, (
                 f"scheduler packed {lane} lanes into a {t_w}-lane step")
             tp = time.perf_counter()
-            greedy, topv, topi, kp, vp = self._call_counted(
-                "mixed", self._mixed_jit, self.params, kp, vp,
+            greedy, topv, topi, kp, vp = self._dispatch_mixed(
+                kp, vp,
                 jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(write_pages), jnp.asarray(write_offs),
                 jnp.asarray(cache.page_tables), jnp.asarray(lane_slots),

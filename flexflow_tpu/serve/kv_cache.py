@@ -54,9 +54,11 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 from collections import OrderedDict
-from typing import Dict, List, Sequence
+from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
+
+from ..config import KV_DTYPES  # the ONE --kv-dtype allowlist
 
 
 def prefix_page_keys(tokens: Sequence[int], page_size: int,
@@ -82,8 +84,27 @@ def prefix_page_keys(tokens: Sequence[int], page_size: int,
 class KVCacheConfig:
     """Geometry of the paged pool. Built from FFConfig + model shape via
     :meth:`from_ff` so every serving component sizes itself from the
-    same knobs (config.py kv_page_size / kv_num_pages /
-    serve_max_seqs)."""
+    same knobs (config.py kv_page_size / kv_num_pages / kv_dtype /
+    kv_pool_mb / serve_max_seqs).
+
+    ``kv_dtype`` selects the PAGE STORAGE format: float32 (exact),
+    bfloat16 (values round on write; exact when the engine's activation
+    dtype is already bf16), or int8 (quantized with per-page scale
+    arrays — one f32 scale per head per in-page token slot, see
+    `scale_shape`). Scales are per-slot rather than per-whole-page
+    because pages fill INCREMENTALLY (decode appends one token at a
+    time): a page-global amax would have to re-quantize every resident
+    token whenever a new token raised it, which is neither cheap nor
+    rollback-safe, while per-slot scales keep quantization write-local
+    so chunk boundaries, preemption replays, and speculative rollbacks
+    cannot change what any resident token dequantizes to.
+
+    All BYTE accounting (``page_bytes``, ``pool_bytes``, the
+    ``kv_pool_mb`` sizing below) derives from the configured dtype's
+    itemsize — never a hardcoded 4 — so watermark fractions, ladder
+    rung thresholds and ``ensure_capacity`` (all page-COUNT math over
+    ``usable_pages``) automatically see the larger effective pool a
+    quantized format buys at the same byte budget."""
 
     num_layers: int
     num_heads: int
@@ -92,16 +113,31 @@ class KVCacheConfig:
     num_pages: int = 257  # including the reserved sink page 0
     max_seqs: int = 8
     max_seq_len: int = 512  # logical cap; rounds up to whole pages
+    kv_dtype: str = "float32"
 
     @classmethod
     def from_ff(cls, config, *, num_layers: int, num_heads: int,
                 head_dim: int, max_seq_len: int = 512) -> "KVCacheConfig":
+        kv_dtype = str(getattr(config, "kv_dtype", "float32"))
+        num_pages = int(getattr(config, "kv_num_pages", 257))
+        pool_mb = float(getattr(config, "kv_pool_mb", 0.0) or 0.0)
+        if pool_mb > 0:
+            # byte-budget sizing: the page count FOLLOWS the storage
+            # format (the quantized-capacity lever — int8 pages cost
+            # ~1/4 the bytes, so the same budget holds ~4x the pages)
+            probe = cls(num_layers=num_layers, num_heads=num_heads,
+                        head_dim=head_dim,
+                        page_size=int(getattr(config, "kv_page_size", 16)),
+                        num_pages=2, max_seqs=1,
+                        max_seq_len=max_seq_len, kv_dtype=kv_dtype)
+            num_pages = 1 + max(1, int(pool_mb * (1 << 20))
+                                // probe.page_bytes)
         return cls(num_layers=num_layers, num_heads=num_heads,
                    head_dim=head_dim,
                    page_size=int(getattr(config, "kv_page_size", 16)),
-                   num_pages=int(getattr(config, "kv_num_pages", 257)),
+                   num_pages=num_pages,
                    max_seqs=int(getattr(config, "serve_max_seqs", 8)),
-                   max_seq_len=max_seq_len)
+                   max_seq_len=max_seq_len, kv_dtype=kv_dtype)
 
     @property
     def pages_per_seq(self) -> int:
@@ -112,6 +148,51 @@ class KVCacheConfig:
     def usable_pages(self) -> int:
         return self.num_pages - 1  # minus the sink
 
+    # ---------------- storage format / byte accounting ----------------
+    @property
+    def quantized(self) -> bool:
+        return self.kv_dtype == "int8"
+
+    @property
+    def kv_itemsize(self) -> int:
+        return int(np.dtype(self.kv_dtype).itemsize)
+
+    @property
+    def scale_shape(self):
+        """Per-page scale-array geometry (int8 pages only): one f32
+        scale per (layer, page, in-page slot, head) for K and for V."""
+        return (self.num_layers, self.num_pages, self.page_size,
+                self.num_heads)
+
+    @property
+    def page_bytes(self) -> int:
+        """Device bytes ONE page costs across all layers: K + V values
+        at kv_dtype itemsize, plus the f32 scale rows when quantized.
+        The basis for every byte-level pool computation (never assume
+        4 bytes/element)."""
+        values = (2 * self.num_layers * self.page_size * self.num_heads
+                  * self.head_dim * self.kv_itemsize)
+        scales = (2 * self.num_layers * self.page_size * self.num_heads
+                  * 4) if self.quantized else 0
+        return values + scales
+
+    @property
+    def f32_page_bytes(self) -> int:
+        """What the same page geometry costs in float32 pages — the
+        baseline for the quantized-capacity comparison."""
+        return (2 * self.num_layers * self.page_size * self.num_heads
+                * self.head_dim * 4)
+
+    @property
+    def pool_bytes(self) -> int:
+        return self.num_pages * self.page_bytes
+
+    @property
+    def effective_page_ratio(self) -> float:
+        """Pages this format fits per byte, relative to f32 — the
+        capacity multiplier int8 buys at an equal pool budget."""
+        return self.f32_page_bytes / self.page_bytes
+
     def validate(self) -> None:
         if self.page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {self.page_size}")
@@ -119,6 +200,10 @@ class KVCacheConfig:
             raise ValueError(
                 f"num_pages must be >= 2 (page 0 is the reserved sink), "
                 f"got {self.num_pages}")
+        if self.kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {KV_DTYPES}, got "
+                f"{self.kv_dtype!r}")
         if self.pages_per_seq > self.usable_pages:
             raise ValueError(
                 f"one max-length sequence needs {self.pages_per_seq} pages "
@@ -155,6 +240,10 @@ class PagedKVCache:
                                     dtype=np.int32)
         self.seq_lens = np.zeros((cfg.max_seqs,), dtype=np.int32)
         self._slot_free = list(range(cfg.max_seqs - 1, -1, -1))
+        # quantized-page scale bookkeeping (register_scale_meta):
+        # geometry of the engine's scale arrays, checked by
+        # check_invariants against cfg.scale_shape
+        self._scale_meta = None
         # serving metrics, merged into ServeEngine.last_stats
         self.stats = {"prefix_hit_pages": 0, "prefix_evictions": 0,
                       "pages_committed": 0, "shared_attaches": 0,
@@ -455,15 +544,64 @@ class PagedKVCache:
     # ---------------- device arrays -----------------------------------
     def alloc_device_cache(self, dtype=None):
         """The (k_pages, v_pages) device arrays, each
-        (num_layers, num_pages, page_size, num_heads, head_dim). Created
-        once per engine; thereafter they only flow through jitted steps
-        (donated), never through this manager."""
+        (num_layers, num_pages, page_size, num_heads, head_dim) at the
+        configured kv_dtype (dtype overrides — the pre-quantization
+        callers passed explicit dtypes). Created once per engine;
+        thereafter they only flow through jitted steps (donated), never
+        through this manager. int8 pools pair with
+        :meth:`alloc_scale_arrays`."""
         import jax.numpy as jnp
         c = self.cfg
         shape = (c.num_layers, c.num_pages, c.page_size, c.num_heads,
                  c.head_dim)
-        dt = dtype or jnp.float32
+        dt = dtype or jnp.dtype(c.kv_dtype)
         return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+    def alloc_scale_arrays(self):
+        """The (k_scales, v_scales) f32 per-page scale arrays for int8
+        pools (cfg.scale_shape). Like the page arrays they flow
+        functionally through the jitted steps, donated."""
+        import jax.numpy as jnp
+        if not self.cfg.quantized:
+            raise RuntimeError(
+                f"scale arrays exist only for int8 pools "
+                f"(kv_dtype={self.cfg.kv_dtype})")
+        return (jnp.zeros(self.cfg.scale_shape, jnp.float32),
+                jnp.zeros(self.cfg.scale_shape, jnp.float32))
+
+    def register_scale_meta(self, k_scales, v_scales) -> None:
+        """Record the scale-array geometry the engine allocated so
+        check_invariants can vouch for the quantized-page bookkeeping
+        (shape/dtype drift between the host page accounting and the
+        device scale arrays would silently dequantize garbage)."""
+        self._scale_meta = (tuple(k_scales.shape), str(k_scales.dtype),
+                            tuple(v_scales.shape), str(v_scales.dtype))
+
+    def parked_pages(self) -> Tuple[int, ...]:
+        """The prefix-cache-parked pages: complete, unreferenced,
+        prefix-matchable — content that must outlive its writer for a
+        later request to attach (the post-run surface
+        ServeEngine.check_kv_scales audits)."""
+        return tuple(int(p) for p in self._lru)
+
+    def pool_report(self) -> Dict[str, object]:
+        """The KV-pool line of ServeEngine.last_stats / serve_report:
+        storage format, per-page and pool bytes (itemsize-derived),
+        effective pages, and the capacity multiplier vs f32 pages.
+        Occupancy here is INSTANTANEOUS (meaningful mid-run; zero once
+        generate() has released every slot) — last_stats overrides it
+        with the run's peak."""
+        c = self.cfg
+        return {
+            "kv_dtype": c.kv_dtype,
+            "bytes_per_page": c.page_bytes,
+            "effective_pages": c.usable_pages,
+            "pool_bytes": c.pool_bytes,
+            "occupancy": 1.0 - self.free_pages / c.usable_pages,
+            "page_ratio_vs_f32": round(c.effective_page_ratio, 3),
+            "pages_saved_vs_f32": int(
+                c.usable_pages - c.usable_pages / c.effective_page_ratio),
+        }
 
     # ---------------- invariant checks (tests) ------------------------
     def check_invariants(self) -> None:
@@ -524,3 +662,19 @@ class PagedKVCache:
         if not self.prefix_enabled:
             assert not self._hash_of_page and not self._lru, (
                 "prefix cache disabled but registry non-empty")
+        # quantized-page scale bookkeeping: an int8 pool must have
+        # registered scale arrays whose geometry matches the page
+        # geometry exactly — a drifted shape would dequantize every
+        # resident token against the wrong scale rows — and a
+        # non-quantized pool must not carry scale state at all.
+        if c.quantized:
+            if self._scale_meta is not None:
+                ks_shape, ks_dt, vs_shape, vs_dt = self._scale_meta
+                assert ks_shape == c.scale_shape == vs_shape, (
+                    f"scale arrays {ks_shape}/{vs_shape} do not match "
+                    f"the pool geometry {c.scale_shape}")
+                assert ks_dt == vs_dt == "float32", (
+                    f"scale arrays must be float32, got {ks_dt}/{vs_dt}")
+        else:
+            assert self._scale_meta is None, (
+                f"kv_dtype={c.kv_dtype} pool carries scale bookkeeping")

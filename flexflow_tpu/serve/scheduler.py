@@ -202,6 +202,14 @@ class ContinuousBatchingScheduler:
     # attach would pin reclaimable pages), rung 3 tightens the
     # admission watermark 4x, rung 4 rejects what cannot be served
     # (structured RejectedRequest instead of a deadlock or a raise).
+    #
+    # Every threshold here — like the admission watermark and all of
+    # ensure_capacity/pages_to_extend — is a fraction of PAGE COUNTS
+    # over cfg.usable_pages, never device bytes: the page count is
+    # derived upstream from the configured kv_dtype's itemsize
+    # (KVCacheConfig.page_bytes / kv_pool_mb sizing), so a quantized
+    # pool's extra pages raise the rung/watermark ceilings
+    # automatically and nothing below may assume 4-byte elements.
     LADDER = (0.85, 0.92, 0.97)
     RUNG3_WATERMARK_FRAC = 0.08
 

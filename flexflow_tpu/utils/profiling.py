@@ -161,6 +161,27 @@ def serve_report(stats: dict) -> str:
             f"(max refs {cache.get('max_page_refs', 0)}), "
             f"{cache.get('prefix_evictions', 0)} evictions, "
             f"{cache.get('rollback_pages', 0)} rolled-back pages")
+    # KV pool: storage format + itemsize-derived byte accounting and
+    # the quantized-capacity multiplier (serve/kv_cache.pool_report);
+    # absent from pre-quantization stats dicts — key-guarded
+    pool = stats.get("kv_pool")
+    if pool:
+        lines.append(
+            f"kv pool: {pool.get('kv_dtype', 'float32')} pages, "
+            f"{pool.get('bytes_per_page', 0)} B/page x "
+            f"{pool.get('effective_pages', 0)} effective pages "
+            f"({pool.get('pool_bytes', 0) / 2**20:.2f} MiB), "
+            f"peak occupancy {pool.get('occupancy', 0.0):.1%}, "
+            f"{pool.get('page_ratio_vs_f32', 1.0):.2f}x pages/byte "
+            f"vs f32 ({pool.get('pages_saved_vs_f32', 0)} pages saved)")
+        dp = pool.get("attn_dispatch_passes")
+        if dp:
+            red = dp["v1"] / dp["v2"] if dp.get("v2") else 0.0
+            lines.append(
+                f"ragged kernel v2: block_kv="
+                f"{pool.get('attn_block_kv', 0)} tokens, "
+                f"{dp['v2']} grid steps vs {dp['v1']} at v1 per-page "
+                f"dispatch ({red:.1f}x fewer)")
     cc = stats.get("compile_counts")
     if cc:
         progs = " ".join(f"{k}={v}" for k, v in cc.items() if v)

@@ -82,6 +82,17 @@ echo "--- 1h. train-bench smoke (async runtime >= 1.10x + exactness gate)"
 env JAX_PLATFORMS=cpu python tools/train_bench.py --smoke \
     -o /tmp/ci_bench_train.json || fail=1
 
+echo "--- 1i. kv-quantization smoke (int8 page capacity + parity gate)"
+# int8 KV pages vs f32 at an EQUAL pool byte budget: fails unless the
+# effective page capacity is >= 1.9x, the same requests run at higher
+# decode concurrency in fewer engine steps, int8 greedy outputs hold
+# token parity with the no-cache reference up to tie-margin flips
+# (and are chunk-boundary invariant), and nothing compiles after
+# warmup. The f32 arm also re-gates kernel-v2 bit-exactness + zero
+# recompiles (tools/serve_bench.py --workload kv, docs/serving.md)
+env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload kv \
+    -o /tmp/ci_bench_serve_kv.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \
