@@ -39,7 +39,9 @@ class ParameterSyncType:
 
 # KV-page storage formats the serve stack supports (--kv-dtype). The
 # ONE allowlist: serve/kv_cache.py derives its byte accounting from it.
-KV_DTYPES = ("float32", "bfloat16", "int8")
+# "float8_e4m3" stores ml_dtypes' e4m3fn pages and reuses the int8
+# per-row scale machinery verbatim (serve/kv_cache.kv_storage_dtype).
+KV_DTYPES = ("float32", "bfloat16", "int8", "float8_e4m3")
 
 
 @dataclasses.dataclass
@@ -361,6 +363,17 @@ class FFConfig:
     # after this many consecutive stalled admission attempts at rung
     # >= 3 (0 = never reject for stalling; offline batches wait).
     serve_reject_stalls: int = 0
+    # tensor-parallel sharded serving (docs/serving.md "Sharded
+    # serving"): shard the ONE mixed program over a 1-D "tensor" mesh —
+    # head-parallel attention over a head-sharded KV page pool,
+    # column/row-parallel projections with one all-reduce after the
+    # attention output and FFN, vocab-sharded embedding/head with ONE
+    # logits all-gather. "" (default) = single device; an integer
+    # string = that tensor-parallel degree; "auto" = resolve the degree
+    # through the placement search (search/serve_place.optimize_serve —
+    # the SOAP-style simulator pricing applied to the serve program).
+    # --serve-mesh.
+    serve_mesh: str = ""
 
     # synthetic input when no dataset is provided (reference: config.h:131)
     synthetic_input: bool = False
@@ -476,6 +489,16 @@ class FFConfig:
             raise ValueError(
                 f"serve_reject_stalls must be >= 0 (0 = never), got "
                 f"{self.serve_reject_stalls}")
+        sm = str(self.serve_mesh or "").strip()
+        if sm and sm != "auto":
+            try:
+                ok = int(sm) >= 1
+            except ValueError:
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"serve_mesh must be '', 'auto', or a positive "
+                    f"tensor-parallel degree, got {self.serve_mesh!r}")
         if self.fault_spec:
             # parse eagerly so a typo'd spec fails at config time, not
             # silently mid-chaos-run
@@ -544,6 +567,7 @@ class FFConfig:
         "--serve-max-retries": ("serve_max_retries", int),
         "--serve-retry-backoff": ("serve_retry_backoff_s", float),
         "--serve-reject-stalls": ("serve_reject_stalls", int),
+        "--serve-mesh": ("serve_mesh", str),
     }
     _BOOL_FLAGS = {
         "--profiling": "profiling",

@@ -78,24 +78,45 @@ except Exception:  # pragma: no cover
 INT8_QMAX = 127.0
 
 
-def quantize_kv_rows(x):
-    """Per-row symmetric int8 quantization of K/V vectors.
+def _qmax_for(dtype) -> float:
+    """Largest representable magnitude of a page storage format: 127
+    for int8, finfo.max (448) for float8_e4m3fn. Rows scale their amax
+    to this value so the full dynamic range of the format is used."""
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.dtype(jnp.int8):
+        return INT8_QMAX
+    return float(jnp.finfo(dtype).max)
 
-    x (..., D) float -> (q (..., D) int8, scales (...) f32) with
-    q = round(x / scale), scale = amax(|x|, -1) / 127. An all-zero row
-    gets scale 0 and q 0 (dequant reproduces the zeros exactly) — the
-    sink-page / padding-lane case. Each row quantizes independently of
-    every other token, which is what makes the serving path's
-    quantized content invariant to chunk boundaries, preemption
-    replays, and speculative rollbacks (serve/engine.py)."""
+
+def quantize_kv_rows(x, dtype=jnp.int8):
+    """Per-row symmetric quantization of K/V vectors into a narrow
+    page storage format (int8 or float8_e4m3fn — the fp8 path reuses
+    this machinery verbatim, scales and all).
+
+    x (..., D) float -> (q (..., D) `dtype`, scales (...) f32) with
+    q = round(x / scale), scale = amax(|x|, -1) / qmax (127 for int8,
+    448 for e4m3). An all-zero row gets scale 0 and q 0 (dequant
+    reproduces the zeros exactly) — the sink-page / padding-lane case.
+    Each row quantizes independently of every other token, which is
+    what makes the serving path's quantized content invariant to chunk
+    boundaries, preemption replays, and speculative rollbacks
+    (serve/engine.py). fp8 rows round at the dtype cast (the scaled
+    values are <= the format's max finite by construction, so the
+    saturating e4m3fn cast never produces NaN)."""
+    dtype = jnp.dtype(dtype)
+    qmax = _qmax_for(dtype)
     xf = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf), axis=-1)
-    scale = amax / INT8_QMAX
+    scale = amax / qmax
     # rows with scale 0 are all-zero: divide by 1 instead and the
     # zeros quantize to 0 regardless
     safe = jnp.where(scale > 0, scale, 1.0)
-    q = jnp.clip(jnp.rint(xf / safe[..., None]), -INT8_QMAX, INT8_QMAX)
-    return q.astype(jnp.int8), scale
+    y = xf / safe[..., None]
+    if dtype == jnp.dtype(jnp.int8):
+        q = jnp.clip(jnp.rint(y), -INT8_QMAX, INT8_QMAX)
+    else:
+        q = y  # the cast below rounds to the format's grid
+    return q.astype(dtype), scale
 
 
 def dequantize_kv(q, scale):
@@ -139,8 +160,8 @@ def choose_block_kv(page_size: int, pages_per_seq: int, num_heads: int,
     if got is not None:
         return got
     per_tok = 2 * num_heads * head_dim * kv_itemsize  # K + V
-    if kv_itemsize == 1:  # int8 pages also stream f32 scale rows
-        per_tok += 2 * num_heads * 4
+    if kv_itemsize == 1:  # quantized (int8/fp8) pages also stream
+        per_tok += 2 * num_heads * 4  # their f32 scale rows
     want = max(1, -(-DMA_TARGET_BYTES // (per_tok * page_size)))
     cap = max(1, (VMEM_BUDGET_BYTES // 2) // (per_tok * page_size))
     ppb = min(max(1, want), cap, pages_per_seq)

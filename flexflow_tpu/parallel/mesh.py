@@ -30,6 +30,12 @@ MODEL = "model"
 SEQ_AX = "seq"
 EXPERT_AX = "expert"
 PIPE = "pipe"
+# serving-side tensor parallelism (docs/serving.md "Sharded serving"):
+# the ONE mixed prefill+decode program shards over a 1-D mesh on this
+# axis — head-parallel attention, head-sharded KV pages, vocab-sharded
+# embedding/head. Named distinctly from the training axes because a
+# serve mesh is built per engine, not per FFModel.
+TENSOR = "tensor"
 
 ALL_AXES = (DATA, MODEL, SEQ_AX, EXPERT_AX, PIPE)
 
@@ -99,3 +105,12 @@ def default_mesh(num_devices: Optional[int] = None) -> Mesh:
 
 def single_device_mesh() -> Mesh:
     return make_mesh((1,), (DATA,), jax.devices()[:1])
+
+
+def serve_tensor_mesh(tensor_parallel: int,
+                      devices: Optional[Sequence] = None) -> Mesh:
+    """The 1-D serving mesh ServeEngine shards the mixed program over:
+    `tensor_parallel` devices on the TENSOR axis (head-parallel
+    attention + head-sharded KV pages + vocab-sharded embedding/head,
+    docs/serving.md)."""
+    return make_mesh((int(tensor_parallel),), (TENSOR,), devices)

@@ -62,7 +62,7 @@ def _pricing_source_hash() -> str:
 
 
 def machine_fingerprint(mm, mesh=None, precision=None,
-                        overlap=None) -> str:
+                        overlap=None, serve=None) -> str:
     """Stable short hash of everything the cost formulas read from the
     machine model + mesh (plus the pricing code itself). Shared by the
     cost cache, sim_validation and perf_report so committed numbers are
@@ -80,7 +80,13 @@ def machine_fingerprint(mm, mesh=None, precision=None,
     Simulator.overlap_sig(): an overlap flip or a bucket-size change
     alters every simulated makespan the cached numbers feed, so it must
     be a guaranteed cache miss (regression-tested in
-    tests/test_overlap.py)."""
+    tests/test_overlap.py).
+
+    `serve` is the serve-placement signature (search/serve_place:
+    tensor degree, axis assignment, KV/activation dtypes) the serve
+    pricing ran under: a placement or page-dtype flip changes the KV
+    streaming and collective bytes of every serve-step cost, so cached
+    serve entries must MISS across it (tests/test_serve_shard.py)."""
     from .cost_model import COST_MODEL_VERSION
     spec = {f.name: getattr(mm.spec, f.name, None)
             for f in dataclasses.fields(mm.spec)}
@@ -99,6 +105,7 @@ def machine_fingerprint(mm, mesh=None, precision=None,
         "precision": (list(str(p) for p in precision)
                       if precision is not None else None),
         "overlap": (list(overlap) if overlap is not None else None),
+        "serve": (list(serve) if serve is not None else None),
     }
     raw = json.dumps(blob, sort_keys=True, default=str)
     return hashlib.sha256(raw.encode()).hexdigest()[:16]

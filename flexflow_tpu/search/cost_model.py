@@ -28,7 +28,11 @@ from .machine_model import TPUMachineModel
 # per-device DP payload) so the simulator can price bucket-granular
 # grad syncs (FFConfig.grad_bucket_mb) with real per-bucket
 # latency+bandwidth instead of one latency term per op.
-COST_MODEL_VERSION = 3
+# v4: serve-program pricing (ServeArch / serve_step_tasks) — the
+# SOAP-style simulation applied to the ONE mixed prefill+decode
+# serving step, per tensor-parallel degree and axis assignment
+# (search/serve_place.optimize_serve resolves --serve-mesh auto).
+COST_MODEL_VERSION = 4
 
 BWD_FLOP_FACTOR = 2.0  # dX and dW GEMMs ≈ 2x fwd (reference bwd = 2 GEMMs)
 # per-op-type overrides: attention bwd recomputes probabilities from the
@@ -535,3 +539,159 @@ def staged_pipeline_cost(model, mesh, mm: TPUMachineModel,
     else:
         mem_total = max(mems) if mems else 0.0
     return pc, syncs, mem_total
+
+
+# ---------------------------------------------------------------------------
+# Serve-program pricing (tensor-parallel sharded serving, PR 9)
+# ---------------------------------------------------------------------------
+
+# the serve mesh's one axis name, shared with parallel/mesh.TENSOR
+# (imported lazily there to keep this module jax-light)
+SERVE_AXIS = "tensor"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeArch:
+    """What the placement search needs to know about one ServeEngine:
+    the LM's dimensions plus the serving workload's steady state. Built
+    by ``ServeEngine.serve_arch()``; priced by :func:`serve_step_tasks`
+    per tensor-parallel degree. ``context`` is the assumed resident
+    KV history per decode lane (the attention/KV-streaming term);
+    ``decode_lanes``/``prefill_lanes`` are the two steady-state
+    workloads the ONE mixed program alternates between — a full decode
+    step and a budget-sized prefill chunk."""
+
+    num_layers: int
+    hidden: int
+    num_heads: int
+    head_dim: int
+    ff_dim: int
+    vocab: int
+    decode_lanes: int = 8
+    prefill_lanes: int = 512
+    context: int = 1024
+    kv_dtype: str = "float32"
+    kv_itemsize: float = 4.0
+    kv_scales: bool = False      # quantized pools stream f32 scale rows
+    act_itemsize: float = 4.0
+    act_dtype: str = "float32"
+    param_itemsize: float = 4.0  # serving weights as resident on device
+
+    def signature(self) -> tuple:
+        """Stable tuple of every field the pricing reads — the
+        cost-cache entry key half (serve_place folds it in), so an
+        arch OR kv/act dtype flip is a guaranteed cache miss."""
+        return tuple(getattr(self, f.name)
+                     for f in dataclasses.fields(self))
+
+    def weight_bytes(self) -> float:
+        """Total LM weight bytes at param_itemsize (qkv + wo + ffn per
+        layer, tied-vocab embedding + head)."""
+        e, hd = self.hidden, self.num_heads * self.head_dim
+        per_layer = 3 * e * hd + hd * e + 2 * e * self.ff_dim
+        return (self.num_layers * per_layer + 2 * self.vocab * e) \
+            * self.param_itemsize
+
+
+@dataclasses.dataclass
+class ServeTask:
+    """One node of the serve-step task graph (the serving analog of
+    the training simulator's _Task): compute tasks run on the MXU/HBM
+    roofline, collective tasks on the ICI ring formulas. deps name
+    earlier tasks; simulator.simulate_serve_tasks runs the critical
+    path."""
+    name: str
+    kind: str            # "compute" | "collective"
+    seconds: float
+    deps: tuple = ()
+
+
+def serve_step_tasks(arch: ServeArch, tensor_parallel: int,
+                     mm: TPUMachineModel, *, lanes: int,
+                     axis: str = SERVE_AXIS) -> list:
+    """Task graph of ONE mixed serving step with ``lanes`` query lanes
+    sharded ``tensor_parallel`` ways on the serve mesh (docs/serving.md
+    "Sharded serving"), priced exactly like the engine executes it:
+
+      per layer — head-column-parallel qkv, paged attention over each
+      lane's ``context`` KV at ``kv_itemsize`` (plus f32 scale rows on
+      quantized pools), head-row-parallel wo with its all-reduce,
+      column→row-parallel FFN with its all-reduce; then the
+      vocab-sharded head with the program's ONE logits all-gather
+      (the embedding psum rides the first layer's entry).
+
+    Weights stream at ``param_itemsize`` (serving is small-batch: the
+    HBM weight traffic is the t× lever), activations/collectives at
+    ``act_itemsize``. Returns [ServeTask] in dependency order."""
+    t = max(1, int(tensor_parallel))
+    T = int(lanes)
+    e, h, d, f = arch.hidden, arch.num_heads, arch.head_dim, arch.ff_dim
+    hd = h * d
+    act = arch.act_itemsize
+    p = arch.param_itemsize
+    ctx = max(1, int(arch.context))
+    dt = arch.act_dtype
+    tasks: list = []
+
+    def compute(name, flops, bytes_moved, deps):
+        tasks.append(ServeTask(
+            name, "compute",
+            mm.compute_time(flops, bytes_moved, True, dtype=dt),
+            deps))
+
+    def all_reduce(name, nbytes, deps):
+        if t > 1:
+            tasks.append(ServeTask(
+                name, "collective", mm.all_reduce(nbytes, t, axis),
+                deps))
+
+    # vocab-row-sharded embedding: gather T rows locally, ONE exact
+    # psum assembles them (engine._embed_tp)
+    compute("embed", 0.0, T * e * act, ())
+    all_reduce("embed_psum", T * e * act, ("embed",))
+    prev = tasks[-1].name
+    for i in range(arch.num_layers):
+        # head-column-parallel qkv (each device its H/t heads)
+        compute(f"l{i}.qkv", 2 * 3 * T * e * hd / t,
+                (3 * e * hd * p) / t + T * e * act
+                + 3 * T * hd * act / t, (prev,))
+        # paged ragged attention: QK^T + PV over each lane's context,
+        # streaming the head shard of the KV pages (+ scale rows on
+        # quantized pools)
+        kv_bytes = 2 * T * ctx * (hd / t) * arch.kv_itemsize
+        if arch.kv_scales:
+            kv_bytes += 2 * T * ctx * (h / t) * 4.0
+        compute(f"l{i}.attn", 4 * T * ctx * hd / t, kv_bytes,
+                (f"l{i}.qkv",))
+        # head-row-parallel wo: partial sums complete in the all-reduce
+        compute(f"l{i}.wo", 2 * T * hd * e / t,
+                (hd * e * p) / t + T * e * act, (f"l{i}.attn",))
+        all_reduce(f"l{i}.ar_attn", T * e * act, (f"l{i}.wo",))
+        # column->row-parallel FFN, one all-reduce before the bias
+        compute(f"l{i}.ffn", 2 * 2 * T * e * f / t,
+                (2 * e * f * p) / t + 2 * T * e * act,
+                (tasks[-1].name,))
+        all_reduce(f"l{i}.ar_ffn", T * e * act, (f"l{i}.ffn",))
+        prev = tasks[-1].name
+    # vocab-column-sharded head + the program's only all-gather
+    compute("head", 2 * T * e * arch.vocab / t,
+            (e * arch.vocab * p) / t + T * e * act, (prev,))
+    if t > 1:
+        tasks.append(ServeTask(
+            "logits_gather", "collective",
+            mm.all_gather(T * arch.vocab * act, t, axis), ("head",)))
+    return tasks
+
+
+def serve_device_bytes(arch: ServeArch, tensor_parallel: int) -> float:
+    """Per-device resident bytes under head/vocab sharding: the weight
+    shard plus each decode lane's context KV shard — what the memory
+    penalty (and the auto placement's HBM fit) sees."""
+    t = max(1, int(tensor_parallel))
+    kv = (2 * arch.decode_lanes * arch.context
+          * (arch.num_heads * arch.head_dim / t) * arch.num_layers
+          * arch.kv_itemsize)
+    if arch.kv_scales:
+        kv += (2 * arch.decode_lanes * arch.context
+               * (arch.num_heads / t) * arch.num_layers * 4.0)
+    return arch.weight_bytes() / t + kv

@@ -1,0 +1,211 @@
+"""Inference-placement search: the paper's loop closed for serving.
+
+The source paper's core move — per-op parallel configs discovered by a
+simulator-driven MCMC search — has only ever priced TRAINING steps
+here (mcmc.optimize over the op graph). This module applies the same
+machinery to the serve program: candidates are (tensor-parallel
+degree, physical axis assignment) pairs for the ONE mixed
+prefill+decode step (docs/serving.md "Sharded serving"), costs come
+from the serve task graph (cost_model.serve_step_tasks) run through
+the serve event loop (simulator.simulate_serve_step) on the same
+TPUMachineModel the training search prices against, and the annealing
+loop is the reference's Metropolis walk (model.cc:1807-1903 idiom,
+mirroring mcmc._anneal) over the placement space.
+
+``optimize_serve`` is what ``--serve-mesh auto`` resolves through
+(ServeEngine._resolve_serve_mesh): it returns the placement whose
+simulated decode step is fastest, with the budget-sized prefill chunk
+as the tiebreak-weighted second workload. Costs persist in the SAME
+CostCache as op costs, scoped by a machine fingerprint that folds the
+serve signature (cost_cache.machine_fingerprint(serve=...)) — a
+placement or KV-dtype flip is a guaranteed cache miss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Dict, List, Optional, Tuple
+
+from .cost_model import ServeArch
+from .machine_model import TPUMachineModel
+from .simulator import simulate_serve_step
+
+# objective weights: serving steady state is decode-dominated (every
+# request decodes for its whole output length but prefills once), so
+# the decode step carries the objective and the prefill chunk enters
+# at a fraction — enough that a placement which wrecks prefill cannot
+# win on decode alone.
+PREFILL_WEIGHT = 0.25
+
+
+@dataclasses.dataclass(frozen=True)
+class ServePlacement:
+    """One serve placement the search priced (the winner when returned
+    by optimize_serve): the tensor-parallel degree the engine shards
+    the mixed program to, the physical torus dims the serve axis rides
+    (() = one flat ICI ring), and the simulated steady-state costs."""
+    tensor_parallel: int
+    axis_dims: Tuple[int, ...]
+    decode_step_s: float
+    prefill_step_s: float
+    cost: float
+    # every candidate degree's best decode step (axis optimized away) —
+    # what serve_bench renders as the t-sweep and the speedup gate reads
+    decode_by_degree: Dict[int, float] = dataclasses.field(
+        default_factory=dict)
+    fingerprint: str = ""
+
+    def speedup_vs_single(self) -> float:
+        base = self.decode_by_degree.get(1)
+        if not base or not self.decode_step_s:
+            return 1.0
+        return base / self.decode_step_s
+
+
+def candidate_degrees(arch: ServeArch, num_devices: int) -> List[int]:
+    """Tensor degrees the engine can actually run: divisors of the
+    head count, bounded by the device count (head sharding is the
+    backbone — ff/vocab pad, heads cannot)."""
+    n = max(1, int(num_devices))
+    return [t for t in range(1, n + 1)
+            if arch.num_heads % t == 0]
+
+
+def axis_assignments(mm: TPUMachineModel, t: int) -> List[Tuple[int, ...]]:
+    """Physical layouts the serve axis could take on this machine: the
+    flat single ring always, plus every contiguous run of the spec's
+    ICI torus dims whose product is exactly t (a k-dim assignment runs
+    ring phases over k link sets concurrently —
+    machine_model._phys)."""
+    out: List[Tuple[int, ...]] = [()]
+    dims = tuple(getattr(mm.spec, "ici_torus_dims", ()) or ())
+    for i in range(len(dims)):
+        prod = 1
+        for j in range(i, len(dims)):
+            prod *= dims[j]
+            if prod == t:
+                out.append(dims[i:j + 1])
+            if prod >= t:
+                break
+    return out
+
+
+def _serve_fingerprint(mm: TPUMachineModel, arch: ServeArch) -> str:
+    from .cost_cache import machine_fingerprint
+    return machine_fingerprint(
+        mm, serve=("serve_v1", arch.kv_dtype, arch.act_dtype,
+                   arch.kv_itemsize, arch.act_itemsize,
+                   arch.param_itemsize))
+
+
+def price_placement(arch: ServeArch, t: int, mm: TPUMachineModel,
+                    axis_dims: Tuple[int, ...] = (),
+                    cache=None, fingerprint: str = ""
+                    ) -> Tuple[float, float]:
+    """(decode_step_s, prefill_step_s) of one candidate, through the
+    persistent cost cache when given: rows are stored OpCost-shaped
+    (decode in fwd, prefill in bwd) under a key carrying the placement
+    AND the full arch signature, inside a fingerprint carrying the
+    serve dtypes — either flip misses."""
+    key = None
+    if cache is not None:
+        key = cache.entry_key("serve_step", (t, tuple(axis_dims)),
+                              extra=arch.signature())
+        row = cache.get(fingerprint, key)
+        if row is not None:
+            return row.fwd, row.bwd
+    dec = simulate_serve_step(arch, t, mm, axis_dims=axis_dims)
+    pre = simulate_serve_step(arch, t, mm, axis_dims=axis_dims,
+                              lanes=arch.prefill_lanes)
+    if cache is not None:
+        from .cost_model import OpCost
+        cache.put(fingerprint, key,
+                  OpCost(fwd=dec, bwd=pre, fwd_comm=0.0, bwd_comm=0.0,
+                         sync=0.0, mem=0.0))
+    return dec, pre
+
+
+def optimize_serve(arch: ServeArch, num_devices: int, *,
+                   mm: Optional[TPUMachineModel] = None,
+                   config=None, budget: int = 64, alpha: float = 0.05,
+                   seed: Optional[int] = None) -> ServePlacement:
+    """Pick the serve placement by simulated annealing over
+    (degree, axis assignment) — the reference's Metropolis walk with
+    the same relative-delta acceptance as mcmc._anneal — then return
+    the best placement visited with its per-degree decode table.
+
+    `config` (an FFConfig) supplies the machine model file, cost-cache
+    path and seed the training search uses, so `--serve-mesh auto`
+    prices serving on exactly the machine the training side was
+    calibrated against. The space is small (divisor degrees × torus
+    runs), so the default budget walks it to the optimum; the walk —
+    not enumeration — is kept so richer placement spaces (replica
+    counts, per-layer degrees) extend without restructuring."""
+    if mm is None:
+        from .machine_model import default_machine_model
+        mm = default_machine_model(
+            machine_file=getattr(config, "machine_model_file", None)
+            if config is not None else None)
+    if seed is None:
+        seed = int(getattr(config, "seed", 0) or 0) \
+            if config is not None else 0
+    cache = None
+    fingerprint = ""
+    if config is None or getattr(config, "search_cost_cache", True):
+        from .cost_cache import CostCache
+        cache = CostCache.open(
+            (getattr(config, "cost_cache_file", None) or None)
+            if config is not None else None)
+        fingerprint = _serve_fingerprint(mm, arch)
+
+    degrees = candidate_degrees(arch, num_devices)
+    space: List[Tuple[int, Tuple[int, ...]]] = [
+        (t, dims) for t in degrees for dims in axis_assignments(mm, t)]
+
+    def cost_of(cand) -> Tuple[float, float, float]:
+        t, dims = cand
+        dec, pre = price_placement(arch, t, mm, dims, cache=cache,
+                                   fingerprint=fingerprint)
+        return dec + PREFILL_WEIGHT * pre, dec, pre
+
+    rng = random.Random(seed)
+    cur = (1, ())
+    cur_cost, cur_dec, cur_pre = cost_of(cur)
+    best, best_cost = cur, cur_cost
+    best_dec, best_pre = cur_dec, cur_pre
+    # every legal degree is priced once up front (flat ring) so the
+    # returned per-degree table is complete — the paper's exhaustive
+    # per-op config enumeration, affordable here because degrees are
+    # few; the walk then also explores axis assignments
+    decode_by_degree: Dict[int, float] = {}
+    for t in degrees:
+        c, dec, pre = cost_of((t, ()))
+        decode_by_degree[t] = dec
+        if c < best_cost:
+            best, best_cost = (t, ()), c
+            best_dec, best_pre = dec, pre
+    for _ in range(max(len(space), int(budget))):
+        nxt = space[rng.randrange(len(space))]
+        if nxt == cur:
+            continue
+        nxt_cost, nxt_dec, nxt_pre = cost_of(nxt)
+        t = nxt[0]
+        if nxt_dec < decode_by_degree.get(t, float("inf")):
+            decode_by_degree[t] = nxt_dec
+        delta = nxt_cost - cur_cost
+        if delta <= 0 or rng.random() < math.exp(
+                -delta / max(1e-12, alpha * cur_cost)):
+            cur, cur_cost = nxt, nxt_cost
+            if cur_cost < best_cost:
+                best, best_cost = cur, cur_cost
+                best_dec, best_pre = nxt_dec, nxt_pre
+    if cache is not None:
+        cache.flush()
+    return ServePlacement(
+        tensor_parallel=best[0], axis_dims=tuple(best[1]),
+        decode_step_s=best_dec, prefill_step_s=best_pre,
+        cost=best_cost, decode_by_degree=dict(
+            sorted(decode_by_degree.items())),
+        fingerprint=fingerprint)

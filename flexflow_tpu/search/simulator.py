@@ -1096,3 +1096,52 @@ class Simulator:
 
     def memory_per_device(self, strategy: Strategy) -> float:
         return sum(self._op_cost(op, strategy).mem for op in self.model.ops)
+
+
+# ---------------------------------------------------------------------------
+# Serve-step simulation (tensor-parallel sharded serving, PR 9)
+# ---------------------------------------------------------------------------
+
+def simulate_serve_tasks(tasks) -> float:
+    """Makespan of a serve-step task graph (cost_model.serve_step_tasks)
+    — the critical path over named dependencies. Tensor-parallel
+    serving's collectives sit ON the critical path (each all-reduce
+    feeds the very next matmul — there is no second microbatch to hide
+    them behind, unlike training's bucketed grad sync), so the chain
+    evaluation IS the event loop: finish(t) = duration(t) +
+    max(finish(deps)). Kept structural (not a plain sum) so a future
+    serve graph with parallel branches (e.g. draft-LM lanes priced
+    beside the target) simulates unchanged."""
+    finish: Dict[str, float] = {}
+    for t in tasks:  # serve_step_tasks emits in dependency order
+        start = max((finish[d] for d in t.deps if d in finish),
+                    default=0.0)
+        finish[t.name] = start + t.seconds
+    return max(finish.values(), default=0.0)
+
+
+def simulate_serve_step(arch, tensor_parallel: int,
+                        mm: Optional[TPUMachineModel] = None, *,
+                        lanes: Optional[int] = None,
+                        axis_dims: tuple = ()) -> float:
+    """Simulated seconds of ONE mixed serving step with `lanes` query
+    lanes (default: a full decode step — `arch.decode_lanes`) at the
+    given tensor-parallel degree, including the reference-style
+    1ms/MB penalty when the per-device resident bytes exceed HBM
+    (simulator.cc:603-628 — what makes a too-big-for-one-chip model
+    price its own sharding). `axis_dims` pins the serve axis onto
+    physical torus dims (machine_model._phys) — the axis-assignment
+    half of the placement search."""
+    from .cost_model import (SERVE_AXIS, serve_device_bytes,
+                             serve_step_tasks)
+    if mm is None:
+        mm = default_machine_model()
+    if axis_dims:
+        mm = dataclasses.replace(
+            mm, axis_topology={**mm.axis_topology,
+                               SERVE_AXIS: tuple(axis_dims)})
+    step = simulate_serve_tasks(serve_step_tasks(
+        arch, tensor_parallel, mm,
+        lanes=int(arch.decode_lanes if lanes is None else lanes)))
+    return step + mm.memory_penalty(
+        serve_device_bytes(arch, tensor_parallel))

@@ -63,10 +63,15 @@ from ..kernels.flash_attention import (paged_attention_decode,
 from ..kernels.paged_ragged_v2 import (choose_block_kv,
                                        quantize_kv_rows,
                                        ragged_dispatch_passes)
+from ..parallel.mesh import TENSOR
 from ..utils.faults import FaultInjector, TransientError, injector_for
-from .kv_cache import KVCacheConfig, PagedKVCache
+from .kv_cache import KVCacheConfig, PagedKVCache, kv_storage_dtype
 from .scheduler import (ChunkPlan, ContinuousBatchingScheduler, Request,
                         RequestOutcome, RequestState, SampleParams)
+
+# pad bias for vocab columns the head padding invents (vocab % t != 0):
+# a padded logit must never win argmax or enter the top-k window
+_PAD_LOGIT_BIAS = -1e30
 
 
 class _CompileEvents:
@@ -118,9 +123,17 @@ def _ln(p, x, eps):
     return y.astype(x.dtype)
 
 
-def _dense(p, x, activation=None):
+def _dense(p, x, activation=None, psum_axis=None):
+    """Dense layer. `psum_axis` is the tensor-parallel row-parallel
+    hook: under sharding the kernel's CONTRACTION dim is sharded, so
+    each device's matmul is a partial sum that all-reduces over the
+    axis BEFORE the (replicated) bias — exactly the Megatron pattern
+    the cost model prices. None (single device) is the unchanged
+    bit-exact path."""
     y = jnp.dot(x, p["kernel"].astype(x.dtype),
                 preferred_element_type=jnp.float32).astype(x.dtype)
+    if psum_axis is not None:
+        y = jax.lax.psum(y, psum_axis)
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
     if activation == "relu":
@@ -151,7 +164,8 @@ class ServeEngine:
                  chunked_prefill: Optional[bool] = None,
                  prefix_cache: Optional[bool] = None,
                  spec_tokens: Optional[int] = None,
-                 drafter=None, faults: Optional[FaultInjector] = None):
+                 drafter=None, faults: Optional[FaultInjector] = None,
+                 mesh=None, tensor_parallel: Optional[int] = None):
         if model.state is None:
             model.compile(comp_mode=CompMode.INFERENCE)
         self.model = model
@@ -165,10 +179,18 @@ class ServeEngine:
             raise ValueError(
                 f"max_seq_len {max_seq_len} exceeds the LM's learned "
                 f"positions ({self.max_positions})")
+        self._max_seq_len = int(max_seq_len)
+        # tensor-parallel sharded serving (docs/serving.md "Sharded
+        # serving"): an explicit `mesh` (1-D, axis "tensor") or
+        # `tensor_parallel` degree wins; otherwise FFConfig.serve_mesh
+        # resolves it — "auto" closes the paper's loop for inference by
+        # asking the placement search (search/serve_place.optimize_serve)
+        # which degree minimizes the simulated decode step.
+        self._resolve_serve_mesh(mesh, tensor_parallel)
         self.cache_cfg = KVCacheConfig.from_ff(
             self.config, num_layers=self.num_layers,
             num_heads=self.num_heads, head_dim=self.head_dim,
-            max_seq_len=max_seq_len)
+            max_seq_len=max_seq_len, tensor_parallel=self.tp)
         self.cache_cfg.validate()
         cfg = self.config
         self.chunked_prefill = bool(
@@ -219,19 +241,34 @@ class ServeEngine:
         # tests/test_kv_quant.py).
         self.kv_dtype = self.cache_cfg.kv_dtype
         self.kv_quantized = self.cache_cfg.quantized
+        self._kv_store_dtype = self.cache_cfg.storage_dtype
         self.kv_exact = (self.kv_dtype == "float32"
-                         or jnp.dtype(self.kv_dtype) == self.act_dtype)
+                         or self._kv_store_dtype == self.act_dtype)
+        # tie margin of the relaxed quantized parity gate
+        # (assert_token_parity): fp8's 3-bit mantissa rounds ~8x
+        # coarser than int8's 127-step grid at amax scale
+        self.kv_tie_margin = 0.25 if self.kv_dtype == "float8_e4m3" \
+            else 0.05
         if self.kv_quantized and not self.chunked_prefill:
             raise ValueError(
-                "kv_dtype='int8' needs the chunked mixed program "
-                "(quantize-on-write lives in the mixed step); the "
-                "legacy bucket-prefill path supports float32/bfloat16")
+                f"kv_dtype={self.kv_dtype!r} needs the chunked mixed "
+                f"program (quantize-on-write lives in the mixed step); "
+                f"the legacy bucket-prefill path supports "
+                f"float32/bfloat16")
+        if self.tp > 1 and not self.chunked_prefill:
+            raise ValueError(
+                "sharded serving (serve_mesh / tensor_parallel > 1) "
+                "shards the ONE mixed program; the legacy bucket-"
+                "prefill path is single-device only")
         # ragged kernel v2 kv-block shape: explicit knob, else the
-        # autotune-by-shape table (kernels/paged_ragged_v2.py)
+        # autotune-by-shape table (kernels/paged_ragged_v2.py) — sized
+        # for the PER-DEVICE head count, which is what the sharded
+        # kernel actually streams
         self.attn_block_kv = int(getattr(cfg, "serve_attn_block_kv", 0)) \
             or choose_block_kv(self.cache_cfg.page_size,
                                self.cache_cfg.pages_per_seq,
-                               self.num_heads, self.head_dim,
+                               self.cache_cfg.heads_per_device,
+                               self.head_dim,
                                self.cache_cfg.kv_itemsize)
         # the one mixed-step geometry: every prefill-budget token plus
         # one decode lane per slot always fits
@@ -258,11 +295,23 @@ class ServeEngine:
             self.buckets.append(b)
             b *= 2
         self.buckets.append(cap)
-        self._mixed_jit = jax.jit(self._mixed_impl, donate_argnums=(1, 2))
-        # quantized pools thread the scale arrays through the same
-        # step, donated alongside the pages
-        self._mixed_q_jit = jax.jit(self._mixed_q_impl,
-                                    donate_argnums=(1, 2, 3, 4))
+        # the mixed-step programs: single-device, or shard_map'd over
+        # the serve mesh (same lane contract, same donation) — ONE
+        # program either way, so the zero-recompile gate is unchanged
+        if self.tp > 1:
+            self._step_params, self._param_specs = self._shard_params()
+            self._mixed_jit = jax.jit(self._mixed_tp_impl,
+                                      donate_argnums=(1, 2))
+            self._mixed_q_jit = jax.jit(self._mixed_q_tp_impl,
+                                        donate_argnums=(1, 2, 3, 4))
+        else:
+            self._step_params = self.params
+            self._mixed_jit = jax.jit(self._mixed_impl,
+                                      donate_argnums=(1, 2))
+            # quantized pools thread the scale arrays through the same
+            # step, donated alongside the pages
+            self._mixed_q_jit = jax.jit(self._mixed_q_impl,
+                                        donate_argnums=(1, 2, 3, 4))
         self._prefill_jit = jax.jit(self._prefill_impl,
                                     donate_argnums=(1, 2))
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2))
@@ -350,6 +399,218 @@ class ServeEngine:
         # recomputed attention stay bit-identical.
         self.act_dtype = jnp.dtype(ops["tok_embed"].out_dtype)
         self.params = model.state.params  # live references, not copies
+        self.ff_dim = int(self.params["layer0_ff1"]["kernel"].shape[1])
+
+    # ---------------- tensor-parallel sharding -------------------------
+    def _resolve_serve_mesh(self, mesh, tensor_parallel) -> None:
+        """Resolve (tp, tp_mesh) from the explicit args or
+        FFConfig.serve_mesh ('' = single device, 'N' = degree N,
+        'auto' = the placement search picks)."""
+        cfg = self.config
+        self.serve_placement = None  # set by the 'auto' path below
+        if mesh is None and tensor_parallel is None:
+            sm = str(getattr(cfg, "serve_mesh", "") or "").strip()
+            if sm == "auto":
+                from ..search.serve_place import optimize_serve
+                place = optimize_serve(self.serve_arch(),
+                                       len(jax.devices()), config=cfg)
+                self.serve_placement = place
+                tensor_parallel = place.tensor_parallel
+            elif sm:
+                tensor_parallel = int(sm)
+        self.tp = 1
+        self.tp_mesh = None
+        if mesh is not None:
+            if TENSOR not in mesh.shape:
+                raise ValueError(
+                    f"serve mesh needs a {TENSOR!r} axis, got "
+                    f"{dict(mesh.shape)}")
+            self.tp = int(mesh.shape[TENSOR])
+            self.tp_mesh = mesh if self.tp > 1 else None
+        elif tensor_parallel is not None and int(tensor_parallel) > 1:
+            from ..parallel.mesh import serve_tensor_mesh
+            self.tp = int(tensor_parallel)
+            self.tp_mesh = serve_tensor_mesh(self.tp)
+        if self.tp > 1 and self.num_heads % self.tp != 0:
+            raise ValueError(
+                f"sharded serving needs num_heads ({self.num_heads}) "
+                f"divisible by the tensor degree ({self.tp})")
+        # ff/vocab need not divide: their shards PAD (zero ff columns
+        # contribute exact zeros; pad vocab columns carry a -1e30 bias
+        # so they never win argmax) — exactness is unaffected
+        self._ff_pad = -(-self.ff_dim // self.tp) * self.tp
+        self._vocab_pad = -(-self.vocab_size // self.tp) * self.tp
+
+    def serve_arch(self, context: Optional[int] = None):
+        """The ServeArch the placement search prices for this engine's
+        model + serving knobs (search/cost_model.serve_step_tasks):
+        decode lanes = the slot reserve, prefill lanes = the budget,
+        steady-state context defaulting to 3/4 of the serveable length,
+        KV traffic at the configured page format's itemsize."""
+        from ..search.cost_model import ServeArch
+        cfg = self.config
+        kv_name = str(getattr(cfg, "kv_dtype", "float32"))
+        from .kv_cache import QUANTIZED_KV_DTYPES
+        return ServeArch(
+            num_layers=self.num_layers, hidden=self.hidden,
+            num_heads=self.num_heads, head_dim=self.head_dim,
+            ff_dim=self.ff_dim, vocab=self.vocab_size,
+            decode_lanes=int(getattr(cfg, "serve_max_seqs", 8)),
+            prefill_lanes=int(getattr(cfg, "serve_prefill_budget", 512)),
+            context=int(context if context is not None
+                        else max(1, self._max_seq_len * 3 // 4)),
+            kv_dtype=kv_name,
+            kv_itemsize=float(kv_storage_dtype(kv_name).itemsize),
+            kv_scales=kv_name in QUANTIZED_KV_DTYPES,
+            act_itemsize=float(self.act_dtype.itemsize),
+            act_dtype=str(self.act_dtype.name))
+
+    def _shard_params(self):
+        """Shard (and where needed pad) the LM parameters over the
+        serve mesh, returning (params, PartitionSpec pytree):
+
+          wq/wk/wv (E, H, D)  -> heads column-parallel
+          wo       (H, D, E)  -> heads row-parallel (psum after)
+          ff1      (E, F)     -> column-parallel (+ bias shard)
+          ff2      (F, E)     -> row-parallel (psum before bias)
+          lm_head  (E, V)     -> vocab column-parallel (all-gather at
+                                 the logits; pad columns biased -inf)
+          tok_embed (V, E)    -> vocab row-parallel (masked local
+                                 gather + exact psum — one device owns
+                                 each row, the rest contribute 0.0)
+          everything else     -> replicated (LNs, pos_embed, biases)
+
+        The originals in self.params stay untouched — the reference
+        paths (generate_reference, assert_token_parity's margin
+        forward) keep running single-device on them."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        mesh = self.tp_mesh
+
+        def pad_to(a, axis, size, value=0.0):
+            extra = size - a.shape[axis]
+            if extra <= 0:
+                return a
+            widths = [(0, 0)] * a.ndim
+            widths[axis] = (0, extra)
+            return jnp.pad(a, widths, constant_values=value)
+
+        def put(a, *spec):
+            return jax.device_put(a, NamedSharding(mesh, P(*spec)))
+
+        out: Dict[str, dict] = {}
+        specs: Dict[str, dict] = {}
+        for name, p in self.params.items():
+            o, s = {}, {}
+            for key, arr in p.items():
+                arr = jnp.asarray(arr)
+                spec = ()
+                if name == "tok_embed" and key == "kernel":
+                    arr = pad_to(arr, 0, self._vocab_pad)
+                    spec = (TENSOR,)
+                elif name.endswith("_attn") and key in ("wq", "wk",
+                                                        "wv"):
+                    spec = (None, TENSOR)
+                elif name.endswith("_attn") and key == "wo":
+                    spec = (TENSOR,)
+                elif name.endswith("_ff1") and key == "kernel":
+                    arr = pad_to(arr, 1, self._ff_pad)
+                    spec = (None, TENSOR)
+                elif name.endswith("_ff1") and key == "bias":
+                    arr = pad_to(arr, 0, self._ff_pad)
+                    spec = (TENSOR,)
+                elif name.endswith("_ff2") and key == "kernel":
+                    arr = pad_to(arr, 0, self._ff_pad)
+                    spec = (TENSOR,)
+                elif name == "lm_head" and key == "kernel":
+                    arr = pad_to(arr, 1, self._vocab_pad)
+                    spec = (None, TENSOR)
+                elif name == "lm_head" and key == "bias":
+                    arr = pad_to(arr, 0, self._vocab_pad,
+                                 value=_PAD_LOGIT_BIAS)
+                    spec = (TENSOR,)
+                o[key] = put(arr, *spec)
+                s[key] = P(*spec)
+            if name == "lm_head" and "bias" not in p \
+                    and self._vocab_pad > self.vocab_size:
+                # padded vocab columns must never win argmax:
+                # synthesize a bias (+0.0 on real columns is exact)
+                b = jnp.zeros((self._vocab_pad,), self.act_dtype)
+                b = b.at[self.vocab_size:].set(_PAD_LOGIT_BIAS)
+                o["bias"] = put(b, TENSOR)
+                s["bias"] = P(TENSOR)
+            out[name], specs[name] = o, s
+        return out, specs
+
+    def _page_shardings(self):
+        """(page, scale) NamedShardings over the serve mesh's head
+        axis, or (None, None) single-device."""
+        if self.tp_mesh is None:
+            return None, None
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        return (NamedSharding(self.tp_mesh,
+                              P(None, None, None, TENSOR, None)),
+                NamedSharding(self.tp_mesh, P(None, None, None, TENSOR)))
+
+    def _sharding_stats(self) -> Optional[dict]:
+        """The last_stats/serve_report sharding block: mesh shape,
+        heads per device, per-device KV pool bytes, and the analytic
+        per-step collective payload (2 all-reduces of the lane
+        activations per layer + the embedding psum + the final logits
+        all-gather)."""
+        if self.tp <= 1:
+            return None
+        c = self.cache_cfg
+        T = self.mixed_width
+        act = int(self.act_dtype.itemsize)
+        coll = ((2 * self.num_layers + 1) * T * self.hidden * act
+                + T * self._vocab_pad * act)
+        return {
+            "mesh": {TENSOR: self.tp},
+            "tensor_parallel": self.tp,
+            "heads_per_device": self.num_heads // self.tp,
+            "kv_pool_device_bytes": int(c.pool_device_bytes),
+            "collective_bytes_per_step": int(coll),
+        }
+
+    def mixed_step_cost_analysis(self) -> Optional[dict]:
+        """XLA's own cost analysis of the compiled mixed program — the
+        PER-DEVICE program under sharding, so serve_bench's sharded
+        FLOPs-per-device gate reads a measured number, not the analytic
+        formula it is checking. Lowers the engine's mixed step at its
+        fixed geometry over abstract ShapeDtypeStructs for the pool
+        operands (AOT — nothing executes, and no duplicate KV pool is
+        materialized next to the resident one) and returns the
+        backend's dict ({'flops': ...,} etc.), or None where the
+        backend doesn't implement cost analysis. The AOT compile is
+        out-of-band of `_call_counted`'s per-program snapshots, but
+        call it outside timed/recompile-gated regions anyway."""
+        c = self.cache_cfg
+        T = self.mixed_width
+        page_sh, scale_sh = self._page_shardings()
+        pool = jax.ShapeDtypeStruct(
+            (c.num_layers, c.num_pages, c.page_size, c.num_heads,
+             c.head_dim), c.storage_dtype, sharding=page_sh)
+        i32 = jnp.int32
+        lane = jnp.zeros((T,), i32)
+        args = (self._step_params, pool, pool)
+        jitted = self._mixed_jit
+        if self.kv_quantized:
+            scales = jax.ShapeDtypeStruct(
+                c.scale_shape, jnp.float32, sharding=scale_sh)
+            args += (scales, scales)
+            jitted = self._mixed_q_jit
+        args += (lane, lane, lane, lane,
+                 jnp.zeros((c.max_seqs, c.pages_per_seq), i32),
+                 lane, lane)
+        try:
+            ca = jitted.lower(*args).compile().cost_analysis()
+        except (NotImplementedError, jax.errors.JaxRuntimeError):
+            return None
+        if isinstance(ca, (list, tuple)):  # older jax: one per device
+            ca = ca[0] if ca else None
+        return dict(ca) if ca else None
 
     # ---------------- pure block math ----------------------------------
     def _embed(self, params, tokens, positions):
@@ -373,23 +634,61 @@ class ServeEngine:
         v = jnp.einsum("...e,ehd->...hd", h, p["wv"].astype(h.dtype))
         return q, k, v
 
-    def _attn_out(self, p, o, x):
+    def _attn_out(self, p, o, x, psum_axis=None):
         y = jnp.einsum("...hd,hde->...e", o, p["wo"].astype(o.dtype))
+        if psum_axis is not None:
+            # head-row-parallel wo: each device contracted its H/t
+            # heads; the all-reduce completes the sum (Megatron)
+            y = jax.lax.psum(y, psum_axis)
         if "bo" in p:
             y = y + p["bo"].astype(y.dtype)
         return x + y
 
-    def _ffn(self, params, i, x):
+    def _ffn(self, params, i, x, psum_axis=None):
         h = _ln(params[f"layer{i}_ln2"], x, self.ln_eps) \
             if self.layer_norm else x
         h = _dense(params[f"layer{i}_ff1"], h, activation="relu")
-        h = _dense(params[f"layer{i}_ff2"], h)
+        h = _dense(params[f"layer{i}_ff2"], h, psum_axis=psum_axis)
         return x + h
 
     def _head(self, params, x):
         if self.layer_norm:
             x = _ln(params["final_ln"], x, self.ln_eps)
         return _dense(params["lm_head"], x)
+
+    # ---------------- sharded block math (inside shard_map) ------------
+    def _embed_tp(self, params, tokens, positions, axis):
+        """Vocab-row-sharded token embedding: each device gathers the
+        rows it owns and contributes exact 0.0 for the rest, so the
+        psum reproduces the unsharded rows BIT-identically (x + 0.0 is
+        exact — the one cross-device sum in the program with no
+        rounding cost). The same OOB discipline as ops/embedding's
+        flat slot-offset gather (_slot_gather): local indices clamp
+        in-range so no lane ever reads a NaN 'fill' row, and the mask
+        zeroes anything the clamp aliased. pos_embed is replicated
+        (positions are tiny next to vocab)."""
+        kern = params["tok_embed"]["kernel"]          # (Vp/t, E) local
+        rows = kern.shape[0]
+        lo = jax.lax.axis_index(axis) * rows
+        idx = tokens - lo
+        te = jnp.take(kern, jnp.clip(idx, 0, rows - 1), axis=0)
+        te = jnp.where(((idx >= 0) & (idx < rows))[:, None], te, 0)
+        te = jax.lax.psum(te, axis)
+        pe = jnp.take(params["pos_embed"]["kernel"], positions, axis=0,
+                      mode="clip")
+        return (te + pe).astype(self.act_dtype)
+
+    def _head_tp(self, params, x, axis):
+        """Vocab-column-sharded head: each device computes its V/t
+        logit columns (full contraction over E — no partial sums) and
+        ONE all-gather assembles the (T, vocab_pad) logits, replicated,
+        for the argmax/top-k tail. This is the program's only
+        all-gather — the 'sharded vocab, gather only at the final
+        logits' contract."""
+        if self.layer_norm:
+            x = _ln(params["final_ln"], x, self.ln_eps)
+        local = _dense(params["lm_head"], x)           # (T, Vp/t)
+        return jax.lax.all_gather(local, axis, axis=1, tiled=True)
 
     # ---------------- full-sequence forward (prefill + reference) ------
     def _forward_tokens(self, params, tokens, length, kv=None):
@@ -478,29 +777,101 @@ class ServeEngine:
             lane_lens)
         return (*out, k_pages, v_pages, k_scales, v_scales)
 
+    # ---------------- the sharded mixed step ---------------------------
+    def _tp_step_specs(self, quantized: bool):
+        """(in_specs, out_specs) of the shard_map'd mixed step: params
+        per _shard_params, pages/scales on the head axis, every host-
+        built lane array replicated, the emitted token streams
+        replicated (psum/all-gather results are)."""
+        from jax.sharding import PartitionSpec as P
+        page = P(None, None, None, TENSOR, None)
+        scl = P(None, None, None, TENSOR)
+        rep = P()
+        ins = (self._param_specs, page, page)
+        if quantized:
+            ins += (scl, scl)
+        ins += (rep,) * 7
+        outs = (rep, rep, rep, page, page)
+        if quantized:
+            outs += (scl, scl)
+        return ins, outs
+
+    def _mixed_tp_impl(self, params, k_pages, v_pages, tokens, positions,
+                       write_pages, write_offs, page_tables, lane_slots,
+                       lane_lens):
+        """The mixed step shard_map'd over the serve mesh: identical
+        lane contract and donation; each device runs _mixed_body on its
+        H/t heads of the params and pages (tp_axis threads the psums /
+        all-gather). check_vma off: the replicated outputs come out of
+        collectives, which the static replication checker cannot always
+        see through."""
+        from ..parallel._compat import shard_map
+        ins, outs = self._tp_step_specs(False)
+
+        def body(params, kp, vp, *rest):
+            out, (kp, vp) = self._mixed_body(
+                params, kp, vp, None, None, *rest, tp_axis=TENSOR)
+            return (*out, kp, vp)
+
+        return shard_map(body, mesh=self.tp_mesh, in_specs=ins,
+                         out_specs=outs, check_vma=False)(
+            params, k_pages, v_pages, tokens, positions, write_pages,
+            write_offs, page_tables, lane_slots, lane_lens)
+
+    def _mixed_q_tp_impl(self, params, k_pages, v_pages, k_scales,
+                         v_scales, tokens, positions, write_pages,
+                         write_offs, page_tables, lane_slots, lane_lens):
+        """The quantized mixed step over the serve mesh: scale arrays
+        shard on the same head axis as the pages, and per-row
+        quantization is per-head — so each device's quantized rows are
+        BIT-identical to the unsharded engine's rows for those heads
+        (the execution-path-invariance contract transfers verbatim)."""
+        from ..parallel._compat import shard_map
+        ins, outs = self._tp_step_specs(True)
+
+        def body(params, kp, vp, ks, vs, *rest):
+            out, (kp, vp, ks, vs) = self._mixed_body(
+                params, kp, vp, ks, vs, *rest, tp_axis=TENSOR)
+            return (*out, kp, vp, ks, vs)
+
+        return shard_map(body, mesh=self.tp_mesh, in_specs=ins,
+                         out_specs=outs, check_vma=False)(
+            params, k_pages, v_pages, k_scales, v_scales, tokens,
+            positions, write_pages, write_offs, page_tables, lane_slots,
+            lane_lens)
+
     def _mixed_body(self, params, k_pages, v_pages, k_scales, v_scales,
                     tokens, positions, write_pages, write_offs,
-                    page_tables, lane_slots, lane_lens):
+                    page_tables, lane_slots, lane_lens, tp_axis=None):
         """Shared mixed-step body. Storage-dtype handling per layer:
         f32 pages store activation values exactly (the bit-exactness
         path); bf16 pages round on the scatter (the .at[].set cast);
-        int8 pages quantize each (lane, head) row against its own amax
-        scale BEFORE any lane attends, so what a lane reads back this
-        very step is already the dequantized value — quantized content
-        is therefore invariant to chunk boundaries, preemption
-        replays, and speculative rollbacks (every token's row
-        quantizes independently)."""
+        quantized (int8/fp8) pages quantize each (lane, head) row
+        against its own amax scale BEFORE any lane attends, so what a
+        lane reads back this very step is already the dequantized
+        value — quantized content is therefore invariant to chunk
+        boundaries, preemption replays, and speculative rollbacks
+        (every token's row quantizes independently).
+
+        `tp_axis` runs the SAME body per device inside shard_map over
+        the serve mesh: head-sharded params/pages make attention and
+        quantization per-head-identical (each head's rows are the
+        unsharded bits), the two per-layer psums complete the
+        row-parallel projections, and the head all-gathers its vocab
+        shards. Exactly one program geometry either way."""
         quantized = k_scales is not None
-        x = self._embed(params, tokens, positions)        # (T, E)
+        x = (self._embed_tp(params, tokens, positions, tp_axis)
+             if tp_axis else
+             self._embed(params, tokens, positions))     # (T, E)
         scale = 1.0 / np.sqrt(self.head_dim)
         for i in range(self.num_layers):
             p = params[f"layer{i}_attn"]
             h = _ln(params[f"layer{i}_ln1"], x, self.ln_eps) \
                 if self.layer_norm else x
-            q, k, v = self._attn_qkv(p, h)                # (T, H, D)
+            q, k, v = self._attn_qkv(p, h)                # (T, H[/t], D)
             if quantized:
-                kq, ksc = quantize_kv_rows(k)             # int8, (T, H)
-                vq, vsc = quantize_kv_rows(v)
+                kq, ksc = quantize_kv_rows(k, self._kv_store_dtype)
+                vq, vsc = quantize_kv_rows(v, self._kv_store_dtype)
                 k_pages = k_pages.at[i, write_pages, write_offs].set(kq)
                 v_pages = v_pages.at[i, write_pages, write_offs].set(vq)
                 k_scales = k_scales.at[i, write_pages,
@@ -519,9 +890,10 @@ class ServeEngine:
                 k_scales=k_scales[i] if quantized else None,
                 v_scales=v_scales[i] if quantized else None,
                 block_kv=self.attn_block_kv)
-            x = self._attn_out(p, o, x)
-            x = self._ffn(params, i, x)
-        logits = self._head(params, x)                    # (T, V)
+            x = self._attn_out(p, o, x, psum_axis=tp_axis)
+            x = self._ffn(params, i, x, psum_axis=tp_axis)
+        logits = (self._head_tp(params, x, tp_axis) if tp_axis
+                  else self._head(params, x))            # (T, V[pad])
         topv, topi = jax.lax.top_k(logits, self.topk_cap)
         out = (jnp.argmax(logits, axis=-1).astype(jnp.int32),
                topv.astype(jnp.float32), topi.astype(jnp.int32))
@@ -615,11 +987,13 @@ class ServeEngine:
                 for name in ("prefill", "decode", "mixed")}
 
     def _device_pages(self):
+        page_sh, scale_sh = self._page_shardings()
         if self._k_pages is None:
-            self._k_pages, self._v_pages = self.cache.alloc_device_cache()
+            self._k_pages, self._v_pages = \
+                self.cache.alloc_device_cache(sharding=page_sh)
         if self.kv_quantized and self._k_scales is None:
             self._k_scales, self._v_scales = \
-                self.cache.alloc_scale_arrays()
+                self.cache.alloc_scale_arrays(sharding=scale_sh)
             self.cache.register_scale_meta(self._k_scales,
                                            self._v_scales)
         return self._k_pages, self._v_pages
@@ -634,12 +1008,13 @@ class ServeEngine:
         the pre-run allocation."""
         if self.kv_quantized:
             greedy, topv, topi, kp, vp, ks, vs = self._call_counted(
-                "mixed", self._mixed_q_jit, self.params, kp, vp,
+                "mixed", self._mixed_q_jit, self._step_params, kp, vp,
                 self._k_scales, self._v_scales, *args)
             self._k_scales, self._v_scales = ks, vs
         else:
             greedy, topv, topi, kp, vp = self._call_counted(
-                "mixed", self._mixed_jit, self.params, kp, vp, *args)
+                "mixed", self._mixed_jit, self._step_params, kp, vp,
+                *args)
         self._k_pages, self._v_pages = kp, vp
         return greedy, topv, topi, kp, vp
 
@@ -774,7 +1149,7 @@ class ServeEngine:
         return next((i for i, (x, y) in enumerate(zip(a, b))
                      if x != y), None)
 
-    def assert_token_parity(self, prompts, out, ref, *, margin=0.05,
+    def assert_token_parity(self, prompts, out, ref, *, margin=None,
                             min_exact_frac=0.0,
                             what="outputs") -> int:
         """The reference-parity gate for generate() outputs (the CI
@@ -791,7 +1166,11 @@ class ServeEngine:
         inside the margin is the priced-in cost of lossy pages (after
         one tie flips, the continuation legitimately diverges, so
         only the first divergence is comparable). Returns the
-        fully-identical request count."""
+        fully-identical request count. `margin` defaults to the
+        engine's pool-format tie margin (int8 rounds at amax/127, fp8
+        at amax/16 — kv_tie_margin)."""
+        if margin is None:
+            margin = self.kv_tie_margin
         if self.kv_exact:
             for i, (o, r) in enumerate(zip(out, ref)):
                 assert list(o) == list(r), (
@@ -1088,6 +1467,12 @@ class ServeEngine:
             "rung_steps": list(sched.stats["rung_steps"]),
             "spec_shed_steps": sched.stats["spec_shed_steps"],
             "cache": dict(cache.stats),   # engine-lifetime counters
+            # tensor-parallel sharding block (None single-device):
+            # mesh shape, heads/device, per-device pool bytes, and the
+            # analytic per-step collective payload (serve_report
+            # renders it; tools/serve_bench.py --workload shard records
+            # it next to the measured A/B)
+            "sharding": self._sharding_stats(),
             # KV pool: storage format, itemsize-derived byte accounting,
             # effective capacity vs f32 pages, and the ragged kernel
             # v2 work-item accounting (serve_report renders both)

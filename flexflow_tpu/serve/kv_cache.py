@@ -60,6 +60,23 @@ import numpy as np
 
 from ..config import KV_DTYPES  # the ONE --kv-dtype allowlist
 
+# KV_DTYPES names that store quantized values against per-row scale
+# arrays (the PR 8 scale machinery; fp8 reuses it with no new
+# bookkeeping — only the page dtype and the qmax change).
+QUANTIZED_KV_DTYPES = ("int8", "float8_e4m3")
+
+# --kv-dtype name -> the dtype actually stored in the page arrays.
+# "float8_e4m3" stores ml_dtypes' float8_e4m3fn (the finite-only OCP
+# variant every jax build ships; the no-suffix e4m3 is newer and not
+# universally available).
+_KV_STORAGE_ALIASES = {"float8_e4m3": "float8_e4m3fn"}
+
+
+def kv_storage_dtype(name: str):
+    """numpy/jnp dtype of the page arrays for a --kv-dtype name."""
+    import jax.numpy as jnp
+    return jnp.dtype(_KV_STORAGE_ALIASES.get(str(name), str(name)))
+
 
 def prefix_page_keys(tokens: Sequence[int], page_size: int,
                      num_pages: int, *, start: int = 0,
@@ -104,7 +121,20 @@ class KVCacheConfig:
     itemsize — never a hardcoded 4 — so watermark fractions, ladder
     rung thresholds and ``ensure_capacity`` (all page-COUNT math over
     ``usable_pages``) automatically see the larger effective pool a
-    quantized format buys at the same byte budget."""
+    quantized format buys at the same byte budget.
+
+    ``tensor_parallel`` is the serve mesh's tensor degree (docs/
+    serving.md "Sharded serving"): pages shard on the HEAD axis, so
+    every device holds all ``num_pages`` pages at ``num_heads / t``
+    heads each. The page COUNT — and with it every watermark /
+    degradation-ladder / ``ensure_capacity`` fraction — is therefore
+    per-device-identical, while the per-device BYTES drop t×
+    (``page_device_bytes``). ``kv_pool_mb`` is a PER-DEVICE HBM budget
+    (the physically meaningful knob): sizing divides it by
+    ``page_device_bytes``, so a sharded pool holds ~t× the pages at
+    the same per-chip budget and the ladder rungs fire at the same
+    relative per-device pressure. All host-side page / refcount /
+    prefix bookkeeping stays replicated and tp-agnostic."""
 
     num_layers: int
     num_heads: int
@@ -114,30 +144,41 @@ class KVCacheConfig:
     max_seqs: int = 8
     max_seq_len: int = 512  # logical cap; rounds up to whole pages
     kv_dtype: str = "float32"
+    tensor_parallel: int = 1  # head-sharding degree of the serve mesh
 
     @classmethod
     def from_ff(cls, config, *, num_layers: int, num_heads: int,
-                head_dim: int, max_seq_len: int = 512) -> "KVCacheConfig":
+                head_dim: int, max_seq_len: int = 512,
+                tensor_parallel: int = 1) -> "KVCacheConfig":
         kv_dtype = str(getattr(config, "kv_dtype", "float32"))
         num_pages = int(getattr(config, "kv_num_pages", 257))
         pool_mb = float(getattr(config, "kv_pool_mb", 0.0) or 0.0)
+        tp = max(1, int(tensor_parallel))
         if pool_mb > 0:
             # byte-budget sizing: the page count FOLLOWS the storage
             # format (the quantized-capacity lever — int8 pages cost
             # ~1/4 the bytes, so the same budget holds ~4x the pages)
+            # AND the sharding degree: the budget is per-DEVICE HBM,
+            # and a head-sharded page costs 1/t of its bytes on each
+            # device, so the same per-chip budget holds ~t× the pages
+            # — which is exactly what keeps every page-count-fraction
+            # threshold (watermark, ladder rungs) firing at the same
+            # relative per-device pressure under sharding.
             probe = cls(num_layers=num_layers, num_heads=num_heads,
                         head_dim=head_dim,
                         page_size=int(getattr(config, "kv_page_size", 16)),
                         num_pages=2, max_seqs=1,
-                        max_seq_len=max_seq_len, kv_dtype=kv_dtype)
+                        max_seq_len=max_seq_len, kv_dtype=kv_dtype,
+                        tensor_parallel=tp)
             num_pages = 1 + max(1, int(pool_mb * (1 << 20))
-                                // probe.page_bytes)
+                                // probe.page_device_bytes)
         return cls(num_layers=num_layers, num_heads=num_heads,
                    head_dim=head_dim,
                    page_size=int(getattr(config, "kv_page_size", 16)),
                    num_pages=num_pages,
                    max_seqs=int(getattr(config, "serve_max_seqs", 8)),
-                   max_seq_len=max_seq_len, kv_dtype=kv_dtype)
+                   max_seq_len=max_seq_len, kv_dtype=kv_dtype,
+                   tensor_parallel=tp)
 
     @property
     def pages_per_seq(self) -> int:
@@ -151,11 +192,17 @@ class KVCacheConfig:
     # ---------------- storage format / byte accounting ----------------
     @property
     def quantized(self) -> bool:
-        return self.kv_dtype == "int8"
+        return self.kv_dtype in QUANTIZED_KV_DTYPES
+
+    @property
+    def storage_dtype(self):
+        """The dtype actually stored in the page arrays (resolves the
+        float8_e4m3 -> float8_e4m3fn alias)."""
+        return kv_storage_dtype(self.kv_dtype)
 
     @property
     def kv_itemsize(self) -> int:
-        return int(np.dtype(self.kv_dtype).itemsize)
+        return int(self.storage_dtype.itemsize)
 
     @property
     def scale_shape(self):
@@ -187,6 +234,22 @@ class KVCacheConfig:
     def pool_bytes(self) -> int:
         return self.num_pages * self.page_bytes
 
+    # ---------------- per-device accounting (sharded serving) ---------
+    @property
+    def heads_per_device(self) -> int:
+        return self.num_heads // max(1, self.tensor_parallel)
+
+    @property
+    def page_device_bytes(self) -> int:
+        """Device bytes ONE page costs under head sharding: both the
+        value blocks and the scale rows carry the head axis, so the
+        whole page cost divides exactly by the tensor degree."""
+        return self.page_bytes // max(1, self.tensor_parallel)
+
+    @property
+    def pool_device_bytes(self) -> int:
+        return self.num_pages * self.page_device_bytes
+
     @property
     def effective_page_ratio(self) -> float:
         """Pages this format fits per byte, relative to f32 — the
@@ -208,6 +271,15 @@ class KVCacheConfig:
             raise ValueError(
                 f"one max-length sequence needs {self.pages_per_seq} pages "
                 f"but the pool only has {self.usable_pages} usable")
+        if self.tensor_parallel < 1:
+            raise ValueError(
+                f"tensor_parallel must be >= 1, got "
+                f"{self.tensor_parallel}")
+        if self.num_heads % max(1, self.tensor_parallel) != 0:
+            raise ValueError(
+                f"head-sharded serving needs num_heads "
+                f"({self.num_heads}) divisible by the tensor degree "
+                f"({self.tensor_parallel})")
 
 
 class PagedKVCache:
@@ -542,32 +614,45 @@ class PagedKVCache:
         self._slot_free.append(slot)
 
     # ---------------- device arrays -----------------------------------
-    def alloc_device_cache(self, dtype=None):
+    def alloc_device_cache(self, dtype=None, sharding=None):
         """The (k_pages, v_pages) device arrays, each
         (num_layers, num_pages, page_size, num_heads, head_dim) at the
         configured kv_dtype (dtype overrides — the pre-quantization
-        callers passed explicit dtypes). Created once per engine;
-        thereafter they only flow through jitted steps (donated), never
-        through this manager. int8 pools pair with
-        :meth:`alloc_scale_arrays`."""
+        callers passed explicit dtypes). `sharding` (a NamedSharding
+        over the serve mesh's head axis) places the pool head-sharded
+        for tensor-parallel serving — each device holds its H/t heads
+        of every page. Created once per engine; thereafter they only
+        flow through jitted steps (donated), never through this
+        manager. Quantized pools pair with :meth:`alloc_scale_arrays`."""
+        import jax
         import jax.numpy as jnp
         c = self.cfg
         shape = (c.num_layers, c.num_pages, c.page_size, c.num_heads,
                  c.head_dim)
-        dt = dtype or jnp.dtype(c.kv_dtype)
-        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+        dt = dtype or c.storage_dtype
+        k, v = jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+        if sharding is not None:
+            k = jax.device_put(k, sharding)
+            v = jax.device_put(v, sharding)
+        return k, v
 
-    def alloc_scale_arrays(self):
-        """The (k_scales, v_scales) f32 per-page scale arrays for int8
-        pools (cfg.scale_shape). Like the page arrays they flow
-        functionally through the jitted steps, donated."""
+    def alloc_scale_arrays(self, sharding=None):
+        """The (k_scales, v_scales) f32 per-page scale arrays for
+        quantized (int8/fp8) pools (cfg.scale_shape). Like the page
+        arrays they flow functionally through the jitted steps, donated
+        — and shard on the same head axis."""
+        import jax
         import jax.numpy as jnp
         if not self.cfg.quantized:
             raise RuntimeError(
-                f"scale arrays exist only for int8 pools "
-                f"(kv_dtype={self.cfg.kv_dtype})")
-        return (jnp.zeros(self.cfg.scale_shape, jnp.float32),
-                jnp.zeros(self.cfg.scale_shape, jnp.float32))
+                f"scale arrays exist only for quantized (int8/fp8) "
+                f"pools (kv_dtype={self.cfg.kv_dtype})")
+        ks = jnp.zeros(self.cfg.scale_shape, jnp.float32)
+        vs = jnp.zeros(self.cfg.scale_shape, jnp.float32)
+        if sharding is not None:
+            ks = jax.device_put(ks, sharding)
+            vs = jax.device_put(vs, sharding)
+        return ks, vs
 
     def register_scale_meta(self, k_scales, v_scales) -> None:
         """Record the scale-array geometry the engine allocated so
@@ -597,6 +682,9 @@ class PagedKVCache:
             "bytes_per_page": c.page_bytes,
             "effective_pages": c.usable_pages,
             "pool_bytes": c.pool_bytes,
+            "tensor_parallel": c.tensor_parallel,
+            "bytes_per_page_device": c.page_device_bytes,
+            "pool_device_bytes": c.pool_device_bytes,
             "occupancy": 1.0 - self.free_pages / c.usable_pages,
             "page_ratio_vs_f32": round(c.effective_page_ratio, 3),
             "pages_saved_vs_f32": int(

@@ -206,10 +206,15 @@ class ContinuousBatchingScheduler:
     # Every threshold here — like the admission watermark and all of
     # ensure_capacity/pages_to_extend — is a fraction of PAGE COUNTS
     # over cfg.usable_pages, never device bytes: the page count is
-    # derived upstream from the configured kv_dtype's itemsize
-    # (KVCacheConfig.page_bytes / kv_pool_mb sizing), so a quantized
-    # pool's extra pages raise the rung/watermark ceilings
-    # automatically and nothing below may assume 4-byte elements.
+    # derived upstream from the configured kv_dtype's itemsize AND the
+    # serve mesh's tensor degree (KVCacheConfig.page_device_bytes /
+    # kv_pool_mb per-DEVICE sizing), so a quantized pool's extra pages
+    # raise the rung/watermark ceilings automatically and nothing
+    # below may assume 4-byte elements. Under head-sharded serving
+    # every device holds ALL pages at H/t heads each, so the count —
+    # and with it every watermark/ladder fraction — is per-device-
+    # identical: rungs fire at the same relative per-device pressure
+    # at any tensor degree (docs/serving.md "Sharded serving").
     LADDER = (0.85, 0.92, 0.97)
     RUNG3_WATERMARK_FRAC = 0.08
 

@@ -182,6 +182,17 @@ def serve_report(stats: dict) -> str:
                 f"{pool.get('attn_block_kv', 0)} tokens, "
                 f"{dp['v2']} grid steps vs {dp['v1']} at v1 per-page "
                 f"dispatch ({red:.1f}x fewer)")
+    # tensor-parallel sharding block (ServeEngine._sharding_stats;
+    # None / absent on single-device engines)
+    sh = stats.get("sharding")
+    if sh:
+        lines.append(
+            f"sharding: mesh {sh.get('mesh')}, "
+            f"{sh.get('heads_per_device', 0)} heads/device, "
+            f"kv pool {sh.get('kv_pool_device_bytes', 0) / 2**20:.2f} "
+            f"MiB/device, "
+            f"~{sh.get('collective_bytes_per_step', 0) / 2**20:.2f} "
+            f"MiB collective payload/step")
     cc = stats.get("compile_counts")
     if cc:
         progs = " ".join(f"{k}={v}" for k, v in cc.items() if v)

@@ -93,6 +93,18 @@ echo "--- 1i. kv-quantization smoke (int8 page capacity + parity gate)"
 env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload kv \
     -o /tmp/ci_bench_serve_kv.json || fail=1
 
+echo "--- 1j. sharded-serving smoke (tensor-parallel parity + sim speedup gate)"
+# the SAME model served single-device vs head-sharded over a forced
+# 4-device host mesh: fails unless greedy outputs are token-identical,
+# nothing compiles after warmup, the per-device KV pool and dispatched
+# FLOPs shrink ~4x, and the placement search's simulated v5e
+# decode-step latency at t=4 is >= 1.5x better than t=1 on the
+# Gemma-31B-class serving arch (tools/serve_bench.py --workload shard,
+# docs/serving.md "Sharded serving")
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    python tools/serve_bench.py --smoke --workload shard \
+    -o /tmp/ci_bench_serve_shard.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \
