@@ -252,18 +252,26 @@ class DispatchWindow:
     time spent blocked in each fetch — the number train_report turns
     into dispatch-gap statistics."""
 
-    def __init__(self, depth: int):
+    def __init__(self, depth: int, telemetry=None):
         self.depth = max(0, int(depth))
         self._pending: collections.deque = collections.deque()
         self._done: List = []
         self.fetch_waits_s: List[float] = []
         self.max_in_flight = 0
+        # optional utils/telemetry bus: each fetch becomes a span on
+        # the ("train", "fetch") track — the host time blocked on a
+        # device result, next to fit's dispatch spans
+        self._telemetry = telemetry
 
     def _fetch_oldest(self) -> None:
         entry = self._pending.popleft()
         t0 = time.perf_counter()
         self._done.append(jax.device_get(entry))
-        self.fetch_waits_s.append(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self.fetch_waits_s.append(t1 - t0)
+        if self._telemetry is not None and self._telemetry.enabled:
+            self._telemetry.span(("train", "fetch"), "fetch_wait",
+                                 t0, t1)
 
     def push(self, entry) -> None:
         self._pending.append(entry)

@@ -83,6 +83,7 @@ class FFModel:
         self.search_stats = None  # set by search.mcmc.optimize*
         # (profiling.search_report renders it)
         self.last_train_stats = None  # set by fit()
+        self.telemetry = None         # set by fit() (utils/telemetry)
         # (profiling.train_report renders it)
         self.label_tensor: Optional[Tensor] = None
         # pretrained weights staged by frontends before compile()
@@ -772,9 +773,33 @@ class FFModel:
         # injected fault never consumes the donated state buffers.
         from .core.overlap import DispatchWindow
         from .utils import faults as _faults
+        from .utils.telemetry import telemetry_for, train_metrics
         inj = _faults.injector_for(self.config)
+        # observability (utils/telemetry.py): dispatch/fetch spans on
+        # the train tracks, the metrics registry train_report renders
+        # from, and the per-epoch simulator-drift sample (measured
+        # step time vs the overlap-exact graph's prediction). All
+        # host-side — telemetry on vs off trains bit-identically.
+        tel = telemetry_for(self.config)
+        self.telemetry = tel
+        # re-price the drift prediction per fit(): the strategy, mesh
+        # or bucket layout may have changed since the last fit, and a
+        # transient pricing failure must not latch None forever
+        self.__dict__.pop("_drift_predicted_step_s", None)
+        _compiles = None
+        if tel.enabled:
+            # process-wide backend-compile counter (the serve engine's
+            # zero-recompile instrument): an epoch whose window saw a
+            # compile (epoch 0's jit, a mid-fit new shape signature)
+            # must not feed the drift calibrator — compile seconds are
+            # not step time, and one contaminated sample poisons the
+            # regime average
+            from .serve.engine import _CompileEvents
+            if _CompileEvents.install():
+                _compiles = _CompileEvents
         win = DispatchWindow(
-            getattr(self.config, "train_dispatch_depth", 2))
+            getattr(self.config, "train_dispatch_depth", 2),
+            telemetry=tel)
         gaps: List[float] = []   # host time between dispatches (prep)
         n_dispatches = [0]
         last_end = [None]
@@ -787,6 +812,10 @@ class FFModel:
             out = fn(*args)
             last_end[0] = time.perf_counter()
             n_dispatches[0] += 1
+            if tel.enabled:
+                tel.span(("train", "dispatch"), "dispatch", t,
+                         last_end[0],
+                         args={"dispatch": n_dispatches[0] - 1})
             return out
 
         history = []
@@ -844,6 +873,8 @@ class FFModel:
             for epoch in range(start_epoch, ep):
                 idx = draw_perm() if shuffle else np.arange(n)
                 t0 = time.time()
+                t0pc = time.perf_counter()
+                compiles0 = _compiles.count if _compiles else 0
                 spd = max(1, steps_per_dispatch)
 
                 if prefetch:
@@ -934,6 +965,29 @@ class FFModel:
                         else:
                             agg[k] = agg.get(k, 0.0) + float(np.sum(v))
                 dt = time.time() - t0
+                if tel.enabled:
+                    t1pc = time.perf_counter()
+                    tel.span(("train", "epoch"), f"epoch {epoch}",
+                             t0pc, t1pc, args={"steps": steps})
+                    # the train half of the drift calibrator: measured
+                    # wall per step (dispatch + device + fetch, the
+                    # number a capacity planner sees) against the
+                    # overlap-exact task graph's prediction for this
+                    # model/mesh/bucket layout
+                    # an epoch containing a backend compile records no
+                    # drift sample (when the compile counter is
+                    # unavailable, the first epoch — where the cold
+                    # jit lives — is skipped instead)
+                    compiled = (_compiles.count > compiles0 if _compiles
+                                else epoch == start_epoch)
+                    if steps and not compiled:
+                        pred = self._predicted_step_s()
+                        if pred:
+                            tel.record_drift(
+                                "train",
+                                f"bs={bs} group={group} "
+                                f"accum={grad_accum_steps}",
+                                pred, (t1pc - t0pc) / steps)
                 out = {"epoch": epoch,
                        "loss": agg.get("loss", 0.0) / max(1, loss_terms),
                        "throughput": steps * bs / dt}
@@ -964,6 +1018,19 @@ class FFModel:
                 pass
             self.last_train_stats = self._train_stats(
                 win, gaps, n_dispatches[0], in_flight_at_exit)
+            if tel.enabled:
+                # fold into the canonical registry train_report renders
+                # from, then flush the Chrome trace when --trace-out
+                # asked for one (the finally runs on faults too, so
+                # chaos runs leave a trace behind)
+                train_metrics(self.last_train_stats,
+                              registry=tel.metrics)
+                trace_out = getattr(self.config, "trace_out", None)
+                if trace_out:
+                    try:
+                        tel.export_chrome_trace(trace_out)
+                    except OSError:
+                        pass  # an unwritable path must not fail fit
             if ckptr is not None:  # commit in-flight saves even on
                 ckptr.wait_until_finished()  # Ctrl-C / mid-epoch errors
                 ckptr.close()
@@ -1000,6 +1067,31 @@ class FFModel:
             "data_parallel": dp,
             "est_comm_hidden": est_hidden,
         }
+
+    def _predicted_step_s(self) -> Optional[float]:
+        """The cost stack's predicted seconds per training step for
+        THIS model on its mesh/strategy — the overlap-exact task graph
+        the strategy search prices (search/simulator.Simulator), which
+        is exactly what the telemetry drift calibrator must compare
+        measured steps against. Cached on the model for the duration
+        of one fit() — fit's prologue drops the cache, so a strategy/
+        mesh/bucket change between fits re-prices and a transient
+        failure cannot latch None forever; None when the model/mesh
+        cannot be priced (drift simply goes unrecorded)."""
+        if not hasattr(self, "_drift_predicted_step_s"):
+            try:
+                from .parallel.pconfig import Strategy
+                from .search.simulator import Simulator
+                mesh = self.mesh
+                if mesh is None:
+                    mesh = make_mesh((1,), ("data",))
+                sim = Simulator(self, mesh)
+                self._drift_predicted_step_s = float(sim.simulate(
+                    self.strategy if self.strategy is not None
+                    else Strategy()))
+            except Exception:
+                self._drift_predicted_step_s = None
+        return self._drift_predicted_step_s
 
     def evaluate(self, x: Dict[str, np.ndarray], y: np.ndarray,
                  batch_size: Optional[int] = None,

@@ -65,6 +65,8 @@ from ..kernels.paged_ragged_v2 import (choose_block_kv,
                                        ragged_dispatch_passes)
 from ..parallel.mesh import TENSOR
 from ..utils.faults import FaultInjector, TransientError, injector_for
+from ..utils.telemetry import (Telemetry, pow2_bucket, serve_metrics,
+                               telemetry_for)
 from .kv_cache import KVCacheConfig, PagedKVCache, kv_storage_dtype
 from .scheduler import (ChunkPlan, ContinuousBatchingScheduler, Request,
                         RequestOutcome, RequestState, SampleParams)
@@ -165,7 +167,8 @@ class ServeEngine:
                  prefix_cache: Optional[bool] = None,
                  spec_tokens: Optional[int] = None,
                  drafter=None, faults: Optional[FaultInjector] = None,
-                 mesh=None, tensor_parallel: Optional[int] = None):
+                 mesh=None, tensor_parallel: Optional[int] = None,
+                 telemetry: Optional[Telemetry] = None):
         if model.state is None:
             model.compile(comp_mode=CompMode.INFERENCE)
         self.model = model
@@ -209,6 +212,20 @@ class ServeEngine:
         # deadlines, host-side cancellation, and the scheduler's
         # degradation ladder
         self.faults = faults if faults is not None else injector_for(cfg)
+        # observability (utils/telemetry.py, docs/observability.md):
+        # per-request/per-step spans, the metrics registry, and the
+        # simulator-drift calibrator. An explicit `telemetry` bus wins
+        # (benches A/B on vs off over one config); else
+        # FFConfig.telemetry / trace_out resolve one (off = the shared
+        # disabled instance, one attribute read per site). All of it
+        # is host-side: telemetry on vs off is token-identical with
+        # zero recompiles (ci.sh step 1k gates <= 3% overhead).
+        self.telemetry = telemetry if telemetry is not None \
+            else telemetry_for(cfg)
+        self.trace_out = getattr(cfg, "trace_out", None)
+        self._drift_cache: Dict[int, Optional[float]] = {}
+        self._slot_tracks: List[tuple] = []  # interned per-slot track
+        # pairs, so the per-step record path never rebuilds f-strings
         self.max_retries = int(getattr(cfg, "serve_max_retries", 3))
         self.retry_backoff = float(
             getattr(cfg, "serve_retry_backoff_s", 0.02))
@@ -357,6 +374,11 @@ class ServeEngine:
                         if hasattr(a, "is_deleted")):
                     raise
                 self._retries += 1
+                if self.telemetry.enabled:
+                    self.telemetry.instant(
+                        ("serve", "engine"), "retry",
+                        args={"site": f"serve.{name}",
+                              "attempt": attempt})
                 if self.retry_backoff:
                     time.sleep(self.retry_backoff * (2 ** (attempt - 1)))
         # jit compiles synchronously at dispatch (only execution is
@@ -1220,14 +1242,22 @@ class ServeEngine:
         flight, and its slot/pages are free for this very step's
         admissions."""
         now = time.perf_counter()
+        tel = self.telemetry
         live = list(sched.running.values()) + list(sched.waiting)
         for req in live:
             if req.rid in self._cancels:
                 if sched.abort(req, RequestOutcome.CANCELLED):
                     req.t_finish = now
+                    if tel.enabled:
+                        tel.instant(("serve", "engine"), "cancel",
+                                    t=now, args={"rid": req.rid})
             elif req.t_deadline and now >= req.t_deadline:
                 if sched.abort(req, RequestOutcome.DEADLINE_EXPIRED):
                     req.t_finish = now
+                    if tel.enabled:
+                        tel.instant(("serve", "engine"),
+                                    "deadline_expired", t=now,
+                                    args={"rid": req.rid})
 
     def _fail_inflight(self, sched, reqs: Sequence[Request]) -> None:
         """Crash containment (replacing the PR-3-era hard brick): a
@@ -1260,6 +1290,122 @@ class ServeEngine:
                 getattr(self._k_scales, "is_deleted", lambda: False)():
             self._k_scales = self._v_scales = None
         self.cache.check_invariants()
+
+    # ---------------- telemetry ----------------------------------------
+    def _drift_predicted(self, ctx_bucket: int) -> Optional[float]:
+        """Predicted seconds for one mixed step at this context
+        bucket, from the SAME cost stack the placement search prices
+        (cost_model.serve_step_tasks -> simulate_serve_step). The
+        fixed-shape mixed program dispatches every lane regardless of
+        occupancy, so the prediction varies only with (arch, tp, lane
+        width, context) — the cache keys on the context bucket alone
+        and the hot-path cost after a bucket's first step is one dict
+        hit. None when the cost stack is unavailable."""
+        if ctx_bucket not in self._drift_cache:
+            try:
+                from ..search.simulator import simulate_serve_step
+                arch = self.serve_arch(context=max(1, ctx_bucket))
+                self._drift_cache[ctx_bucket] = float(
+                    simulate_serve_step(arch, self.tp,
+                                        lanes=self.mixed_width))
+            except Exception:
+                self._drift_cache[ctx_bucket] = None
+        return self._drift_cache[ctx_bucket]
+
+    def _drift_regime(self, n_decode: int, pre_bucket: int,
+                      ctx_bucket: int) -> str:
+        return (f"t={self.tp} kv={self.kv_dtype} dec={n_decode} "
+                f"pre={pre_bucket} ctx={ctx_bucket}")
+
+    _ENGINE_TRACK = ("serve", "engine")
+    _QUEUE_TRACK = ("serve", "queue")
+
+    def _slot_track(self, slot: int):
+        tracks = self._slot_tracks
+        while len(tracks) <= slot:
+            tracks.append(("serve", f"slot {len(tracks)}"))
+        return tracks[slot]
+
+    def _record_step_telemetry(self, tel, plan, step_idx: int,
+                               t_start: float, dt: float,
+                               rung: int, occupancy: float) -> None:
+        """One engine step's telemetry: the step span on the engine
+        track, a chunk span per request on its slot track, queue-wait
+        async spans for this step's admissions, preemption instants,
+        pool-occupancy/rung counter samples, and the drift sample
+        (measured dt vs the cost model's prediction for this step's
+        regime). Called AFTER the dispatch returned, so a fault that
+        kills the step never half-records it. The whole step is built
+        as raw event tuples and handed to the bus in ONE
+        :meth:`Telemetry.emit` — this runs on every engine step, and
+        the per-call overhead of the one-at-a-time recorders is what
+        the <= 3% gate budget goes to."""
+        t_end = t_start + dt
+        dur = max(0.0, dt)
+        now = time.perf_counter()
+        evs = []
+        for req in plan.admitted:
+            if req._t_requeue is not None:
+                # re-admission after preemption: the span an operator
+                # debugging page pressure needs is preempt -> readmit
+                # (NOT a duplicate of the original queue wait; ident
+                # carries the preemption ordinal so Perfetto pairs
+                # each b/e uniquely per eviction)
+                ident = f"{req.rid}.{req.preemptions}"
+                evs.append(("b", self._QUEUE_TRACK, "requeue_wait",
+                            req._t_requeue, 0.0, ident,
+                            {"rid": req.rid,
+                             "preemptions": req.preemptions}))
+                evs.append(("e", self._QUEUE_TRACK, "requeue_wait",
+                            now, 0.0, ident, None))
+                req._t_requeue = None
+            elif not req.t_admit:
+                req.t_admit = now
+                evs.append(("b", self._QUEUE_TRACK, "queue_wait",
+                            req.t_submit, 0.0, req.rid,
+                            {"rid": req.rid,
+                             "prompt_tokens": len(req.prompt)}))
+                evs.append(("e", self._QUEUE_TRACK, "queue_wait",
+                            req.t_admit, 0.0, req.rid, None))
+        for victim in plan.preempted:
+            victim._t_requeue = now
+            evs.append(("i", self._ENGINE_TRACK, "preempt", now, 0.0,
+                        None, {"rid": victim.rid,
+                               "preemptions": victim.preemptions}))
+        drafted = 0
+        for ch in plan.chunks:
+            name = ("spec_decode" if ch.draft_tokens
+                    else "decode" if ch.is_decode else "prefill")
+            drafted += len(ch.draft_tokens)
+            evs.append(("X", self._slot_track(ch.req.slot), name,
+                        t_start, dur,
+                        None, {"rid": ch.req.rid, "start": ch.start,
+                               "end": ch.end,
+                               "drafted": len(ch.draft_tokens)}))
+        n_dec = plan.num_decode_lanes
+        n_pre = plan.num_prefill_lanes
+        evs.append(("X", self._ENGINE_TRACK, "step", t_start, dur,
+                    None, {"step": step_idx, "decode_lanes": n_dec,
+                           "prefill_lanes": n_pre, "drafted": drafted,
+                           "rung": rung}))
+        evs.append(("C", self._ENGINE_TRACK, "pool_occupancy", t_end,
+                    occupancy, None, None))
+        evs.append(("C", self._ENGINE_TRACK, "rung", t_end,
+                    float(rung), None, None))
+        tel.emit(evs)
+        if plan.chunks and self.chunked_prefill:
+            # O(1) context length — Request.context materializes a
+            # prompt+out_tokens list copy, far too hot for every step
+            ctxs = [len(ch.req.prompt) + len(ch.req.out_tokens)
+                    for ch in plan.chunks
+                    if ch.is_decode] or [ch.end for ch in plan.chunks]
+            ctx_b = pow2_bucket(int(sum(ctxs) / len(ctxs)))
+            pre_b = pow2_bucket(n_pre)
+            pred = self._drift_predicted(ctx_b)
+            if pred is not None:
+                tel.record_drift(
+                    "serve", self._drift_regime(n_dec, pre_b, ctx_b),
+                    pred, dt)
 
     # ---------------- the serving loop ---------------------------------
     def generate(self, prompts: Sequence[Sequence[int]],
@@ -1380,12 +1526,18 @@ class ServeEngine:
                 if req.is_done() or not ok:
                     break
             sched.complete_spec_chunk(chunk, matched)
+            if self.telemetry.enabled:
+                self.telemetry.instant(
+                    ("serve", f"slot {req.slot}"), "spec_verify",
+                    args={"rid": req.rid, "drafted": k,
+                          "accepted": matched, "emitted": emitted})
             if req.is_done():
                 req.t_finish = time.perf_counter()
                 sched.finish(req)
             return emitted
 
         retries0 = self._retries
+        tel = self.telemetry
         try:
             if self.chunked_prefill:
                 kp, vp = self._run_chunked(sched, cache, kp, vp, emit,
@@ -1403,6 +1555,20 @@ class ServeEngine:
         finally:
             self._active.clear()
             self._cancels.clear()
+            # chaos runs stay inspectable post-hoc (docs/robustness.md):
+            # the injector's fired accounting and the Chrome trace
+            # flush even when a fault aborts the run (every span is
+            # already in the ring by the time the dispatch raised), and
+            # an unwritable --trace-out path must not fail a generate
+            # that already produced tokens (fit() makes both promises
+            # in its own finally)
+            if tel.enabled:
+                tel.record_faults(self.faults)
+                if self.trace_out:
+                    try:
+                        tel.export_chrome_trace(self.trace_out)
+                    except OSError:
+                        pass
         self._k_pages, self._v_pages = kp, vp
         cache.check_invariants()
         assert cache.free_pages == c.usable_pages, "pages leaked"
@@ -1491,6 +1657,13 @@ class ServeEngine:
                     ).items()} if self.chunked_prefill else None,
             },
         }
+        # fold this run into the engine-lifetime telemetry registry
+        # (counters accumulate, gauges overwrite, histograms extend) —
+        # the same canonical definitions serve_report renders from
+        # (fault accounting + the trace flush already happened in the
+        # finally above, so aborted runs get them too)
+        if tel.enabled:
+            serve_metrics(self.last_stats, registry=tel.metrics)
         return [list(r.out_tokens) for r in reqs]
 
     def _run_chunked(self, sched, cache, kp, vp, emit, emit_spec,
@@ -1566,6 +1739,10 @@ class ServeEngine:
             topi = np.asarray(topi)
             dt = time.perf_counter() - tp
             util.append(1.0 - cache.free_pages / c.usable_pages)
+            if self.telemetry.enabled:
+                self._record_step_telemetry(
+                    self.telemetry, plan, len(util) - 1, tp, dt,
+                    sched.rung, util[-1])
             # bookkeeping FIRST (page commits hash the context as it
             # was when the chunk ran), emission second; speculative
             # chunks verify LAST — their residency bookkeeping is a
@@ -1606,6 +1783,7 @@ class ServeEngine:
             plan = sched.schedule()
             if not plan.chunks:
                 continue
+            t_step0 = time.perf_counter()
             pre = [ch for ch in plan.chunks if not ch.is_decode]
             dec = [ch for ch in plan.chunks if ch.is_decode]
             for ch in pre:
@@ -1658,6 +1836,14 @@ class ServeEngine:
                     emit(ch, nxt[ch.req.slot], topv[ch.req.slot],
                          topi[ch.req.slot])
             util.append(1.0 - cache.free_pages / c.usable_pages)
+            if self.telemetry.enabled:
+                # legacy-path steps get the engine-track span + pool
+                # counter (no drift: the cost model prices the mixed
+                # program, not the bucketed prefill/decode pair)
+                self._record_step_telemetry(
+                    self.telemetry, plan, len(util) - 1,
+                    t_step0, time.perf_counter() - t_step0,
+                    sched.rung, util[-1])
             if on_step is not None:
                 on_step(len(util) - 1)
         return kp, vp

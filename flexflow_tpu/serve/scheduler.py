@@ -121,10 +121,17 @@ class Request:
     spec: Optional[DraftControl] = None
     _page_keys: List[bytes] = dataclasses.field(default_factory=list,
                                                 repr=False)
-    # serving metrics (utils/profiling.serve_report): wall-clock stamps
+    # serving metrics (utils/profiling.serve_report, telemetry queue-
+    # wait spans): wall-clock stamps. t_admit is stamped by the engine
+    # at the first step that plans the request (0.0 until then).
     t_submit: float = 0.0
+    t_admit: float = 0.0
     t_first_token: float = 0.0
     t_finish: float = 0.0
+    # preemption stamp for the telemetry requeue_wait span (set at
+    # eviction, cleared at re-admission; telemetry-only bookkeeping)
+    _t_requeue: Optional[float] = dataclasses.field(default=None,
+                                                    repr=False)
 
     @property
     def total_tokens(self) -> int:

@@ -11,19 +11,50 @@ from __future__ import annotations
 
 import contextlib
 import time
+import warnings
 from typing import Optional
+
+from .telemetry import serve_metrics, train_metrics
+
+DEFAULT_TRACE_DIR = "/tmp/flexflow_tpu_trace"
 
 
 @contextlib.contextmanager
-def trace(log_dir: str = "/tmp/flexflow_tpu_trace"):
+def trace(log_dir: Optional[str] = None, config=None):
     """Capture an XLA/TPU profiler trace viewable in TensorBoard
-    (jax.profiler; the analog of Legion's -lg:prof)."""
-    import jax
-    jax.profiler.start_trace(log_dir)
+    (jax.profiler; the analog of Legion's -lg:prof).
+
+    The log dir resolves: explicit ``log_dir`` arg, then
+    ``FFConfig.trace_dir`` (``--trace-dir``), then the legacy
+    ``/tmp/flexflow_tpu_trace`` default — and is YIELDED, so callers
+    can report where the trace landed. Degrades gracefully (one
+    warning, then a no-op context) when jax.profiler tracing is
+    unavailable on the backend — a remote tunnel or a jax build
+    without profiler support must not crash the run it was meant to
+    observe."""
+    if log_dir is None:
+        log_dir = getattr(config, "trace_dir", None) or DEFAULT_TRACE_DIR
+    started = False
+    jax = None
+    try:
+        import jax
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception as e:  # profiler absent / backend refuses traces
+        warnings.warn(
+            f"jax.profiler trace unavailable on this backend "
+            f"({type(e).__name__}: {e}); profiling.trace is a no-op")
     try:
         yield log_dir
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                warnings.warn(
+                    f"jax.profiler stop_trace failed "
+                    f"({type(e).__name__}: {e}); trace in {log_dir} "
+                    f"may be incomplete")
 
 
 def op_profile(model, peak_flops: Optional[float] = None) -> str:
@@ -52,35 +83,33 @@ def op_profile(model, peak_flops: Optional[float] = None) -> str:
     return "\n".join(lines)
 
 
-def _pct(sorted_vals, q):
-    """Nearest-rank percentile of an ascending list (no numpy dep for a
-    report string)."""
-    if not sorted_vals:
-        return 0.0
-    i = min(len(sorted_vals) - 1, max(0, int(round(
-        q / 100.0 * (len(sorted_vals) - 1)))))
-    return sorted_vals[i]
-
-
 def serve_percentiles(stats: dict, qs=(50, 99)) -> dict:
-    """Per-token decode latency percentiles (seconds) from
+    """Per-token decode latency (TPOT) percentiles (seconds) from
     ServeEngine.last_stats: each decode step's wall time divided over
     the tokens that step produced — the batched-decode amortization IS
-    the per-token number that matters under continuous batching. The
-    one definition serve_report and tools/serve_bench.py both use."""
-    per_tok = sorted(
-        t / w for t, w in zip(stats.get("decode_step_times_s", []),
-                              stats.get("decode_widths", [])) if w > 0)
-    return {q: _pct(per_tok, q) for q in qs}
+    the per-token number that matters under continuous batching. Reads
+    the `serve_tpot_seconds` histogram of the canonical metrics fold
+    (utils/telemetry.serve_metrics), so the report string, this
+    helper, and every exported snapshot share one definition —
+    nearest-rank over the histogram's bounded sample window
+    (MetricsRegistry.HIST_WINDOW, 4096): a run longer than the window
+    quantiles its most recent samples, the bounded-memory telemetry
+    contract."""
+    m = serve_metrics(stats)
+    return {q: m.quantile("serve_tpot_seconds", q) for q in qs}
 
 
 def serve_report(stats: dict) -> str:
     """Render ServeEngine.last_stats as the serving analog of
     op_profile: a per-request latency table plus aggregate
-    tokens/sec and per-token latency percentiles. Per-token latency is
-    each decode step's wall time divided over the tokens that step
-    produced (the batched-decode amortization IS the number that
-    matters for continuous batching)."""
+    tokens/sec and per-token latency percentiles. Every AGGREGATE
+    number below reads from the canonical metrics fold
+    (utils/telemetry.serve_metrics) — the same registry the
+    Prometheus/JSON exporters publish — so this string and the
+    exported numbers can never drift. Per-request rows and
+    config-fact blocks (kv pool geometry, sharding) render from the
+    stats dict directly (they are identities, not measurements)."""
+    m = serve_metrics(stats)
     lines = [f"{'rid':>4s} {'prompt':>7s} {'new':>5s} {'ttft ms':>9s} "
              f"{'latency ms':>11s} {'tok/s':>8s}  {'outcome':s}"]
     for r in stats.get("requests", []):
@@ -97,38 +126,39 @@ def serve_report(stats: dict) -> str:
             + (f"{lat*1e3:>11.2f} " if lat is not None else f"{'-':>11s} ")
             + f"{tps:>8.1f}"
             + (f"  {outcome}" if outcome != "completed" else ""))
-    pct = serve_percentiles(stats)
+    p50 = m.quantile("serve_tpot_seconds", 50)
+    p99 = m.quantile("serve_tpot_seconds", 99)
     lines.append(
-        f"total: {stats.get('total_new_tokens', 0)} tokens in "
-        f"{stats.get('wall_s', 0.0)*1e3:.1f} ms "
-        f"({stats.get('tokens_per_sec', 0.0):.1f} tok/s, "
-        f"{stats.get('decode_steps', 0)} decode steps)")
-    if any(pct.values()):
+        f"total: {m.counter('serve_tokens_generated_total'):.0f} tokens "
+        f"in {m.gauge('serve_wall_seconds')*1e3:.1f} ms "
+        f"({m.gauge('serve_tokens_per_sec'):.1f} tok/s, "
+        f"{m.counter('serve_decode_steps_total'):.0f} decode steps)")
+    if p50 or p99:
         lines.append(
-            f"per-token decode latency: p50={pct[50]*1e3:.3f} ms "
-            f"p99={pct[99]*1e3:.3f} ms")
+            f"per-token decode latency: p50={p50*1e3:.3f} ms "
+            f"p99={p99*1e3:.3f} ms")
     # prefix cache / chunked prefill / preemption instrumentation
     # (absent from pre-v2 stats dicts — every line is key-guarded)
-    pt = stats.get("prompt_tokens_total")
-    if pt is not None:
-        comp = stats.get("prefill_tokens_computed", 0)
-        hit = stats.get("prefix_hit_tokens", 0)
+    if stats.get("prompt_tokens_total") is not None:
+        pt = m.counter("serve_prompt_tokens_total")
+        comp = m.counter("serve_prefill_tokens_computed_total")
+        hit = m.counter("serve_prefix_hit_tokens_total")
         red = pt / comp if comp else float("inf")
         lines.append(
-            f"prefill: computed {comp} of {pt} prompt tokens "
-            f"({hit} prefix-cache hits, {red:.2f}x reduction)")
+            f"prefill: computed {comp:.0f} of {pt:.0f} prompt tokens "
+            f"({hit:.0f} prefix-cache hits, {red:.2f}x reduction)")
     # speculative decoding: drafted/accepted and the per-sequence
     # steps-per-token (1.0 = sequential decode; lower = accepted
     # drafts advanced sequences several tokens per dispatched step)
-    drafted = stats.get("spec_drafted_tokens")
-    if drafted is not None and stats.get("spec_tokens", 0) > 0:
-        acc = stats.get("spec_accepted_tokens", 0)
-        rate = stats.get("spec_acceptance", 0.0)
-        spt = stats.get("steps_per_decode_token", 0.0)
+    if stats.get("spec_drafted_tokens") is not None \
+            and stats.get("spec_tokens", 0) > 0:
         lines.append(
-            f"speculation: drafted {drafted}, accepted {acc} "
-            f"({rate:.1%} acceptance), "
-            f"{spt:.2f} steps/token")
+            f"speculation: drafted "
+            f"{m.counter('serve_spec_drafted_tokens_total'):.0f}, "
+            f"accepted "
+            f"{m.counter('serve_spec_accepted_tokens_total'):.0f} "
+            f"({m.gauge('serve_spec_acceptance'):.1%} acceptance), "
+            f"{m.gauge('serve_steps_per_decode_token'):.2f} steps/token")
     # robustness: aborts, retried dispatches, degradation-ladder climb
     # (absent from pre-robustness stats dicts — key-guarded like the
     # rest)
@@ -137,30 +167,34 @@ def serve_report(stats: dict) -> str:
                                   "degradation_rung_max")):
         rungs = stats.get("rung_steps")
         lines.append(
-            f"robustness: {stats.get('cancelled', 0)} cancelled, "
-            f"{stats.get('deadline_expired', 0)} deadline-expired, "
-            f"{stats.get('rejected', 0)} rejected, "
-            f"{stats.get('retries', 0)} retried dispatches, "
-            f"degradation rung max "
-            f"{stats.get('degradation_rung_max', 0)}"
+            f"robustness: {m.counter('serve_cancelled_total'):.0f} "
+            f"cancelled, "
+            f"{m.counter('serve_deadline_expired_total'):.0f} "
+            f"deadline-expired, "
+            f"{m.counter('serve_rejected_total'):.0f} rejected, "
+            f"{m.counter('serve_retries_total'):.0f} retried "
+            f"dispatches, degradation rung max "
+            f"{m.gauge('serve_degradation_rung_max'):.0f}"
             + (f" (steps/rung {rungs}, "
                f"{stats.get('spec_shed_steps', 0)} spec sheds)"
                if rungs else ""))
     if "preemptions" in stats or "page_util_mean" in stats:
         lines.append(
-            f"pages: utilization mean={stats.get('page_util_mean', 0.0):.1%}"
-            f" max={stats.get('page_util_max', 0.0):.1%}, "
-            f"{stats.get('preemptions', 0)} preemptions")
-    cache = stats.get("cache")
-    if cache:
+            f"pages: utilization "
+            f"mean={m.gauge('serve_pool_occupancy_mean'):.1%}"
+            f" max={m.gauge('serve_pool_occupancy_peak'):.1%}, "
+            f"{m.counter('serve_preemptions_total'):.0f} preemptions")
+    if stats.get("cache"):
+        def cc(k):
+            return m.counter(f"serve_prefix_cache_{k}_total")
         lines.append(
             f"prefix cache (engine lifetime): "
-            f"{cache.get('prefix_hit_pages', 0)} page hits / "
-            f"{cache.get('pages_committed', 0)} committed, "
-            f"{cache.get('shared_attaches', 0)} shared attaches "
-            f"(max refs {cache.get('max_page_refs', 0)}), "
-            f"{cache.get('prefix_evictions', 0)} evictions, "
-            f"{cache.get('rollback_pages', 0)} rolled-back pages")
+            f"{cc('prefix_hit_pages'):.0f} page hits / "
+            f"{cc('pages_committed'):.0f} committed, "
+            f"{cc('shared_attaches'):.0f} shared attaches "
+            f"(max refs {cc('max_page_refs'):.0f}), "
+            f"{cc('prefix_evictions'):.0f} evictions, "
+            f"{cc('rollback_pages'):.0f} rolled-back pages")
     # KV pool: storage format + itemsize-derived byte accounting and
     # the quantized-capacity multiplier (serve/kv_cache.pool_report);
     # absent from pre-quantization stats dicts — key-guarded
@@ -195,7 +229,9 @@ def serve_report(stats: dict) -> str:
             f"MiB collective payload/step")
     cc = stats.get("compile_counts")
     if cc:
-        progs = " ".join(f"{k}={v}" for k, v in cc.items() if v)
+        progs = " ".join(
+            f"{k}={m.counter('serve_compiled_programs', program=k):.0f}"
+            for k in cc if cc[k])
         lines.append(f"compiled programs: {progs or 'none'}")
     return "\n".join(lines)
 
@@ -256,29 +292,33 @@ def train_report(stats: dict) -> str:
     estimate of the comm fraction the bucketed backward hides."""
     if not stats:
         return "train: no stats recorded"
+    m = train_metrics(stats)
     lines = [
-        f"train: {stats.get('dispatches', 0)} dispatches, "
-        f"window depth {stats.get('dispatch_depth', 0)} "
-        f"(max in flight {stats.get('max_in_flight', 0)}, "
-        f"{stats.get('in_flight_at_exit', 0)} drained at exit)"]
+        f"train: {m.counter('train_dispatches_total'):.0f} dispatches, "
+        f"window depth {m.gauge('train_dispatch_depth'):.0f} "
+        f"(max in flight {m.gauge('train_max_in_flight'):.0f}, "
+        f"{m.gauge('train_in_flight_at_exit'):.0f} drained at exit)"]
     lines.append(
-        f"dispatch gap: mean={stats.get('dispatch_gap_s_mean', 0.0)*1e3:.3f} ms "
-        f"p50={stats.get('dispatch_gap_s_p50', 0.0)*1e3:.3f} ms "
-        f"max={stats.get('dispatch_gap_s_max', 0.0)*1e3:.3f} ms; "
-        f"fetch wait total={stats.get('fetch_wait_s_total', 0.0)*1e3:.1f} ms "
-        f"(max {stats.get('fetch_wait_s_max', 0.0)*1e3:.3f} ms)")
+        f"dispatch gap: "
+        f"mean={m.gauge('train_dispatch_gap_seconds_mean')*1e3:.3f} ms "
+        f"p50={m.gauge('train_dispatch_gap_seconds_p50')*1e3:.3f} ms "
+        f"max={m.gauge('train_dispatch_gap_seconds_max')*1e3:.3f} ms; "
+        f"fetch wait "
+        f"total={m.gauge('train_fetch_wait_seconds_total')*1e3:.1f} ms "
+        f"(max {m.gauge('train_fetch_wait_seconds_max')*1e3:.3f} ms)")
     b = stats.get("grad_buckets") or {}
     if b.get("count"):
         sizes = " ".join(f"{x/2**20:.2f}" for x in b.get("bytes", []))
         lines.append(
-            f"grad sync: {b['count']} bucket(s) of "
-            f"[{sizes}] MiB (target {b.get('bucket_mb', 0.0):g} MiB), "
-            f"dp={stats.get('data_parallel', 1)}, "
-            f"est. comm hidden {stats.get('est_comm_hidden', 0.0):.0%}")
+            f"grad sync: {m.gauge('train_grad_buckets'):.0f} bucket(s) "
+            f"of [{sizes}] MiB "
+            f"(target {m.gauge('train_grad_bucket_mb'):g} MiB), "
+            f"dp={m.gauge('train_data_parallel'):.0f}, "
+            f"est. comm hidden {m.gauge('train_est_comm_hidden'):.0%}")
     else:
         lines.append(
             f"grad sync: monolithic (grad_bucket_mb=0), "
-            f"dp={stats.get('data_parallel', 1)}")
+            f"dp={m.gauge('train_data_parallel'):.0f}")
     return "\n".join(lines)
 
 

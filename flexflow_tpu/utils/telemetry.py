@@ -1,0 +1,611 @@
+"""Unified telemetry: structured event bus, metrics, drift calibration.
+
+FlexFlow's core bet is that an execution simulator can price real
+placements accurately — but until this module nothing ever checked the
+simulator's predictions against what the engine measures, and all
+serving/training stats lived in ad-hoc ``last_stats`` dicts rendered
+only as report strings. This module is the machine-readable layer
+underneath (docs/observability.md):
+
+  * :class:`Telemetry` — a low-overhead structured event bus. Spans,
+    instants and counter samples land in a BOUNDED ring buffer
+    (``collections.deque(maxlen=...)``) stamped from ONE monotonic
+    clock; the hot-path cost of a record is a single tuple append
+    (and a no-op attribute check when disabled). ServeEngine and
+    fit()/DispatchWindow mark per-request lifecycle spans (queue-wait,
+    prefill chunks, decode steps, preemption, speculation verify,
+    retries, degradation rungs, cancel/deadline) and per-step train
+    spans (dispatch, fetch-wait) on named (process, thread) tracks.
+  * :class:`MetricsRegistry` — counters / gauges / histograms with
+    nearest-rank quantiles, exported as a Prometheus-style text page
+    (:meth:`~MetricsRegistry.to_prometheus`) or a JSON snapshot
+    (:meth:`~MetricsRegistry.snapshot`). The canonical metric
+    definitions live HERE (:func:`serve_metrics` /
+    :func:`train_metrics`), and ``utils/profiling.serve_report`` /
+    ``train_report`` are rendered FROM these snapshots — the string
+    reports and the exported numbers cannot drift apart.
+  * Chrome trace-event export (:meth:`Telemetry.export_chrome_trace`)
+    — a ``chrome://tracing`` / Perfetto-loadable JSON with one track
+    per request slot plus one per engine step stream (``--trace-out``).
+  * The simulator-drift calibrator (:meth:`Telemetry.record_drift` /
+    :meth:`drift_report`): each engine step records its measured wall
+    time next to the cost model's predicted time for the same (batch
+    composition, kv dtype, mesh degree) regime — via
+    ``search/cost_model.serve_step_tasks`` +
+    ``simulator.simulate_serve_step`` for serving and the bucketed
+    overlap graph for training — and the report emits per-regime
+    predicted/measured ratios, flagged when drift exceeds the
+    configured threshold. This is the measurement substrate future
+    machine-model recalibration (and the ROADMAP router/autoscaler)
+    will trust.
+
+Contract: telemetry on vs off is token-identical with zero recompiles
+(everything here is host-side bookkeeping — no jax in the record path)
+at <= 3% step-time overhead, gated in ci.sh step 1k; every site keeps
+working under fault injection, so chaos runs become traceable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "MetricsRegistry", "Telemetry", "telemetry_for", "pct",
+    "pow2_bucket", "serve_metrics", "train_metrics",
+]
+
+
+def pct(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending list — THE percentile
+    definition of this repo (serve_report, serve_percentiles and every
+    exported histogram quantile share it, so a report line and its
+    BENCH record can never disagree)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, int(round(
+        q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def pow2_bucket(n: int) -> int:
+    """Round up to a power of two (0 stays 0) — the drift calibrator's
+    regime-bucketing for prefill lane counts and context lengths, so a
+    long run collapses into a handful of comparable regimes instead of
+    one regime per distinct step shape."""
+    n = int(n)
+    if n <= 0:
+        return 0
+    return 1 << (n - 1).bit_length()
+
+
+def _label_key(labels: Dict[str, object]) -> str:
+    """Prometheus-style series key: ``name{k="v",...}`` tail."""
+    if not labels:
+        return ""
+    body = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return "{" + body + "}"
+
+
+class MetricsRegistry:
+    """Counters, gauges and histograms keyed by name + optional labels.
+
+    Histograms keep exact count/sum totals plus a bounded window of
+    recent samples (the quantile source — nearest-rank over the
+    window, the same :func:`pct` the reports use). Everything is plain
+    host Python; no locks needed for the GIL-atomic dict/list ops the
+    recording paths perform."""
+
+    HIST_WINDOW = 4096
+
+    def __init__(self):
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self._hists: Dict[str, dict] = {}
+
+    # ---------------- recording ---------------------------------------
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        key = name + _label_key(labels)
+        self.counters[key] = self.counters.get(key, 0.0) + float(value)
+
+    def counter_set(self, name: str, value: float, **labels) -> None:
+        """Absolute-set a counter — for sources that track their own
+        cumulative totals (compile counts, fault-injector fired
+        counts), where re-adding each snapshot would double-count."""
+        self.counters[name + _label_key(labels)] = float(value)
+
+    def set(self, name: str, value: float, **labels) -> None:
+        self.gauges[name + _label_key(labels)] = float(value)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        key = name + _label_key(labels)
+        h = self._hists.get(key)
+        if h is None:
+            h = self._hists[key] = {
+                "count": 0, "sum": 0.0,
+                "window": deque(maxlen=self.HIST_WINDOW)}
+        h["count"] += 1
+        h["sum"] += float(value)
+        h["window"].append(float(value))
+
+    # ---------------- reading -----------------------------------------
+    def counter(self, name: str, default: float = 0.0, **labels) -> float:
+        return self.counters.get(name + _label_key(labels), default)
+
+    def gauge(self, name: str, default: float = 0.0, **labels) -> float:
+        return self.gauges.get(name + _label_key(labels), default)
+
+    def quantile(self, name: str, q: float, **labels) -> float:
+        h = self._hists.get(name + _label_key(labels))
+        if not h or not h["window"]:
+            return 0.0
+        return pct(sorted(h["window"]), q)
+
+    def hist_count(self, name: str, **labels) -> int:
+        h = self._hists.get(name + _label_key(labels))
+        return int(h["count"]) if h else 0
+
+    # ---------------- export ------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-ready snapshot: every counter/gauge value plus each
+        histogram's count/sum/min/max and p50/p90/p99 (nearest-rank
+        over the retained window)."""
+        hists = {}
+        for key, h in self._hists.items():
+            win = sorted(h["window"])
+            hists[key] = {
+                "count": h["count"], "sum": h["sum"],
+                "min": win[0] if win else 0.0,
+                "max": win[-1] if win else 0.0,
+                "p50": pct(win, 50), "p90": pct(win, 90),
+                "p99": pct(win, 99),
+            }
+        return {"counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "histograms": hists}
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (one ``# TYPE`` line per
+        metric family; histogram quantiles as `{quantile="..."}`
+        summary series plus `_count`/`_sum`)."""
+        lines: List[str] = []
+        fams = set()
+
+        def family(key: str) -> str:
+            return key.split("{", 1)[0]
+
+        def type_line(key: str, typ: str) -> None:
+            fam = family(key)
+            if fam not in fams:
+                fams.add(fam)
+                lines.append(f"# TYPE {fam} {typ}")
+
+        for key in sorted(self.counters):
+            type_line(key, "counter")
+            lines.append(f"{key} {self.counters[key]:g}")
+        for key in sorted(self.gauges):
+            type_line(key, "gauge")
+            lines.append(f"{key} {self.gauges[key]:g}")
+        for key in sorted(self._hists):
+            h = self._hists[key]
+            fam, _, tail = key.partition("{")
+            base_labels = ("{" + tail) if tail else ""
+            type_line(key, "summary")
+            win = sorted(h["window"])
+            for q in (0.5, 0.9, 0.99):
+                if base_labels:
+                    series = (f"{fam}{base_labels[:-1]},"
+                              f'quantile="{q}"}}')
+                else:
+                    series = f'{fam}{{quantile="{q}"}}'
+                lines.append(f"{series} {pct(win, q * 100):g}")
+            lines.append(f"{fam}_count{base_labels} {h['count']}")
+            lines.append(f"{fam}_sum{base_labels} {h['sum']:g}")
+        return "\n".join(lines) + "\n"
+
+
+class _DriftStat:
+    """Accumulated predicted-vs-measured seconds for one regime."""
+
+    __slots__ = ("predicted_s", "measured_s", "count")
+
+    def __init__(self):
+        self.predicted_s = 0.0
+        self.measured_s = 0.0
+        self.count = 0
+
+
+class Telemetry:
+    """The event bus + metrics + drift store one engine or model owns.
+
+    Events are ``(ph, track, name, ts, dur, ident, args)`` tuples in a
+    bounded ring (``max_events``); ``track`` is a (process, thread)
+    string pair that the Chrome exporter maps to pid/tid. ``enabled``
+    is checked by every caller BEFORE building the record, so a
+    disabled Telemetry costs one attribute read per site."""
+
+    # chaos-proof cap on drift regimes: a pathological workload cannot
+    # grow the store without bound (drops are counted, never silent)
+    MAX_DRIFT_REGIMES = 512
+
+    def __init__(self, enabled: bool = True, max_events: int = 65536,
+                 drift_threshold: float = 0.5):
+        self.enabled = bool(enabled)
+        self.max_events = int(max_events)
+        self.drift_threshold = float(drift_threshold)
+        self.events: deque = deque(maxlen=self.max_events)
+        self.metrics = MetricsRegistry()
+        self.dropped_events = 0
+        self._drift: Dict[Tuple[str, str], _DriftStat] = {}
+        self.drift_regimes_dropped = 0
+        # ONE monotonic clock zero for every span in the buffer
+        self._t0 = time.perf_counter()
+
+    # ---------------- clock -------------------------------------------
+    def now(self) -> float:
+        """Seconds on the trace clock (monotonic, zero at creation)."""
+        return time.perf_counter() - self._t0
+
+    def _rel(self, t: float) -> float:
+        # callers pass raw perf_counter stamps; store trace-relative
+        return t - self._t0
+
+    # ---------------- recording (hot path: ONE append) ----------------
+    def span(self, track: Tuple[str, str], name: str, t_start: float,
+             t_end: float, args: Optional[dict] = None) -> None:
+        """Complete span [t_start, t_end) (perf_counter stamps)."""
+        if not self.enabled:
+            return
+        if len(self.events) == self.max_events:
+            self.dropped_events += 1
+        self.events.append(("X", track, name, self._rel(t_start),
+                            max(0.0, t_end - t_start), None, args))
+
+    def instant(self, track: Tuple[str, str], name: str,
+                t: Optional[float] = None,
+                args: Optional[dict] = None) -> None:
+        if not self.enabled:
+            return
+        if len(self.events) == self.max_events:
+            self.dropped_events += 1
+        self.events.append(("i", track, name,
+                            self.now() if t is None else self._rel(t),
+                            0.0, None, args))
+
+    def async_span(self, track: Tuple[str, str], name: str, ident,
+                   t_start: float, t_end: float,
+                   args: Optional[dict] = None) -> None:
+        """Async (b/e) span — the Chrome-trace form for intervals that
+        legitimately overlap on one track (queue-wait of concurrently
+        waiting requests)."""
+        if not self.enabled:
+            return
+        n = len(self.events)
+        if n >= self.max_events:        # both appends evict
+            self.dropped_events += 2
+        elif n == self.max_events - 1:  # the second append evicts
+            self.dropped_events += 1
+        self.events.append(("b", track, name, self._rel(t_start), 0.0,
+                            ident, args))
+        self.events.append(("e", track, name, self._rel(t_end), 0.0,
+                            ident, None))
+
+    def counter(self, track: Tuple[str, str], name: str, value: float,
+                t: Optional[float] = None) -> None:
+        """Counter-track sample (Perfetto renders these as a stepped
+        line — pool occupancy, degradation rung)."""
+        if not self.enabled:
+            return
+        if len(self.events) == self.max_events:
+            self.dropped_events += 1
+        self.events.append(("C", track, name,
+                            self.now() if t is None else self._rel(t),
+                            float(value), None, None))
+
+    def emit(self, events: Iterable[tuple]) -> None:
+        """Bulk raw-event append — the per-step hot path of
+        ServeEngine hands the WHOLE step's records over in one call
+        instead of ~10 method calls. Each item is a finished
+        ``(ph, track, name, t_abs, dur_or_value, ident, args)`` tuple
+        whose timestamp is an ABSOLUTE perf_counter stamp; it is
+        rebased to the trace clock here. Eviction accounting matches
+        the one-at-a-time recorders: every event pushed out of the
+        bounded ring (or unbuffered because the batch itself overflows
+        it) counts as dropped."""
+        if not self.enabled:
+            return
+        t0 = self._t0
+        evs = [(ph, tr, nm, ts - t0, d, i, a)
+               for ph, tr, nm, ts, d, i, a in events]
+        over = len(self.events) + len(evs) - self.max_events
+        if over > 0:
+            self.dropped_events += over
+        self.events.extend(evs)
+
+    @contextlib.contextmanager
+    def timed(self, track: Tuple[str, str], name: str,
+              args: Optional[dict] = None):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.span(track, name, t0, time.perf_counter(), args)
+
+    # ---------------- drift calibration --------------------------------
+    def record_drift(self, domain: str, regime: str, predicted_s: float,
+                     measured_s: float) -> None:
+        """One step's measured wall time next to the cost model's
+        predicted time for the same regime (a stable string like
+        ``"t=1 kv=float32 dec=4 pre=0 ctx=64"``)."""
+        if not self.enabled:
+            return
+        key = (str(domain), str(regime))
+        st = self._drift.get(key)
+        if st is None:
+            if len(self._drift) >= self.MAX_DRIFT_REGIMES:
+                self.drift_regimes_dropped += 1
+                return
+            st = self._drift[key] = _DriftStat()
+        st.predicted_s += float(predicted_s)
+        st.measured_s += float(measured_s)
+        st.count += 1
+
+    def drift_snapshot(self, threshold: Optional[float] = None) -> dict:
+        """Per-regime predicted/measured accounting:
+        ``{domain: {regime: {predicted_ms_per_step, measured_ms_per_step,
+        ratio, count, flagged}}}`` where ``ratio`` is measured /
+        predicted and ``flagged`` marks drift beyond ``threshold``
+        (default: the construction-time threshold) in either
+        direction — ratio above ``1 + threshold`` or below
+        ``1 / (1 + threshold)``."""
+        thr = self.drift_threshold if threshold is None else float(
+            threshold)
+        out: Dict[str, dict] = {}
+        for (domain, regime), st in self._drift.items():
+            pred = st.predicted_s / st.count if st.count else 0.0
+            meas = st.measured_s / st.count if st.count else 0.0
+            ratio = (meas / pred) if pred > 0 else 0.0
+            flagged = bool(
+                pred > 0 and (ratio > 1.0 + thr
+                              or ratio < 1.0 / (1.0 + thr)))
+            out.setdefault(domain, {})[regime] = {
+                "predicted_ms_per_step": pred * 1e3,
+                "measured_ms_per_step": meas * 1e3,
+                "ratio": ratio,
+                "count": st.count,
+                "flagged": flagged,
+            }
+        return out
+
+    def drift_report(self, threshold: Optional[float] = None) -> str:
+        """Human rendering of :meth:`drift_snapshot` — per-regime
+        measured/predicted ratios with a DRIFT flag past the
+        threshold. The flag is the recalibration signal: a regime the
+        machine model consistently mis-prices is exactly where
+        ``measure.calibrate`` should spend its next measurement."""
+        snap = self.drift_snapshot(threshold)
+        if not snap:
+            return "drift: no samples recorded"
+        lines = [f"{'domain':8s} {'regime':44s} {'steps':>6s} "
+                 f"{'pred ms':>9s} {'meas ms':>9s} {'meas/pred':>10s}"]
+        for domain in sorted(snap):
+            for regime in sorted(snap[domain]):
+                r = snap[domain][regime]
+                lines.append(
+                    f"{domain:8s} {regime:44s} {r['count']:>6d} "
+                    f"{r['predicted_ms_per_step']:>9.3f} "
+                    f"{r['measured_ms_per_step']:>9.3f} "
+                    f"{r['ratio']:>10.3f}"
+                    + ("  DRIFT" if r["flagged"] else ""))
+        if self.drift_regimes_dropped:
+            lines.append(f"({self.drift_regimes_dropped} regimes past "
+                         f"the {self.MAX_DRIFT_REGIMES}-regime cap "
+                         f"dropped)")
+        return "\n".join(lines)
+
+    # ---------------- fault observability ------------------------------
+    def record_faults(self, injector) -> None:
+        """Export a FaultInjector's lifetime accounting (fired sites by
+        kind, per-site hit counters) into the metrics registry, so
+        chaos runs (ci.sh 1g) are inspectable post-hoc. Absolute-set:
+        the injector already accumulates."""
+        if not self.enabled or injector is None:
+            return
+        for site, kinds in getattr(injector, "fired", {}).items():
+            for kind, n in kinds.items():
+                self.metrics.counter_set("fault_fired_total", n,
+                                         site=site, kind=kind)
+        for site, n in getattr(injector, "_count", {}).items():
+            self.metrics.counter_set("fault_site_hits_total", n,
+                                     site=site)
+
+    # ---------------- exporters ----------------------------------------
+    def export_chrome_trace(self, path: str) -> str:
+        """Write the event buffer as Chrome trace-event JSON (the
+        ``{"traceEvents": [...]}`` object form) loadable in Perfetto /
+        ``chrome://tracing``. Tracks become pid/tid pairs with ``M``
+        metadata naming them; ts/dur are microseconds on the trace
+        clock. Returns the path written."""
+        pids: Dict[str, int] = {}
+        tids: Dict[Tuple[str, str], int] = {}
+        out: List[dict] = []
+        for ph, track, name, ts, dur, ident, args in list(self.events):
+            proc, thread = track
+            pid = pids.setdefault(proc, len(pids) + 1)
+            tid = tids.setdefault(track, len(tids) + 1)
+            ev = {"ph": ph, "name": name, "pid": pid, "tid": tid,
+                  "ts": ts * 1e6, "cat": proc}
+            if ph == "X":
+                ev["dur"] = dur * 1e6
+            elif ph == "i":
+                ev["s"] = "t"
+            elif ph in ("b", "e"):
+                ev["id"] = str(ident)
+            elif ph == "C":
+                ev["args"] = {name: dur}  # dur slot carries the value
+            if args and ph != "C":
+                ev["args"] = dict(args)
+            out.append(ev)
+        meta: List[dict] = []
+        for proc, pid in pids.items():
+            meta.append({"ph": "M", "name": "process_name", "pid": pid,
+                         "tid": 0, "args": {"name": proc}})
+        for (proc, thread), tid in tids.items():
+            meta.append({"ph": "M", "name": "thread_name",
+                         "pid": pids[proc], "tid": tid,
+                         "args": {"name": thread}})
+        doc = {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)  # no partially-written trace is visible
+        return path
+
+    def metrics_snapshot(self) -> dict:
+        """The full machine-readable snapshot: metrics + drift + event
+        accounting — what serve_bench/train_bench embed into their
+        BENCH_*.json records."""
+        return {
+            "metrics": self.metrics.snapshot(),
+            "drift": self.drift_snapshot(),
+            "events_buffered": len(self.events),
+            "events_dropped": self.dropped_events,
+        }
+
+    def to_prometheus(self) -> str:
+        return self.metrics.to_prometheus()
+
+    def clear(self) -> None:
+        self.events.clear()
+        self.dropped_events = 0
+
+
+# one shared disabled instance: the off path costs an attribute read
+_DISABLED = Telemetry(enabled=False, max_events=1)
+
+
+def telemetry_for(config=None) -> Telemetry:
+    """The Telemetry a subsystem should use (the ``injector_for``
+    idiom): a FRESH enabled bus when ``config.telemetry`` or
+    ``config.trace_out`` asks for one — each engine/model gets its own
+    buffer — else the shared disabled instance (recording is a no-op
+    attribute check)."""
+    if config is not None and (getattr(config, "telemetry", False)
+                               or getattr(config, "trace_out", None)):
+        return Telemetry(
+            enabled=True,
+            max_events=int(getattr(config, "telemetry_buffer_events",
+                                   65536)),
+            drift_threshold=float(getattr(config,
+                                          "telemetry_drift_threshold",
+                                          0.5)))
+    return _DISABLED
+
+
+# ---------------------------------------------------------------------------
+# Canonical metric definitions — serve_report/train_report render FROM
+# these snapshots, and the exporters publish the same registry, so the
+# human report and the machine numbers share one source of truth.
+# ---------------------------------------------------------------------------
+
+def serve_metrics(stats: dict,
+                  registry: Optional[MetricsRegistry] = None
+                  ) -> MetricsRegistry:
+    """Fold one ServeEngine.last_stats dict into a MetricsRegistry:
+    counters for tokens/requests/robustness events, gauges for
+    rates/occupancy, histograms for TTFT / TPOT (per-token decode
+    latency — each decode step's wall time divided over the tokens it
+    produced, the batched-decode amortization) and request latency.
+    Pass the engine's registry to ACCUMULATE across generate() calls
+    (counters add, gauges overwrite, histograms extend); the default
+    fresh registry is what serve_report renders from."""
+    m = registry if registry is not None else MetricsRegistry()
+    for r in stats.get("requests", []):
+        m.inc("serve_requests_total",
+              outcome=r.get("outcome", "completed"))
+        if r.get("ttft_s") is not None:
+            m.observe("serve_ttft_seconds", r["ttft_s"])
+        if r.get("latency_s") is not None:
+            m.observe("serve_request_latency_seconds", r["latency_s"])
+    for t, w in zip(stats.get("decode_step_times_s", []),
+                    stats.get("decode_widths", [])):
+        if w > 0:
+            m.observe("serve_tpot_seconds", t / w)
+    m.inc("serve_tokens_generated_total",
+          stats.get("total_new_tokens", 0))
+    m.inc("serve_engine_steps_total", stats.get("steps", 0))
+    m.inc("serve_decode_steps_total", stats.get("decode_steps", 0))
+    m.inc("serve_prompt_tokens_total",
+          stats.get("prompt_tokens_total", 0))
+    m.inc("serve_prefill_tokens_computed_total",
+          stats.get("prefill_tokens_computed", 0))
+    m.inc("serve_prefix_hit_tokens_total",
+          stats.get("prefix_hit_tokens", 0))
+    m.inc("serve_preemptions_total", stats.get("preemptions", 0))
+    m.inc("serve_retries_total", stats.get("retries", 0))
+    for k in ("cancelled", "deadline_expired", "rejected"):
+        m.inc(f"serve_{k}_total", stats.get(k, 0))
+    for rung, n in enumerate(stats.get("rung_steps") or []):
+        m.inc("serve_rung_steps_total", n, rung=rung)
+    m.inc("serve_spec_drafted_tokens_total",
+          stats.get("spec_drafted_tokens", 0))
+    m.inc("serve_spec_accepted_tokens_total",
+          stats.get("spec_accepted_tokens", 0))
+    m.set("serve_wall_seconds", stats.get("wall_s", 0.0))
+    m.set("serve_tokens_per_sec", stats.get("tokens_per_sec", 0.0))
+    m.set("serve_pool_occupancy_peak", stats.get("page_util_max", 0.0))
+    m.set("serve_pool_occupancy_mean", stats.get("page_util_mean", 0.0))
+    pt = stats.get("prompt_tokens_total", 0)
+    m.set("serve_prefix_hit_rate",
+          stats.get("prefix_hit_tokens", 0) / pt if pt else 0.0)
+    m.set("serve_spec_acceptance", stats.get("spec_acceptance", 0.0))
+    m.set("serve_steps_per_decode_token",
+          stats.get("steps_per_decode_token", 0.0))
+    m.set("serve_degradation_rung_max",
+          stats.get("degradation_rung_max", 0))
+    for prog, n in (stats.get("compile_counts") or {}).items():
+        m.counter_set("serve_compiled_programs", n, program=prog)
+    # engine-lifetime prefix-cache counters track their own totals
+    for k, v in (stats.get("cache") or {}).items():
+        if isinstance(v, (int, float)):
+            m.counter_set(f"serve_prefix_cache_{k}_total", v)
+    return m
+
+
+def train_metrics(stats: dict,
+                  registry: Optional[MetricsRegistry] = None
+                  ) -> MetricsRegistry:
+    """Fold one fit() run's last_train_stats into a MetricsRegistry —
+    the source train_report renders from and train_bench exports."""
+    m = registry if registry is not None else MetricsRegistry()
+    if not stats:
+        return m
+    m.inc("train_dispatches_total", stats.get("dispatches", 0))
+    m.set("train_dispatch_depth", stats.get("dispatch_depth", 0))
+    m.set("train_max_in_flight", stats.get("max_in_flight", 0))
+    m.set("train_in_flight_at_exit", stats.get("in_flight_at_exit", 0))
+    m.set("train_dispatch_gap_seconds_mean",
+          stats.get("dispatch_gap_s_mean", 0.0))
+    m.set("train_dispatch_gap_seconds_p50",
+          stats.get("dispatch_gap_s_p50", 0.0))
+    m.set("train_dispatch_gap_seconds_max",
+          stats.get("dispatch_gap_s_max", 0.0))
+    m.set("train_fetch_wait_seconds_total",
+          stats.get("fetch_wait_s_total", 0.0))
+    m.set("train_fetch_wait_seconds_max",
+          stats.get("fetch_wait_s_max", 0.0))
+    m.set("train_data_parallel", stats.get("data_parallel", 1))
+    m.set("train_est_comm_hidden", stats.get("est_comm_hidden", 0.0))
+    b = stats.get("grad_buckets") or {}
+    m.set("train_grad_buckets", b.get("count", 0))
+    m.set("train_grad_bucket_mb", b.get("bucket_mb", 0.0))
+    for i, nbytes in enumerate(b.get("bytes", []) or []):
+        m.set("train_grad_bucket_bytes", nbytes, bucket=i)
+    return m

@@ -105,6 +105,20 @@ env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
     python tools/serve_bench.py --smoke --workload shard \
     -o /tmp/ci_bench_serve_shard.json || fail=1
 
+echo "--- 1k. telemetry smoke (trace export + metrics + <=3% overhead gate)"
+# telemetry-on serving must be token-identical to telemetry-off with
+# zero recompiles at <= 3% wall overhead (min paired on/off block
+# ratio, order-alternating interleave); the
+# exported Chrome trace must load with well-formed per-request/per-step
+# tracks (every ts/dur/pid/tid checked), the Prometheus text must
+# parse, the metrics snapshot must carry the required TTFT/TPOT/pool/
+# robustness keys, and drift_report must price every measured serve
+# regime (tools/serve_bench.py --workload telemetry,
+# docs/observability.md)
+env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload telemetry \
+    --trace-out /tmp/ci_serve_trace.json \
+    -o /tmp/ci_bench_serve_telemetry.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \

@@ -224,6 +224,60 @@ def sim_overlap_record(args):
     }
 
 
+def telemetry_record(args):
+    """A small telemetry-on fit() over the bench transformer: exports
+    the train metrics snapshot (dispatch gaps, fetch waits, window
+    stats) and the train half of the simulator-drift calibration
+    (measured wall/step vs the overlap-exact graph's prediction) into
+    the BENCH artifact — the perf trajectory carries the numbers the
+    string report renders (docs/observability.md)."""
+    from flexflow_tpu import FFConfig, SGDOptimizer
+    from flexflow_tpu.models.transformer import build_transformer
+
+    cfg = FFConfig(batch_size=args.batch)
+    cfg.telemetry = True
+    ff = build_transformer(
+        cfg, batch_size=args.batch, seq_len=args.seq,
+        hidden=args.hidden, num_heads=4, num_layers=args.layers,
+        ff_dim=args.hidden * 2, num_classes=10)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type="sparse_categorical_crossentropy", metrics=[])
+    rng = np.random.RandomState(0)
+    n = args.batch * 4
+    x = {"input": rng.randn(n, args.seq, args.hidden)}
+    y = rng.randint(0, 10, (n,)).astype(np.int64)
+    ff.fit(x, y, epochs=2, verbose=False)
+    tel = ff.telemetry
+    snap = tel.metrics_snapshot()
+    drift = snap["drift"].get("train", {})
+    st = ff.last_train_stats
+    return {
+        "metric": "train_telemetry_profile",
+        "value": st["dispatches"],
+        "unit": "dispatches",
+        "extra": {
+            "dispatch_gap_ms_mean": round(
+                st["dispatch_gap_s_mean"] * 1e3, 4),
+            "dispatch_gap_ms_p50": round(
+                st["dispatch_gap_s_p50"] * 1e3, 4),
+            "dispatch_gap_ms_max": round(
+                st["dispatch_gap_s_max"] * 1e3, 4),
+            "fetch_wait_ms_total": round(
+                st["fetch_wait_s_total"] * 1e3, 3),
+            "max_in_flight": st["max_in_flight"],
+            "events_buffered": snap["events_buffered"],
+            "drift_ratio_by_regime": {
+                reg: round(d["ratio"], 2) for reg, d in drift.items()},
+            "drift_predicted_ms_per_step": {
+                reg: round(d["predicted_ms_per_step"], 4)
+                for reg, d in drift.items()},
+            "drift_measured_ms_per_step": {
+                reg: round(d["measured_ms_per_step"], 4)
+                for reg, d in drift.items()},
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -232,7 +286,8 @@ def main():
                          "bit-identical losses, zero recompiles after "
                          "warmup")
     ap.add_argument("--workload", choices=("all", "dlrm", "transformer",
-                                           "sim"), default="all")
+                                           "sim", "telemetry"),
+                    default="all")
     ap.add_argument("--steps", type=int, default=64)
     ap.add_argument("--repeat", type=int, default=3)
     ap.add_argument("--warmup", type=int, default=8)
@@ -263,7 +318,8 @@ def main():
     records = []
     gates = []
     workloads = (["dlrm", "transformer"] if args.workload == "all"
-                 else [args.workload] if args.workload != "sim" else [])
+                 else [args.workload]
+                 if args.workload in ("dlrm", "transformer") else [])
     for model in workloads:
         log(f"{model}: sync arm ({args.steps} steps x{args.repeat})...")
         t_sync, l_sync, s_sync = run_arm(model, args, overlap=False)
@@ -321,6 +377,19 @@ def main():
                 f"simulator prices overlapped sync SLOWER than "
                 f"serialized ({rec['value']}x)")
             gates.append(f"sim_reduction={rec['value']}x>=1.0x")
+
+    if args.workload in ("all", "telemetry"):
+        log("telemetry profile (telemetry-on fit + drift)...")
+        rec = telemetry_record(args)
+        records.append(rec)
+        log(f"telemetry: {rec['value']} dispatches, drift regimes: "
+            f"{list(rec['extra']['drift_ratio_by_regime'])}")
+        if args.smoke:
+            assert rec["extra"]["events_buffered"] > 0, (
+                "telemetry-on fit recorded no events")
+            assert rec["extra"]["drift_ratio_by_regime"], (
+                "telemetry-on fit recorded no train drift regimes")
+            gates.append("telemetry_profile+drift recorded")
 
     # merge-by-metric (serve_bench convention): partial --workload runs
     # never clobber the other records
