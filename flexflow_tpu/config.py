@@ -122,6 +122,18 @@ class FFConfig:
     # drift_report() flags a regime when measured/predicted leaves
     # [1/(1+thr), 1+thr] — 0.5 means "off by more than 1.5x either way"
     telemetry_drift_threshold: float = 0.5
+    # live scrape endpoint (utils/telemetry.MetricsServer): serve
+    # /metrics (Prometheus text from the engine's lifetime registry)
+    # and /healthz from a stdlib http.server thread. None = off;
+    # 0 = bind an ephemeral port (the bound port is on
+    # engine.metrics_server.port); N = that port. Setting it also
+    # enables telemetry (the registry must be live to scrape). The
+    # ROADMAP replica-autoscaler polls this. --metrics-port.
+    metrics_port: Optional[int] = None
+    # bind address for the scrape endpoint: loopback by default (safe
+    # on shared hosts); set "0.0.0.0" to expose it to a pod/host
+    # network scraper. --metrics-host.
+    metrics_host: str = "127.0.0.1"
 
     # ---- async/overlap training runtime (core/overlap.py) ----
     # bucketed, backward-overlapped gradient sync: the walk's weighted
@@ -223,6 +235,22 @@ class FFConfig:
     # DOT export of the simulated task graph (reference --taskgraph,
     # simulator.cc:508-556); written by the first simulate() of a search.
     taskgraph_file: Optional[str] = None
+    # Perfetto export of the WINNING strategy's simulated event-loop
+    # schedule (Simulator.export_schedule): per-resource tracks,
+    # critical-path flags, exact makespan metadata — the visual twin
+    # of a measured --trace-out trace. Written at the end of optimize.
+    # --schedule-trace.
+    schedule_trace_file: Optional[str] = None
+    # per-proposal search tracing (search/trace.SearchTrace): every
+    # MCMC proposal (iteration, chain, op moved, delta-cost,
+    # accept/reject, delta-vs-full path) lands in a bounded ring with
+    # convergence diagnostics (acceptance by phase, best-cost curve)
+    # surfaced in search_report / BENCH_search.json. Pure host-side
+    # observation: traced and untraced searches are bit-identical at
+    # the same seed. The native C++ walk is untraced (its loop lives
+    # in csrc/mcmc.cc): use_native=False gets diagnostics there.
+    # --no-search-trace disables.
+    search_trace: bool = True
 
     # MoE dispatch path: "auto" uses dense GShard masks (MXU-friendly,
     # clean EP all-to-alls) until the mask would exceed
@@ -532,6 +560,11 @@ class FFConfig:
             raise ValueError(
                 f"telemetry_drift_threshold must be >= 0, got "
                 f"{self.telemetry_drift_threshold}")
+        if self.metrics_port is not None and not (
+                0 <= int(self.metrics_port) <= 65535):
+            raise ValueError(
+                f"metrics_port must be None (off) or 0..65535 "
+                f"(0 = ephemeral), got {self.metrics_port}")
         if self.fault_spec:
             # parse eagerly so a typo'd spec fails at config time, not
             # silently mid-chaos-run
@@ -605,6 +638,9 @@ class FFConfig:
         "--trace-dir": ("trace_dir", str),
         "--telemetry-buffer": ("telemetry_buffer_events", int),
         "--drift-threshold": ("telemetry_drift_threshold", float),
+        "--metrics-port": ("metrics_port", int),
+        "--metrics-host": ("metrics_host", str),
+        "--schedule-trace": ("schedule_trace_file", str),
     }
     _BOOL_FLAGS = {
         "--profiling": "profiling",
@@ -635,6 +671,7 @@ class FFConfig:
         "--no-prefix-cache": "serve_prefix_cache",
         "--no-spec-decode": "serve_spec_decode",
         "--no-degrade-ladder": "serve_degrade_ladder",
+        "--no-search-trace": "search_trace",
     }
 
     def parse_args(self, argv: Sequence[str]) -> None:

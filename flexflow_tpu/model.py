@@ -982,12 +982,13 @@ class FFModel:
                                 else epoch == start_epoch)
                     if steps and not compiled:
                         pred = self._predicted_step_s()
-                        if pred:
+                        if pred and pred[0]:
                             tel.record_drift(
                                 "train",
                                 f"bs={bs} group={group} "
                                 f"accum={grad_accum_steps}",
-                                pred, (t1pc - t0pc) / steps)
+                                pred[0], (t1pc - t0pc) / steps,
+                                breakdown=pred[1])
                 out = {"epoch": epoch,
                        "loss": agg.get("loss", 0.0) / max(1, loss_terms),
                        "throughput": steps * bs / dt}
@@ -1068,12 +1069,14 @@ class FFModel:
             "est_comm_hidden": est_hidden,
         }
 
-    def _predicted_step_s(self) -> Optional[float]:
-        """The cost stack's predicted seconds per training step for
-        THIS model on its mesh/strategy — the overlap-exact task graph
-        the strategy search prices (search/simulator.Simulator), which
-        is exactly what the telemetry drift calibrator must compare
-        measured steps against. Cached on the model for the duration
+    def _predicted_step_s(self) -> Optional[tuple]:
+        """(predicted seconds per training step, per-task-class
+        breakdown) for THIS model on its mesh/strategy — the
+        overlap-exact task graph the strategy search prices
+        (search/simulator.Simulator), which is exactly what the
+        telemetry drift calibrator must compare measured steps against
+        (the breakdown is the attribution vector drift_report folds
+        per task class). Cached on the model for the duration
         of one fit() — fit's prologue drops the cache, so a strategy/
         mesh/bucket change between fits re-prices and a transient
         failure cannot latch None forever; None when the model/mesh
@@ -1086,12 +1089,68 @@ class FFModel:
                 if mesh is None:
                     mesh = make_mesh((1,), ("data",))
                 sim = Simulator(self, mesh)
-                self._drift_predicted_step_s = float(sim.simulate(
-                    self.strategy if self.strategy is not None
-                    else Strategy()))
+                strat = (self.strategy if self.strategy is not None
+                         else Strategy())
+                self._drift_predicted_step_s = (
+                    float(sim.simulate(strat)),
+                    sim.step_breakdown(strat))
             except Exception:
                 self._drift_predicted_step_s = None
         return self._drift_predicted_step_s
+
+    def memory_ledger(self) -> dict:
+        """Per-device HBM byte accounting for training — params and
+        optimizer state from the LIVE device buffers (shard-aware
+        nbytes, search/explain.pytree_device_bytes) next to the
+        simulator's HBM-penalty input (Simulator.memory_per_device —
+        weights + optimizer mirror + activation estimate per op), with
+        the residual reported as the activation estimate. Components
+        land as ``train_hbm_bytes{component=...}`` gauges when a fit()
+        telemetry bus is live."""
+        from .search.explain import pytree_device_bytes
+        params = opt = 0.0
+        if self.state is not None:
+            params = pytree_device_bytes(self.state.params)
+            opt = pytree_device_bytes(self.state.opt_state)
+        sim_bytes = None
+        try:
+            from .parallel.pconfig import Strategy
+            from .search.simulator import Simulator
+            mesh = self.mesh
+            if mesh is None:
+                mesh = make_mesh((1,), ("data",))
+            sim = Simulator(self, mesh)
+            sim_bytes = float(sim.memory_per_device(
+                self.strategy if self.strategy is not None
+                else Strategy()))
+            hbm = float(sim.mm.spec.hbm_capacity)
+        except Exception:
+            hbm = None
+        ledger = {
+            "params_bytes": params,
+            "optimizer_bytes": opt,
+            "live_bytes": params + opt,
+            "sim_hbm_input_bytes": sim_bytes,
+            # the cost model's activation/workspace share: its memory
+            # input beyond the live persistent buffers
+            "activation_est_bytes": (max(0.0, sim_bytes - params - opt)
+                                     if sim_bytes is not None else None),
+        }
+        if hbm:
+            ledger["hbm_capacity_bytes"] = hbm
+            ledger["hbm_utilization"] = (
+                (sim_bytes if sim_bytes is not None
+                 else params + opt) / hbm)
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            for comp in ("params", "optimizer", "live"):
+                tel.metrics.set("train_hbm_bytes",
+                                ledger[f"{comp}_bytes"],
+                                component=comp)
+            if sim_bytes is not None:
+                tel.metrics.set("train_hbm_bytes", sim_bytes,
+                                component="sim_hbm_input")
+        return ledger
 
     def evaluate(self, x: Dict[str, np.ndarray], y: np.ndarray,
                  batch_size: Optional[int] = None,

@@ -288,7 +288,7 @@ def optimize_with_mesh(model, budget: int = 1000, alpha: float = 0.05,
     else:
         annealed = [anneal_shape(s) for s in shapes]
 
-    best = None  # (cost, strategy, mesh, sim, pipeline_knobs)
+    best = None  # (cost, strategy, mesh, sim, pipeline_knobs, stats)
     agg_stats: Dict[str, object] = {}
     for shape, mesh, sim, found, cost, stats in annealed:
         strat = _interleaved_upgrade(model, cfg, mesh, sim, found,
@@ -301,14 +301,19 @@ def optimize_with_mesh(model, budget: int = 1000, alpha: float = 0.05,
         if verbose:
             print(f"[search/mesh] {shape}: {cost*1e3:.3f} ms/step")
         if best is None or cost < best[0]:
-            best = (cost, strat, mesh, sim, knobs)
+            best = (cost, strat, mesh, sim, knobs, stats)
     cfg.pipeline_stages, cfg.pipeline_virtual_stages = best[4]
+    # _merge_stats last-wins on nested dicts; the convergence trace the
+    # report should show is the WINNING shape's walk, not the last one
+    if "trace" in best[5]:
+        agg_stats["trace"] = best[5]["trace"]
     if verbose:
         print(f"[search/mesh] best: {dict(best[2].shape)} "
               f"at {best[0]*1e3:.3f} ms/step")
     if cfg.taskgraph_file:  # re-export for the WINNING mesh (inner runs
         # each wrote their own shape's graph; last is not best)
         best[3].simulate(best[1], dot_path=cfg.taskgraph_file)
+    _export_schedule_trace(cfg, best[3], best[1], agg_stats)
     best[3].flush_cost_cache()
     # per-shape wall times overlap in the pool — summing them (what
     # _merge_stats did for the counters) would understate proposals/sec
@@ -400,7 +405,7 @@ def _interleaved_upgrade(model, cfg, mesh, sim, best, best_cost=None,
 def _anneal_chain(model, sim: Simulator, cands, staged, edges,
                   searchable, init: Strategy, init_cost: float,
                   budget: int, alpha: float, seed: int,
-                  verbose: bool, chain: int = 0):
+                  verbose: bool, chain: int = 0, trace=None):
     """One annealing chain (the body of the reference FFModel::optimize
     loop, model.cc:1905-1968) over `sim`. Proposal costs come from the
     DELTA path (simulate_delta: re-cost only the moved op, replay the
@@ -409,13 +414,19 @@ def _anneal_chain(model, sim: Simulator, cands, staged, edges,
     or placement flips — fall back to a full simulate() and rebase the
     template. A periodic re-sync full-simulates the current strategy
     and counts any divergence (stats["drift_resyncs"]); the delta
-    replay is exact, so a nonzero count means a bug, not noise."""
+    replay is exact, so a nonzero count means a bug, not noise.
+
+    `trace` (search/trace.SearchTrace) records every proposal — pure
+    observation AFTER each accept decision, so traced walks consume
+    the RNG identically to untraced ones (bit-identical results)."""
     cfg = model.config
     rng = random.Random(seed)
     current = init.copy()
     cur_cost = init_cost
     best, best_cost = current.copy(), cur_cost
     delta_on = sim.delta_rebase(current)
+    if trace is not None:
+        trace.record_best(-1, chain, best_cost)
 
     reset_every = max(1, budget // 100)
     resync_every = max(64, reset_every)
@@ -441,15 +452,22 @@ def _anneal_chain(model, sim: Simulator, cands, staged, edges,
             nxt = rng.choice(staged).copy()
             nxt_cost = sim.simulate(nxt)
             delta = nxt_cost - cur_cost
-            if delta <= 0 or rng.random() < math.exp(
-                    -delta / max(1e-12, alpha * cur_cost)):
+            temp = alpha * cur_cost
+            accepted = delta <= 0 or rng.random() < math.exp(
+                -delta / max(1e-12, temp))
+            if accepted:
                 current, cur_cost = nxt, nxt_cost
                 delta_on = sim.delta_rebase(current)
                 if cur_cost < best_cost:
                     best, best_cost = current.copy(), cur_cost
+                    if trace is not None:
+                        trace.record_best(it, chain, best_cost)
                     if verbose:
                         print(f"[search] iter {it}: staged pipeline "
                               f"{best_cost*1e3:.3f} ms/step")
+            if trace is not None:
+                trace.record(it, chain, "staged", None, delta,
+                             accepted, temp, "full")
             continue
         # rewrite/propagate moves mutate `current` IN PLACE (one op's
         # entry swapped, restored on rejection) — copying the whole
@@ -463,14 +481,17 @@ def _anneal_chain(model, sim: Simulator, cands, staged, edges,
             m = current.for_op(src.name).axis_map
             if m in cands.get(dst.name, []):
                 changed, new_map = dst.name, dict(m)
+                kind = "propagate"
             else:
                 op = rng.choice(searchable)
                 changed = op.name
                 new_map = dict(rng.choice(cands[op.name]))
+                kind = "rewrite"
         else:
             op = rng.choice(searchable)
             changed = op.name
             new_map = dict(rng.choice(cands[op.name]))
+            kind = "rewrite"
         # .get: after an accepted staged jump `current` only carries
         # the pinned ops' entries (for_op falls back to the default)
         prev = current.op_strategies.get(changed)
@@ -479,14 +500,18 @@ def _anneal_chain(model, sim: Simulator, cands, staged, edges,
         tok = sim.simulate_delta(current, (changed,)) if delta_on else None
         nxt_cost = tok.cost if tok is not None else sim.simulate(current)
         delta = nxt_cost - cur_cost
-        if delta <= 0 or rng.random() < math.exp(
-                -delta / max(1e-12, alpha * cur_cost)):
+        temp = alpha * cur_cost
+        accepted = delta <= 0 or rng.random() < math.exp(
+            -delta / max(1e-12, temp))
+        if accepted:
             cur_cost = nxt_cost
             if tok is None:
                 # structural move accepted outside the template
                 delta_on = sim.delta_rebase(current)
             if cur_cost < best_cost:
                 best, best_cost = current.copy(), cur_cost
+                if trace is not None:
+                    trace.record_best(it, chain, best_cost)
                 if verbose:
                     print(f"[search] iter {it}: {best_cost*1e3:.3f} ms/step")
         else:
@@ -496,6 +521,9 @@ def _anneal_chain(model, sim: Simulator, cands, staged, edges,
                 current.op_strategies[changed] = prev
             if tok is not None:
                 sim.delta_reject(tok)
+        if trace is not None:
+            trace.record(it, chain, kind, changed, delta, accepted,
+                         temp, "delta" if tok is not None else "full")
 
     if verbose:
         print(f"[search] chain {chain} best estimated step time: "
@@ -534,6 +562,8 @@ def _optimize_impl(model, budget: int, alpha: float, mesh, seed: int,
     cands = {op.name: candidate_maps(op, mesh, cfg, op_index=i)
              for i, op in enumerate(model.ops)}
     t0 = time.perf_counter()
+    trace = None  # per-proposal search tracing (search/trace.py);
+    # created once the per-chain budget is known below
 
     def stats_for(sims, proposals):
         out: Dict[str, object] = {}
@@ -544,6 +574,8 @@ def _optimize_impl(model, budget: int, alpha: float, mesh, seed: int,
         out["wall_s"] = time.perf_counter() - t0
         out["proposals_per_sec"] = (proposals / out["wall_s"]
                                     if out["wall_s"] > 0 else 0.0)
+        if trace is not None:
+            out["trace"] = trace.summary()
         return out
 
     # graph-PP staged candidates: a staged strategy's simulated cost is
@@ -598,6 +630,9 @@ def _optimize_impl(model, budget: int, alpha: float, mesh, seed: int,
     # the walk, they don't multiply the work) and the best strategy
     # across chains wins, ties to the lowest chain id for determinism.
     per_chain = max(1, budget // chains)
+    if getattr(cfg, "search_trace", True):
+        from .trace import SearchTrace
+        trace = SearchTrace(budget=per_chain, chains=chains)
     sims = [sim] + [Simulator(model, mesh, sim.mm,
                               overlap_backward_sync=sim.overlap)
                     for _ in range(chains - 1)]
@@ -609,7 +644,7 @@ def _optimize_impl(model, budget: int, alpha: float, mesh, seed: int,
         return _anneal_chain(model, sims[k], cands, staged, edges,
                              searchable, init, init_cost, per_chain,
                              alpha, _chain_seed(seed, k), verbose,
-                             chain=k)
+                             chain=k, trace=trace)
 
     if chains == 1:
         results = [run_chain(0)]
@@ -671,6 +706,23 @@ def optimize(model, budget: int = 1000, alpha: float = 0.05,
                                     best_cost=best_cost, verbose=verbose)
     if cfg.taskgraph_file:
         sim.simulate(strategy, dot_path=cfg.taskgraph_file)
+    _export_schedule_trace(cfg, sim, strategy, stats)
     sim.flush_cost_cache()
     model.search_stats = stats
     return strategy
+
+
+def _export_schedule_trace(cfg, sim, strategy, stats) -> None:
+    """--schedule-trace: Perfetto export of the winning strategy's
+    simulated event-loop schedule (Simulator.export_schedule), summary
+    stashed in the search stats. An unwritable path must not fail the
+    search that found the strategy."""
+    path = getattr(cfg, "schedule_trace_file", None)
+    if not path:
+        return
+    try:
+        stats["schedule_trace"] = sim.export_schedule(strategy, path)
+    except OSError as e:
+        import warnings
+        warnings.warn(f"schedule-trace export to {path!r} failed "
+                      f"({type(e).__name__}: {e})")

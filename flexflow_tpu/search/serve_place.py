@@ -56,6 +56,9 @@ class ServePlacement:
     decode_by_degree: Dict[int, float] = dataclasses.field(
         default_factory=dict)
     fingerprint: str = ""
+    # convergence diagnostics of the placement walk
+    # (search/trace.SearchTrace.summary(); None with tracing off)
+    trace: Optional[dict] = None
 
     def speedup_vs_single(self) -> float:
         base = self.decode_by_degree.get(1)
@@ -171,10 +174,17 @@ def optimize_serve(arch: ServeArch, num_devices: int, *,
         return dec + PREFILL_WEIGHT * pre, dec, pre
 
     rng = random.Random(seed)
+    walk_budget = max(len(space), int(budget))
+    trace = None
+    if config is None or getattr(config, "search_trace", True):
+        from .trace import SearchTrace
+        trace = SearchTrace(budget=walk_budget)
     cur = (1, ())
     cur_cost, cur_dec, cur_pre = cost_of(cur)
     best, best_cost = cur, cur_cost
     best_dec, best_pre = cur_dec, cur_pre
+    if trace is not None:
+        trace.record_best(-1, 0, best_cost)
     # every legal degree is priced once up front (flat ring) so the
     # returned per-degree table is complete — the paper's exhaustive
     # per-op config enumeration, affordable here because degrees are
@@ -186,7 +196,9 @@ def optimize_serve(arch: ServeArch, num_devices: int, *,
         if c < best_cost:
             best, best_cost = (t, ()), c
             best_dec, best_pre = dec, pre
-    for _ in range(max(len(space), int(budget))):
+            if trace is not None:
+                trace.record_best(-1, 0, best_cost)
+    for it in range(walk_budget):
         nxt = space[rng.randrange(len(space))]
         if nxt == cur:
             continue
@@ -195,12 +207,21 @@ def optimize_serve(arch: ServeArch, num_devices: int, *,
         if nxt_dec < decode_by_degree.get(t, float("inf")):
             decode_by_degree[t] = nxt_dec
         delta = nxt_cost - cur_cost
-        if delta <= 0 or rng.random() < math.exp(
-                -delta / max(1e-12, alpha * cur_cost)):
+        temp = alpha * cur_cost
+        accepted = delta <= 0 or rng.random() < math.exp(
+            -delta / max(1e-12, temp))
+        if accepted:
             cur, cur_cost = nxt, nxt_cost
             if cur_cost < best_cost:
                 best, best_cost = cur, cur_cost
                 best_dec, best_pre = nxt_dec, nxt_pre
+                if trace is not None:
+                    trace.record_best(it, 0, best_cost)
+        if trace is not None:  # observation only, after the decision —
+            # traced and untraced walks consume the RNG identically
+            trace.record(it, 0, "serve_place",
+                         f"t={t} dims={tuple(nxt[1])}", delta,
+                         accepted, temp, "serve")
     if cache is not None:
         cache.flush()
     return ServePlacement(
@@ -208,4 +229,5 @@ def optimize_serve(arch: ServeArch, num_devices: int, *,
         decode_step_s=best_dec, prefill_step_s=best_pre,
         cost=best_cost, decode_by_degree=dict(
             sorted(decode_by_degree.items())),
-        fingerprint=fingerprint)
+        fingerprint=fingerprint,
+        trace=trace.summary() if trace is not None else None)

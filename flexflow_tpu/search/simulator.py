@@ -55,6 +55,14 @@ class SimTask:
     unresolved: int = 0
     ready_time: float = 0.0
     finish_time: float = 0.0
+    # schedule recording (simulate(record=True) only): the task's
+    # scheduled start (the event loop's exact float, NOT finish -
+    # duration, which re-rounds) and what bound it — the dep that set
+    # its ready time, or the previous occupant of its resource —
+    # walked backward for the critical path
+    start_time: float = 0.0
+    blocker: object = None
+    ready_by: object = None
 
 
 class TaskGraph:
@@ -67,18 +75,26 @@ class TaskGraph:
         self.tasks.append(t)
         return t
 
-    def simulate(self) -> float:
+    def simulate(self, record: bool = False) -> float:
         """Priority-queue event loop (reference simulator.cc:499-554).
         A task may occupy several resources at once (tuple resource) —
         this is how per-device concurrency is modeled: ops bound to
         disjoint device sets proceed in parallel, overlapping sets
-        serialize (reference: per-device task queues in slice_task)."""
+        serialize (reference: per-device task queues in slice_task).
+
+        ``record=True`` additionally stamps each task's binding
+        constraint (``blocker``: the dep that set its ready time, or
+        the resource's previous occupant when the task waited on the
+        resource instead) so :meth:`critical_path` can walk the chain
+        that determined the makespan. The recording branch is gated so
+        the annealing hot path pays nothing for it."""
         children: Dict[int, List[SimTask]] = {}
         for t in self.tasks:
             t.unresolved = len(t.deps)
             for d in t.deps:
                 children.setdefault(id(d), []).append(t)
         free: Dict[object, float] = {}
+        last_occupant: Dict[object, SimTask] = {}
         counter = 0
         q = []
         for t in self.tasks:
@@ -99,23 +115,53 @@ class TaskGraph:
                 # comm/sync task exactly equivalent to no task — the
                 # invariant the delta-simulation template relies on.
                 t.finish_time = ready
+                if record:
+                    t.start_time = ready
+                    t.blocker = t.ready_by
             else:
                 keys = t.resource if isinstance(t.resource, list) \
                     else (t.resource,)
                 start = max([ready] + [free.get(k, 0.0) for k in keys])
                 t.finish_time = start + t.duration
+                if record:
+                    t.start_time = start
+                    t.blocker = t.ready_by
+                    if start > ready or t.ready_by is None:
+                        for k in keys:
+                            if free.get(k, 0.0) == start \
+                                    and k in last_occupant:
+                                t.blocker = last_occupant[k]
+                                break
+                    for k in keys:
+                        last_occupant[k] = t
                 for k in keys:
                     free[k] = t.finish_time
             makespan = max(makespan, t.finish_time)
             done += 1
             for c in children.get(id(t), []):
-                c.ready_time = max(c.ready_time, t.finish_time)
+                if t.finish_time >= c.ready_time:
+                    c.ready_time = t.finish_time
+                    if record:
+                        c.ready_by = t
                 c.unresolved -= 1
                 if c.unresolved == 0:
                     heapq.heappush(q, (c.ready_time, counter, c))
                     counter += 1
         assert done == len(self.tasks), "cycle in task graph"
         return makespan
+
+    def critical_path(self) -> set:
+        """ids of the tasks on the chain that determined the makespan
+        (valid after simulate(record=True)): start at the last-finishing
+        task and walk each task's binding constraint backward."""
+        if not self.tasks:
+            return set()
+        t = max(self.tasks, key=lambda x: x.finish_time)
+        crit = set()
+        while t is not None and id(t) not in crit:
+            crit.add(id(t))
+            t = t.blocker
+        return crit
 
     def export_dot(self, path: str) -> None:
         """Taskgraph DOT export (reference --taskgraph, simulator.h DotFile)."""
@@ -135,6 +181,31 @@ def _axis_sig(s) -> tuple:
     """Hashable signature of one op's axis map — the in-memory cost-cache
     key and the delta template's change detector."""
     return tuple(sorted((k, str(v)) for k, v in s.axis_map.items()))
+
+
+def _res_label(res) -> str:
+    """Human label of one simulator resource key."""
+    if isinstance(res, list):
+        if "compute" in res:
+            return "compute"
+        return "dev " + ",".join(str(k[1]) for k in res)
+    if isinstance(res, tuple):
+        if res[0] == "dev":
+            return f"dev {res[1]}"
+        if res[0] == "stage":
+            return f"{res[1]} stage {res[2]}"
+        return " ".join(str(p) for p in res)
+    return str(res)
+
+
+def _res_track(res):
+    """(process, thread) track of a simulator resource — one Perfetto
+    row per contended resource, so a task's placement in the trace IS
+    its placement in the event loop ("comm" renders as the ICI
+    fabric row)."""
+    if res == "comm":
+        return ("sim", "ici")
+    return ("sim", _res_label(res))
 
 
 def op_edges(model):
@@ -247,6 +318,8 @@ class Simulator:
         # delta-simulation template (simulate_delta); None until a
         # delta_rebase() established one for the current base strategy
         self._delta: Optional[_DeltaTemplate] = None
+        # last record=True event-loop graph (export_schedule)
+        self._last_graph: Optional[TaskGraph] = None
         # search instrumentation, rendered by profiling.search_report
         self.stats: Dict[str, int] = {
             "full_sims": 0, "delta_sims": 0, "delta_fallbacks": 0,
@@ -582,7 +655,8 @@ class Simulator:
         return stage_of
 
     def _simulate_staged(self, strategy: Strategy, stage_of,
-                         dot_path: Optional[str] = None):
+                         dot_path: Optional[str] = None,
+                         record: bool = False):
         """Event-loop makespan of a graph-level staged strategy: one
         pipeline covering the whole model, per-stage tick costs from the
         cost model (staged_pipeline_cost), per-stage grad sync, memory
@@ -606,7 +680,7 @@ class Simulator:
                        if n_stages % vstages == 0 else None))
         tick_step = (self._price_1f1b_ticks(pc, syncs)
                      if key[2] == "1f1b" else None)
-        if tick_step is not None and not dot_path:
+        if tick_step is not None and not dot_path and not record:
             return tick_step, self.mm.memory_penalty(mem)
         g = TaskGraph()
         exits: Dict[str, List] = {}
@@ -616,7 +690,9 @@ class Simulator:
         for k, s in enumerate(syncs):
             if s > 0:
                 g.add(f"net:sync.s{k}", s, "comm", [bwd_join])
-        step_time = g.simulate()
+        step_time = g.simulate(record)
+        if record:
+            self._last_graph = g
         if dot_path:
             g.export_dot(dot_path)
         if tick_step is not None:  # DOT exported; price stays tick-based
@@ -657,16 +733,115 @@ class Simulator:
         return ticks + sum(syncs)
 
     def _simulate_raw(self, strategy: Strategy,
-                      dot_path: Optional[str] = None):
+                      dot_path: Optional[str] = None,
+                      record: bool = False):
         """Returns (unscaled step seconds, memory penalty seconds)."""
         stage_of = self._staged_assignment(strategy)
         if stage_of is not None:
-            return self._simulate_staged(strategy, stage_of, dot_path)
+            return self._simulate_staged(strategy, stage_of, dot_path,
+                                         record)
         built = self._build_graph(strategy)
-        step_time = built.graph.simulate()
+        step_time = built.graph.simulate(record)
+        if record:
+            self._last_graph = built.graph
         if dot_path:
             built.graph.export_dot(dot_path)
         return step_time, self.mm.memory_penalty(built.total_mem)
+
+    def export_schedule(self, strategy: Strategy, path: str) -> dict:
+        """Export the simulated event-loop schedule of `strategy` as a
+        Perfetto-loadable Chrome trace (rendered through
+        utils/telemetry.Telemetry.export_chrome_trace): one track per
+        simulated resource (compute stream, ICI fabric, per-device /
+        per-stage rows), each task a complete span carrying its exact
+        start/end seconds and critical-path flag in ``args``, plus
+        anchor spans for the calibrated dispatch overhead and the HBM
+        penalty so the trace's exact end time
+        (``metadata["makespan_s"]``, = the max ``t_end_s`` over events)
+        equals :meth:`simulate`'s return for the same strategy
+        bit-exactly. Returns a summary dict (path, makespan_s, task and
+        critical-path counts)."""
+        from ..utils.telemetry import Telemetry
+        self._last_graph = None
+        step_raw, penalty = self._simulate_raw(strategy, record=True)
+        g = self._last_graph
+        # the SAME float expression simulate() evaluates — bit-equality
+        # of the trace end with the priced step time is the contract
+        total = step_raw * self.time_scale + penalty + self.step_overhead
+        crit = g.critical_path()
+        scale = self.time_scale
+        off = self.step_overhead
+        # a tick-priced 1F1B staged strategy returns the tick-table
+        # price while the recorded graph is the event-loop VISUAL —
+        # normalize the graph onto the priced span (factor is exactly
+        # 1.0 whenever the event loop IS the price, i.e. every
+        # non-staged and gpipe-staged strategy) and clamp to the
+        # anchor so the trace end stays bit-equal to simulate()
+        graph_end = max((t.finish_time for t in g.tasks), default=0.0)
+        eff = scale if graph_end == step_raw or graph_end <= 0.0 \
+            else scale * (step_raw / graph_end)
+        pen_start = off + step_raw * scale
+        events = [t for t in g.tasks if t.duration > 0.0]
+        # t0=0.0 pins the trace clock: spans carry trace-absolute
+        # simulator seconds, not wall time
+        tel = Telemetry(enabled=True, max_events=len(events) + 8,
+                        t0=0.0)
+        if off > 0.0:
+            tel.span(("sim", "host"), "step_overhead", 0.0, off,
+                     args={"t_start_s": 0.0, "t_end_s": off,
+                           "crit": False})
+        n_crit = 0
+        for t in events:
+            t0 = min(off + t.start_time * eff, pen_start)
+            t1 = min(off + t.finish_time * eff, pen_start)
+            on_crit = id(t) in crit
+            n_crit += bool(on_crit)
+            tel.span(_res_track(t.resource), t.name, t0, t1,
+                     args={"t_start_s": t0, "t_end_s": t1,
+                           "crit": bool(on_crit),
+                           "res": _res_label(t.resource)})
+        # tail anchor: the (strategy-dependent) HBM penalty closes the
+        # trace at the exact priced step time, zero-width when no
+        # penalty applies
+        tel.span(("sim", "hbm"), "hbm_penalty", pen_start, total,
+                 args={"t_start_s": pen_start, "t_end_s": total,
+                       "crit": False, "penalty_s": penalty})
+        summary = {
+            "path": path, "makespan_s": total,
+            "event_loop_s": step_raw, "time_scale": scale,
+            "hbm_penalty_s": penalty, "step_overhead_s": off,
+            "tasks": len(events), "critical_tasks": n_crit,
+            "domain": "train",
+        }
+        tel.export_chrome_trace(path, metadata=dict(summary))
+        return summary
+
+    # task classes of the drift attribution (docs/observability.md):
+    # the train half — compute fwd/bwd, the optimizer-update sweep,
+    # fwd/bwd collectives, and the DP grad sync (bucketed or per-op)
+    TRAIN_TASK_CLASSES = ("fwd", "bwd", "update", "collective",
+                          "grad_sync", "overhead")
+
+    def step_breakdown(self, strategy: Strategy) -> Dict[str, float]:
+        """Predicted seconds per task CLASS for one step of `strategy`
+        — the attribution vector the drift calibrator aligns measured
+        steps against (utils/telemetry.record_drift(breakdown=...)).
+        These are summed task durations (scaled like simulate()), not
+        makespan shares: overlapped classes intentionally sum past the
+        critical path, which is exactly what lets the least-squares
+        attribution tell WHICH term mis-prices."""
+        out = {k: 0.0 for k in self.TRAIN_TASK_CLASSES}
+        for op in self.model.ops:
+            c = self._op_cost(op, strategy)
+            out["fwd"] += c.fwd
+            out["bwd"] += c.bwd
+            out["update"] += c.update
+            out["collective"] += c.fwd_comm + c.bwd_comm
+            out["grad_sync"] += c.sync
+        s = self.time_scale
+        out = {k: v * s for k, v in out.items()}
+        out["overhead"] = self.step_overhead
+        return out
 
     def _build_graph(self, strategy: Strategy) -> "_BuiltGraph":
         """Build the (non-staged) task graph for `strategy`. Comm and
@@ -1102,22 +1277,33 @@ class Simulator:
 # Serve-step simulation (tensor-parallel sharded serving, PR 9)
 # ---------------------------------------------------------------------------
 
+def serve_task_schedule(tasks) -> Dict[str, tuple]:
+    """(start, finish) seconds per task of a serve-step task graph
+    (cost_model.serve_step_tasks): finish(t) = duration(t) +
+    max(finish(deps)). The ONE chain evaluation — the makespan
+    (simulate_serve_tasks) and the schedule export derive from this
+    same float accumulation, which is what keeps the exported trace's
+    end time bit-equal to the simulated step."""
+    sched: Dict[str, tuple] = {}
+    for t in tasks:  # serve_step_tasks emits in dependency order
+        start = max((sched[d][1] for d in t.deps if d in sched),
+                    default=0.0)
+        sched[t.name] = (start, start + t.seconds)
+    return sched
+
+
 def simulate_serve_tasks(tasks) -> float:
     """Makespan of a serve-step task graph (cost_model.serve_step_tasks)
     — the critical path over named dependencies. Tensor-parallel
     serving's collectives sit ON the critical path (each all-reduce
     feeds the very next matmul — there is no second microbatch to hide
     them behind, unlike training's bucketed grad sync), so the chain
-    evaluation IS the event loop: finish(t) = duration(t) +
-    max(finish(deps)). Kept structural (not a plain sum) so a future
-    serve graph with parallel branches (e.g. draft-LM lanes priced
-    beside the target) simulates unchanged."""
-    finish: Dict[str, float] = {}
-    for t in tasks:  # serve_step_tasks emits in dependency order
-        start = max((finish[d] for d in t.deps if d in finish),
-                    default=0.0)
-        finish[t.name] = start + t.seconds
-    return max(finish.values(), default=0.0)
+    evaluation IS the event loop (serve_task_schedule). Kept
+    structural (not a plain sum) so a future serve graph with parallel
+    branches (e.g. draft-LM lanes priced beside the target) simulates
+    unchanged."""
+    return max((f for _, f in serve_task_schedule(tasks).values()),
+               default=0.0)
 
 
 def simulate_serve_step(arch, tensor_parallel: int,
@@ -1145,3 +1331,96 @@ def simulate_serve_step(arch, tensor_parallel: int,
         lanes=int(arch.decode_lanes if lanes is None else lanes)))
     return step + mm.memory_penalty(
         serve_device_bytes(arch, tensor_parallel))
+
+
+# task classes of the serve drift attribution: the paged-attention
+# kernel, the dense matmuls (qkv/wo/ffn/head/embed), and the tensor-
+# parallel collectives (all-reduces + the logits all-gather)
+SERVE_TASK_CLASSES = ("attention", "matmul", "collective")
+
+
+def serve_task_class(task) -> str:
+    """Attribution class of one ServeTask (cost_model.serve_step_tasks
+    names are stable: ``l{i}.attn`` is the paged-attention kernel)."""
+    if task.kind == "collective":
+        return "collective"
+    if task.name.endswith(".attn"):
+        return "attention"
+    return "matmul"
+
+
+def serve_step_breakdown(arch, tensor_parallel: int,
+                         mm: Optional[TPUMachineModel] = None, *,
+                         lanes: Optional[int] = None,
+                         axis_dims: tuple = ()) -> Dict[str, float]:
+    """Predicted seconds per task class of ONE mixed serving step —
+    the serve half of the drift attribution vector. The serve graph is
+    a serial chain, so the classes (plus the HBM penalty) sum exactly
+    to :func:`simulate_serve_step`."""
+    from .cost_model import SERVE_AXIS, serve_device_bytes, \
+        serve_step_tasks
+    if mm is None:
+        mm = default_machine_model()
+    if axis_dims:
+        mm = dataclasses.replace(
+            mm, axis_topology={**mm.axis_topology,
+                               SERVE_AXIS: tuple(axis_dims)})
+    out = {k: 0.0 for k in SERVE_TASK_CLASSES}
+    for t in serve_step_tasks(
+            arch, tensor_parallel, mm,
+            lanes=int(arch.decode_lanes if lanes is None else lanes)):
+        out[serve_task_class(t)] += t.seconds
+    out["hbm_penalty"] = mm.memory_penalty(
+        serve_device_bytes(arch, tensor_parallel))
+    return out
+
+
+def export_serve_schedule(arch, tensor_parallel: int, path: str,
+                          mm: Optional[TPUMachineModel] = None, *,
+                          lanes: Optional[int] = None,
+                          axis_dims: tuple = ()) -> dict:
+    """Perfetto-loadable export of the simulated serve-step schedule
+    (the serving mirror of Simulator.export_schedule): one track per
+    task class, every task a complete span with exact start/end seconds
+    in ``args``, an ``hbm_penalty`` anchor closing the trace at exactly
+    :func:`simulate_serve_step`'s return for the same placement
+    (``metadata["makespan_s"]``). The serve chain is serial, so every
+    task is on the critical path by construction."""
+    from ..utils.telemetry import Telemetry
+    from .cost_model import SERVE_AXIS, serve_device_bytes, \
+        serve_step_tasks
+    if mm is None:
+        mm = default_machine_model()
+    if axis_dims:
+        mm = dataclasses.replace(
+            mm, axis_topology={**mm.axis_topology,
+                               SERVE_AXIS: tuple(axis_dims)})
+    tasks = serve_step_tasks(
+        arch, tensor_parallel, mm,
+        lanes=int(arch.decode_lanes if lanes is None else lanes))
+    penalty = mm.memory_penalty(
+        serve_device_bytes(arch, tensor_parallel))
+    # the SAME chain evaluation simulate_serve_tasks prices from
+    sched = serve_task_schedule(tasks)
+    tel = Telemetry(enabled=True, max_events=len(tasks) + 8, t0=0.0)
+    end = 0.0
+    for t in tasks:
+        start, finish = sched[t.name]
+        end = max(end, finish)
+        if t.seconds > 0.0:
+            tel.span(("sim", serve_task_class(t)), t.name, start,
+                     finish,
+                     args={"t_start_s": start,
+                           "t_end_s": finish, "crit": True,
+                           "kind": t.kind})
+    total = end + penalty  # simulate_serve_step's float expression
+    tel.span(("sim", "hbm"), "hbm_penalty", end, total,
+             args={"t_start_s": end, "t_end_s": total, "crit": False,
+                   "penalty_s": penalty})
+    summary = {
+        "path": path, "makespan_s": total, "event_loop_s": end,
+        "hbm_penalty_s": penalty, "tasks": len(tasks),
+        "tensor_parallel": int(tensor_parallel), "domain": "serve",
+    }
+    tel.export_chrome_trace(path, metadata=dict(summary))
+    return summary

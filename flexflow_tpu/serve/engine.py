@@ -223,7 +223,9 @@ class ServeEngine:
         self.telemetry = telemetry if telemetry is not None \
             else telemetry_for(cfg)
         self.trace_out = getattr(cfg, "trace_out", None)
-        self._drift_cache: Dict[int, Optional[float]] = {}
+        # (ctx bucket) -> (predicted step seconds, per-task-class
+        # breakdown) | None when the cost stack cannot price it
+        self._drift_cache: Dict[int, Optional[tuple]] = {}
         self._slot_tracks: List[tuple] = []  # interned per-slot track
         # pairs, so the per-step record path never rebuilds f-strings
         self.max_retries = int(getattr(cfg, "serve_max_retries", 3))
@@ -346,6 +348,20 @@ class ServeEngine:
                                              "decode": set(),
                                              "mixed": set()}
         self.last_stats: Optional[dict] = None
+        # live scrape endpoint (--metrics-port, docs/observability.md):
+        # /metrics serves the engine-lifetime registry as Prometheus
+        # text, /healthz liveness — the autoscaler's poll target.
+        # Started LAST (a construction failure above must not leak a
+        # bound port/thread), stopped by close(); scrapes read the
+        # registry from the server thread, never touching the serving
+        # hot path.
+        self.metrics_server = None
+        mport = getattr(cfg, "metrics_port", None)
+        if mport is not None:
+            from ..utils.telemetry import MetricsServer
+            self.metrics_server = MetricsServer(
+                self.telemetry.to_prometheus, port=int(mport),
+                host=str(getattr(cfg, "metrics_host", "127.0.0.1")))
 
     def _call_counted(self, name, fn, *args):
         self._shapes_seen[name].add(tuple(
@@ -1292,22 +1308,27 @@ class ServeEngine:
         self.cache.check_invariants()
 
     # ---------------- telemetry ----------------------------------------
-    def _drift_predicted(self, ctx_bucket: int) -> Optional[float]:
-        """Predicted seconds for one mixed step at this context
-        bucket, from the SAME cost stack the placement search prices
-        (cost_model.serve_step_tasks -> simulate_serve_step). The
-        fixed-shape mixed program dispatches every lane regardless of
-        occupancy, so the prediction varies only with (arch, tp, lane
-        width, context) — the cache keys on the context bucket alone
-        and the hot-path cost after a bucket's first step is one dict
-        hit. None when the cost stack is unavailable."""
+    def _drift_predicted(self, ctx_bucket: int) -> Optional[tuple]:
+        """(predicted seconds, per-task-class breakdown) for one mixed
+        step at this context bucket, from the SAME cost stack the
+        placement search prices (cost_model.serve_step_tasks ->
+        simulate_serve_step; the breakdown is the attribution vector
+        drift_report folds per task class). The fixed-shape mixed
+        program dispatches every lane regardless of occupancy, so the
+        prediction varies only with (arch, tp, lane width, context) —
+        the cache keys on the context bucket alone and the hot-path
+        cost after a bucket's first step is one dict hit. None when
+        the cost stack is unavailable."""
         if ctx_bucket not in self._drift_cache:
             try:
-                from ..search.simulator import simulate_serve_step
+                from ..search.simulator import (serve_step_breakdown,
+                                                simulate_serve_step)
                 arch = self.serve_arch(context=max(1, ctx_bucket))
-                self._drift_cache[ctx_bucket] = float(
-                    simulate_serve_step(arch, self.tp,
-                                        lanes=self.mixed_width))
+                self._drift_cache[ctx_bucket] = (
+                    float(simulate_serve_step(arch, self.tp,
+                                              lanes=self.mixed_width)),
+                    serve_step_breakdown(arch, self.tp,
+                                         lanes=self.mixed_width))
             except Exception:
                 self._drift_cache[ctx_bucket] = None
         return self._drift_cache[ctx_bucket]
@@ -1405,7 +1426,89 @@ class ServeEngine:
             if pred is not None:
                 tel.record_drift(
                     "serve", self._drift_regime(n_dec, pre_b, ctx_b),
-                    pred, dt)
+                    pred[0], dt, breakdown=pred[1])
+
+    # ---------------- memory ledger ------------------------------------
+    def memory_ledger(self) -> dict:
+        """Per-device HBM byte accounting for this engine — params, KV
+        pages + scale rows, the mixed step's activation estimate, and
+        adapter headroom (reserved for the multi-tenant LoRA pool,
+        ROADMAP) — next to the simulator's HBM-penalty input
+        (cost_model.serve_device_bytes) so a mis-priced memory term is
+        visible before it mis-ranks a placement. ``live_bytes`` reads
+        the ACTUAL device buffers (shard-aware nbytes); the ledger's
+        params + KV accounting must match it (ci.sh gates within 5%).
+        Components land as ``serve_hbm_bytes{component=...}`` gauges on
+        the engine's registry, so the ledger is scrapeable."""
+        from ..search.cost_model import serve_device_bytes
+        from ..search.explain import pytree_device_bytes
+        c = self.cache_cfg
+        t = max(1, self.tp)
+        params = pytree_device_bytes(self._step_params)
+        kv_pool = float(c.pool_device_bytes)   # values + scale rows
+        act_itemsize = float(self.act_dtype.itemsize)
+        # live set of ONE mixed step: lane activations through the
+        # widest shards (qkv, ffn hidden, logits) — an estimate, the
+        # jitted program's true peak is XLA's to schedule
+        activations = float(self.mixed_width) * act_itemsize * (
+            self.hidden + 3.0 * self.num_heads * self.head_dim / t
+            + float(self._ff_pad) / t + float(self._vocab_pad) / t)
+        adapter = 0.0
+        total = params + kv_pool + activations + adapter
+        pools_live = self._k_pages is not None
+        live = params + pytree_device_bytes(
+            (self._k_pages, self._v_pages,
+             self._k_scales, self._v_scales))
+        arch = self.serve_arch()
+        sim_input = float(serve_device_bytes(arch, t))
+        ledger = {
+            "tensor_parallel": t,
+            "params_bytes": params,
+            "kv_pool_bytes": kv_pool,
+            "activation_est_bytes": activations,
+            "adapter_bytes": adapter,
+            "total_bytes": total,
+            # ground truth: live device buffers (params + allocated
+            # pools); pools allocate lazily on the first generate()
+            "live_bytes": live,
+            "pools_live": pools_live,
+            "ledger_vs_live": ((params + kv_pool) / live
+                               if pools_live and live > 0 else None),
+            # the simulator's HBM-penalty input for this engine's arch
+            # (steady-state context KV, not the allocated pool)
+            "sim_hbm_input_bytes": sim_input,
+        }
+        try:
+            from ..search.machine_model import default_machine_model
+            mm = default_machine_model(machine_file=getattr(
+                self.config, "machine_model_file", None))
+            ledger["hbm_capacity_bytes"] = float(mm.spec.hbm_capacity)
+            ledger["hbm_utilization"] = total / ledger[
+                "hbm_capacity_bytes"]
+        except Exception:
+            pass  # no machine model — the byte accounting stands alone
+        tel = self.telemetry
+        if tel.enabled:
+            for comp in ("params", "kv_pool", "activation_est",
+                         "adapter", "total", "live",
+                         "sim_hbm_input"):
+                tel.metrics.set("serve_hbm_bytes",
+                                ledger[f"{comp}_bytes"], component=comp)
+        return ledger
+
+    def close(self) -> None:
+        """Shut down host-side services (the /metrics endpoint thread).
+        Idempotent; the engine remains usable for generate() after
+        close — only the scrape endpoint goes away."""
+        server, self.metrics_server = self.metrics_server, None
+        if server is not None:
+            server.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     # ---------------- the serving loop ---------------------------------
     def generate(self, prompts: Sequence[Sequence[int]],

@@ -279,6 +279,36 @@ def search_report(stats: dict) -> str:
             f"schedule tables (lru {st.get('currsize', 0)}/"
             f"{st.get('maxsize', 0)}): {st.get('hits', 0)} hits / "
             f"{st.get('misses', 0)} misses")
+    tr = stats.get("trace")
+    if tr:
+        # convergence diagnostics (search/trace.SearchTrace.summary):
+        # acceptance by annealing phase, proposals by simulation path,
+        # and the best-cost-curve tail
+        phases = " ".join(
+            f"{p['rate']:.1%}" for p in tr.get("acceptance_by_phase",
+                                               []))
+        lines.append(
+            f"trace: {tr.get('accepts', 0)}/{tr.get('proposals', 0)} "
+            f"accepted ({tr.get('acceptance_rate', 0.0):.1%}; by phase "
+            f"{phases}), {tr.get('improvements', 0)} improvements")
+        bp = tr.get("by_path") or {}
+        if bp:
+            lines.append("trace paths: " + ", ".join(
+                f"{path} {d['proposals']} proposed / {d['accepts']} "
+                f"accepted" for path, d in bp.items()))
+        curve = tr.get("best_cost_curve") or []
+        if curve:
+            tail = curve[-5:]
+            lines.append("best-cost curve (tail): " + " -> ".join(
+                f"{c['cost_s']*1e3:.3f}ms@{c['iteration']}"
+                for c in tail))
+    sched = stats.get("schedule_trace")
+    if sched:
+        lines.append(
+            f"schedule trace: {sched.get('path')} "
+            f"({sched.get('tasks', 0)} tasks, "
+            f"{sched.get('critical_tasks', 0)} on the critical path, "
+            f"makespan {sched.get('makespan_s', 0.0)*1e3:.3f} ms)")
     return "\n".join(lines)
 
 

@@ -55,8 +55,8 @@ from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = [
-    "MetricsRegistry", "Telemetry", "telemetry_for", "pct",
-    "pow2_bucket", "serve_metrics", "train_metrics",
+    "MetricsRegistry", "MetricsServer", "Telemetry", "telemetry_for",
+    "pct", "pow2_bucket", "serve_metrics", "train_metrics",
 ]
 
 
@@ -209,14 +209,20 @@ class MetricsRegistry:
 
 
 class _DriftStat:
-    """Accumulated predicted-vs-measured seconds for one regime."""
+    """Accumulated predicted-vs-measured seconds for one regime.
 
-    __slots__ = ("predicted_s", "measured_s", "count")
+    ``breakdown`` (optional) accumulates the predicted seconds per
+    task CLASS for the regime — the attribution vector
+    :meth:`Telemetry.task_drift_snapshot` aligns measured steps
+    against."""
+
+    __slots__ = ("predicted_s", "measured_s", "count", "breakdown")
 
     def __init__(self):
         self.predicted_s = 0.0
         self.measured_s = 0.0
         self.count = 0
+        self.breakdown: Optional[Dict[str, float]] = None
 
 
 class Telemetry:
@@ -233,7 +239,8 @@ class Telemetry:
     MAX_DRIFT_REGIMES = 512
 
     def __init__(self, enabled: bool = True, max_events: int = 65536,
-                 drift_threshold: float = 0.5):
+                 drift_threshold: float = 0.5,
+                 t0: Optional[float] = None):
         self.enabled = bool(enabled)
         self.max_events = int(max_events)
         self.drift_threshold = float(drift_threshold)
@@ -242,8 +249,11 @@ class Telemetry:
         self.dropped_events = 0
         self._drift: Dict[Tuple[str, str], _DriftStat] = {}
         self.drift_regimes_dropped = 0
-        # ONE monotonic clock zero for every span in the buffer
-        self._t0 = time.perf_counter()
+        # ONE monotonic clock zero for every span in the buffer. An
+        # explicit `t0` pins the epoch instead — t0=0.0 makes every
+        # recorder take trace-absolute seconds, which is how the
+        # simulated-schedule exporters emit exact simulator times.
+        self._t0 = time.perf_counter() if t0 is None else float(t0)
 
     # ---------------- clock -------------------------------------------
     def now(self) -> float:
@@ -340,10 +350,16 @@ class Telemetry:
 
     # ---------------- drift calibration --------------------------------
     def record_drift(self, domain: str, regime: str, predicted_s: float,
-                     measured_s: float) -> None:
+                     measured_s: float,
+                     breakdown: Optional[Dict[str, float]] = None
+                     ) -> None:
         """One step's measured wall time next to the cost model's
-        predicted time for the same regime (a stable string like
-        ``"t=1 kv=float32 dec=4 pre=0 ctx=64"``)."""
+        predicted time for the same regime (a stable string of NAMED
+        fields like ``"t=1 kv=float32 dec=4 pre=0 ctx=64"`` — named so
+        drift_report reads without a decoder ring). ``breakdown``
+        optionally carries the prediction's per-task-class seconds
+        (``Simulator.step_breakdown`` / ``serve_step_breakdown``) for
+        the attribution pass."""
         if not self.enabled:
             return
         key = (str(domain), str(regime))
@@ -356,6 +372,12 @@ class Telemetry:
         st.predicted_s += float(predicted_s)
         st.measured_s += float(measured_s)
         st.count += 1
+        if breakdown:
+            if st.breakdown is None:
+                st.breakdown = {}
+            b = st.breakdown
+            for cls, v in breakdown.items():
+                b[cls] = b.get(cls, 0.0) + float(v)
 
     def drift_snapshot(self, threshold: Optional[float] = None) -> dict:
         """Per-regime predicted/measured accounting:
@@ -384,10 +406,83 @@ class Telemetry:
             }
         return out
 
+    def task_drift_snapshot(self) -> dict:
+        """Per-task-class drift attribution: fold the per-regime
+        measured/predicted accounting down to ``{domain: {class:
+        {predicted_s, attributed_measured_s, ratio}}}`` — turning
+        "regime X is 1.4x off" into "the all-reduce term is 1.4x off",
+        which is what ``measure.calibrate`` needs targeted at.
+
+        Regimes mix the classes in different proportions, so the fold
+        is an alignment, not a per-regime split: when enough regimes
+        with distinct mixes exist, a least-squares solve of
+        ``measured_r ~= sum_c ratio_c * predicted_{r,c}`` recovers the
+        per-class scale factors (method "lstsq"); otherwise each
+        regime's measured seconds are attributed to its classes by
+        predicted share and the per-class totals ratioed (method
+        "share"). Only regimes recorded WITH a breakdown
+        participate."""
+        by_domain: Dict[str, list] = {}
+        for (domain, _regime), st in self._drift.items():
+            if st.breakdown and st.count:
+                by_domain.setdefault(domain, []).append(st)
+        out: Dict[str, dict] = {}
+        for domain, stats in by_domain.items():
+            classes = sorted({c for st in stats for c in st.breakdown})
+            pred = {c: 0.0 for c in classes}
+            attr = {c: 0.0 for c in classes}
+            for st in stats:
+                tot = sum(st.breakdown.values())
+                for c in classes:
+                    p = st.breakdown.get(c, 0.0)
+                    pred[c] += p
+                    # attribute the regime's measured seconds to its
+                    # classes by predicted share
+                    attr[c] += st.measured_s * (p / tot) if tot else 0.0
+            ratios = {c: (attr[c] / pred[c]) if pred[c] > 0 else 0.0
+                      for c in classes}
+            method = "share"
+            if len(stats) >= len(classes) >= 1:
+                try:
+                    import numpy as np
+                    # weight regimes by sample count: X rows are the
+                    # mean per-step class vectors, y the mean measured
+                    X = np.array([[st.breakdown.get(c, 0.0) / st.count
+                                   for c in classes] for st in stats])
+                    y = np.array([st.measured_s / st.count
+                                  for st in stats])
+                    w = np.sqrt([st.count for st in stats])
+                    sol, _, rank, _ = np.linalg.lstsq(
+                        X * w[:, None], y * w, rcond=None)
+                    if rank == len(classes) \
+                            and np.all(np.isfinite(sol)):
+                        ratios = {c: max(0.0, float(s))
+                                  for c, s in zip(classes, sol)}
+                        # keep the columns reconciled: under lstsq the
+                        # attributed seconds ARE ratio * predicted, so
+                        # attr/pred always equals the printed ratio
+                        attr = {c: ratios[c] * pred[c] for c in classes}
+                        method = "lstsq"
+                except Exception:
+                    pass  # attribution falls back to the share fold
+            out[domain] = {
+                "method": method,
+                "regimes": len(stats),
+                "classes": {c: {
+                    "predicted_s": pred[c],
+                    "attributed_measured_s": attr[c],
+                    "ratio": ratios[c],
+                } for c in classes},
+            }
+        return out
+
     def drift_report(self, threshold: Optional[float] = None) -> str:
         """Human rendering of :meth:`drift_snapshot` — per-regime
-        measured/predicted ratios with a DRIFT flag past the
-        threshold. The flag is the recalibration signal: a regime the
+        measured/predicted ratios (regime keys are named
+        ``dec=/pre=/ctx=``-style fields, never bare tuples) with a
+        DRIFT flag past the threshold, followed by the per-task-class
+        attribution table (:meth:`task_drift_snapshot`) when breakdowns
+        were recorded. The flag is the recalibration signal: a TERM the
         machine model consistently mis-prices is exactly where
         ``measure.calibrate`` should spend its next measurement."""
         snap = self.drift_snapshot(threshold)
@@ -408,6 +503,30 @@ class Telemetry:
             lines.append(f"({self.drift_regimes_dropped} regimes past "
                          f"the {self.MAX_DRIFT_REGIMES}-regime cap "
                          f"dropped)")
+        task = self.task_drift_snapshot()
+        if task:
+            thr = self.drift_threshold if threshold is None \
+                else float(threshold)
+            lines.append("")
+            lines.append(
+                f"{'domain':8s} {'task class':20s} {'pred s':>10s} "
+                f"{'attr s':>10s} {'ratio':>7s}   (per-task drift "
+                f"attribution)")
+            for domain in sorted(task):
+                t = task[domain]
+                for cls in sorted(t["classes"]):
+                    r = t["classes"][cls]
+                    flag = r["ratio"] > 1.0 + thr or (
+                        0.0 < r["ratio"] < 1.0 / (1.0 + thr))
+                    lines.append(
+                        f"{domain:8s} {cls:20s} "
+                        f"{r['predicted_s']:>10.4f} "
+                        f"{r['attributed_measured_s']:>10.4f} "
+                        f"{r['ratio']:>7.3f}"
+                        + ("  DRIFT" if flag else ""))
+                lines.append(
+                    f"{domain:8s} ({t['method']} over "
+                    f"{t['regimes']} regime(s))")
         return "\n".join(lines)
 
     # ---------------- fault observability ------------------------------
@@ -427,12 +546,16 @@ class Telemetry:
                                      site=site)
 
     # ---------------- exporters ----------------------------------------
-    def export_chrome_trace(self, path: str) -> str:
+    def export_chrome_trace(self, path: str,
+                            metadata: Optional[dict] = None) -> str:
         """Write the event buffer as Chrome trace-event JSON (the
         ``{"traceEvents": [...]}`` object form) loadable in Perfetto /
         ``chrome://tracing``. Tracks become pid/tid pairs with ``M``
         metadata naming them; ts/dur are microseconds on the trace
-        clock. Returns the path written."""
+        clock. ``metadata`` lands under a top-level ``"metadata"`` key
+        (ignored by viewers; how the simulated-schedule export stamps
+        its exact makespan next to the display-unit events). Returns
+        the path written."""
         pids: Dict[str, int] = {}
         tids: Dict[Tuple[str, str], int] = {}
         out: List[dict] = []
@@ -462,6 +585,8 @@ class Telemetry:
                          "pid": pids[proc], "tid": tid,
                          "args": {"name": thread}})
         doc = {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+        if metadata:
+            doc["metadata"] = dict(metadata)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(doc, f)
@@ -475,6 +600,7 @@ class Telemetry:
         return {
             "metrics": self.metrics.snapshot(),
             "drift": self.drift_snapshot(),
+            "task_drift": self.task_drift_snapshot(),
             "events_buffered": len(self.events),
             "events_dropped": self.dropped_events,
         }
@@ -487,18 +613,84 @@ class Telemetry:
         self.dropped_events = 0
 
 
+class MetricsServer:
+    """Live scrape endpoint: a stdlib ``http.server`` thread serving
+    ``/metrics`` (Prometheus text from a callable — the engine's
+    lifetime :class:`MetricsRegistry`) and ``/healthz`` (liveness).
+    This is the hook a replica autoscaler polls (docs/observability.md
+    "The metrics endpoint"); enabled by ``--metrics-port`` on FFConfig
+    (port 0 binds an ephemeral port — ``self.port`` is the bound one).
+    ``close()`` shuts the thread down cleanly and is idempotent; the
+    serving hot path never touches the server (scrapes read the
+    GIL-atomic registry from the server thread)."""
+
+    def __init__(self, render, port: int = 0, host: str = "127.0.0.1"):
+        import http.server
+        import threading
+        self._render = render
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(h):
+                if h.path == "/healthz":
+                    body = b"ok\n"
+                    ctype = "text/plain; charset=utf-8"
+                elif h.path == "/metrics":
+                    try:
+                        body = str(render()).encode()
+                    except Exception as e:  # a render bug must not
+                        h.send_error(500, str(e))  # kill the thread
+                        return
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                else:
+                    h.send_error(404)
+                    return
+                h.send_response(200)
+                h.send_header("Content-Type", ctype)
+                h.send_header("Content-Length", str(len(body)))
+                h.end_headers()
+                h.wfile.write(body)
+
+            def log_message(h, *a):  # no per-scrape stderr noise
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="ff-metrics",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 # one shared disabled instance: the off path costs an attribute read
 _DISABLED = Telemetry(enabled=False, max_events=1)
 
 
 def telemetry_for(config=None) -> Telemetry:
     """The Telemetry a subsystem should use (the ``injector_for``
-    idiom): a FRESH enabled bus when ``config.telemetry`` or
-    ``config.trace_out`` asks for one — each engine/model gets its own
-    buffer — else the shared disabled instance (recording is a no-op
-    attribute check)."""
-    if config is not None and (getattr(config, "telemetry", False)
-                               or getattr(config, "trace_out", None)):
+    idiom): a FRESH enabled bus when ``config.telemetry``,
+    ``config.trace_out`` or ``config.metrics_port`` asks for one —
+    each engine/model gets its own buffer — else the shared disabled
+    instance (recording is a no-op attribute check)."""
+    if config is not None and (
+            getattr(config, "telemetry", False)
+            or getattr(config, "trace_out", None)
+            or getattr(config, "metrics_port", None) is not None):
         return Telemetry(
             enabled=True,
             max_events=int(getattr(config, "telemetry_buffer_events",

@@ -296,8 +296,9 @@ def test_serve_drift_report_against_rigged_cost_model(lm, monkeypatch):
     tel = Telemetry(drift_threshold=0.5)
     eng = ServeEngine(lm, telemetry=tel)
     eng.warmup()
-    monkeypatch.setattr(ServeEngine, "_drift_predicted",
-                        lambda self, *key: 1.0)  # 1 s/step predicted
+    monkeypatch.setattr(  # 1 s/step predicted, no breakdown
+        ServeEngine, "_drift_predicted",
+        lambda self, *key: (1.0, None))
     rng = np.random.RandomState(3)
     eng.generate(_prompts(rng, 4), 4)
     snap = tel.drift_snapshot()

@@ -119,6 +119,19 @@ env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload telemetry \
     --trace-out /tmp/ci_serve_trace.json \
     -o /tmp/ci_bench_serve_telemetry.json || fail=1
 
+echo "--- 1l. observability smoke (simulated-trace + search-trace + ledger + endpoint gate)"
+# explainable-search tentpole (tools/explain.py --smoke,
+# docs/observability.md): the exported simulated-schedule trace must be
+# Perfetto-schema-valid with its end time bit-equal to the simulator's
+# returned makespan (train + serve); search tracing on vs off must be
+# bit-identical at the same seed with the search_trace record present
+# in BENCH_search.json; the HBM memory ledger must match the live
+# device buffers within 5% on a real ServeEngine (explain_placement
+# component sums exact); and the --metrics-port endpoint must serve a
+# parseable /metrics page + /healthz, going down cleanly on close().
+# The 1k telemetry-overhead gate above is unchanged.
+env JAX_PLATFORMS=cpu python tools/explain.py --smoke || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \

@@ -1,0 +1,345 @@
+"""Explainable-placement CLI + the observability CI gate.
+
+Two modes:
+
+* Default: run (or reuse) a strategy search on the small-transformer
+  config, print the explain_placement report (per-op chosen config,
+  cost breakdown, top-k rejected alternatives), the search-trace
+  convergence diagnostics, and the HBM memory ledger; optionally
+  export the winning strategy's simulated schedule as a
+  Perfetto-loadable trace (--trace) and dump everything as JSON (-o).
+
+      python tools/explain.py --budget 1000 --trace /tmp/sched.json
+      python tools/explain.py --serve          # serve-placement side
+
+* --smoke (tools/ci.sh step 1l): gates the observability tentpole —
+    1. simulated-schedule trace validity: Perfetto schema well-formed
+       AND the trace's exact end time equals Simulator.simulate's
+       returned makespan bit-exactly (train) / simulate_serve_step's
+       (serve);
+    2. search tracing is pure observation: tracing on vs off at the
+       same seed returns bit-identical strategies, with the trace
+       populated — and the committed BENCH_search.json artifact
+       carries the search_trace record;
+    3. HBM memory ledger within 5% of the actual nbytes of the live
+       device buffers on a real ServeEngine, and explain_placement
+       component sums exact;
+    4. /metrics + /healthz endpoint scrape success on an engine with
+       --metrics-port, clean shutdown on close().
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _platform import select_platform  # noqa: E402
+
+_plat = select_platform("EXPLAIN_PLATFORM")
+if _plat == "cpu" and "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                               + os.environ.get("XLA_FLAGS", ""))
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def build_model(budget=0):
+    from flexflow_tpu import FFConfig
+    from flexflow_tpu.models.transformer import build_transformer
+
+    cfg = FFConfig(batch_size=8)
+    cfg.enable_parameter_parallel = True
+    cfg.enable_sequence_parallel = True
+    cfg.search_budget = budget
+    return build_transformer(cfg, batch_size=8, seq_len=64, hidden=128,
+                             num_heads=4, num_layers=4, ff_dim=256,
+                             num_classes=10)
+
+
+def build_lm(metrics_port=None):
+    from flexflow_tpu import FFConfig
+    from flexflow_tpu.models.transformer import build_transformer_lm
+
+    cfg = FFConfig(batch_size=1, kv_page_size=8, kv_num_pages=73,
+                   serve_max_seqs=8, serve_prefill_budget=48,
+                   serve_retry_backoff_s=0.0)
+    cfg.metrics_port = metrics_port
+    return build_transformer_lm(cfg, vocab_size=89, max_seq_len=64,
+                                hidden=32, num_heads=4, num_layers=2,
+                                ff_dim=64)
+
+
+def serve_arch():
+    """The Gemma-31B-class serving arch the sharded-serving bench
+    prices (tools/serve_bench.py --workload shard)."""
+    from flexflow_tpu.search.cost_model import ServeArch
+    return ServeArch(num_layers=48, hidden=6144, num_heads=48,
+                     head_dim=128, ff_dim=24576, vocab=256000,
+                     decode_lanes=8, prefill_lanes=512, context=2048,
+                     act_itemsize=2.0, act_dtype="bfloat16",
+                     param_itemsize=2.0)
+
+
+def check_trace_schema(path):
+    """Perfetto schema check shared by smoke and ci: returns the doc
+    after asserting every event is well-formed."""
+    with open(path) as f:
+        doc = json.load(f)
+    assert isinstance(doc.get("traceEvents"), list) and doc["traceEvents"]
+    for ev in doc["traceEvents"]:
+        assert isinstance(ev.get("ph"), str) and ev.get("name"), ev
+        assert isinstance(ev.get("pid"), int) \
+            and isinstance(ev.get("tid"), int), ev
+        if ev["ph"] != "M":
+            assert isinstance(ev["ts"], (int, float)) \
+                and ev["ts"] >= 0, ev
+        if ev["ph"] == "X":
+            assert isinstance(ev["dur"], (int, float)) \
+                and ev["dur"] >= 0, ev
+    return doc
+
+
+def smoke() -> int:
+    import numpy as np
+
+    from flexflow_tpu import make_mesh
+    from flexflow_tpu.search.mcmc import optimize
+    from flexflow_tpu.search.simulator import (Simulator,
+                                               export_serve_schedule,
+                                               simulate_serve_step)
+    from flexflow_tpu.serve import ServeEngine
+
+    gates = []
+
+    # ---- 1. simulated-schedule trace validity (train + serve) ------
+    ff = build_model()
+    mesh = make_mesh((2, 2, 2), ("data", "model", "seq"))
+    strat = optimize(ff, budget=200, mesh=mesh, seed=0,
+                     use_native=False, chains=1)
+    sim = Simulator(ff, mesh)
+    train_trace = "/tmp/explain_smoke_train_trace.json"
+    summ = sim.export_schedule(strat, train_trace)
+    doc = check_trace_schema(train_trace)
+    full = sim.simulate(strat)
+    ends = [e["args"]["t_end_s"] for e in doc["traceEvents"]
+            if e["ph"] == "X" and "t_end_s" in e.get("args", {})]
+    if max(ends) != full or doc["metadata"]["makespan_s"] != full \
+            or summ["makespan_s"] != full:
+        print(f"FAIL: train schedule-trace end {max(ends)!r} != "
+              f"simulate() makespan {full!r}")
+        return 1
+    arch = serve_arch()
+    serve_trace = "/tmp/explain_smoke_serve_trace.json"
+    ssum = export_serve_schedule(arch, 4, serve_trace)
+    sdoc = check_trace_schema(serve_trace)
+    sref = simulate_serve_step(arch, 4)
+    sends = [e["args"]["t_end_s"] for e in sdoc["traceEvents"]
+             if e["ph"] == "X" and "t_end_s" in e.get("args", {})]
+    if max(sends) != sref or ssum["makespan_s"] != sref:
+        print(f"FAIL: serve schedule-trace end {max(sends)!r} != "
+              f"simulate_serve_step {sref!r}")
+        return 1
+    gates.append("schedule_trace: schema ok, makespan bit-exact "
+                 "(train+serve)")
+
+    # ---- 2. search tracing: pure observation + artifact presence ---
+    trace = ff.search_stats.get("trace")
+    if not trace or trace.get("proposals", 0) <= 0:
+        print("FAIL: traced search recorded no proposals")
+        return 1
+    ff.config.search_trace = False
+    strat_off = optimize(ff, budget=200, mesh=mesh, seed=0,
+                         use_native=False, chains=1)
+    ff.config.search_trace = True
+    on = {k: dict(v.axis_map) for k, v in strat.op_strategies.items()}
+    off = {k: dict(v.axis_map)
+           for k, v in strat_off.op_strategies.items()}
+    if on != off:
+        print("FAIL: search results differ with tracing on vs off at "
+              "the same seed")
+        return 1
+    bench = os.path.join(ROOT, "BENCH_search.json")
+    have_trace_record = False
+    try:
+        with open(bench) as f:
+            for ln in f:
+                try:
+                    r = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(r, dict) \
+                        and r.get("metric") == "search_trace":
+                    have_trace_record = True
+    except OSError:
+        pass
+    if not have_trace_record:
+        print(f"FAIL: no search_trace record in {bench} "
+              f"(run python tools/search_bench.py)")
+        return 1
+    gates.append(f"search_trace: on==off bit-identical, "
+                 f"{trace['proposals']} proposals at "
+                 f"{trace['acceptance_rate']:.1%} acceptance, "
+                 f"bench artifact carries the record")
+
+    # ---- 3. memory ledger within 5% + explain sums exact -----------
+    lm = build_lm(metrics_port=0)
+    eng = ServeEngine(lm)
+    try:
+        eng.warmup()
+        rng = np.random.RandomState(0)
+        prompts = [list(rng.randint(1, 89, size=rng.randint(4, 24)))
+                   for _ in range(4)]
+        eng.generate(prompts, 4)
+        led = eng.memory_ledger()
+        ratio = led["ledger_vs_live"]
+        if not led["pools_live"] or ratio is None \
+                or abs(ratio - 1.0) > 0.05:
+            print(f"FAIL: memory ledger off by more than 5% vs live "
+                  f"device buffers (ratio {ratio!r})")
+            return 1
+        from flexflow_tpu.search.explain import explain_placement
+        info = explain_placement(ff, mesh=mesh, strategy=strat,
+                                 top_k=2)
+        for o in info["ops"]:
+            if sum(o["components"].values()) != o["total_s"]:
+                print(f"FAIL: explain_placement components of "
+                      f"{o['op']} do not sum to its priced cost")
+                return 1
+        gates.append(f"memory_ledger: ledger/live {ratio:.4f} "
+                     f"(<=5%), explain sums exact over "
+                     f"{len(info['ops'])} ops")
+
+        # ---- 4. /metrics + /healthz scrape -------------------------
+        port = eng.metrics_server.port
+        h = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10)
+        if h.status != 200 or h.read() != b"ok\n":
+            print("FAIL: /healthz scrape")
+            return 1
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        for ln in page.strip().splitlines():
+            if ln.startswith("#"):
+                continue
+            name, _, val = ln.rpartition(" ")
+            float(val)  # every sample line must parse
+            assert name, ln
+        if "serve_tokens_generated_total" not in page \
+                or "serve_hbm_bytes" not in page:
+            print("FAIL: /metrics page missing required series")
+            return 1
+    finally:
+        eng.close()
+    try:
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                               timeout=2)
+        print("FAIL: metrics endpoint still up after close()")
+        return 1
+    except Exception:
+        pass
+    gates.append("metrics_endpoint: /metrics parses + /healthz ok, "
+                 "down after close()")
+
+    print("explain smoke OK: " + "; ".join(gates))
+    return 0
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the observability CI gate (ci.sh 1l)")
+    ap.add_argument("--serve", action="store_true",
+                    help="explain the serve placement instead of the "
+                         "training search")
+    ap.add_argument("--budget", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--top-k", type=int, default=3)
+    ap.add_argument("--trace", default=None,
+                    help="also export the simulated schedule as a "
+                         "Perfetto trace here")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the full explain JSON here")
+    args = ap.parse_args()
+
+    if args.smoke:
+        return smoke()
+
+    if args.serve:
+        from flexflow_tpu.search.serve_place import optimize_serve
+        from flexflow_tpu.search.simulator import (
+            export_serve_schedule, serve_step_breakdown)
+        arch = serve_arch()
+        place = optimize_serve(arch, 4, seed=args.seed)
+        bd = serve_step_breakdown(arch, place.tensor_parallel,
+                                  axis_dims=place.axis_dims)
+        print(f"serve placement: t={place.tensor_parallel} "
+              f"dims={place.axis_dims} decode "
+              f"{place.decode_step_s*1e3:.3f} ms "
+              f"({place.speedup_vs_single():.2f}x vs t=1)")
+        print("decode by degree: " + " ".join(
+            f"t{t}={v*1e3:.3f}ms"
+            for t, v in place.decode_by_degree.items()))
+        print("breakdown: " + " ".join(
+            f"{k}={v*1e3:.3f}ms" for k, v in bd.items()))
+        if place.trace:
+            print(f"walk: {place.trace['proposals']} proposals at "
+                  f"{place.trace['acceptance_rate']:.1%} acceptance, "
+                  f"{place.trace['improvements']} improvements")
+        out = {"placement": {
+            "tensor_parallel": place.tensor_parallel,
+            "axis_dims": list(place.axis_dims),
+            "decode_step_s": place.decode_step_s,
+            "prefill_step_s": place.prefill_step_s,
+            "decode_by_degree": place.decode_by_degree,
+            "breakdown_s": bd, "trace": place.trace}}
+        if args.trace:
+            out["schedule_trace"] = export_serve_schedule(
+                arch, place.tensor_parallel, args.trace,
+                axis_dims=place.axis_dims)
+            print(f"wrote {args.trace}")
+    else:
+        from flexflow_tpu import make_mesh
+        from flexflow_tpu.search.explain import (explain_placement,
+                                                 explain_report)
+        from flexflow_tpu.search.mcmc import optimize
+        from flexflow_tpu.search.simulator import Simulator
+        from flexflow_tpu.utils.profiling import search_report
+
+        ff = build_model()
+        mesh = make_mesh((2, 2, 2), ("data", "model", "seq"))
+        strat = optimize(ff, budget=args.budget, mesh=mesh,
+                         seed=args.seed, use_native=False)
+        sim = Simulator(ff, mesh)
+        info = explain_placement(ff, mesh=mesh, strategy=strat,
+                                 simulator=sim, top_k=args.top_k)
+        print(explain_report(info))
+        print()
+        print(search_report(ff.search_stats))
+        ledger = ff.memory_ledger()
+        print("train ledger: " + " ".join(
+            f"{k}={v/2**20:.2f}MiB" for k, v in ledger.items()
+            if k.endswith("_bytes") and v is not None))
+        out = {"explain": info, "search_stats": {
+            k: v for k, v in ff.search_stats.items()
+            if isinstance(v, (int, float, str, dict, list))},
+            "memory_ledger": ledger}
+        if args.trace:
+            out["schedule_trace"] = sim.export_schedule(strat,
+                                                        args.trace)
+            print(f"wrote {args.trace}")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2, default=str)
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,7 +103,26 @@ def search_line() -> str:
     (tools/search_bench.py refreshes the JSON)."""
     try:
         with open(os.path.join(ROOT, "BENCH_search.json")) as f:
-            b = json.load(f)
+            text = f.read()
+        b = None
+        try:  # pre-PR-11 whole-file dict form
+            doc = json.loads(text)
+            if isinstance(doc, dict) and "speedup" in doc:
+                b = {"speedup": doc["speedup"], **doc}
+        except json.JSONDecodeError:
+            pass
+        if b is None:  # merge-by-metric JSONL (search_bench.py)
+            for ln in text.splitlines():
+                try:
+                    r = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(r, dict) \
+                        and r.get("metric") == "search_delta_speedup":
+                    b = {"speedup": r["value"], **r.get("extra", {})}
+                    break
+        if b is None:
+            return ""
         return (f" Strategy search: "
                 f"{b['proposals_per_sec_delta']:,.0f} proposals/s with "
                 f"delta simulation vs {b['proposals_per_sec_full']:,.0f} "

@@ -142,24 +142,55 @@ def main():
     pps_delta = delta_stats["proposals_per_sec"]
     speedup = pps_delta / pps_full if pps_full > 0 else 0.0
     sim = Simulator(ff, mesh)
-    out = {
-        "config": "small-transformer b8 s64 h128 4L, mesh d2xm2xs2",
-        "platform": jax.default_backend(),
-        "budget": budget,
-        "proposals_per_sec_full": round(pps_full, 1),
-        "proposals_per_sec_delta": round(pps_delta, 1),
-        "speedup": round(speedup, 2),
-        "runs_full": [round(s["proposals_per_sec"], 1)
-                      for s in full_runs],
-        "runs_delta": [round(s["proposals_per_sec"], 1)
-                       for s in delta_runs],
-        "delta_vs_full_max_rel_err": max_rel,
-        "delta_stats": {k: v for k, v in delta_stats.items()
-                        if isinstance(v, (int, float))},
-        "fingerprint": machine_fingerprint(sim.mm, mesh,
-                                           precision=sim._precision(),
-                                           overlap=sim.overlap_sig()),
-    }
+    fingerprint = machine_fingerprint(sim.mm, mesh,
+                                      precision=sim._precision(),
+                                      overlap=sim.overlap_sig())
+    records = [{
+        "metric": "search_delta_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "extra": {
+            "config": "small-transformer b8 s64 h128 4L, mesh d2xm2xs2",
+            "platform": jax.default_backend(),
+            "budget": budget,
+            "proposals_per_sec_full": round(pps_full, 1),
+            "proposals_per_sec_delta": round(pps_delta, 1),
+            "runs_full": [round(s["proposals_per_sec"], 1)
+                          for s in full_runs],
+            "runs_delta": [round(s["proposals_per_sec"], 1)
+                           for s in delta_runs],
+            "delta_vs_full_max_rel_err": max_rel,
+            "delta_stats": {k: v for k, v in delta_stats.items()
+                            if isinstance(v, (int, float))},
+            "fingerprint": fingerprint,
+        },
+    }]
+    # search-trace convergence diagnostics (search/trace.SearchTrace):
+    # acceptance rate (overall + by annealing phase), proposals/sec by
+    # delta-vs-full simulation path, and the best-cost-curve tail
+    trace = delta_stats.get("trace") or {}
+    if trace:
+        records.append({
+            "metric": "search_trace",
+            "value": round(trace.get("acceptance_rate", 0.0), 4),
+            "unit": "acceptance_rate",
+            "extra": {
+                "platform": jax.default_backend(),
+                "budget": budget,
+                "acceptance_by_phase": [
+                    round(p["rate"], 4)
+                    for p in trace.get("acceptance_by_phase", [])],
+                "by_path": trace.get("by_path", {}),
+                "proposals_per_sec": {
+                    "delta": round(pps_delta, 1),
+                    "full": round(pps_full, 1)},
+                "best_cost_curve_tail": trace.get(
+                    "best_cost_curve", [])[-8:],
+                "improvements": trace.get("improvements", 0),
+                "events_recorded": trace.get("events_recorded", 0),
+                "fingerprint": fingerprint,
+            },
+        })
     print(search_report(delta_stats))
     print(f"full: {pps_full:,.0f} proposals/s | "
           f"delta: {pps_delta:,.0f} proposals/s | "
@@ -167,9 +198,7 @@ def main():
 
     if not smoke:
         path = os.path.join(ROOT, "BENCH_search.json")
-        with open(path, "w") as f:
-            json.dump(out, f, indent=2)
-            f.write("\n")
+        write_records(path, records)
         print(f"wrote {os.path.normpath(path)}")
 
     if gate is not None:
@@ -181,11 +210,45 @@ def main():
             print(f"FAIL: delta/full makespans diverge "
                   f"(max rel err {max_rel:.2e} > {EQUIV_TOL})")
             ok = False
+        if not trace:
+            print("FAIL: search ran without a trace "
+                  "(search_trace diagnostics missing)")
+            ok = False
         if not ok:
             return 1
         print(f"smoke OK: speedup {speedup:.2f}x >= {gate}x, "
-              f"delta == full within {EQUIV_TOL}")
+              f"delta == full within {EQUIV_TOL}, trace "
+              f"{trace.get('proposals', 0)} proposals at "
+              f"{trace.get('acceptance_rate', 0.0):.1%} acceptance")
     return 0
+
+
+def write_records(path: str, records) -> None:
+    """Merge-by-metric JSONL (the BENCH_serve.json idiom): a partial
+    run refreshes ITS records without clobbering others', tolerating
+    individually corrupt lines in the old artifact. (Pre-PR-11
+    BENCH_search.json was one whole-file dict — such a line has no
+    "metric" key and is simply superseded.)"""
+    merged = {r["metric"]: r for r in records}
+    old = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    r = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(r, dict) and "metric" in r:
+                    old.append(r)
+    except OSError:
+        pass
+    merged = {**{r["metric"]: r for r in old}, **merged}
+    with open(path, "w") as f:
+        f.write("\n".join(json.dumps(r) for r in merged.values())
+                + "\n")
 
 
 if __name__ == "__main__":
