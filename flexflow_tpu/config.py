@@ -427,6 +427,22 @@ class FFConfig:
     # the SOAP-style simulator pricing applied to the serve program).
     # --serve-mesh.
     serve_mesh: str = ""
+    # disaggregated prefill/decode serving (serve/disagg.py,
+    # docs/serving.md "Disaggregated serving"): dedicated prefill
+    # engines stream finished KV pages to dedicated decode engines
+    # over a host-side page handoff, so decode steps stop paying for
+    # the prefill budget's lanes (the TPOT tax of the ONE mixed
+    # program). --serve-disagg enables it; serve_disagg_ratio is
+    # "P:D" engine counts ("" = 1:1, "auto" = the placement search's
+    # ratio table via optimize_serve(..., disaggregated=True) — the
+    # SOAP don't-hand-tune-it discipline on a new axis);
+    # serve_disagg_decode_budget is the decode role's prefill-lane
+    # stub (tokens; 0 = 2 pages' worth — just enough to recompute a
+    # handoff's partial tail page). --serve-disagg-ratio /
+    # --serve-disagg-decode-budget.
+    serve_disagg: bool = False
+    serve_disagg_ratio: str = ""
+    serve_disagg_decode_budget: int = 0
 
     # synthetic input when no dataset is provided (reference: config.h:131)
     synthetic_input: bool = False
@@ -542,6 +558,24 @@ class FFConfig:
             raise ValueError(
                 f"serve_reject_stalls must be >= 0 (0 = never), got "
                 f"{self.serve_reject_stalls}")
+        sr = str(self.serve_disagg_ratio or "").strip()
+        if sr and sr != "auto":
+            parts = sr.split(":")
+            ok = len(parts) == 2
+            if ok:
+                try:
+                    ok = int(parts[0]) >= 1 and int(parts[1]) >= 1
+                except ValueError:
+                    ok = False
+            if not ok:
+                raise ValueError(
+                    f"serve_disagg_ratio must be '', 'auto', or "
+                    f"'P:D' with positive engine counts, got "
+                    f"{self.serve_disagg_ratio!r}")
+        if self.serve_disagg_decode_budget < 0:
+            raise ValueError(
+                f"serve_disagg_decode_budget must be >= 0 (0 = two "
+                f"pages' worth), got {self.serve_disagg_decode_budget}")
         sm = str(self.serve_mesh or "").strip()
         if sm and sm != "auto":
             try:
@@ -634,6 +668,9 @@ class FFConfig:
         "--serve-retry-backoff": ("serve_retry_backoff_s", float),
         "--serve-reject-stalls": ("serve_reject_stalls", int),
         "--serve-mesh": ("serve_mesh", str),
+        "--serve-disagg-ratio": ("serve_disagg_ratio", str),
+        "--serve-disagg-decode-budget": ("serve_disagg_decode_budget",
+                                         int),
         "--trace-out": ("trace_out", str),
         "--trace-dir": ("trace_dir", str),
         "--telemetry-buffer": ("telemetry_buffer_events", int),
@@ -660,6 +697,7 @@ class FFConfig:
         "--synthetic-input": "synthetic_input",
         "--sparse-embedding-lazy": "sparse_embedding_lazy",
         "--telemetry": "telemetry",
+        "--serve-disagg": "serve_disagg",
     }
     _NEG_BOOL_FLAGS = {
         "--no-overlap-sync": "search_overlap_backward_sync",

@@ -64,6 +64,14 @@ class MachineSpec:
     # dcn_bandwidth/chips_per_host (the reference's EnhancedMachineModel
     # models the same shared-NIC congestion, machine_model.cc:172+)
     chips_per_host: int = 4
+    # host link (PCIe-class DMA between a chip's HBM and its host's
+    # DRAM): the path a DISAGGREGATED serving deployment ships finished
+    # KV pages over (prefill engine -> host -> decode engine,
+    # serve/disagg.py). Priced by TPUMachineModel.host_transfer so the
+    # placement search can weigh the page-handoff link against the
+    # compute it frees (search/serve_place.optimize_serve_disagg).
+    host_link_bandwidth: float = 5e10  # bytes/s per chip<->host DMA
+    host_link_latency: float = 5e-6
     # physical ICI torus factorization of the slice, e.g. (4, 4, 4) for
     # a 64-chip v5p cube or (16, 16) for a v5e pod; () = flat/unknown
     # (every mesh axis priced as a single ring). A mesh axis laid out

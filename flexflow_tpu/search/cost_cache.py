@@ -51,7 +51,7 @@ def _pricing_source_hash() -> str:
         h = hashlib.sha256()
         base = os.path.dirname(os.path.abspath(__file__))
         for mod in ("cost_model.py", "machine_model.py",
-                    "op_measure.py"):
+                    "op_measure.py", "serve_place.py"):
             try:
                 with open(os.path.join(base, mod), "rb") as f:
                     h.update(f.read())

@@ -32,7 +32,12 @@ from .machine_model import TPUMachineModel
 # SOAP-style simulation applied to the ONE mixed prefill+decode
 # serving step, per tensor-parallel degree and axis assignment
 # (search/serve_place.optimize_serve resolves --serve-mesh auto).
-COST_MODEL_VERSION = 4
+# v5: disaggregated serving — the page-handoff transfer link priced on
+# the machine model's host link (kv_handoff_bytes at the KV storage
+# itemsize + scale rows; serve_step_tasks transfer_tokens) and the
+# prefill:decode ratio search over per-role tensor degrees
+# (serve_place.optimize_serve_disagg).
+COST_MODEL_VERSION = 5
 
 BWD_FLOP_FACTOR = 2.0  # dX and dW GEMMs ≈ 2x fwd (reference bwd = 2 GEMMs)
 # per-op-type overrides: attention bwd recomputes probabilities from the
@@ -570,6 +575,15 @@ class ServeArch:
     decode_lanes: int = 8
     prefill_lanes: int = 512
     context: int = 1024
+    # steady-state output length per request — the decode-side work a
+    # disaggregated ratio search balances against one prompt's prefill
+    # chunks + page handoff (optimize_serve_disagg)
+    decode_tokens: int = 64
+    # the disaggregated decode role's prefill-lane stub (the cluster's
+    # serve_disagg_decode_budget, default two pages): its fixed
+    # program dispatches decode_lanes + THIS many lanes every step, so
+    # the ratio search must price that width, not bare decode_lanes
+    handoff_stub_lanes: int = 32
     kv_dtype: str = "float32"
     kv_itemsize: float = 4.0
     kv_scales: bool = False      # quantized pools stream f32 scale rows
@@ -606,9 +620,28 @@ class ServeTask:
     deps: tuple = ()
 
 
+def kv_handoff_bytes(arch: ServeArch,
+                     tokens: Optional[int] = None) -> float:
+    """Host-link bytes of ONE prefill->decode page handoff: `tokens`
+    (default: the arch's steady-state context) of K and V across every
+    layer at the PAGE STORAGE dtype's itemsize, plus the f32 per-row
+    scale arrays on quantized pools — exactly what
+    ServeEngine.export_kv ships (serve/disagg.py). This is the term
+    that makes a KV-dtype flip change the priced transfer cost: int8
+    pages cost ~1/4 the f32 bytes on the link, the same 4x lever they
+    are in HBM."""
+    n = max(1, int(arch.context if tokens is None else tokens))
+    hd = arch.num_heads * arch.head_dim
+    b = 2.0 * n * hd * arch.num_layers * arch.kv_itemsize
+    if arch.kv_scales:
+        b += 2.0 * n * arch.num_heads * arch.num_layers * 4.0
+    return b
+
+
 def serve_step_tasks(arch: ServeArch, tensor_parallel: int,
                      mm: TPUMachineModel, *, lanes: int,
-                     axis: str = SERVE_AXIS) -> list:
+                     axis: str = SERVE_AXIS,
+                     transfer_tokens: int = 0) -> list:
     """Task graph of ONE mixed serving step with ``lanes`` query lanes
     sharded ``tensor_parallel`` ways on the serve mesh (docs/serving.md
     "Sharded serving"), priced exactly like the engine executes it:
@@ -622,7 +655,16 @@ def serve_step_tasks(arch: ServeArch, tensor_parallel: int,
 
     Weights stream at ``param_itemsize`` (serving is small-batch: the
     HBM weight traffic is the t× lever), activations/collectives at
-    ``act_itemsize``. Returns [ServeTask] in dependency order."""
+    ``act_itemsize``. Returns [ServeTask] in dependency order.
+
+    ``transfer_tokens`` > 0 adds the disaggregated page-handoff link:
+    a ``kv_handoff`` task of kind "transfer" pricing that many tokens'
+    KV pages over the host link (:func:`kv_handoff_bytes` at the KV
+    storage itemsize + scale rows). It carries NO deps — the host-side
+    DMA runs beside the device step, so it lengthens the makespan only
+    when the link, not the compute, is the bottleneck (exactly how a
+    decode engine imports one request's pages while decoding the
+    others)."""
     t = max(1, int(tensor_parallel))
     T = int(lanes)
     e, h, d, f = arch.hidden, arch.num_heads, arch.head_dim, arch.ff_dim
@@ -680,6 +722,12 @@ def serve_step_tasks(arch: ServeArch, tensor_parallel: int,
         tasks.append(ServeTask(
             "logits_gather", "collective",
             mm.all_gather(T * arch.vocab * act, t, axis), ("head",)))
+    if transfer_tokens > 0:
+        tasks.append(ServeTask(
+            "kv_handoff", "transfer",
+            mm.host_transfer(kv_handoff_bytes(arch,
+                                              int(transfer_tokens))),
+            ()))
     return tasks
 
 

@@ -170,6 +170,21 @@ class TPUMachineModel:
         bw, lat = self._bw_lat(axis)
         return nbytes / bw + lat
 
+    # ---- host link (disaggregated serving's page-handoff path) ----
+    def host_transfer(self, nbytes: float) -> float:
+        """Seconds to move `nbytes` over the chip<->host DMA link — the
+        path a prefill engine ships finished KV pages over to a decode
+        engine (serve/disagg.py). Priced like ppermute on the host-link
+        spec: the search's transfer term, so a KV-dtype flip (fewer
+        bytes per page) changes the handoff cost it weighs a
+        prefill:decode ratio against."""
+        if nbytes <= 0:
+            return 0.0
+        bw = max(1.0, float(getattr(self.spec, "host_link_bandwidth",
+                                    5e10)))
+        lat = float(getattr(self.spec, "host_link_latency", 5e-6))
+        return nbytes / bw + lat
+
     # ---- memory penalty (reference simulator.cc:603-628: 1ms per MB
     # over framebuffer capacity) ----
     def memory_penalty(self, bytes_per_device: float) -> float:

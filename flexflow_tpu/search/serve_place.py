@@ -28,7 +28,7 @@ import math
 import random
 from typing import Dict, List, Optional, Tuple
 
-from .cost_model import ServeArch
+from .cost_model import ServeArch, kv_handoff_bytes
 from .machine_model import TPUMachineModel
 from .simulator import simulate_serve_step
 
@@ -133,7 +133,8 @@ def price_placement(arch: ServeArch, t: int, mm: TPUMachineModel,
 def optimize_serve(arch: ServeArch, num_devices: int, *,
                    mm: Optional[TPUMachineModel] = None,
                    config=None, budget: int = 64, alpha: float = 0.05,
-                   seed: Optional[int] = None) -> ServePlacement:
+                   seed: Optional[int] = None,
+                   disaggregated: bool = False):
     """Pick the serve placement by simulated annealing over
     (degree, axis assignment) — the reference's Metropolis walk with
     the same relative-delta acceptance as mcmc._anneal — then return
@@ -145,7 +146,15 @@ def optimize_serve(arch: ServeArch, num_devices: int, *,
     calibrated against. The space is small (divisor degrees × torus
     runs), so the default budget walks it to the optimum; the walk —
     not enumeration — is kept so richer placement spaces (replica
-    counts, per-layer degrees) extend without restructuring."""
+    counts, per-layer degrees) extend without restructuring.
+
+    ``disaggregated=True`` searches the SPLIT serving space instead
+    (prefill:decode engine ratio × per-role tensor degree, the page-
+    handoff link priced on the host link) and returns a
+    :class:`DisaggPlacement` — see :func:`optimize_serve_disagg`."""
+    if disaggregated:
+        return optimize_serve_disagg(arch, num_devices, mm=mm,
+                                     config=config, seed=seed)
     if mm is None:
         from .machine_model import default_machine_model
         mm = default_machine_model(
@@ -231,3 +240,214 @@ def optimize_serve(arch: ServeArch, num_devices: int, *,
             sorted(decode_by_degree.items())),
         fingerprint=fingerprint,
         trace=trace.summary() if trace is not None else None)
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode placement (serve/disagg.py's search half)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DisaggPlacement:
+    """One disaggregated serving placement the search priced: how many
+    dedicated prefill vs decode engines to run (at which per-role
+    tensor degrees), with the page-handoff link costed on the host
+    link. ``ratio_table`` maps "p:d" engine ratios to their best
+    steady-state per-request seconds (per-role degrees optimized away)
+    — the disaggregated mirror of ServePlacement.decode_by_degree."""
+
+    prefill_engines: int
+    prefill_tensor: int
+    decode_engines: int
+    decode_tensor: int
+    # steady-state components of the winning candidate (seconds)
+    decode_step_s: float        # one decode-engine step — the TPOT floor
+    prefill_step_s: float       # one budget-wide prefill-engine step
+    transfer_s: float           # one request's page handoff on the link
+    bottleneck_s: float         # slowest pipeline stage, per request
+    cost: float
+    # "p:d" -> best per-request seconds at that engine ratio
+    ratio_table: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    # the unified baseline at the same device count (optimize_serve's
+    # winner run as num_devices/t data-parallel replicas): its TPOT is
+    # the full mixed-width step — what the A/B's reduction is against
+    unified_tpot_s: float = 0.0
+    unified_per_request_s: float = 0.0
+    fingerprint: str = ""
+
+    @property
+    def ratio(self) -> str:
+        return f"{self.prefill_engines}:{self.decode_engines}"
+
+    def tpot_reduction_vs_unified(self) -> float:
+        """Simulated TPOT win of the split: the unified engine's
+        mixed-width step over the decode engine's decode-only step."""
+        if not self.decode_step_s or not self.unified_tpot_s:
+            return 1.0
+        return self.unified_tpot_s / self.decode_step_s
+
+
+def price_disagg_candidate(arch: ServeArch, t_pre: int, t_dec: int,
+                           mm: TPUMachineModel, *, cache=None,
+                           fingerprint: str = ""
+                           ) -> Tuple[float, float, float]:
+    """(prefill_step_s, decode_step_s, transfer_s) of one per-role
+    degree pair, through the persistent cost cache when given.
+
+    The prefill engine's step is the budget-wide mixed program at
+    ``t_pre``; the decode engine's step is its REAL fixed program —
+    ``decode_lanes`` query lanes plus the ``handoff_stub_lanes``
+    prefill stub that recomputes handoff tails (no full prefill
+    budget riding along, the whole point of the split) — at
+    ``t_dec``, priced WITH the
+    steady-state page-handoff load importing beside it
+    (cost_model.serve_step_tasks): the decode engine turns over its
+    ``decode_lanes`` requests every ``decode_tokens`` steps, so each
+    step imports ``context * decode_lanes / decode_tokens`` tokens'
+    pages on average; the transfer term itself is the host-link
+    seconds of one full context's pages — what the ratio balance
+    weighs against freed compute. Cached rows carry the full arch
+    signature (kv dtype/itemsize included), so a KV-dtype flip is a
+    guaranteed miss AND a changed transfer price."""
+    key = None
+    if cache is not None:
+        key = cache.entry_key("serve_disagg", (t_pre, t_dec),
+                              extra=arch.signature())
+        row = cache.get(fingerprint, key)
+        if row is not None:
+            return row.fwd, row.bwd, row.sync
+    pre = simulate_serve_step(arch, t_pre, mm,
+                              lanes=arch.prefill_lanes)
+    per_step_tokens = max(1, round(
+        arch.context * arch.decode_lanes
+        / max(1, getattr(arch, "decode_tokens", 64))))
+    dec_lanes = arch.decode_lanes + int(
+        getattr(arch, "handoff_stub_lanes", 32))
+    dec = simulate_serve_step(arch, t_dec, mm, lanes=dec_lanes,
+                              transfer_tokens=per_step_tokens)
+    xfer = mm.host_transfer(kv_handoff_bytes(arch))
+    if cache is not None:
+        from .cost_model import OpCost
+        cache.put(fingerprint, key,
+                  OpCost(fwd=pre, bwd=dec, fwd_comm=0.0, bwd_comm=0.0,
+                         sync=xfer, mem=0.0))
+    return pre, dec, xfer
+
+
+def optimize_serve_disagg(arch: ServeArch, num_devices: int, *,
+                          mm: Optional[TPUMachineModel] = None,
+                          config=None,
+                          seed: Optional[int] = None
+                          ) -> DisaggPlacement:
+    """Pick the prefill:decode split — engine counts × per-role tensor
+    degrees — whose steady-state per-request bottleneck is smallest:
+    the SOAP don't-hand-tune-it discipline applied to the
+    disaggregation axis (ROADMAP).
+
+    Steady state under mixed traffic: every request prefills its
+    ``context`` tokens in budget-sized chunks on SOME prefill engine,
+    ships its pages over the host link once, and decodes
+    ``decode_tokens`` tokens on a decode-lane of SOME decode engine.
+    Each stage's per-request seconds:
+
+      prefill  = prefill_step_s * ceil(context/prefill_lanes) / p
+      transfer = host_transfer(kv_handoff_bytes) / p   (one DMA link
+                 per prefill engine's host)
+      decode   = decode_step_s * decode_tokens / decode_lanes / d
+
+    and the pipeline sustains 1/max(stages) requests per second. The
+    objective is that bottleneck plus ``PREFILL_WEIGHT`` × the decode
+    step (TTFT already carries the prefill weight in the unified
+    objective; here the extra term keeps a ratio that wrecks TPOT from
+    winning on raw throughput). The space is small (ratios × divisor
+    degrees), so it is enumerated exhaustively — the per-op
+    exhaustive-config half of the reference search — and the full
+    ratio table is returned the way optimize_serve returns the
+    per-degree decode table."""
+    if mm is None:
+        from .machine_model import default_machine_model
+        mm = default_machine_model(
+            machine_file=getattr(config, "machine_model_file", None)
+            if config is not None else None)
+    n = max(2, int(num_devices))
+    cache = None
+    fingerprint = ""
+    if config is None or getattr(config, "search_cost_cache", True):
+        from .cost_cache import CostCache
+        cache = CostCache.open(
+            (getattr(config, "cost_cache_file", None) or None)
+            if config is not None else None)
+        fingerprint = _serve_fingerprint(mm, arch)
+
+    degrees = candidate_degrees(arch, n)
+    chunks_per_prompt = max(1.0, math.ceil(
+        arch.context / max(1, arch.prefill_lanes)))
+    dec_tokens = max(1, int(getattr(arch, "decode_tokens", 64)))
+
+    best = None
+    best_cost = float("inf")
+    ratio_table: Dict[str, float] = {}
+    # each role's step cost depends on ITS degree only (the transfer
+    # term on neither), so one pricing per degree covers every
+    # (t_pre, t_dec) pair — O(D) simulations, not O(D^2)
+    priced = {t: price_disagg_candidate(arch, t, t, mm, cache=cache,
+                                        fingerprint=fingerprint)
+              for t in degrees}
+    for t_pre in degrees:
+        pre = priced[t_pre][0]
+        for t_dec in degrees:
+            dec, xfer = priced[t_dec][1], priced[t_dec][2]
+            p_max = (n - t_dec) // t_pre
+            if p_max < 1:
+                continue
+            for p in range(1, p_max + 1):
+                d = (n - p * t_pre) // t_dec
+                if d < 1:
+                    continue
+                stage_pre = pre * chunks_per_prompt / p
+                stage_xfer = xfer / p
+                stage_dec = dec * dec_tokens / max(
+                    1, arch.decode_lanes) / d
+                bottleneck = max(stage_pre, stage_xfer, stage_dec)
+                cost = bottleneck + PREFILL_WEIGHT * dec
+                ratio = f"{p}:{d}"
+                if bottleneck < ratio_table.get(ratio, float("inf")):
+                    ratio_table[ratio] = bottleneck
+                if cost < best_cost:
+                    best_cost = cost
+                    best = (p, t_pre, d, t_dec, pre, dec, xfer,
+                            bottleneck)
+    if best is None:
+        raise ValueError(
+            f"no disaggregated placement fits {num_devices} devices "
+            f"(need >= 1 prefill + 1 decode engine)")
+
+    # the unified baseline at the same device count: optimize_serve's
+    # winner replicated data-parallel, its TPOT the FULL mixed-width
+    # step (decode lanes pay for the prefill budget every step — the
+    # interference disaggregation removes)
+    uni = optimize_serve(arch, n, mm=mm, config=config, seed=seed)
+    replicas = max(1, n // max(1, uni.tensor_parallel))
+    uni_tpot = simulate_serve_step(
+        arch, uni.tensor_parallel, mm, axis_dims=uni.axis_dims,
+        lanes=arch.decode_lanes + arch.prefill_lanes)
+    uni_per_req = (uni_tpot * dec_tokens / max(1, arch.decode_lanes)
+                   + uni.prefill_step_s * chunks_per_prompt) / replicas
+
+    if cache is not None:
+        cache.flush()
+    p, t_pre, d, t_dec, pre, dec, xfer, bottleneck = best
+
+    def _ratio_key(r: str) -> Tuple[int, int]:
+        a, b = r.split(":")
+        return int(a), int(b)
+
+    return DisaggPlacement(
+        prefill_engines=p, prefill_tensor=t_pre,
+        decode_engines=d, decode_tensor=t_dec,
+        decode_step_s=dec, prefill_step_s=pre, transfer_s=xfer,
+        bottleneck_s=bottleneck, cost=best_cost,
+        ratio_table=dict(sorted(ratio_table.items(),
+                                key=lambda kv: _ratio_key(kv[0]))),
+        unified_tpot_s=uni_tpot, unified_per_request_s=uni_per_req,
+        fingerprint=fingerprint)

@@ -1309,7 +1309,8 @@ def simulate_serve_tasks(tasks) -> float:
 def simulate_serve_step(arch, tensor_parallel: int,
                         mm: Optional[TPUMachineModel] = None, *,
                         lanes: Optional[int] = None,
-                        axis_dims: tuple = ()) -> float:
+                        axis_dims: tuple = (),
+                        transfer_tokens: int = 0) -> float:
     """Simulated seconds of ONE mixed serving step with `lanes` query
     lanes (default: a full decode step — `arch.decode_lanes`) at the
     given tensor-parallel degree, including the reference-style
@@ -1317,7 +1318,11 @@ def simulate_serve_step(arch, tensor_parallel: int,
     (simulator.cc:603-628 — what makes a too-big-for-one-chip model
     price its own sharding). `axis_dims` pins the serve axis onto
     physical torus dims (machine_model._phys) — the axis-assignment
-    half of the placement search."""
+    half of the placement search. `transfer_tokens` > 0 prices a
+    disaggregated page handoff of that many tokens riding the host
+    link BESIDE the step (cost_model.serve_step_tasks): the makespan
+    grows only when the link is the bottleneck — the decode-engine
+    import-while-decoding steady state."""
     from .cost_model import (SERVE_AXIS, serve_device_bytes,
                              serve_step_tasks)
     if mm is None:
@@ -1328,15 +1333,17 @@ def simulate_serve_step(arch, tensor_parallel: int,
                                SERVE_AXIS: tuple(axis_dims)})
     step = simulate_serve_tasks(serve_step_tasks(
         arch, tensor_parallel, mm,
-        lanes=int(arch.decode_lanes if lanes is None else lanes)))
+        lanes=int(arch.decode_lanes if lanes is None else lanes),
+        transfer_tokens=int(transfer_tokens)))
     return step + mm.memory_penalty(
         serve_device_bytes(arch, tensor_parallel))
 
 
 # task classes of the serve drift attribution: the paged-attention
-# kernel, the dense matmuls (qkv/wo/ffn/head/embed), and the tensor-
-# parallel collectives (all-reduces + the logits all-gather)
-SERVE_TASK_CLASSES = ("attention", "matmul", "collective")
+# kernel, the dense matmuls (qkv/wo/ffn/head/embed), the tensor-
+# parallel collectives (all-reduces + the logits all-gather), and the
+# disaggregated page-handoff host-link transfer
+SERVE_TASK_CLASSES = ("attention", "matmul", "collective", "transfer")
 
 
 def serve_task_class(task) -> str:
@@ -1344,6 +1351,8 @@ def serve_task_class(task) -> str:
     names are stable: ``l{i}.attn`` is the paged-attention kernel)."""
     if task.kind == "collective":
         return "collective"
+    if task.kind == "transfer":
+        return "transfer"
     if task.name.endswith(".attn"):
         return "attention"
     return "matmul"
@@ -1352,11 +1361,15 @@ def serve_task_class(task) -> str:
 def serve_step_breakdown(arch, tensor_parallel: int,
                          mm: Optional[TPUMachineModel] = None, *,
                          lanes: Optional[int] = None,
-                         axis_dims: tuple = ()) -> Dict[str, float]:
+                         axis_dims: tuple = (),
+                         transfer_tokens: int = 0) -> Dict[str, float]:
     """Predicted seconds per task class of ONE mixed serving step —
-    the serve half of the drift attribution vector. The serve graph is
-    a serial chain, so the classes (plus the HBM penalty) sum exactly
-    to :func:`simulate_serve_step`."""
+    the serve half of the drift attribution vector. The serve compute
+    graph is a serial chain, so with no transfer task the classes
+    (plus the HBM penalty) sum exactly to
+    :func:`simulate_serve_step`; a priced handoff runs BESIDE the
+    chain, so its class reports its own seconds while the makespan
+    stays max(chain, transfer)."""
     from .cost_model import SERVE_AXIS, serve_device_bytes, \
         serve_step_tasks
     if mm is None:
@@ -1368,7 +1381,8 @@ def serve_step_breakdown(arch, tensor_parallel: int,
     out = {k: 0.0 for k in SERVE_TASK_CLASSES}
     for t in serve_step_tasks(
             arch, tensor_parallel, mm,
-            lanes=int(arch.decode_lanes if lanes is None else lanes)):
+            lanes=int(arch.decode_lanes if lanes is None else lanes),
+            transfer_tokens=int(transfer_tokens)):
         out[serve_task_class(t)] += t.seconds
     out["hbm_penalty"] = mm.memory_penalty(
         serve_device_bytes(arch, tensor_parallel))
@@ -1378,7 +1392,8 @@ def serve_step_breakdown(arch, tensor_parallel: int,
 def export_serve_schedule(arch, tensor_parallel: int, path: str,
                           mm: Optional[TPUMachineModel] = None, *,
                           lanes: Optional[int] = None,
-                          axis_dims: tuple = ()) -> dict:
+                          axis_dims: tuple = (),
+                          transfer_tokens: int = 0) -> dict:
     """Perfetto-loadable export of the simulated serve-step schedule
     (the serving mirror of Simulator.export_schedule): one track per
     task class, every task a complete span with exact start/end seconds
@@ -1397,7 +1412,8 @@ def export_serve_schedule(arch, tensor_parallel: int, path: str,
                                SERVE_AXIS: tuple(axis_dims)})
     tasks = serve_step_tasks(
         arch, tensor_parallel, mm,
-        lanes=int(arch.decode_lanes if lanes is None else lanes))
+        lanes=int(arch.decode_lanes if lanes is None else lanes),
+        transfer_tokens=int(transfer_tokens))
     penalty = mm.memory_penalty(
         serve_device_bytes(arch, tensor_parallel))
     # the SAME chain evaluation simulate_serve_tasks prices from

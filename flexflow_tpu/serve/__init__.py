@@ -7,7 +7,11 @@ scheduler with chunked prefill, watermark admission and preemption
 (:mod:`scheduler`), host-side drafting for verified speculative decode
 (:mod:`speculative`), and a :class:`ServeEngine` (:mod:`engine`) that
 wraps a built LM into ONE fixed-shape mixed prefill+decode step so XLA
-compiles a single serving program, ever.
+compiles a single serving program, ever. :mod:`disagg` splits serving
+into dedicated prefill and decode engine roles with a host-side KV
+page handoff between them (:class:`DisaggCluster`) — decode steps stop
+paying for prefill lanes, the tail-latency win the placement search
+prices via ``optimize_serve(..., disaggregated=True)``.
 """
 
 from .kv_cache import KVCacheConfig, PagedKVCache, prefix_page_keys
@@ -16,8 +20,12 @@ from .scheduler import (ChunkPlan, ContinuousBatchingScheduler,
                         RequestState, SampleParams, StepPlan)
 from .speculative import DraftControl, Drafter, PromptLookupDrafter
 from .engine import ServeEngine
+from .disagg import DisaggCluster, PageShipment, engine_for
 
 __all__ = [
+    "DisaggCluster",
+    "PageShipment",
+    "engine_for",
     "KVCacheConfig",
     "PagedKVCache",
     "prefix_page_keys",

@@ -1,0 +1,594 @@
+"""Disaggregated prefill/decode serving: page-handoff engines.
+
+Why split the roles (docs/serving.md "Disaggregated serving"): the ONE
+mixed program is fixed-shape — every step dispatches
+``serve_prefill_budget + serve_max_seqs`` lanes whether or not any
+prefill is riding along, so under mixed traffic every DECODE token
+pays the prefill budget's compute. That is the TPOT tax disaggregation
+removes: a ``PrefillEngine`` role runs the budget-wide program and
+nothing else, a ``DecodeEngine`` role runs a program whose prefill
+budget is a page-sized stub (just enough to recompute a handoff's
+partial tail page), and finished KV pages cross between them as a
+host-side page transfer.
+
+The handoff rides the existing machinery end to end:
+
+  * pages are already the transfer unit (serve/kv_cache.py), and the
+    chain-hash prefix registry is already a content identity — a page's
+    key commits to every token before it, so equal keys mean equal
+    (content, position) on ANY engine serving the same model;
+  * ``PagedKVCache.export_pages`` names a finished slot's full pages +
+    keys, ``ServeEngine.export_kv`` gathers their device rows (values
+    + scale rows — int8/fp8 pools ship their quantized bytes, the same
+    up-to-4x lever they are in HBM), ``import_pages``/``import_kv``
+    park them in the decode engine's prefix LRU: hashed, refcount 0,
+    matchable — EXACTLY the state a locally computed page reaches when
+    its last owner finishes, so admission, attach, eviction and the
+    degradation ladder need no new states;
+  * the decode engine then serves the request as a prefix-cache hit:
+    its admission path matches the imported chain, attaches the pages
+    with zero compute, and chunk-prefills only the partial tail page
+    (+ the first token's position) — which keeps the cluster
+    token-identical to the unified engine by construction, because
+    every K/V the decode engine reads is either bit-equal transferred
+    content or locally recomputed at the same positions.
+
+Backpressure is the degradation ladder: a shipment only imports while
+the decode pool can hold it above the admission watermark; past that
+the cluster SKIPS the import (counted, spanned) and the decode engine
+re-prefills the prompt itself — graceful degradation to unified
+behavior instead of a stalled link.
+
+The prefill:decode engine ratio is not hand-tuned: the placement
+search prices the split — per-role step costs + the page-handoff link
+on the machine model's host link — and returns the ratio table
+(search/serve_place.optimize_serve_disagg, ``optimize_serve(...,
+disaggregated=True)``), the "Beyond Data and Model Parallelism"
+discipline applied to a new axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.telemetry import (Telemetry, serve_metrics,
+                               telemetry_for)
+from .engine import ServeEngine
+
+# the cluster's telemetry track (kv_handoff spans + skip instants)
+_CLUSTER_TRACK = ("serve", "cluster")
+
+
+@dataclasses.dataclass
+class PageShipment:
+    """One slot's finished KV pages, host-side: the unit a prefill
+    engine hands a decode engine. ``keys`` are the chain hashes (the
+    transfer identity — position-dependence is implicit in the chain),
+    ``k_rows``/``v_rows`` the page value rows as numpy
+    ``(layers, n_pages, page_size, heads, head_dim)`` at the pool's
+    storage dtype, ``*_scale_rows`` the f32 per-row scale arrays on
+    quantized pools (None otherwise). The geometry stamp lets
+    ``import_kv`` reject a pool-shape mismatch loudly instead of
+    dequantizing garbage."""
+
+    keys: List[bytes]
+    ntokens: int
+    k_rows: np.ndarray
+    v_rows: np.ndarray
+    k_scale_rows: Optional[np.ndarray]
+    v_scale_rows: Optional[np.ndarray]
+    page_size: int
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    kv_dtype: str
+
+    def signature(self) -> tuple:
+        return (self.page_size, self.num_layers, self.num_heads,
+                self.head_dim, self.kv_dtype)
+
+    @property
+    def num_pages(self) -> int:
+        return len(self.keys)
+
+    @property
+    def nbytes(self) -> int:
+        """Host-link bytes this shipment moves (values + scale rows) —
+        what kv_transfer_bytes_total counts and what the search prices
+        via cost_model.kv_handoff_bytes."""
+        n = int(self.k_rows.nbytes + self.v_rows.nbytes)
+        if self.k_scale_rows is not None:
+            n += int(self.k_scale_rows.nbytes
+                     + self.v_scale_rows.nbytes)
+        return n
+
+
+def engine_for(model, **kw):
+    """The config-driven serving entry point — the consumer of
+    ``--serve-disagg``: a :class:`DisaggCluster` (ratio per
+    ``serve_disagg_ratio``: "" = 1:1, "P:D", or "auto" via the ratio
+    search) when ``FFConfig.serve_disagg`` is set, else a plain
+    :class:`ServeEngine`.
+
+    The SHARED surface a flag-agnostic driver may use: ``warmup()``,
+    ``generate(prompts, max_new_tokens, eos_token=, temperature=,
+    top_k=, sample_seed=)``, ``generate_reference()``, ``last_stats``,
+    ``close()`` / context manager. Anything beyond it is type-specific
+    — engine-only constructor kwargs (``mesh``/``faults``/...) or the
+    differing ``on_step`` signatures (``on_step(step)`` vs
+    ``on_step(role, engine_idx, step)``) — and ``**kw`` goes verbatim
+    to whichever type the flag selects, so pass only kwargs valid for
+    that type."""
+    if getattr(model.config, "serve_disagg", False):
+        return DisaggCluster.from_config(model, **kw)
+    return ServeEngine(model, **kw)
+
+
+class DisaggCluster:
+    """Prefill/decode-disaggregated serving over one model.
+
+    Builds dedicated ``ServeEngine`` roles sharing the model's
+    parameters (and device copies thereof):
+
+      * ``prefill_engines`` engines run the full budget-wide mixed
+        program; each request prefills there with ``max_new=1`` — the
+        final prefill chunk emits the FIRST token, and the finished
+        prompt pages export at that boundary (generate's ``on_finish``
+        hook, while the slot is still mapped);
+      * ``decode_engines`` engines run a program whose prefill budget
+        is ``decode_budget`` lanes (default 2 pages' worth — the stub
+        that recomputes a handoff's partial tail), so a decode step
+        costs the decode lanes, not the budget;
+      * requests route prefill -> (page handoff) -> decode
+        round-robin, with the decode pool's admission watermark as the
+        handoff backpressure signal.
+
+    Greedy (and ``top_k=1``) decoding only: the cluster's split moves a
+    request between schedulers, and seeded sampling streams are keyed
+    by (rid, token index) WITHIN one scheduler — a disaggregated
+    temperature>0 stream could not reproduce the unified engine's, so
+    it is refused rather than silently diverging.
+
+    Everything is synchronous host-side orchestration (one process,
+    both roles' programs on the same devices here): the measurable win
+    is structural — decode steps stop paying for prefill lanes — and
+    tools/serve_bench.py ``--workload disagg`` gates it as the
+    TPOT-p99 reduction at equal device count, next to the placement
+    search's simulated ratio table for the production shape."""
+
+    def __init__(self, model, *, prefill_engines: int = 1,
+                 decode_engines: int = 1,
+                 decode_budget: Optional[int] = None,
+                 spec_tokens: Optional[int] = None, drafter=None,
+                 use_pallas: Optional[bool] = None,
+                 interpret: bool = False,
+                 telemetry: Optional[Telemetry] = None):
+        if prefill_engines < 1 or decode_engines < 1:
+            raise ValueError(
+                f"a disaggregated cluster needs >= 1 engine per role, "
+                f"got {prefill_engines}:{decode_engines}")
+        if model.state is None:
+            from ..config import CompMode
+            model.compile(comp_mode=CompMode.INFERENCE)
+        self.model = model
+        cfg = model.config
+        self.config = cfg
+        self.telemetry = telemetry if telemetry is not None \
+            else telemetry_for(cfg)
+        ps = int(getattr(cfg, "kv_page_size", 16))
+        if decode_budget is None:
+            decode_budget = int(getattr(cfg, "serve_disagg_decode_budget",
+                                        0) or 0)
+        # the decode role's prefill stub: big enough for one handoff
+        # tail chunk per admission (a tail is < page_size prompt tokens
+        # + the first generated token), two pages' worth by default so
+        # two requests can land per step
+        self.decode_budget = int(decode_budget) if decode_budget \
+            else 2 * ps
+        if self.decode_budget < ps:
+            raise ValueError(
+                f"decode_budget ({self.decode_budget}) must cover at "
+                f"least one page ({ps} tokens): the decode role "
+                f"recomputes handoff tail chunks through it")
+
+        def role_engine(budget: int) -> ServeEngine:
+            role_cfg = dataclasses.replace(
+                cfg, serve_prefill_budget=int(budget),
+                # role engines own no scrape endpoint — the cluster's
+                # caller decides where metrics serve from
+                metrics_port=None)
+            return ServeEngine(
+                model, chunked_prefill=True, prefix_cache=True,
+                spec_tokens=spec_tokens, drafter=drafter,
+                use_pallas=use_pallas, interpret=interpret,
+                telemetry=self.telemetry, config=role_cfg)
+
+        full_budget = int(getattr(cfg, "serve_prefill_budget", 512))
+        self.prefill: List[ServeEngine] = [
+            role_engine(full_budget) for _ in range(int(prefill_engines))]
+        self.decode: List[ServeEngine] = [
+            role_engine(self.decode_budget)
+            for _ in range(int(decode_engines))]
+        # prefill-role speculation is moot (max_new=1 never decodes);
+        # leave it configured — the scheduler simply never drafts
+        self.kv_exact = self.prefill[0].kv_exact
+        self.stats: Dict[str, float] = {
+            "handoff_requests": 0, "handoff_pages": 0,
+            "handoff_bytes": 0, "handoff_dedup_pages": 0,
+            "handoff_skipped": 0, "handoff_seconds": 0.0}
+        self.last_stats: Optional[dict] = None
+        self.placement = None   # set by from_config's "auto" path
+        # the cluster-lifetime registry the per-role TTFT/TPOT split
+        # folds into (serve_metrics role labels; disagg_report reads
+        # it). With telemetry enabled it IS the bus's registry (the
+        # engines fold their aggregates there too); disabled, the
+        # cluster keeps its own — never the shared disabled
+        # singleton's, which other components would see polluted.
+        from ..utils.telemetry import MetricsRegistry
+        self.metrics = self.telemetry.metrics if self.telemetry.enabled \
+            else MetricsRegistry()
+        # the cluster owns the scrape endpoint the role engines were
+        # denied (role_cfg forces metrics_port=None): --metrics-port
+        # under --serve-disagg serves the CLUSTER registry — aggregate
+        # + role-labeled series + handoff counters — from one port,
+        # exactly the autoscaler poll target a unified engine exposes
+        self.metrics_server = None
+        mport = getattr(cfg, "metrics_port", None)
+        if mport is not None:
+            from ..utils.telemetry import MetricsServer
+            self.metrics_server = MetricsServer(
+                self.metrics.to_prometheus, port=int(mport),
+                host=str(getattr(cfg, "metrics_host", "127.0.0.1")))
+
+    @classmethod
+    def from_config(cls, model, *, num_devices: Optional[int] = None,
+                    **kw) -> "DisaggCluster":
+        """Build a cluster from FFConfig's --serve-disagg knobs:
+        serve_disagg_ratio "" = 1:1, "P:D" = those engine counts,
+        "auto" = the placement search's ratio table
+        (search/serve_place.optimize_serve_disagg over this model's
+        ServeArch at `num_devices` — default: the visible device
+        count, floored at 2 so the split exists). The winning
+        DisaggPlacement lands on `cluster.placement`."""
+        cfg = model.config
+        sr = str(getattr(cfg, "serve_disagg_ratio", "") or "").strip()
+        p = d = 1
+        placement = None
+        if sr == "auto":
+            import jax
+            from ..search.serve_place import optimize_serve
+            # a light probe engine, purely for serve_arch()'s model
+            # introspection: no scrape port, no serve-mesh resolution
+            # (which could itself run the unified search), and the
+            # device page pools are lazy so nothing allocates
+            probe = ServeEngine(
+                model, tensor_parallel=1,
+                config=dataclasses.replace(cfg, metrics_port=None,
+                                           serve_mesh=""))
+            try:
+                ndev = int(num_devices) if num_devices else max(
+                    2, len(jax.devices()))
+                ps = int(getattr(cfg, "kv_page_size", 16))
+                stub = int(getattr(cfg, "serve_disagg_decode_budget",
+                                   0) or 0) or 2 * ps
+                # price the decode role at the stub width the cluster
+                # will ACTUALLY build (the search's
+                # priced-like-executed contract)
+                arch = dataclasses.replace(probe.serve_arch(),
+                                           handoff_stub_lanes=stub)
+                placement = optimize_serve(arch, ndev, config=cfg,
+                                           disaggregated=True)
+                p, d = (placement.prefill_engines,
+                        placement.decode_engines)
+            finally:
+                probe.close()
+        elif sr:
+            p, d = (int(x) for x in sr.split(":"))
+        cluster = cls(model, prefill_engines=p, decode_engines=d, **kw)
+        cluster.placement = placement
+        return cluster
+
+    # ---------------- role plumbing ------------------------------------
+    def engines(self) -> List[Tuple[str, ServeEngine]]:
+        return ([("prefill", e) for e in self.prefill]
+                + [("decode", e) for e in self.decode])
+
+    def warmup(self) -> Dict[str, Dict[str, int]]:
+        """Compile every role's mixed program AND the handoff
+        export/import programs; after this the cluster never compiles
+        (compile_counts drift is the zero-recompile gate)."""
+        out = {}
+        for i, (role, eng) in enumerate(self.engines()):
+            eng.warmup()
+            out[f"{role}{i}"] = eng.warmup_handoff()
+        return out
+
+    def compile_counts(self) -> Dict[str, Dict[str, int]]:
+        return {f"{role}{i}": eng.compile_counts()
+                for i, (role, eng) in enumerate(self.engines())}
+
+    def check_invariants(self) -> None:
+        for _, eng in self.engines():
+            eng.cache.check_invariants()
+
+    def close(self) -> None:
+        server, self.metrics_server = self.metrics_server, None
+        if server is not None:
+            server.close()
+        for _, eng in self.engines():
+            eng.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---------------- the handoff --------------------------------------
+    def _admit_shipment(self, eng: ServeEngine, ship: PageShipment
+                        ) -> bool:
+        """Backpressure: import only while the decode pool can hold
+        the new pages AND stay above its admission watermark — the
+        same planning-visible pressure signal the degradation ladder
+        reads. Past it the shipment is dropped and the decode engine
+        re-prefills (rung-2 behavior: stop pinning reclaimable pages
+        when admissions are starved)."""
+        need = sum(1 for k in ship.keys
+                   if not eng.cache.key_resident(k))
+        headroom = eng.cache.free_pages - need
+        wm = int(eng.admit_watermark * eng.cache_cfg.usable_pages)
+        return headroom >= max(wm, 1)
+
+    def _handoff(self, ship: Optional[PageShipment], rid) -> None:
+        """Move one shipment prefill -> decode (round-robin by rid),
+        emitting the kv_handoff span + transfer counters."""
+        if ship is None:
+            return
+        eng = self.decode[rid % len(self.decode)]
+        tel = self.telemetry
+        t0 = time.perf_counter()
+        if not self._admit_shipment(eng, ship):
+            self.stats["handoff_skipped"] += 1
+            if tel.enabled:
+                tel.instant(_CLUSTER_TRACK, "kv_handoff_skipped",
+                            args={"rid": rid, "pages": ship.num_pages})
+            return
+        before_dedup = eng.cache.stats["import_dedup_pages"]
+        written = eng.import_kv(ship)
+        dt = time.perf_counter() - t0
+        dedup = eng.cache.stats["import_dedup_pages"] - before_dedup
+        nbytes = ship.nbytes * written // max(1, ship.num_pages)
+        self.stats["handoff_requests"] += 1
+        self.stats["handoff_pages"] += written
+        self.stats["handoff_bytes"] += nbytes
+        self.stats["handoff_dedup_pages"] += dedup
+        self.stats["handoff_seconds"] += dt
+        if tel.enabled:
+            tel.span(_CLUSTER_TRACK, "kv_handoff", t0, t0 + dt,
+                     args={"rid": rid, "pages": written,
+                           "dedup_pages": dedup, "bytes": nbytes})
+            tel.metrics.inc("kv_transfer_bytes_total", nbytes)
+            tel.metrics.inc("kv_transfer_pages_total", written)
+
+    # ---------------- the serving loop ---------------------------------
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens, eos_token: Optional[int] = None,
+                 temperature=None, top_k=None, sample_seed: int = 0,
+                 on_step=None) -> List[List[int]]:
+        """Serve a batch disaggregated: prefill engines compute every
+        prompt and its FIRST token, finished pages hand off to decode
+        engines, which emit the rest. Token-identical to the unified
+        ``ServeEngine.generate`` on lossless pools (the quantized
+        contract relaxes exactly as it does everywhere else). Greedy /
+        top_k=1 only (see class docstring). ``on_step(role, engine_idx,
+        step)`` observes every role engine's steps (the per-pool
+        invariant hook of the property tests)."""
+        n = len(prompts)
+
+        def per_req(x, name):
+            """Broadcast a scalar/None arg to one entry per request —
+            the waves below slice these, so every role engine sees
+            exactly its requests' entries."""
+            if x is None or np.isscalar(x):
+                return [x] * n
+            x = list(x)
+            if len(x) != n:
+                raise ValueError(
+                    f"{name} has {len(x)} entries for {n} prompts")
+            return x
+
+        temps = per_req(temperature, "temperature")
+        tks = per_req(top_k, "top_k")
+        for t, k in zip(temps, tks):
+            if t is not None and float(t) > 0.0 and k != 1:
+                raise ValueError(
+                    "DisaggCluster serves deterministic decodes "
+                    "(greedy or top_k=1): a sampled stream is keyed "
+                    "to one scheduler's rid/token indices and cannot "
+                    "reproduce across the prefill->decode split")
+        if isinstance(max_new_tokens, int):
+            max_new_tokens = [max_new_tokens] * n
+        if len(max_new_tokens) != n:
+            raise ValueError(
+                f"max_new_tokens has {len(max_new_tokens)} entries "
+                f"for {n} prompts")
+        for mnt in max_new_tokens:
+            if int(mnt) < 1:
+                # mirror scheduler.submit's contract up front: the
+                # prefill role would otherwise silently serve 1 token
+                # where the unified engine refuses
+                raise ValueError(
+                    f"max_new_tokens must be >= 1, got {mnt}")
+        t_start = time.perf_counter()
+        tel = self.telemetry
+        stats0 = dict(self.stats)  # lifetime counters: fold the DELTA
+
+        # ---- phase 1: prefill role (+ export at each finish) ----------
+        # round-robin the batch over the prefill engines; every request
+        # runs max_new=1, so the mixed program only ever carries
+        # prefill chunks and each request's finish IS its first token
+        first: List[Optional[int]] = [None] * n
+        ships: List[Optional[PageShipment]] = [None] * n
+        waves: List[List[int]] = [[] for _ in self.prefill]
+        for i in range(n):
+            waves[i % len(self.prefill)].append(i)
+        pre_stats: List[dict] = []
+        for w, (eng, idxs) in enumerate(zip(self.prefill, waves)):
+            if not idxs:
+                continue
+            local = {}
+
+            def grab(req, _eng=eng, _local=local, _idxs=idxs):
+                # rids are assigned in submit order within this wave;
+                # skip the export entirely for requests phase 3 will
+                # drop anyway (max_new=1, or eos as the first token) —
+                # no point gathering and copying pages nobody imports
+                i = _idxs[req.rid]
+                if max_new_tokens[i] <= 1 or (
+                        eos_token is not None and req.out_tokens
+                        and req.out_tokens[-1] == eos_token):
+                    return
+                _local[req.rid] = _eng.export_kv(req.slot, req.context)
+
+            out = eng.generate(
+                [prompts[i] for i in idxs], 1, eos_token=eos_token,
+                temperature=[temps[i] for i in idxs],
+                top_k=[tks[i] for i in idxs],
+                sample_seed=sample_seed, on_finish=grab,
+                on_step=(None if on_step is None else
+                         (lambda s, _w=w: on_step("prefill", _w, s))))
+            for rid, i in enumerate(idxs):
+                # an aborted prefill (deadline expiry, fault-failed
+                # in-flight) returns NO tokens — mirror the unified
+                # engine's empty output instead of crashing the batch
+                first[i] = out[rid][0] if out[rid] else None
+                ships[i] = local.get(rid)
+            pre_stats.append(eng.last_stats)
+
+        # which requests actually continue to the decode role: done-at-
+        # first-token requests (max_new=1, eos on the first token, or
+        # aborted before emitting) ship NOTHING — their pages would
+        # only park in the decode pool and compete with real handoffs
+        # for backpressure headroom
+        decode_idx = [i for i in range(n)
+                      if first[i] is not None
+                      and max_new_tokens[i] > 1
+                      and not (eos_token is not None
+                               and first[i] == eos_token)]
+
+        # ---- phase 2: page handoff (with backpressure) ----------------
+        for i in decode_idx:
+            self._handoff(ships[i], i)
+
+        # ---- phase 3: decode role -------------------------------------
+        # each surviving request continues as prompt + [first token]
+        # with max_new - 1 budget; the decode engine admits it as a
+        # prefix-cache hit over the imported pages and recomputes only
+        # the tail chunk
+        results: List[List[int]] = [
+            [] if t is None else [t] for t in first]
+        dec_stats: List[dict] = []
+        dwaves: List[List[int]] = [[] for _ in self.decode]
+        for i in decode_idx:
+            dwaves[i % len(self.decode)].append(i)
+        for w, (eng, idxs) in enumerate(zip(self.decode, dwaves)):
+            if not idxs:
+                continue
+            out = eng.generate(
+                [list(prompts[i]) + [first[i]] for i in idxs],
+                [max_new_tokens[i] - 1 for i in idxs],
+                eos_token=eos_token,
+                temperature=[temps[i] for i in idxs],
+                top_k=[tks[i] for i in idxs],
+                sample_seed=sample_seed,
+                on_step=(None if on_step is None else
+                         (lambda s, _w=w: on_step("decode", _w, s))))
+            for j, i in enumerate(idxs):
+                results[i].extend(out[j])
+            dec_stats.append(eng.last_stats)
+
+        wall = time.perf_counter() - t_start
+        total_new = sum(len(r) for r in results)
+        self.last_stats = {
+            "mode": "disagg",
+            "prefill_engines": len(self.prefill),
+            "decode_engines": len(self.decode),
+            "decode_budget": self.decode_budget,
+            "wall_s": wall,
+            "total_new_tokens": total_new,
+            "tokens_per_sec": total_new / wall if wall > 0 else 0.0,
+            # THIS call's handoff accounting (self.stats stays the
+            # cluster-lifetime totals) — per-call numbers must sit
+            # next to per-call wall_s/tokens
+            "handoff": {k: self.stats[k] - stats0[k]
+                        for k in self.stats},
+            "roles": {"prefill": pre_stats, "decode": dec_stats},
+            "compile_counts": self.compile_counts(),
+        }
+        # fold the per-role latency split into the cluster registry —
+        # what disagg_report renders from. With telemetry enabled the
+        # role engines already folded the UNLABELED aggregates into
+        # this same registry after their generates, so only the
+        # role-labeled series are added here; disabled, the cluster
+        # owns its registry and folds both.
+        m = self.metrics
+        for st in pre_stats:
+            if not tel.enabled:
+                serve_metrics(st, registry=m)
+            serve_metrics(st, registry=m, role="prefill")
+        for st in dec_stats:
+            if not tel.enabled:
+                serve_metrics(st, registry=m)
+            serve_metrics(st, registry=m, role="decode")
+        def delta(k):
+            return self.stats[k] - stats0[k]
+
+        m.inc("kv_handoff_requests_total", delta("handoff_requests"))
+        m.inc("kv_handoff_skipped_total", delta("handoff_skipped"))
+        if not tel.enabled:
+            # with telemetry on, _handoff already counted these on the
+            # (same) registry per shipment
+            m.inc("kv_transfer_bytes_total", delta("handoff_bytes"))
+            m.inc("kv_transfer_pages_total", delta("handoff_pages"))
+        return results
+
+    # ---------------- reference / ledger --------------------------------
+    def generate_reference(self, prompts, max_new_tokens,
+                           eos_token=None) -> List[List[int]]:
+        """The no-cache greedy oracle (one engine's reference — they
+        share the model's params)."""
+        return self.prefill[0].generate_reference(
+            prompts, max_new_tokens, eos_token=eos_token)
+
+    def memory_ledger(self) -> dict:
+        """Cluster-wide HBM accounting: BOTH roles' pools summed (the
+        satellite contract — a disaggregated deployment's gauges must
+        not undercount by reporting one role), with the per-role
+        ledgers attached and the serve_hbm_bytes gauges emitted per
+        (component, role) plus the cluster totals."""
+        tel = self.telemetry
+        roles = {}
+        totals = {"params_bytes": 0.0, "kv_pool_bytes": 0.0,
+                  "activation_est_bytes": 0.0, "total_bytes": 0.0,
+                  "live_bytes": 0.0}
+        for i, (role, eng) in enumerate(self.engines()):
+            led = eng.memory_ledger()
+            roles[f"{role}{i}"] = led
+            for k in totals:
+                totals[k] += float(led.get(k) or 0.0)
+            if tel.enabled:
+                for comp in ("params", "kv_pool", "activation_est",
+                             "total", "live"):
+                    tel.metrics.set("serve_hbm_bytes",
+                                    led[f"{comp}_bytes"],
+                                    component=comp, role=f"{role}{i}")
+        if tel.enabled:
+            for k, v in totals.items():
+                tel.metrics.set("serve_hbm_bytes", v,
+                                component=k[:-len("_bytes")],
+                                role="cluster")
+        return {"mode": "disagg", "roles": roles, **totals}

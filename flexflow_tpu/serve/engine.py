@@ -168,11 +168,15 @@ class ServeEngine:
                  spec_tokens: Optional[int] = None,
                  drafter=None, faults: Optional[FaultInjector] = None,
                  mesh=None, tensor_parallel: Optional[int] = None,
-                 telemetry: Optional[Telemetry] = None):
+                 telemetry: Optional[Telemetry] = None,
+                 config=None):
         if model.state is None:
             model.compile(comp_mode=CompMode.INFERENCE)
         self.model = model
-        self.config = model.config
+        # an explicit `config` overrides the model's: how a
+        # DisaggCluster gives each role its own serving knobs (prefill
+        # budget, scrape endpoint) over ONE shared model
+        self.config = config if config is not None else model.config
         self._use_pallas = use_pallas
         self._interpret = interpret
         self._read_arch(model)
@@ -335,6 +339,28 @@ class ServeEngine:
                                     donate_argnums=(1, 2))
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2))
         self._forward_jit = jax.jit(self._forward_logits)  # naive reference
+        # disaggregated page handoff (serve/disagg.py): fixed-shape
+        # gather/scatter programs moving whole page rows (values +
+        # scale rows on quantized pools) between this engine's pool
+        # and the host. The page-index vector is padded to
+        # pages_per_seq with 0 — the sink-page convention — so ONE
+        # program geometry serves every shipment size and the
+        # zero-recompile contract extends to handoff traffic. Import
+        # donates the pool arrays exactly like the mixed step.
+        self._n_pools = 4 if self.kv_quantized else 2
+        _imp_donate = tuple(range(1, 1 + self._n_pools))
+        if self.tp > 1:
+            self._export_jit = jax.jit(self._export_tp_impl,
+                                       static_argnums=(0,))
+            self._import_jit = jax.jit(self._import_tp_impl,
+                                       static_argnums=(0,),
+                                       donate_argnums=_imp_donate)
+        else:
+            self._export_jit = jax.jit(self._export_impl,
+                                       static_argnums=(0,))
+            self._import_jit = jax.jit(self._import_impl,
+                                       static_argnums=(0,),
+                                       donate_argnums=_imp_donate)
         # per-function compile accounting: `_compiles` counts calls
         # that triggered at least one real XLA backend compile
         # (jax.monitoring events, see _CompileEvents); `_shapes_seen`
@@ -343,10 +369,13 @@ class ServeEngine:
         # without the monitoring API
         self._events_ok = _CompileEvents.install()
         self._compiles: Dict[str, int] = {"prefill": 0, "decode": 0,
-                                          "mixed": 0}
+                                          "mixed": 0, "export": 0,
+                                          "import": 0}
         self._shapes_seen: Dict[str, set] = {"prefill": set(),
                                              "decode": set(),
-                                             "mixed": set()}
+                                             "mixed": set(),
+                                             "export": set(),
+                                             "import": set()}
         self.last_stats: Optional[dict] = None
         # live scrape endpoint (--metrics-port, docs/observability.md):
         # /metrics serves the engine-lifetime registry as Prometheus
@@ -939,6 +968,186 @@ class ServeEngine:
             else (k_pages, v_pages)
         return out, caches
 
+    # ---------------- disaggregated page handoff -----------------------
+    # Device half of the prefill->decode transfer (serve/disagg.py;
+    # host bookkeeping in PagedKVCache.export_pages/import_pages).
+    # Both directions move whole page ROWS — (layers, page, slot,
+    # head[, dim]) blocks of the pool arrays (and the f32 scale arrays
+    # on quantized pools, so quantized content crosses the link
+    # bit-exactly and dequantizes identically on the far side) —
+    # through ONE fixed-shape program each: the page-index vector pads
+    # to pages_per_seq with the sink page 0, exactly the padding
+    # convention of the mixed step's write lanes.
+
+    def _pool_args(self):
+        args = (self._k_pages, self._v_pages)
+        if self.kv_quantized:
+            args += (self._k_scales, self._v_scales)
+        return args
+
+    def _restash_pools(self, pools) -> None:
+        self._k_pages, self._v_pages = pools[0], pools[1]
+        if self.kv_quantized:
+            self._k_scales, self._v_scales = pools[2], pools[3]
+
+    def _export_impl(self, n_pools, *args):
+        """Gather page rows: args = (*pools, idx); idx (pages_per_seq,)
+        int32, padding entries aim at the sink (their rows ship as
+        garbage the importer never addresses)."""
+        idx = args[n_pools]
+        return tuple(a[:, idx] for a in args[:n_pools])
+
+    def _import_impl(self, n_pools, *args):
+        """Scatter page rows: args = (*pools, *rows, idx). Padding
+        entries write their (zero) rows into the sink page — harmless
+        by the sink convention (reads are masked by seq_lens)."""
+        idx = args[2 * n_pools]
+        return tuple(p.at[:, idx].set(r)
+                     for p, r in zip(args[:n_pools],
+                                     args[n_pools:2 * n_pools]))
+
+    def _handoff_specs(self, n_pools):
+        """shard_map specs of the handoff programs: pools AND rows
+        shard on the head axis (a page row carries the head dim), the
+        index vector is replicated."""
+        from jax.sharding import PartitionSpec as P
+        page = P(None, None, None, TENSOR, None)
+        scl = P(None, None, None, TENSOR)
+        arrs = (page, page) + ((scl, scl) if n_pools == 4 else ())
+        return arrs, P()
+
+    def _export_tp_impl(self, n_pools, *args):
+        # the SAME gather body per device over its head shard (pure on
+        # its args, so no duplicated indexing convention to drift)
+        import functools
+
+        from ..parallel._compat import shard_map
+        arrs, rep = self._handoff_specs(n_pools)
+        return shard_map(functools.partial(self._export_impl, n_pools),
+                         mesh=self.tp_mesh, in_specs=arrs + (rep,),
+                         out_specs=arrs, check_vma=False)(*args)
+
+    def _import_tp_impl(self, n_pools, *args):
+        import functools
+
+        from ..parallel._compat import shard_map
+        arrs, rep = self._handoff_specs(n_pools)
+        return shard_map(functools.partial(self._import_impl, n_pools),
+                         mesh=self.tp_mesh,
+                         in_specs=arrs + arrs + (rep,),
+                         out_specs=arrs, check_vma=False)(*args)
+
+    def _pad_idx(self, pages: Sequence[int]) -> np.ndarray:
+        c = self.cache_cfg
+        if len(pages) > c.pages_per_seq:
+            raise ValueError(
+                f"shipment of {len(pages)} pages exceeds this pool's "
+                f"page-table ceiling ({c.pages_per_seq})")
+        idx = np.zeros((c.pages_per_seq,), np.int32)
+        idx[:len(pages)] = pages
+        return idx
+
+    def export_kv(self, slot: int, tokens: Sequence[int]):
+        """Ship `slot`'s full resident pages to the host: the
+        prefill-engine half of a disaggregated handoff. Returns a
+        PageShipment (serve/disagg.py) carrying the chain keys, the
+        page rows (+ scale rows on quantized pools) as host numpy, and
+        the geometry stamp import_kv validates — or None when the slot
+        has no full page yet (the importer simply recomputes). Must
+        run while the slot is still mapped (DisaggCluster exports from
+        generate's on_finish hook, before the slot is freed)."""
+        from .disagg import PageShipment
+        pages, keys, ntokens = self.cache.export_pages(slot, tokens)
+        if not pages:
+            return None
+        self._device_pages()
+        n = len(pages)
+        rows = self._call_counted(
+            "export", self._export_jit, self._n_pools,
+            *self._pool_args(), jnp.asarray(self._pad_idx(pages)))
+        # copy the real-page slice: a view would pin the whole
+        # pages_per_seq-padded gather buffer for the shipment's life
+        host = [np.asarray(r)[:, :n].copy() for r in rows]
+        c = self.cache_cfg
+        return PageShipment(
+            keys=list(keys), ntokens=int(ntokens),
+            k_rows=host[0], v_rows=host[1],
+            k_scale_rows=host[2] if self.kv_quantized else None,
+            v_scale_rows=host[3] if self.kv_quantized else None,
+            page_size=c.page_size, num_layers=c.num_layers,
+            num_heads=c.num_heads, head_dim=c.head_dim,
+            kv_dtype=c.kv_dtype)
+
+    def import_kv(self, ship) -> int:
+        """Adopt a PageShipment into this engine's pool: the
+        decode-engine half of a disaggregated handoff. Registers the
+        chain keys (PagedKVCache.import_pages — already-resident keys
+        dedupe to nothing) and scatters the needed rows into freshly
+        parked pages, so the NEXT generate()'s admission path prefix-
+        matches the handed-off prompt exactly as it would a locally
+        computed one. Returns the number of pages actually written
+        (0 = full dedupe). The caller owns backpressure: check
+        `cache.free_pages` first (DisaggCluster skips the import and
+        lets the decode engine re-prefill instead of squeezing a
+        loaded pool)."""
+        c = self.cache_cfg
+        if (ship.page_size, ship.num_layers, ship.num_heads,
+                ship.head_dim, ship.kv_dtype) != (
+                c.page_size, c.num_layers, c.num_heads, c.head_dim,
+                c.kv_dtype):
+            raise ValueError(
+                f"shipment geometry {ship.signature()} does not match "
+                f"this pool "
+                f"({(c.page_size, c.num_layers, c.num_heads, c.head_dim, c.kv_dtype)})"
+            )
+        todo = self.cache.import_pages(ship.keys)
+        if not todo:
+            return 0
+        self._device_pages()
+        idx = self._pad_idx([page for _, page in todo])
+        srcs = [ship.k_rows, ship.v_rows]
+        if self.kv_quantized:
+            srcs += [ship.k_scale_rows, ship.v_scale_rows]
+        rows = []
+        for src in srcs:
+            buf = np.zeros((src.shape[0], c.pages_per_seq)
+                           + src.shape[2:], src.dtype)
+            for j, (chain_i, _) in enumerate(todo):
+                buf[:, j] = src[:, chain_i]
+            rows.append(jnp.asarray(buf))
+        pools = self._call_counted(
+            "import", self._import_jit, self._n_pools,
+            *self._pool_args(), *rows, jnp.asarray(idx))
+        self._restash_pools(pools)
+        return len(todo)
+
+    def warmup_handoff(self) -> Dict[str, int]:
+        """Compile the export/import programs on sink-page dummies (a
+        no-op on the pool content), so a DisaggCluster's serving loop
+        never compiles after DisaggCluster.warmup(). The import dummies
+        are HOST-built arrays, exactly the layout import_kv dispatches
+        (a sharded engine would otherwise warm the program against
+        device-committed shardings and recompile on the first real,
+        host-laid-out shipment). Returns compile_counts()."""
+        self._device_pages()
+        c = self.cache_cfg
+        idx = jnp.zeros((c.pages_per_seq,), jnp.int32)
+        self._call_counted(
+            "export", self._export_jit, self._n_pools,
+            *self._pool_args(), idx)
+        val = (c.num_layers, c.pages_per_seq, c.page_size,
+               c.num_heads, c.head_dim)
+        shapes = [(val, c.storage_dtype), (val, c.storage_dtype)]
+        if self.kv_quantized:
+            scl = val[:-1]
+            shapes += [(scl, np.float32), (scl, np.float32)]
+        zero_rows = [jnp.asarray(np.zeros(s, d)) for s, d in shapes]
+        pools = self._call_counted(
+            "import", self._import_jit, self._n_pools,
+            *self._pool_args(), *zero_rows, idx)
+        self._restash_pools(pools)
+        return self.compile_counts()
+
     # ---------------- legacy prefill -----------------------------------
     def _prefill_impl(self, params, k_pages, v_pages, tokens, length,
                       pt_row):
@@ -1022,7 +1231,8 @@ class ServeEngine:
         SAME-signature recompile the shape count cannot see."""
         return {name: max(self._compiles[name],
                           len(self._shapes_seen[name]))
-                for name in ("prefill", "decode", "mixed")}
+                for name in ("prefill", "decode", "mixed", "export",
+                             "import")}
 
     def _device_pages(self):
         page_sh, scale_sh = self._page_shardings()
@@ -1514,7 +1724,8 @@ class ServeEngine:
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens, eos_token: Optional[int] = None,
                  temperature=None, top_k=None, sample_seed: int = 0,
-                 deadline_s=None, on_step=None) -> List[List[int]]:
+                 deadline_s=None, on_step=None,
+                 on_finish=None) -> List[List[int]]:
         """Decode a ragged batch under continuous batching.
         `max_new_tokens` is an int or a per-prompt sequence; greedy by
         default, per-request seeded temperature/top-k sampling when
@@ -1532,9 +1743,13 @@ class ServeEngine:
         `last_stats["requests"][i]["rid"]`, assigned in prompt order)
         aborts a request the same way. `on_step(step_index)` is called
         after every engine step — the hook chaos tests drive cancels
-        and invariant checks from. A mid-batch exception fails only
-        the in-flight requests and the engine keeps serving
-        (_fail_inflight)."""
+        and invariant checks from. `on_finish(req)` is called when a
+        request completes, BEFORE its slot releases — its pages are
+        still mapped, which is the window a disaggregated prefill
+        engine exports them in (serve/disagg.py passes
+        `lambda r: export_kv(r.slot, r.context)` here). A mid-batch
+        exception fails only the in-flight requests and the engine
+        keeps serving (_fail_inflight)."""
         c = self.cache_cfg
         cache = self.cache
         if cache.free_slots != c.max_seqs:
@@ -1597,6 +1812,8 @@ class ServeEngine:
                 req.t_first_token = time.perf_counter()
             if req.is_done():
                 req.t_finish = time.perf_counter()
+                if on_finish is not None:
+                    on_finish(req)
                 sched.finish(req)
 
         def emit_spec(chunk: ChunkPlan, lane0: int, greedy, topv,
@@ -1636,6 +1853,8 @@ class ServeEngine:
                           "accepted": matched, "emitted": emitted})
             if req.is_done():
                 req.t_finish = time.perf_counter()
+                if on_finish is not None:
+                    on_finish(req)
                 sched.finish(req)
             return emitted
 

@@ -316,11 +316,19 @@ class PagedKVCache:
         # geometry of the engine's scale arrays, checked by
         # check_invariants against cfg.scale_shape
         self._scale_meta = None
+        # pages whose content arrived over the disaggregated handoff
+        # (import_pages) rather than from this engine's own compute:
+        # they must stay hashed for as long as they are resident — an
+        # imported page the registry stopped vouching for would be
+        # unreachable garbage (check_invariants)
+        self._imported: set = set()
         # serving metrics, merged into ServeEngine.last_stats
         self.stats = {"prefix_hit_pages": 0, "prefix_evictions": 0,
                       "pages_committed": 0, "shared_attaches": 0,
                       "max_page_refs": 0, "rollback_pages": 0,
-                      "lru_shed_pages": 0, "slots_reclaimed": 0}
+                      "lru_shed_pages": 0, "slots_reclaimed": 0,
+                      "exported_pages": 0, "imported_pages": 0,
+                      "import_dedup_pages": 0}
 
     # ---------------- capacity queries (scheduler admission) ----------
     @property
@@ -385,6 +393,9 @@ class PagedKVCache:
         key = self._hash_of_page.pop(page, None)
         if key is not None:
             del self._page_of_hash[key]
+        # a de-hashed imported page is no longer vouched-for handoff
+        # content — it is just a free/garbage page again
+        self._imported.discard(page)
 
     def _take_page(self) -> int:
         """A writable page: the free list first, then evict the
@@ -432,6 +443,86 @@ class PagedKVCache:
             shed += 1
         self.stats["lru_shed_pages"] += shed
         return shed
+
+    # ---------------- disaggregated page handoff ----------------------
+    # Host-side half of the prefill->decode transfer (serve/disagg.py):
+    # export names the FULL, resident pages of a slot with their chain
+    # keys; import allocates pages for foreign keys and parks them in
+    # the prefix LRU — hashed, refcount 0, matchable — which is
+    # EXACTLY the state a locally-computed page reaches when its last
+    # owner finishes, so everything downstream (match_prefix /
+    # attach_prefix / eviction / the ladder) treats handed-off content
+    # identically to local content. The device rows ride separately
+    # through ServeEngine.export_kv/import_kv (this class never
+    # touches device memory).
+
+    def export_pages(self, slot: int, tokens: Sequence[int]
+                     ) -> Tuple[List[int], List[bytes], int]:
+        """(pages, chain keys, covered tokens) for every FULL page of
+        `slot`'s resident sequence — the transfer unit of a
+        disaggregated handoff. `tokens` is the slot's context (the
+        caller owns it; page content is a pure function of the token
+        prefix, which is what makes the chain key a sound transfer
+        identity). The partial tail page is never exported: like
+        prefix sharing, only whole pages have a content identity —
+        the importer recomputes the tail (< page_size tokens), exactly
+        as a prefix-cache hit would."""
+        ps = self.cfg.page_size
+        full = int(self.seq_lens[slot]) // ps
+        if full * ps > len(tokens):
+            raise ValueError(
+                f"slot {slot} has {self.seq_lens[slot]} resident "
+                f"tokens but only {len(tokens)} were supplied")
+        pages = [int(self.page_tables[slot, i]) for i in range(full)]
+        if any(p == 0 for p in pages):
+            raise RuntimeError(
+                f"slot {slot} table is not a mapped prefix over its "
+                f"resident length")
+        keys = prefix_page_keys(tokens, ps, full)
+        self.stats["exported_pages"] += len(pages)
+        return pages, keys, full * ps
+
+    def import_pages(self, keys: Sequence[bytes]
+                     ) -> List[Tuple[int, int]]:
+        """Adopt a handed-off page chain: for every chain key not
+        already resident, allocate a page, register the key, and park
+        the page in the prefix LRU (refcount 0, hashed, matchable —
+        the same state finish-time eviction leaves a local page in).
+        Returns [(chain_index, page)] for the pages whose device rows
+        the caller must now write (ServeEngine.import_kv); keys that
+        are already resident dedupe to nothing — a shared system
+        preamble crosses the link ONCE per decode engine, not once per
+        request. The caller must have checked `free_pages` against
+        len(keys): running the allocator dry here is a cluster
+        backpressure bug (DisaggCluster skips the import instead)."""
+        if not self.prefix_enabled:
+            raise RuntimeError(
+                "import_pages needs the prefix cache: an imported page "
+                "is only reachable through its chain-key registration")
+        out: List[Tuple[int, int]] = []
+        for i, key in enumerate(keys):
+            if key in self._page_of_hash:
+                self.stats["import_dedup_pages"] += 1
+                continue
+            page = self._take_page()
+            self._hash_of_page[page] = key
+            self._page_of_hash[key] = page
+            self._lru[page] = None     # most-recently parked
+            self._imported.add(page)
+            out.append((i, page))
+        self.stats["imported_pages"] += len(out)
+        return out
+
+    def imported_pages(self) -> Tuple[int, ...]:
+        """Pages whose resident content arrived over the handoff link
+        (still hashed — eviction drops them from this set too)."""
+        return tuple(sorted(self._imported))
+
+    def key_resident(self, key: bytes) -> bool:
+        """Whether a chain key is already registered here — what the
+        cluster's backpressure check counts a shipment's NEW pages
+        with (resident keys dedupe on import)."""
+        return key in self._page_of_hash
 
     # ---------------- slot lifecycle ----------------------------------
     def release_all(self) -> int:
@@ -750,6 +841,18 @@ class PagedKVCache:
         if not self.prefix_enabled:
             assert not self._hash_of_page and not self._lru, (
                 "prefix cache disabled but registry non-empty")
+        # disaggregated-handoff bookkeeping: an IMPORTED page's content
+        # was never computed here, so it is reachable ONLY through its
+        # chain-key registration — a resident imported page without a
+        # hash would be unidentifiable garbage. Every imported page
+        # must therefore still be hashed (eviction/_unregister removes
+        # it from the imported set atomically with its key) and in one
+        # of the hashed states the coverage rule above already vouches
+        # for (parked, or mapped under a resident sequence).
+        for page in self._imported:
+            assert page in self._hash_of_page, (
+                f"imported page {page} lost its chain key while still "
+                f"tracked as handoff content")
         # quantized-page scale bookkeeping: an int8 pool must have
         # registered scale arrays whose geometry matches the page
         # geometry exactly — a drifted shape would dequantize every

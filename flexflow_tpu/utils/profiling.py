@@ -236,6 +236,64 @@ def serve_report(stats: dict) -> str:
     return "\n".join(lines)
 
 
+def disagg_report(stats: dict, metrics=None) -> str:
+    """Render a DisaggCluster.last_stats dict: the role-split serving
+    A/B surface (docs/serving.md "Disaggregated serving"). Every
+    latency number reads from the role-labeled metrics fold
+    (utils/telemetry.serve_metrics role=...). Pass the cluster's own
+    registry (`cluster.metrics`) to render exactly what it exports —
+    the PR 10 no-drift rule — noting that registry is
+    CLUSTER-LIFETIME (counters accumulate across generate calls, so
+    the per-role lines are labeled "(lifetime)" and can legitimately
+    exceed the header's per-call totals). With metrics=None the fold
+    is rebuilt from the per-role stats of THIS call's dict, so every
+    line describes the same run."""
+    lifetime = metrics is not None
+    m = metrics
+    if m is None:
+        from .telemetry import MetricsRegistry
+        m = MetricsRegistry()
+        for role, role_stats in (stats.get("roles") or {}).items():
+            for st in role_stats:
+                # only the role-labeled series feed the lines below
+                serve_metrics(st, registry=m, role=role)
+    lines = [
+        f"disaggregated cluster: {stats.get('prefill_engines', 0)} "
+        f"prefill + {stats.get('decode_engines', 0)} decode engines "
+        f"(decode-role prefill stub {stats.get('decode_budget', 0)} "
+        f"lanes)"]
+    lines.append(
+        f"total: {stats.get('total_new_tokens', 0)} tokens in "
+        f"{stats.get('wall_s', 0.0)*1e3:.1f} ms "
+        f"({stats.get('tokens_per_sec', 0.0):.1f} tok/s)")
+    for role in ("prefill", "decode"):
+        ttft50 = m.quantile("serve_ttft_seconds", 50, role=role)
+        ttft99 = m.quantile("serve_ttft_seconds", 99, role=role)
+        tpot50 = m.quantile("serve_tpot_seconds", 50, role=role)
+        tpot99 = m.quantile("serve_tpot_seconds", 99, role=role)
+        toks = m.counter("serve_tokens_generated_total", role=role)
+        steps = m.counter("serve_engine_steps_total", role=role)
+        scope = " (lifetime)" if lifetime else ""
+        line = (f"{role} role{scope}: {toks:.0f} tokens / "
+                f"{steps:.0f} steps, "
+                f"ttft p50={ttft50*1e3:.2f} p99={ttft99*1e3:.2f} ms")
+        if tpot50 or tpot99:
+            line += (f", tpot p50={tpot50*1e3:.3f} "
+                     f"p99={tpot99*1e3:.3f} ms")
+        lines.append(line)
+    h = stats.get("handoff") or {}
+    if h:
+        lines.append(
+            f"kv handoff: {h.get('handoff_requests', 0):.0f} requests, "
+            f"{h.get('handoff_pages', 0):.0f} pages / "
+            f"{h.get('handoff_bytes', 0) / 2**20:.2f} MiB transferred, "
+            f"{h.get('handoff_dedup_pages', 0):.0f} deduped, "
+            f"{h.get('handoff_skipped', 0):.0f} skipped "
+            f"(backpressure), "
+            f"{h.get('handoff_seconds', 0.0)*1e3:.1f} ms on the link")
+    return "\n".join(lines)
+
+
 def search_report(stats: dict) -> str:
     """Render one strategy search's instrumentation (optimize stashes
     it on model.search_stats; tools/search_bench.py records the same

@@ -442,22 +442,29 @@ class Telemetry:
             ratios = {c: (attr[c] / pred[c]) if pred[c] > 0 else 0.0
                       for c in classes}
             method = "share"
-            if len(stats) >= len(classes) >= 1:
+            # solve only the classes that predicted ANY time: a class
+            # every breakdown carries at 0.0 (an unified engine's
+            # "transfer" column, a fits-in-HBM run's hbm_penalty) is an
+            # all-zero column that would pin rank below full and lock
+            # the solve out forever — its ratio is 0 by definition
+            solve = [c for c in classes if pred[c] > 0.0]
+            if len(stats) >= len(solve) >= 1:
                 try:
                     import numpy as np
                     # weight regimes by sample count: X rows are the
                     # mean per-step class vectors, y the mean measured
                     X = np.array([[st.breakdown.get(c, 0.0) / st.count
-                                   for c in classes] for st in stats])
+                                   for c in solve] for st in stats])
                     y = np.array([st.measured_s / st.count
                                   for st in stats])
                     w = np.sqrt([st.count for st in stats])
                     sol, _, rank, _ = np.linalg.lstsq(
                         X * w[:, None], y * w, rcond=None)
-                    if rank == len(classes) \
+                    if rank == len(solve) \
                             and np.all(np.isfinite(sol)):
-                        ratios = {c: max(0.0, float(s))
-                                  for c, s in zip(classes, sol)}
+                        ratios = {c: 0.0 for c in classes}
+                        ratios.update({c: max(0.0, float(s))
+                                       for c, s in zip(solve, sol)})
                         # keep the columns reconciled: under lstsq the
                         # attributed seconds ARE ratio * predicted, so
                         # attr/pred always equals the printed ratio
@@ -708,8 +715,8 @@ def telemetry_for(config=None) -> Telemetry:
 # ---------------------------------------------------------------------------
 
 def serve_metrics(stats: dict,
-                  registry: Optional[MetricsRegistry] = None
-                  ) -> MetricsRegistry:
+                  registry: Optional[MetricsRegistry] = None,
+                  role: Optional[str] = None) -> MetricsRegistry:
     """Fold one ServeEngine.last_stats dict into a MetricsRegistry:
     counters for tokens/requests/robustness events, gauges for
     rates/occupancy, histograms for TTFT / TPOT (per-token decode
@@ -717,8 +724,39 @@ def serve_metrics(stats: dict,
     produced, the batched-decode amortization) and request latency.
     Pass the engine's registry to ACCUMULATE across generate() calls
     (counters add, gauges overwrite, histograms extend); the default
-    fresh registry is what serve_report renders from."""
+    fresh registry is what serve_report renders from.
+
+    ``role`` folds the ROLE-LABELED split instead (disaggregated
+    serving, serve/disagg.py): only the latency histograms and the
+    core token/request counters, each under ``{role="prefill-engine"
+    -style}`` labels, so a DisaggCluster can split TTFT/TPOT
+    percentiles per role WITHOUT double-counting the unlabeled
+    aggregates its engines already folded (docs/observability.md)."""
     m = registry if registry is not None else MetricsRegistry()
+    if role is not None:
+        lab = {"role": str(role)}
+        for r in stats.get("requests", []):
+            m.inc("serve_requests_total",
+                  outcome=r.get("outcome", "completed"), **lab)
+            if r.get("ttft_s") is not None:
+                m.observe("serve_ttft_seconds", r["ttft_s"], **lab)
+            if r.get("latency_s") is not None:
+                m.observe("serve_request_latency_seconds",
+                          r["latency_s"], **lab)
+        for t, w in zip(stats.get("decode_step_times_s", []),
+                        stats.get("decode_widths", [])):
+            if w > 0:
+                m.observe("serve_tpot_seconds", t / w, **lab)
+        m.inc("serve_tokens_generated_total",
+              stats.get("total_new_tokens", 0), **lab)
+        m.inc("serve_engine_steps_total", stats.get("steps", 0), **lab)
+        m.inc("serve_decode_steps_total",
+              stats.get("decode_steps", 0), **lab)
+        m.inc("serve_prefill_tokens_computed_total",
+              stats.get("prefill_tokens_computed", 0), **lab)
+        m.inc("serve_prefix_hit_tokens_total",
+              stats.get("prefix_hit_tokens", 0), **lab)
+        return m
     for r in stats.get("requests", []):
         m.inc("serve_requests_total",
               outcome=r.get("outcome", "completed"))

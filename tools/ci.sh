@@ -132,6 +132,19 @@ echo "--- 1l. observability smoke (simulated-trace + search-trace + ledger + end
 # The 1k telemetry-overhead gate above is unchanged.
 env JAX_PLATFORMS=cpu python tools/explain.py --smoke || fail=1
 
+echo "--- 1m. disaggregated-serving smoke (TPOT-p99 + handoff exactness gate)"
+# unified vs prefill/decode-disaggregated serving under mixed
+# heavy-prefill + steady-decode traffic at equal device count: fails
+# unless the cluster's outputs are token-identical to the unified
+# engine (pages crossed the handoff link), nothing compiles after
+# DisaggCluster.warmup() on either role, and the TPOT-p99 reduction —
+# measured on this host or simulated by the ratio search (priced
+# page-transfer link, Gemma-31B-class arch on 16 v5e chips) — is
+# >= 1.3x (tools/serve_bench.py --workload disagg, docs/serving.md
+# "Disaggregated serving")
+env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload disagg \
+    -o /tmp/ci_bench_serve_disagg.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \
