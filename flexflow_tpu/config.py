@@ -443,6 +443,29 @@ class FFConfig:
     serve_disagg: bool = False
     serve_disagg_ratio: str = ""
     serve_disagg_decode_budget: int = 0
+    # multi-replica serving tier (serve/router.py, docs/serving.md
+    # "Multi-replica routing"): N engine replicas behind a request
+    # router. serve_replicas sizes the starting pool
+    # (--serve-replicas); router_policy picks how requests land —
+    # "affinity" routes to the replica whose chain-hash prefix
+    # registry holds the LONGEST matching prefix of the prompt (a
+    # host-side dict probe per page-aligned block; tenant-sticky
+    # fallback hash when nothing matches, load-aware spill off
+    # rung-3/occupancy pressure), "round_robin" is the A/B baseline
+    # (--router-policy). slo_ttft_ms / slo_tpot_ms define
+    # goodput-under-SLO — a request counts only when its TTFT and
+    # per-token decode latency both meet target (0 = that bound is
+    # waived) (--slo-ttft-ms / --slo-tpot-ms). serve_autoscale arms
+    # the telemetry-driven replica autoscaler (TTFT/TPOT p99 +
+    # pool-occupancy gauges vs the SLOs, priced against the placement
+    # search's per-degree decode table; --autoscale), scaling between
+    # 1 and serve_autoscale_max replicas (0 = 2x serve_replicas).
+    serve_replicas: int = 1
+    router_policy: str = "affinity"
+    slo_ttft_ms: float = 0.0
+    slo_tpot_ms: float = 0.0
+    serve_autoscale: bool = False
+    serve_autoscale_max: int = 0
 
     # synthetic input when no dataset is provided (reference: config.h:131)
     synthetic_input: bool = False
@@ -576,6 +599,22 @@ class FFConfig:
             raise ValueError(
                 f"serve_disagg_decode_budget must be >= 0 (0 = two "
                 f"pages' worth), got {self.serve_disagg_decode_budget}")
+        if self.serve_replicas < 1:
+            raise ValueError(
+                f"serve_replicas must be >= 1, got "
+                f"{self.serve_replicas}")
+        if self.router_policy not in ("affinity", "round_robin"):
+            raise ValueError(
+                f"router_policy must be 'affinity' or 'round_robin', "
+                f"got {self.router_policy!r}")
+        if self.slo_ttft_ms < 0 or self.slo_tpot_ms < 0:
+            raise ValueError(
+                f"slo_ttft_ms/slo_tpot_ms must be >= 0 (0 = no "
+                f"bound), got {self.slo_ttft_ms}/{self.slo_tpot_ms}")
+        if self.serve_autoscale_max < 0:
+            raise ValueError(
+                f"serve_autoscale_max must be >= 0 (0 = 2x "
+                f"serve_replicas), got {self.serve_autoscale_max}")
         sm = str(self.serve_mesh or "").strip()
         if sm and sm != "auto":
             try:
@@ -671,6 +710,11 @@ class FFConfig:
         "--serve-disagg-ratio": ("serve_disagg_ratio", str),
         "--serve-disagg-decode-budget": ("serve_disagg_decode_budget",
                                          int),
+        "--serve-replicas": ("serve_replicas", int),
+        "--router-policy": ("router_policy", str),
+        "--slo-ttft-ms": ("slo_ttft_ms", float),
+        "--slo-tpot-ms": ("slo_tpot_ms", float),
+        "--autoscale-max": ("serve_autoscale_max", int),
         "--trace-out": ("trace_out", str),
         "--trace-dir": ("trace_dir", str),
         "--telemetry-buffer": ("telemetry_buffer_events", int),
@@ -698,6 +742,7 @@ class FFConfig:
         "--sparse-embedding-lazy": "sparse_embedding_lazy",
         "--telemetry": "telemetry",
         "--serve-disagg": "serve_disagg",
+        "--autoscale": "serve_autoscale",
     }
     _NEG_BOOL_FLAGS = {
         "--no-overlap-sync": "search_overlap_backward_sync",

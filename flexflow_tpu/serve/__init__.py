@@ -11,7 +11,12 @@ compiles a single serving program, ever. :mod:`disagg` splits serving
 into dedicated prefill and decode engine roles with a host-side KV
 page handoff between them (:class:`DisaggCluster`) — decode steps stop
 paying for prefill lanes, the tail-latency win the placement search
-prices via ``optimize_serve(..., disaggregated=True)``.
+prices via ``optimize_serve(..., disaggregated=True)``. :mod:`router`
+builds the tier ABOVE one replica: a :class:`ReplicaPool` of N engines
+behind a prefix-affinity router with load-aware spill and a
+telemetry-driven :class:`Autoscaler`, serving the seeded timed traffic
+:mod:`traffic` synthesizes — goodput-under-SLO as a reproducible
+number (docs/serving.md "Multi-replica routing").
 """
 
 from .kv_cache import KVCacheConfig, PagedKVCache, prefix_page_keys
@@ -19,10 +24,20 @@ from .scheduler import (ChunkPlan, ContinuousBatchingScheduler,
                         RejectedRequest, Request, RequestOutcome,
                         RequestState, SampleParams, StepPlan)
 from .speculative import DraftControl, Drafter, PromptLookupDrafter
-from .engine import ServeEngine
+from .engine import ServeEngine, ServeSession, StepEvents
 from .disagg import DisaggCluster, PageShipment, engine_for
+from .router import Autoscaler, Replica, ReplicaPool
+from .traffic import TrafficRequest, TrafficSpec, make_traffic
 
 __all__ = [
+    "Autoscaler",
+    "Replica",
+    "ReplicaPool",
+    "ServeSession",
+    "StepEvents",
+    "TrafficRequest",
+    "TrafficSpec",
+    "make_traffic",
     "DisaggCluster",
     "PageShipment",
     "engine_for",

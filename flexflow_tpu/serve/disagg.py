@@ -73,7 +73,11 @@ class PageShipment:
     storage dtype, ``*_scale_rows`` the f32 per-row scale arrays on
     quantized pools (None otherwise). The geometry stamp lets
     ``import_kv`` reject a pool-shape mismatch loudly instead of
-    dequantizing garbage."""
+    dequantizing garbage. ``stream_id`` carries the request's
+    sampling-stream identity across the split (docs/serving.md
+    "Sampled streams"): the decode role resumes the stream at offset
+    1, so seeded temperature/top-k decoding survives the handoff
+    token-for-token instead of being refused."""
 
     keys: List[bytes]
     ntokens: int
@@ -86,6 +90,7 @@ class PageShipment:
     num_heads: int
     head_dim: int
     kv_dtype: str
+    stream_id: Optional[int] = None
 
     def signature(self) -> tuple:
         return (self.page_size, self.num_layers, self.num_heads,
@@ -147,11 +152,13 @@ class DisaggCluster:
         round-robin, with the decode pool's admission watermark as the
         handoff backpressure signal.
 
-    Greedy (and ``top_k=1``) decoding only: the cluster's split moves a
-    request between schedulers, and seeded sampling streams are keyed
-    by (rid, token index) WITHIN one scheduler — a disaggregated
-    temperature>0 stream could not reproduce the unified engine's, so
-    it is refused rather than silently diverging.
+    Sampled streams cross the split (docs/serving.md "Sampled
+    streams"): seeded draws key on a stream-id carried with the
+    request (and stamped into its PageShipment) plus a stream offset,
+    not the local scheduler's rid/token index — the prefill role draws
+    index 0 of stream i, the decode role resumes stream i at offset 1,
+    so seeded temperature/top-k decoding is token-identical to the
+    unified engine at the same seed instead of being refused.
 
     Everything is synchronous host-side orchestration (one process,
     both roles' programs on the same devices here): the measurable win
@@ -403,13 +410,6 @@ class DisaggCluster:
 
         temps = per_req(temperature, "temperature")
         tks = per_req(top_k, "top_k")
-        for t, k in zip(temps, tks):
-            if t is not None and float(t) > 0.0 and k != 1:
-                raise ValueError(
-                    "DisaggCluster serves deterministic decodes "
-                    "(greedy or top_k=1): a sampled stream is keyed "
-                    "to one scheduler's rid/token indices and cannot "
-                    "reproduce across the prefill->decode split")
         if isinstance(max_new_tokens, int):
             max_new_tokens = [max_new_tokens] * n
         if len(max_new_tokens) != n:
@@ -452,13 +452,18 @@ class DisaggCluster:
                         eos_token is not None and req.out_tokens
                         and req.out_tokens[-1] == eos_token):
                     return
-                _local[req.rid] = _eng.export_kv(req.slot, req.context)
+                _local[req.rid] = _eng.export_kv(
+                    req.slot, req.context, stream_id=req.stream_id)
 
+            # stream ids = GLOBAL request indices (the identity a
+            # unified engine's rids would be), so sampled draws on
+            # either side of the split reproduce the unified stream
             out = eng.generate(
                 [prompts[i] for i in idxs], 1, eos_token=eos_token,
                 temperature=[temps[i] for i in idxs],
                 top_k=[tks[i] for i in idxs],
                 sample_seed=sample_seed, on_finish=grab,
+                stream_ids=list(idxs),
                 on_step=(None if on_step is None else
                          (lambda s, _w=w: on_step("prefill", _w, s))))
             for rid, i in enumerate(idxs):
@@ -498,6 +503,10 @@ class DisaggCluster:
         for w, (eng, idxs) in enumerate(zip(self.decode, dwaves)):
             if not idxs:
                 continue
+            # the decode role RESUMES each stream at offset 1: the
+            # prefill role already drew token-index 0 (the first
+            # token), so the continuation's draws line up with the
+            # unified engine's indices 1..max_new-1
             out = eng.generate(
                 [list(prompts[i]) + [first[i]] for i in idxs],
                 [max_new_tokens[i] - 1 for i in idxs],
@@ -505,6 +514,7 @@ class DisaggCluster:
                 temperature=[temps[i] for i in idxs],
                 top_k=[tks[i] for i in idxs],
                 sample_seed=sample_seed,
+                stream_ids=list(idxs), stream_offset=1,
                 on_step=(None if on_step is None else
                          (lambda s, _w=w: on_step("decode", _w, s))))
             for j, i in enumerate(idxs):

@@ -227,6 +227,16 @@ class ServeEngine:
         self.telemetry = telemetry if telemetry is not None \
             else telemetry_for(cfg)
         self.trace_out = getattr(cfg, "trace_out", None)
+        # telemetry track process name: a ReplicaPool re-homes each
+        # replica's tracks (set_track_process) so N replicas' spans
+        # don't merge onto one "serve" track in the exported trace
+        self._proc = "serve"
+        self._ENGINE_TRACK = (self._proc, "engine")
+        self._QUEUE_TRACK = (self._proc, "queue")
+        # at most ONE live ServeSession owns the scheduler/slots at a
+        # time (serve/router.py keeps one open per replica; generate()
+        # opens and closes its own)
+        self._session: Optional["ServeSession"] = None
         # (ctx bucket) -> (predicted step seconds, per-task-class
         # breakdown) | None when the cost stack cannot price it
         self._drift_cache: Dict[int, Optional[tuple]] = {}
@@ -421,7 +431,7 @@ class ServeEngine:
                 self._retries += 1
                 if self.telemetry.enabled:
                     self.telemetry.instant(
-                        ("serve", "engine"), "retry",
+                        self._ENGINE_TRACK, "retry",
                         args={"site": f"serve.{name}",
                               "attempt": attempt})
                 if self.retry_backoff:
@@ -1047,7 +1057,8 @@ class ServeEngine:
         idx[:len(pages)] = pages
         return idx
 
-    def export_kv(self, slot: int, tokens: Sequence[int]):
+    def export_kv(self, slot: int, tokens: Sequence[int],
+                  stream_id: Optional[int] = None):
         """Ship `slot`'s full resident pages to the host: the
         prefill-engine half of a disaggregated handoff. Returns a
         PageShipment (serve/disagg.py) carrying the chain keys, the
@@ -1076,7 +1087,7 @@ class ServeEngine:
             v_scale_rows=host[3] if self.kv_quantized else None,
             page_size=c.page_size, num_layers=c.num_layers,
             num_heads=c.num_heads, head_dim=c.head_dim,
-            kv_dtype=c.kv_dtype)
+            kv_dtype=c.kv_dtype, stream_id=stream_id)
 
     def import_kv(self, ship) -> int:
         """Adopt a PageShipment into this engine's pool: the
@@ -1326,8 +1337,14 @@ class ServeEngine:
     def _pick_token(self, req: Request, greedy: int, topv, topi) -> int:
         """The emitted token for a lane: greedy argmax, or a seeded
         draw from the lane's top-k logits. The RNG is stateless per
-        (seed, rid, token-index), so a fixed seed reproduces a stream
-        exactly and preemption/resume replays nothing."""
+        (seed, stream-id, stream-offset + token-index) — stream_id
+        defaults to the local rid, so a plain engine keeps the
+        historical (seed, rid, index) keying bit-for-bit — which makes
+        a fixed seed reproduce a stream exactly, preemption/resume
+        replay nothing, and a stream SURVIVE crossing schedulers: the
+        disaggregated decode role resumes a handed-off request at
+        offset 1, and a routed replica draws the same stream a
+        single-replica engine would (docs/serving.md)."""
         sp = req.sample
         if sp is None:
             return int(greedy)
@@ -1336,8 +1353,9 @@ class ServeEngine:
         v -= v.max()
         p = np.exp(v)
         p /= p.sum()
-        rng = np.random.default_rng([sp.seed, req.rid,
-                                     len(req.out_tokens)])
+        sid = req.rid if req.stream_id is None else req.stream_id
+        rng = np.random.default_rng(
+            [sp.seed, sid, req.stream_offset + len(req.out_tokens)])
         return int(topi[int(rng.choice(k, p=p))])
 
     # ---------------- quantized-page verification (tests) -------------
@@ -1472,16 +1490,22 @@ class ServeEngine:
         live = list(sched.running.values()) + list(sched.waiting)
         for req in live:
             if req.rid in self._cancels:
+                # consume the mark either way: applied, or moot (the
+                # request already finished). A long-lived session
+                # (ReplicaPool) never reaches generate()'s wholesale
+                # clear, and rids restart at 0 in a recovery-reopened
+                # session — a stale mark must not cancel a stranger.
+                self._cancels.discard(req.rid)
                 if sched.abort(req, RequestOutcome.CANCELLED):
                     req.t_finish = now
                     if tel.enabled:
-                        tel.instant(("serve", "engine"), "cancel",
+                        tel.instant(self._ENGINE_TRACK, "cancel",
                                     t=now, args={"rid": req.rid})
             elif req.t_deadline and now >= req.t_deadline:
                 if sched.abort(req, RequestOutcome.DEADLINE_EXPIRED):
                     req.t_finish = now
                     if tel.enabled:
-                        tel.instant(("serve", "engine"),
+                        tel.instant(self._ENGINE_TRACK,
                                     "deadline_expired", t=now,
                                     args={"rid": req.rid})
 
@@ -1548,13 +1572,19 @@ class ServeEngine:
         return (f"t={self.tp} kv={self.kv_dtype} dec={n_decode} "
                 f"pre={pre_bucket} ctx={ctx_bucket}")
 
-    _ENGINE_TRACK = ("serve", "engine")
-    _QUEUE_TRACK = ("serve", "queue")
+    def set_track_process(self, proc: str) -> None:
+        """Re-home this engine's telemetry tracks under a new process
+        name (ReplicaPool labels each replica's tracks replica0/1/...
+        so a multi-replica trace keeps one track group per replica)."""
+        self._proc = str(proc)
+        self._ENGINE_TRACK = (self._proc, "engine")
+        self._QUEUE_TRACK = (self._proc, "queue")
+        self._slot_tracks = []
 
     def _slot_track(self, slot: int):
         tracks = self._slot_tracks
         while len(tracks) <= slot:
-            tracks.append(("serve", f"slot {len(tracks)}"))
+            tracks.append((self._proc, f"slot {len(tracks)}"))
         return tracks[slot]
 
     def _record_step_telemetry(self, tel, plan, step_idx: int,
@@ -1724,8 +1754,9 @@ class ServeEngine:
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens, eos_token: Optional[int] = None,
                  temperature=None, top_k=None, sample_seed: int = 0,
-                 deadline_s=None, on_step=None,
-                 on_finish=None) -> List[List[int]]:
+                 deadline_s=None, on_step=None, on_finish=None,
+                 stream_ids: Optional[Sequence[int]] = None,
+                 stream_offset: int = 0) -> List[List[int]]:
         """Decode a ragged batch under continuous batching.
         `max_new_tokens` is an int or a per-prompt sequence; greedy by
         default, per-request seeded temperature/top-k sampling when
@@ -1749,27 +1780,22 @@ class ServeEngine:
         engine exports them in (serve/disagg.py passes
         `lambda r: export_kv(r.slot, r.context)` here). A mid-batch
         exception fails only the in-flight requests and the engine
-        keeps serving (_fail_inflight)."""
+        keeps serving (_fail_inflight).
+
+        `stream_ids` (per-prompt, default None = the local rid) and
+        `stream_offset` key the seeded sampling draws to an engine-
+        independent stream identity (docs/serving.md "Sampled
+        streams"): a DisaggCluster resumes each request's stream at
+        offset 1 on the decode role, and a routed replica draws the
+        exact stream a single-replica engine would — token streams
+        survive crossing schedulers instead of being refused.
+
+        The chunked path runs through a :class:`ServeSession` (the
+        steppable form the multi-replica router drives directly);
+        generate() is submit-everything + drain over it, so both
+        tiers serve through one code path."""
         c = self.cache_cfg
         cache = self.cache
-        if cache.free_slots != c.max_seqs:
-            # a previous batch died WITHOUT _fail_inflight running (a
-            # BaseException like KeyboardInterrupt mid-loop, or a user
-            # driving the scheduler directly): reclaim the orphaned
-            # slots/pages AND reset the pool state — the registry may
-            # vouch for arrays the dead batch lost, and donation may
-            # have consumed the pools — then keep serving. The
-            # PR-3-era answer ("build a fresh ServeEngine") threw away
-            # a warm compiled program for a recoverable host state.
-            cache.release_all()
-            self._reset_pool_state()
-        sched = ContinuousBatchingScheduler(
-            cache, prefill_token_budget=self.prefill_budget,
-            chunked_prefill=self.chunked_prefill,
-            admit_watermark=self.admit_watermark,
-            spec_tokens=self.spec_tokens, drafter=self.drafter,
-            faults=self.faults, degrade_ladder=self.degrade_ladder,
-            reject_stalls=self.reject_stalls)
         if isinstance(max_new_tokens, int):
             max_new_tokens = [max_new_tokens] * len(prompts)
         if len(max_new_tokens) != len(prompts):
@@ -1786,11 +1812,44 @@ class ServeEngine:
             raise ValueError(
                 f"deadline_s has {len(deadline_s)} entries for "
                 f"{len(prompts)} prompts")
+        if stream_ids is not None and len(stream_ids) != len(prompts):
+            raise ValueError(
+                f"stream_ids has {len(stream_ids)} entries for "
+                f"{len(prompts)} prompts")
+        if self.chunked_prefill:
+            return self._generate_session(
+                prompts, max_new_tokens, samples, eos_token,
+                deadline_s, stream_ids, stream_offset, on_step,
+                on_finish)
+        # ---- legacy bucket path: its own scheduler + orphan recovery
+        # (the chunked path's ServeSession owns both)
+        if cache.free_slots != c.max_seqs:
+            # a previous batch died WITHOUT _fail_inflight running (a
+            # BaseException like KeyboardInterrupt mid-loop, or a user
+            # driving the scheduler directly): reclaim the orphaned
+            # slots/pages AND reset the pool state — the registry may
+            # vouch for arrays the dead batch lost, and donation may
+            # have consumed the pools — then keep serving. The
+            # PR-3-era answer ("build a fresh ServeEngine") threw away
+            # a warm compiled program for a recoverable host state.
+            cache.release_all()
+            self._reset_pool_state()
+        sched = ContinuousBatchingScheduler(
+            cache, prefill_token_budget=self.prefill_budget,
+            chunked_prefill=False,
+            admit_watermark=self.admit_watermark,
+            spec_tokens=self.spec_tokens, drafter=self.drafter,
+            faults=self.faults, degrade_ladder=self.degrade_ladder,
+            reject_stalls=self.reject_stalls)
         reqs: List[Request] = []
         t0 = time.perf_counter()
         for i, (prompt, mnt, sp) in enumerate(
                 zip(prompts, max_new_tokens, samples)):
-            r = sched.submit(prompt, mnt, eos_token=eos_token, sample=sp)
+            r = sched.submit(prompt, mnt, eos_token=eos_token, sample=sp,
+                             stream_id=(stream_ids[i]
+                                        if stream_ids is not None
+                                        else None),
+                             stream_offset=stream_offset)
             r.t_submit = time.perf_counter()
             if deadline_s is not None and deadline_s[i] \
                     and float(deadline_s[i]) > 0:
@@ -1816,60 +1875,12 @@ class ServeEngine:
                     on_finish(req)
                 sched.finish(req)
 
-        def emit_spec(chunk: ChunkPlan, lane0: int, greedy, topv,
-                      topi) -> int:
-            """Verify a speculative decode chunk and emit its step's
-            tokens: walk lanes lane0..lane0+k (the context token and
-            the k drafts), picking each lane's token exactly as
-            sequential decode would — lane j's logits are valid
-            BECAUSE every earlier pick matched the draft that fed lane
-            j+1 — and stop at the first mismatch (that pick IS the
-            corrected token), at EOS / max_new, or after the bonus
-            token when every draft held. Then the scheduler commits
-            the verified prefix and rolls the rejected tail's pages
-            back. Returns the number of tokens emitted (1 when k=0 —
-            the plain decode step, bit for bit)."""
-            req = chunk.req
-            k = len(chunk.draft_tokens)
-            matched = emitted = 0
-            for j in range(k + 1):
-                ln = lane0 + j
-                tok = self._pick_token(req, greedy[ln], topv[ln],
-                                       topi[ln])
-                # (no t_first_token stamp: only decode chunks
-                # speculate, and a decoding request already emitted)
-                req.out_tokens.append(tok)
-                emitted += 1
-                ok = j < k and tok == chunk.draft_tokens[j]
-                if ok:
-                    matched += 1
-                if req.is_done() or not ok:
-                    break
-            sched.complete_spec_chunk(chunk, matched)
-            if self.telemetry.enabled:
-                self.telemetry.instant(
-                    ("serve", f"slot {req.slot}"), "spec_verify",
-                    args={"rid": req.rid, "drafted": k,
-                          "accepted": matched, "emitted": emitted})
-            if req.is_done():
-                req.t_finish = time.perf_counter()
-                if on_finish is not None:
-                    on_finish(req)
-                sched.finish(req)
-            return emitted
-
         retries0 = self._retries
         tel = self.telemetry
         try:
-            if self.chunked_prefill:
-                kp, vp = self._run_chunked(sched, cache, kp, vp, emit,
-                                           emit_spec, decode_times,
-                                           decode_widths, prefill_times,
-                                           util, on_step)
-            else:
-                kp, vp = self._run_legacy(sched, cache, kp, vp, emit,
-                                          decode_times, decode_widths,
-                                          prefill_times, util, on_step)
+            kp, vp = self._run_legacy(sched, cache, kp, vp, emit,
+                                      decode_times, decode_widths,
+                                      prefill_times, util, on_step)
             steps = len(util)
         except Exception:
             self._fail_inflight(sched, reqs)
@@ -1894,10 +1905,32 @@ class ServeEngine:
         self._k_pages, self._v_pages = kp, vp
         cache.check_invariants()
         assert cache.free_pages == c.usable_pages, "pages leaked"
+        self.last_stats = self._build_stats(
+            reqs, sched, wall=time.perf_counter() - t0, steps=steps,
+            retries0=retries0, decode_times=decode_times,
+            decode_widths=decode_widths, prefill_times=prefill_times,
+            util=util)
+        # fold this run into the engine-lifetime telemetry registry
+        # (counters accumulate, gauges overwrite, histograms extend) —
+        # the same canonical definitions serve_report renders from
+        # (fault accounting + the trace flush already happened in the
+        # finally above, so aborted runs get them too)
+        if tel.enabled:
+            serve_metrics(self.last_stats, registry=tel.metrics)
+        return [list(r.out_tokens) for r in reqs]
+
+    def _build_stats(self, reqs, sched, *, wall, steps, retries0,
+                     decode_times, decode_widths, prefill_times,
+                     util) -> dict:
+        """The last_stats dict — ONE construction shared by
+        generate()'s legacy path and ServeSession.stats_dict() (the
+        chunked path and every routed replica), so the stats surface
+        cannot fork between tiers."""
+        c = self.cache_cfg
+        cache = self.cache
         total_new = sum(len(r.out_tokens) for r in reqs)
-        wall = time.perf_counter() - t0
         peak_util = float(np.max(util)) if util else 0.0
-        self.last_stats = {
+        return {
             "requests": [
                 {"rid": r.rid, "prompt_tokens": len(r.prompt),
                  "new_tokens": len(r.out_tokens),
@@ -1979,117 +2012,75 @@ class ServeEngine:
                     ).items()} if self.chunked_prefill else None,
             },
         }
-        # fold this run into the engine-lifetime telemetry registry
-        # (counters accumulate, gauges overwrite, histograms extend) —
+
+    def start_session(self) -> "ServeSession":
+        """Open an incremental serving session — the engine hook the
+        multi-replica router tier drives (serve/router.py): submit
+        requests at any time, advance ONE mixed step per
+        :meth:`ServeSession.step` call, ``close()`` when done.
+        generate() is submit-everything + drain over the same session
+        machinery, so a routed replica serves through exactly the code
+        path the single-engine contracts (token parity, zero
+        recompiles, invariants) are proven on. Chunked engines only;
+        at most one live session per engine (the session's scheduler
+        owns the slots)."""
+        return ServeSession(self)
+
+    def _generate_session(self, prompts, max_new_tokens, samples,
+                          eos_token, deadline_s, stream_ids,
+                          stream_offset, on_step, on_finish
+                          ) -> List[List[int]]:
+        """generate()'s chunked path: one ServeSession, every prompt
+        submitted up front, stepped to drain — behavior-identical to
+        the pre-session inline loop (same sweep/plan/dispatch order,
+        same stats, same failure containment)."""
+        session = self.start_session()
+        reqs = session.reqs
+        for i, (prompt, mnt, sp) in enumerate(
+                zip(prompts, max_new_tokens, samples)):
+            session.submit(
+                prompt, mnt, eos_token=eos_token, sample=sp,
+                deadline_s=(deadline_s[i] if deadline_s is not None
+                            else None),
+                stream_id=(stream_ids[i] if stream_ids is not None
+                           else None),
+                stream_offset=stream_offset, on_finish=on_finish)
+        tel = self.telemetry
+        try:
+            while True:
+                ev = session.step()
+                if ev is None:
+                    break
+                if ev.dispatched and on_step is not None:
+                    on_step(ev.step_index)
+        except Exception:
+            self._fail_inflight(session.sched, reqs)
+            raise
+        finally:
+            session.close()
+            self._active.clear()
+            self._cancels.clear()
+            # chaos runs stay inspectable post-hoc (docs/robustness.md):
+            # the injector's fired accounting and the Chrome trace
+            # flush even when a fault aborts the run, and an unwritable
+            # --trace-out path must not fail a generate that already
+            # produced tokens
+            if tel.enabled:
+                tel.record_faults(self.faults)
+                if self.trace_out:
+                    try:
+                        tel.export_chrome_trace(self.trace_out)
+                    except OSError:
+                        pass
+        self.cache.check_invariants()
+        assert self.cache.free_pages == self.cache_cfg.usable_pages, \
+            "pages leaked"
+        self.last_stats = session.stats_dict()
+        # fold this run into the engine-lifetime telemetry registry —
         # the same canonical definitions serve_report renders from
-        # (fault accounting + the trace flush already happened in the
-        # finally above, so aborted runs get them too)
         if tel.enabled:
             serve_metrics(self.last_stats, registry=tel.metrics)
         return [list(r.out_tokens) for r in reqs]
-
-    def _run_chunked(self, sched, cache, kp, vp, emit, emit_spec,
-                     decode_times, decode_widths, prefill_times, util,
-                     on_step=None):
-        """The mixed-step loop: every iteration packs this step's
-        chunks into the fixed `mixed_width` lanes and runs ONE program.
-        Draft lanes pack right after their chunk's context lanes, so a
-        speculative decode chunk occupies 1 + k CONSECUTIVE lanes —
-        each lane's K/V scatters before any lane attends (the mixed
-        step's contract), which is exactly what makes lane j's logits
-        the true next-token distribution given the drafts before it."""
-        c = self.cache_cfg
-        t_w = self.mixed_width
-        ps = c.page_size
-        while sched.has_work():
-            # chunk boundary: cancels and expired deadlines leave the
-            # system HERE, before any of this step's chunks exist
-            self._sweep_aborts(sched)
-            if not sched.has_work():
-                break
-            plan = sched.schedule()
-            if not plan.chunks:
-                # every waiting request was rejected (rung 4) or the
-                # running set was preempted whole under injected
-                # pressure; the next iteration re-plans (forced
-                # progress guarantees this cannot spin)
-                continue
-            tokens = np.zeros((t_w,), np.int32)
-            positions = np.zeros((t_w,), np.int32)
-            write_pages = np.zeros((t_w,), np.int32)   # sink by default
-            write_offs = np.zeros((t_w,), np.int32)
-            lane_slots = np.zeros((t_w,), np.int32)
-            lane_lens = np.ones((t_w,), np.int32)      # NaN-free padding
-            lane = 0
-            emitters: List[Tuple[ChunkPlan, int]] = []
-            spec_emitters: List[Tuple[ChunkPlan, int]] = []
-            for ch in plan.chunks:
-                ctx = ch.req.context
-                row = cache.page_tables[ch.req.slot]
-                for pos in range(ch.start, ch.end):
-                    tokens[lane] = ctx[pos]
-                    positions[lane] = pos
-                    write_pages[lane] = row[pos // ps]
-                    write_offs[lane] = pos % ps
-                    lane_slots[lane] = ch.req.slot
-                    lane_lens[lane] = pos + 1
-                    lane += 1
-                if ch.draft_tokens:
-                    spec_emitters.append((ch, lane - 1))
-                    for j, d in enumerate(ch.draft_tokens):
-                        pos = ch.end + j
-                        tokens[lane] = d
-                        positions[lane] = pos
-                        write_pages[lane] = row[pos // ps]
-                        write_offs[lane] = pos % ps
-                        lane_slots[lane] = ch.req.slot
-                        lane_lens[lane] = pos + 1
-                        lane += 1
-                elif ch.emits:
-                    emitters.append((ch, lane - 1))
-            assert lane <= t_w, (
-                f"scheduler packed {lane} lanes into a {t_w}-lane step")
-            tp = time.perf_counter()
-            greedy, topv, topi, kp, vp = self._dispatch_mixed(
-                kp, vp,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(write_pages), jnp.asarray(write_offs),
-                jnp.asarray(cache.page_tables), jnp.asarray(lane_slots),
-                jnp.asarray(lane_lens))
-            greedy = np.asarray(greedy)
-            topv = np.asarray(topv)
-            topi = np.asarray(topi)
-            dt = time.perf_counter() - tp
-            util.append(1.0 - cache.free_pages / c.usable_pages)
-            if self.telemetry.enabled:
-                self._record_step_telemetry(
-                    self.telemetry, plan, len(util) - 1, tp, dt,
-                    sched.rung, util[-1])
-            # bookkeeping FIRST (page commits hash the context as it
-            # was when the chunk ran), emission second; speculative
-            # chunks verify LAST — their residency bookkeeping is a
-            # function of the tokens they emit
-            for ch in plan.chunks:
-                if not ch.draft_tokens:
-                    sched.complete_chunk(ch)
-            dec_tokens = 0
-            for ch, ln in emitters:
-                emit(ch, greedy[ln], topv[ln], topi[ln])
-                if ch.is_decode:
-                    dec_tokens += 1
-            for ch, ln in spec_emitters:
-                dec_tokens += emit_spec(ch, ln, greedy, topv, topi)
-            if plan.num_decode_lanes:
-                decode_times.append(dt)
-                # width = tokens this step's decode chunks EMITTED
-                # (speculation makes it exceed the decode-lane count),
-                # the denominator of per-token decode latency
-                decode_widths.append(dec_tokens)
-            if plan.num_prefill_lanes:
-                prefill_times.append((plan.num_prefill_lanes, dt))
-            if on_step is not None:
-                on_step(len(util) - 1)
-        return kp, vp
 
     def _run_legacy(self, sched, cache, kp, vp, emit, decode_times,
                     decode_widths, prefill_times, util, on_step=None):
@@ -2202,3 +2193,310 @@ class ServeEngine:
                     break
             out.append(new)
         return out
+
+
+class StepEvents:
+    """What one :meth:`ServeSession.step` did — the router tier's
+    window into a replica's progress (serve/router.py advances each
+    replica's virtual clock by a cost-model-priced step and stamps
+    TTFT/TPOT off these). ``emitted`` is [(request, tokens emitted
+    this step)] (speculation can emit several per step), ``finished``
+    the requests that completed THIS step, ``ctx_mean`` the mean
+    decode-context length (the drift calibrator's pricing regime),
+    ``dispatched`` False for a planning-only iteration (rung-4
+    rejections / whole-set preemption under injected pressure — the
+    scheduler's forced-progress rule guarantees re-planning
+    converges)."""
+
+    __slots__ = ("dispatched", "step_index", "plan", "emitted",
+                 "finished", "ctx_mean", "wall_s")
+
+    def __init__(self, plan=None):
+        self.dispatched = False
+        self.step_index = -1
+        self.plan = plan
+        self.emitted: List[Tuple[Request, int]] = []
+        self.finished: List[Request] = []
+        self.ctx_mean = 0
+        self.wall_s = 0.0
+
+
+class ServeSession:
+    """Incremental (steppable) serving over one ServeEngine.
+
+    The engine hook of the multi-replica tier (serve/router.py): a
+    ReplicaPool keeps ONE long-lived session per replica, submits
+    requests as routed traffic arrives, and advances each replica one
+    mixed step at a time — while generate() drives the very same
+    session submit-all + drain, so the two tiers cannot fork. The
+    session owns the scheduler (and with it the engine's slots); at
+    most one is live per engine until ``close()``.
+
+    The step body is the former ``_run_chunked`` loop body verbatim:
+    sweep cancels/deadlines at the chunk boundary, plan, pack lanes,
+    dispatch the ONE mixed program, bookkeeping first / emission
+    second / speculative verification last."""
+
+    def __init__(self, engine: ServeEngine):
+        if not engine.chunked_prefill:
+            raise ValueError(
+                "serving sessions need the chunked mixed program "
+                "(serve_chunked_prefill=True); the legacy bucket path "
+                "has no single-step form")
+        if engine._session is not None:
+            raise RuntimeError(
+                "engine already has a live ServeSession — close() it "
+                "first (the session's scheduler owns the slots)")
+        self.eng = engine
+        cache = engine.cache
+        c = engine.cache_cfg
+        if cache.free_slots != c.max_seqs:
+            # same orphan recovery as the pre-session generate(): a
+            # previous batch died without _fail_inflight running —
+            # reclaim slots/pages, reset the pool state, serve on
+            cache.release_all()
+            engine._reset_pool_state()
+        self.sched = ContinuousBatchingScheduler(
+            cache, prefill_token_budget=engine.prefill_budget,
+            chunked_prefill=True,
+            admit_watermark=engine.admit_watermark,
+            spec_tokens=engine.spec_tokens, drafter=engine.drafter,
+            faults=engine.faults,
+            degrade_ladder=engine.degrade_ladder,
+            reject_stalls=engine.reject_stalls)
+        self.reqs: List[Request] = []
+        self._on_finish: Dict[int, object] = {}
+        self.decode_times: List[float] = []
+        self.decode_widths: List[int] = []
+        self.prefill_times: List[Tuple[int, float]] = []
+        self.util: List[float] = []
+        self._retries0 = engine._retries
+        self._t0 = time.perf_counter()
+        engine._device_pages()
+        engine._session = self
+
+    # ---------------- submission ---------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
+               eos_token: Optional[int] = None,
+               sample: Optional[SampleParams] = None,
+               deadline_s: Optional[float] = None,
+               stream_id: Optional[int] = None,
+               stream_offset: int = 0, on_finish=None) -> Request:
+        """Queue one request (admission happens at the next step()).
+        `sample` is a ready SampleParams (None = greedy); `stream_id`/
+        `stream_offset` key its sampling stream (engine._pick_token);
+        `on_finish(req)` fires when THIS request completes, before its
+        slot releases."""
+        r = self.sched.submit(prompt, int(max_new_tokens),
+                              eos_token=eos_token, sample=sample,
+                              stream_id=stream_id,
+                              stream_offset=stream_offset)
+        r.t_submit = time.perf_counter()
+        if deadline_s is None and self.eng.default_deadline > 0:
+            deadline_s = self.eng.default_deadline
+        if deadline_s and float(deadline_s) > 0:
+            r.t_deadline = r.t_submit + float(deadline_s)
+        if on_finish is not None:
+            self._on_finish[r.rid] = on_finish
+        self.reqs.append(r)
+        self.eng._active[r.rid] = r
+        return r
+
+    def has_work(self) -> bool:
+        return self.sched.has_work()
+
+    # ---------------- emission -----------------------------------------
+    def _finish(self, ev: StepEvents, req: Request) -> None:
+        req.t_finish = time.perf_counter()
+        cb = self._on_finish.pop(req.rid, None)
+        if cb is not None:
+            cb(req)
+        self.sched.finish(req)
+        self.eng._active.pop(req.rid, None)
+        ev.finished.append(req)
+
+    def _emit(self, ev: StepEvents, chunk: ChunkPlan, greedy, topv,
+              topi) -> None:
+        req = chunk.req
+        tok = self.eng._pick_token(req, greedy, topv, topi)
+        req.out_tokens.append(tok)
+        ev.emitted.append((req, 1))
+        if len(req.out_tokens) == 1:
+            req.t_first_token = time.perf_counter()
+        if req.is_done():
+            self._finish(ev, req)
+
+    def _emit_spec(self, ev: StepEvents, chunk: ChunkPlan, lane0: int,
+                   greedy, topv, topi) -> int:
+        """Verify a speculative decode chunk and emit its step's
+        tokens: walk lanes lane0..lane0+k (the context token and the k
+        drafts), picking each lane's token exactly as sequential
+        decode would — lane j's logits are valid BECAUSE every earlier
+        pick matched the draft that fed lane j+1 — and stop at the
+        first mismatch (that pick IS the corrected token), at EOS /
+        max_new, or after the bonus token when every draft held. Then
+        the scheduler commits the verified prefix and rolls the
+        rejected tail's pages back. Returns the number of tokens
+        emitted (1 when k=0 — the plain decode step, bit for bit)."""
+        eng = self.eng
+        req = chunk.req
+        k = len(chunk.draft_tokens)
+        matched = emitted = 0
+        for j in range(k + 1):
+            ln = lane0 + j
+            tok = eng._pick_token(req, greedy[ln], topv[ln], topi[ln])
+            # (no t_first_token stamp: only decode chunks speculate,
+            # and a decoding request already emitted)
+            req.out_tokens.append(tok)
+            emitted += 1
+            ok = j < k and tok == chunk.draft_tokens[j]
+            if ok:
+                matched += 1
+            if req.is_done() or not ok:
+                break
+        self.sched.complete_spec_chunk(chunk, matched)
+        if eng.telemetry.enabled:
+            eng.telemetry.instant(
+                eng._slot_track(req.slot), "spec_verify",
+                args={"rid": req.rid, "drafted": k,
+                      "accepted": matched, "emitted": emitted})
+        ev.emitted.append((req, emitted))
+        if req.is_done():
+            self._finish(ev, req)
+        return emitted
+
+    # ---------------- the step -----------------------------------------
+    def step(self) -> Optional[StepEvents]:
+        """Advance one engine step. Returns None when the session is
+        drained (no waiting or running requests survive the abort
+        sweep), else a StepEvents."""
+        eng = self.eng
+        sched = self.sched
+        cache = eng.cache
+        c = eng.cache_cfg
+        # chunk boundary: cancels and expired deadlines leave the
+        # system HERE, before any of this step's chunks exist
+        eng._sweep_aborts(sched)
+        if not sched.has_work():
+            return None
+        plan = sched.schedule()
+        ev = StepEvents(plan)
+        if not plan.chunks:
+            # every waiting request was rejected (rung 4) or the
+            # running set was preempted whole under injected pressure;
+            # the next step() re-plans (forced progress guarantees
+            # this cannot spin)
+            return ev
+        t_w = eng.mixed_width
+        ps = c.page_size
+        tokens = np.zeros((t_w,), np.int32)
+        positions = np.zeros((t_w,), np.int32)
+        write_pages = np.zeros((t_w,), np.int32)   # sink by default
+        write_offs = np.zeros((t_w,), np.int32)
+        lane_slots = np.zeros((t_w,), np.int32)
+        lane_lens = np.ones((t_w,), np.int32)      # NaN-free padding
+        lane = 0
+        emitters: List[Tuple[ChunkPlan, int]] = []
+        spec_emitters: List[Tuple[ChunkPlan, int]] = []
+        for ch in plan.chunks:
+            ctx = ch.req.context
+            row = cache.page_tables[ch.req.slot]
+            for pos in range(ch.start, ch.end):
+                tokens[lane] = ctx[pos]
+                positions[lane] = pos
+                write_pages[lane] = row[pos // ps]
+                write_offs[lane] = pos % ps
+                lane_slots[lane] = ch.req.slot
+                lane_lens[lane] = pos + 1
+                lane += 1
+            if ch.draft_tokens:
+                spec_emitters.append((ch, lane - 1))
+                for j, d in enumerate(ch.draft_tokens):
+                    pos = ch.end + j
+                    tokens[lane] = d
+                    positions[lane] = pos
+                    write_pages[lane] = row[pos // ps]
+                    write_offs[lane] = pos % ps
+                    lane_slots[lane] = ch.req.slot
+                    lane_lens[lane] = pos + 1
+                    lane += 1
+            elif ch.emits:
+                emitters.append((ch, lane - 1))
+        assert lane <= t_w, (
+            f"scheduler packed {lane} lanes into a {t_w}-lane step")
+        tp = time.perf_counter()
+        greedy, topv, topi, _, _ = eng._dispatch_mixed(
+            eng._k_pages, eng._v_pages,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(write_pages), jnp.asarray(write_offs),
+            jnp.asarray(cache.page_tables), jnp.asarray(lane_slots),
+            jnp.asarray(lane_lens))
+        greedy = np.asarray(greedy)
+        topv = np.asarray(topv)
+        topi = np.asarray(topi)
+        dt = time.perf_counter() - tp
+        self.util.append(1.0 - cache.free_pages / c.usable_pages)
+        if eng.telemetry.enabled:
+            eng._record_step_telemetry(
+                eng.telemetry, plan, len(self.util) - 1, tp, dt,
+                sched.rung, self.util[-1])
+        # bookkeeping FIRST (page commits hash the context as it was
+        # when the chunk ran), emission second; speculative chunks
+        # verify LAST — their residency bookkeeping is a function of
+        # the tokens they emit
+        for ch in plan.chunks:
+            if not ch.draft_tokens:
+                sched.complete_chunk(ch)
+        dec_tokens = 0
+        for ch, ln in emitters:
+            self._emit(ev, ch, greedy[ln], topv[ln], topi[ln])
+            if ch.is_decode:
+                dec_tokens += 1
+        for ch, ln in spec_emitters:
+            dec_tokens += self._emit_spec(ev, ch, ln, greedy, topv,
+                                          topi)
+        if plan.num_decode_lanes:
+            self.decode_times.append(dt)
+            # width = tokens this step's decode chunks EMITTED
+            # (speculation makes it exceed the decode-lane count),
+            # the denominator of per-token decode latency
+            self.decode_widths.append(dec_tokens)
+        if plan.num_prefill_lanes:
+            self.prefill_times.append((plan.num_prefill_lanes, dt))
+        ev.dispatched = True
+        ev.step_index = len(self.util) - 1
+        ev.wall_s = dt
+        ctxs = [len(ch.req.prompt) + len(ch.req.out_tokens)
+                for ch in plan.chunks if ch.is_decode] \
+            or [ch.end for ch in plan.chunks]
+        ev.ctx_mean = int(sum(ctxs) / len(ctxs))
+        return ev
+
+    # ---------------- stats / lifecycle --------------------------------
+    def stats_dict(self) -> dict:
+        """This session's last_stats-shaped dict so far (generate()
+        publishes it as engine.last_stats; a ReplicaPool folds it per
+        replica via serve_metrics(..., replica=...))."""
+        return self.eng._build_stats(
+            self.reqs, self.sched,
+            wall=time.perf_counter() - self._t0,
+            steps=len(self.util), retries0=self._retries0,
+            decode_times=self.decode_times,
+            decode_widths=self.decode_widths,
+            prefill_times=self.prefill_times, util=self.util)
+
+    def close(self) -> None:
+        """Release the session (idempotent): the engine can open a new
+        one. Does NOT force-abort live requests — drain first, or use
+        engine.cancel / _fail_inflight for abnormal teardown."""
+        if self.eng._session is self:
+            self.eng._session = None
+        for r in self.reqs:
+            self.eng._active.pop(r.rid, None)
+            self.eng._cancels.discard(r.rid)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

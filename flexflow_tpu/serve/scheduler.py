@@ -104,6 +104,16 @@ class Request:
     max_new_tokens: int
     eos_token: Optional[int] = None
     sample: Optional[SampleParams] = None
+    # sampling stream identity (docs/serving.md "Sampled streams"):
+    # seeded draws key on (seed, stream_id, stream_offset + token
+    # index) instead of the LOCAL scheduler's rid/token index, so a
+    # stream survives crossing schedulers — the disaggregated
+    # prefill->decode handoff resumes a stream at offset 1 on the
+    # decode engine, and a routed replica reproduces the exact stream
+    # a single-replica engine would emit. None = the rid (the
+    # pre-stream behavior, bit-identical).
+    stream_id: Optional[int] = None
+    stream_offset: int = 0
 
     state: RequestState = RequestState.WAITING
     slot: int = -1
@@ -269,7 +279,9 @@ class ContinuousBatchingScheduler:
     # ---------------- submission --------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                eos_token: Optional[int] = None,
-               sample: Optional[SampleParams] = None) -> Request:
+               sample: Optional[SampleParams] = None,
+               stream_id: Optional[int] = None,
+               stream_offset: int = 0) -> Request:
         if len(prompt) < 1:
             raise ValueError("empty prompt")
         if int(max_new_tokens) < 1:
@@ -281,9 +293,16 @@ class ContinuousBatchingScheduler:
             raise ValueError(
                 f"request needs {total} tokens > max_seq_len "
                 f"{self.cache.cfg.max_seq_len}")
+        if stream_id is not None and int(stream_id) < 0:
+            raise ValueError(
+                f"stream_id must be >= 0 (seed-sequence entries are "
+                f"unsigned), got {stream_id}")
         req = Request(rid=self._next_rid, prompt=list(prompt),
                       max_new_tokens=int(max_new_tokens),
-                      eos_token=eos_token, sample=sample)
+                      eos_token=eos_token, sample=sample,
+                      stream_id=(None if stream_id is None
+                                 else int(stream_id)),
+                      stream_offset=int(stream_offset))
         # speculation needs a deterministic per-lane pick to verify
         # against: greedy, or top_k=1 sampling (the already-drawn sample
         # is always the top-1 logit). Other sampling decodes with k=0.

@@ -294,6 +294,74 @@ def disagg_report(stats: dict, metrics=None) -> str:
     return "\n".join(lines)
 
 
+def router_report(stats: dict, metrics=None) -> str:
+    """Render a ReplicaPool.last_stats dict (serve/router.py): the
+    multi-replica routing surface — goodput-under-SLO, the routing
+    split (affinity hits / tenant fallbacks / spills / cancels), the
+    per-replica load table, and the autoscaler's decisions. Latency
+    and counter lines read from the pool's exported registry when
+    given (``pool.metrics`` — the PR 10 no-drift rule: the report
+    renders what the autoscaler and /metrics scrapes actually see);
+    virtual-clock numbers (goodput, makespan) come from the stats
+    dict — they ARE the exported accounting."""
+    lines = [
+        f"router: policy={stats.get('policy')}, "
+        f"{stats.get('replicas_start', 0)} -> "
+        f"{stats.get('replicas_end', 0)} replicas "
+        f"({stats.get('replicas_total', 0)} built), "
+        f"{len(stats.get('requests', []))} requests in "
+        f"{stats.get('makespan_s', 0.0)*1e3:.2f} virtual ms"]
+    slo_t = stats.get("slo_ttft_s")
+    slo_p = stats.get("slo_tpot_s")
+    lines.append(
+        f"goodput-under-SLO: {stats.get('goodput_per_s', 0.0):.1f} "
+        f"req/s ({stats.get('slo_ok', 0)}/"
+        f"{len(stats.get('requests', []))} met "
+        f"ttft<={slo_t*1e3 if slo_t else 0:.2f}ms & "
+        f"tpot<={slo_p*1e3 if slo_p else 0:.3f}ms; "
+        f"{stats.get('completed', 0)} completed, "
+        f"{stats.get('cancelled', 0)} cancelled)")
+    r = stats.get("routing") or {}
+    lines.append(
+        f"routing: {r.get('affinity_hits', 0)} affinity hits / "
+        f"{r.get('routed', 0)} routed, "
+        f"{r.get('fallbacks', 0)} tenant-sticky fallbacks, "
+        f"{r.get('spills', 0)} load spills, "
+        f"{r.get('cancels_sent', 0)} cancels")
+    if metrics is not None:
+        t50 = metrics.quantile("serve_router_ttft_virtual_seconds", 50)
+        t99 = metrics.quantile("serve_router_ttft_virtual_seconds", 99)
+        p50 = metrics.quantile("serve_router_tpot_virtual_seconds", 50)
+        p99 = metrics.quantile("serve_router_tpot_virtual_seconds", 99)
+        lines.append(
+            f"virtual latency: ttft p50={t50*1e3:.3f} "
+            f"p99={t99*1e3:.3f} ms, tpot p50={p50*1e3:.4f} "
+            f"p99={p99*1e3:.4f} ms")
+    per = stats.get("per_replica") or []
+    if per:
+        lines.append(f"{'replica':>8s} {'state':>8s} {'reqs':>6s} "
+                     f"{'steps':>7s} {'tokens':>7s} {'busy ms':>9s} "
+                     f"{'peak occ':>9s}")
+        for p in per:
+            state = "live" if p.get("live") else "parked"
+            lines.append(
+                f"{p['replica']:>8d} {state:>8s} "
+                f"{p['assigned']:>6d} {p['steps']:>7d} "
+                f"{p['tokens']:>7d} "
+                f"{p['busy_virtual_s']*1e3:>9.2f} "
+                f"{p['peak_occupancy']:>9.1%}")
+    ev = stats.get("scale_events") or []
+    if ev:
+        for e in ev:
+            lines.append(
+                f"autoscale {e['direction']} @ {e['t']*1e3:.2f} "
+                f"virtual ms -> replica {e['replica']} "
+                f"({e.get('reason', '')})")
+    elif stats.get("scale_events") is not None:
+        lines.append("autoscale: no decisions (steady)")
+    return "\n".join(lines)
+
+
 def search_report(stats: dict) -> str:
     """Render one strategy search's instrumentation (optimize stashes
     it on model.search_stats; tools/search_bench.py records the same

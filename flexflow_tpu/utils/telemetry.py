@@ -716,7 +716,8 @@ def telemetry_for(config=None) -> Telemetry:
 
 def serve_metrics(stats: dict,
                   registry: Optional[MetricsRegistry] = None,
-                  role: Optional[str] = None) -> MetricsRegistry:
+                  role: Optional[str] = None,
+                  replica: Optional[str] = None) -> MetricsRegistry:
     """Fold one ServeEngine.last_stats dict into a MetricsRegistry:
     counters for tokens/requests/robustness events, gauges for
     rates/occupancy, histograms for TTFT / TPOT (per-token decode
@@ -726,15 +727,24 @@ def serve_metrics(stats: dict,
     (counters add, gauges overwrite, histograms extend); the default
     fresh registry is what serve_report renders from.
 
-    ``role`` folds the ROLE-LABELED split instead (disaggregated
-    serving, serve/disagg.py): only the latency histograms and the
-    core token/request counters, each under ``{role="prefill-engine"
-    -style}`` labels, so a DisaggCluster can split TTFT/TPOT
-    percentiles per role WITHOUT double-counting the unlabeled
-    aggregates its engines already folded (docs/observability.md)."""
+    ``role`` / ``replica`` fold the LABELED split instead
+    (disaggregated serving's per-role split, serve/disagg.py, and the
+    multi-replica router's per-replica split, serve/router.py): only
+    the latency histograms and the core token/request counters, each
+    under ``{role=...}`` / ``{replica=...}`` labels, so a
+    DisaggCluster / ReplicaPool can split TTFT/TPOT percentiles per
+    engine WITHOUT double-counting the unlabeled aggregates — the
+    same no-double-counting fold for both label axes, which is what
+    lets the autoscaler and disagg_report/router_report read
+    per-engine latency from ONE registry instead of scraping engines
+    individually (docs/observability.md)."""
     m = registry if registry is not None else MetricsRegistry()
+    lab = {}
     if role is not None:
-        lab = {"role": str(role)}
+        lab["role"] = str(role)
+    if replica is not None:
+        lab["replica"] = str(replica)
+    if lab:
         for r in stats.get("requests", []):
             m.inc("serve_requests_total",
                   outcome=r.get("outcome", "completed"), **lab)

@@ -331,26 +331,47 @@ def test_disagg_quantized_pages(kv_dtype):
                             what=f"disagg {kv_dtype} outputs")
 
 
-def test_disagg_rejects_sampled_streams():
+def test_disagg_sampled_streams_survive_the_split():
+    """Seeded sampling crosses the prefill->decode handoff (the PR-12
+    follow-up): draws key on the stream-id carried with the request /
+    PageShipment — NOT the local scheduler's rid/token index — with
+    the decode role resuming at offset 1, so unified and disaggregated
+    token streams are identical at one seed for temperature/top-k
+    sampling (the mixes that used to be refused loudly)."""
+    rng = np.random.RandomState(3)
     ff = _lm()
-    cl = DisaggCluster(ff)
-    with pytest.raises(ValueError, match="deterministic"):
-        cl.generate([[1, 2, 3]], 4, temperature=0.7)
-    # a scalar temperature must broadcast against a per-request top_k
-    # list (the guard must see EVERY pair, not just the first)
-    with pytest.raises(ValueError, match="deterministic"):
-        cl.generate([[1, 2], [3, 4], [5, 6]], 4, temperature=0.9,
-                    top_k=[1, 5, 1])
-    # the unified engine's submit contract holds up front
+    uni = ServeEngine(ff, spec_tokens=0)
+    uni.warmup()
+    cl = DisaggCluster(ff, spec_tokens=0)
+    cl.warmup()
+    prompts = _prompts(rng, 6, hi=24)
+    # mixed per-request sampling: greedy, top_k=1, and real top-k
+    # temperature streams in one batch, crossing 2 decode waves
+    temps = [0.0, 0.7, 0.9, 0.8, 1.3, 0.6]
+    tks = [None, 1, 5, 8, 3, None]
+    for seed in (0, 7):
+        ref = uni.generate(prompts, 6, temperature=temps, top_k=tks,
+                           sample_seed=seed)
+        out = cl.generate(prompts, 6, temperature=temps, top_k=tks,
+                          sample_seed=seed)
+        assert out == ref, (
+            f"disagg sampled streams diverged from unified at seed "
+            f"{seed}")
+    # a DIFFERENT seed must move the sampled streams (the equality
+    # above is not vacuous greedy collapse)
+    alt = cl.generate(prompts, 6, temperature=temps, top_k=tks,
+                      sample_seed=11)
+    assert alt != out
+    # eos emitted mid-stream by a SAMPLED request truncates identically
+    eos = int(ref[2][1]) if len(ref[2]) > 1 else 7
+    assert cl.generate(prompts, 6, temperature=temps, top_k=tks,
+                       sample_seed=0, eos_token=eos) == \
+        uni.generate(prompts, 6, temperature=temps, top_k=tks,
+                     sample_seed=0, eos_token=eos)
+    # the unified engine's submit contract still holds up front
     with pytest.raises(ValueError, match="max_new_tokens"):
         cl.generate([[1, 2], [3, 4]], [4, 0])
-    # top_k=1 sampling is deterministic and allowed
-    cl.warmup()
-    out = cl.generate([[1, 2, 3, 4, 5]], 3, temperature=0.7, top_k=1)
-    uni = ServeEngine(ff)
-    uni.warmup()
-    assert out == uni.generate([[1, 2, 3, 4, 5]], 3, temperature=0.7,
-                               top_k=1)
+    assert cl.stats["handoff_requests"] > 0
 
 
 def test_disagg_per_request_args_slice_per_wave():

@@ -1,0 +1,55 @@
+"""Shared BENCH_*.json artifact I/O: merge-by-metric JSONL.
+
+Every bench artifact in this repo (BENCH_serve.json, BENCH_search.json,
+BENCH_train.json, ...) is one JSON record per line keyed by "metric".
+``write_records`` merges new records over the old artifact so a
+partial run (one ``--workload``, one smoke arm) refreshes ITS lines
+without clobbering the others', and the line-by-line legacy parser
+tolerates individually corrupt lines AND pre-JSONL whole-file dicts
+(they carry no "metric" key and are simply superseded) — one bad line
+never drops every other workload's history. serve_bench and
+search_bench both write through here; tools/perf_report.py reads
+through ``read_records``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+
+def read_records(path: str) -> List[dict]:
+    """Every well-formed {"metric": ...} record in the artifact, in
+    file order; unreadable lines (and legacy non-record lines) are
+    skipped, a missing file reads as empty."""
+    out: List[dict] = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    r = json.loads(ln)
+                except ValueError:
+                    continue   # skip the bad line, keep the rest
+                if isinstance(r, dict) and "metric" in r:
+                    out.append(r)
+    except OSError:
+        pass
+    return out
+
+
+def record_map(path: str) -> Dict[str, dict]:
+    """read_records folded metric -> record (last line wins)."""
+    return {r["metric"]: r for r in read_records(path)}
+
+
+def write_records(path: str, records: List[dict]) -> None:
+    """Merge `records` into the artifact by metric name and rewrite
+    it as JSONL (old records whose metric was not refreshed are
+    preserved verbatim)."""
+    merged = {**record_map(path), **{r["metric"]: r for r in records}}
+    with open(path, "w") as f:
+        f.write("\n".join(json.dumps(r) for r in merged.values())
+                + "\n")

@@ -145,6 +145,20 @@ echo "--- 1m. disaggregated-serving smoke (TPOT-p99 + handoff exactness gate)"
 env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload disagg \
     -o /tmp/ci_bench_serve_disagg.json || fail=1
 
+echo "--- 1n. multi-replica router smoke (goodput-under-SLO + exactness gate)"
+# prefix-affinity routing vs round-robin over a 3-replica simulated
+# cluster on a seeded multi-tenant prefix mix (Poisson arrivals,
+# heavy-tailed lengths, cancels, seeded sampling; virtual time priced
+# by the cost model): fails unless affinity's goodput-under-SLO is
+# >= 1.3x round-robin's, every completed request is token-identical
+# to a single replica serving the same stream ids, no replica
+# compiles after its own warmup, every page reclaims after drain,
+# and the telemetry-driven autoscaler's decisions replay identically
+# across two runs with spans emitted (tools/serve_bench.py
+# --workload router, docs/serving.md "Multi-replica routing")
+env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload router \
+    -o /tmp/ci_bench_serve_router.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \

@@ -92,6 +92,7 @@ def build_table(bench):
             f"table.")
     note += search_line()
     note += mp_line()
+    note += serve_line()
     return "\n".join(lines), note
 
 
@@ -111,16 +112,15 @@ def search_line() -> str:
                 b = {"speedup": doc["speedup"], **doc}
         except json.JSONDecodeError:
             pass
-        if b is None:  # merge-by-metric JSONL (search_bench.py)
-            for ln in text.splitlines():
-                try:
-                    r = json.loads(ln)
-                except json.JSONDecodeError:
-                    continue
-                if isinstance(r, dict) \
-                        and r.get("metric") == "search_delta_speedup":
-                    b = {"speedup": r["value"], **r.get("extra", {})}
-                    break
+        if b is None:  # merge-by-metric JSONL (tools/_bench_io.py)
+            sys.path.insert(0, os.path.dirname(
+                os.path.abspath(__file__)))
+            from _bench_io import record_map
+            r = record_map(
+                os.path.join(ROOT, "BENCH_search.json")).get(
+                "search_delta_speedup")
+            if r is not None:
+                b = {"speedup": r["value"], **r.get("extra", {})}
         if b is None:
             return ""
         return (f" Strategy search: "
@@ -150,6 +150,42 @@ def mp_line() -> str:
                      f"({wall['bfloat16']['tokens_per_sec']:,.0f} tok/s)")
         return line + " (`BENCH_mp.json`)."
     except (OSError, json.JSONDecodeError, KeyError):
+        return ""
+
+
+def serve_line() -> str:
+    """Serving sentence from BENCH_serve.json (merge-by-metric JSONL
+    via the shared reader, which also tolerates the legacy formats):
+    the headline multipliers of the serving stack — prefix-cache
+    prefill reduction, speculative step reduction, disaggregated
+    TPOT-p99, and the multi-replica router's goodput-under-SLO gain
+    (tools/serve_bench.py refreshes the JSON per --workload)."""
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from _bench_io import record_map
+        recs = record_map(os.path.join(ROOT, "BENCH_serve.json"))
+        parts = []
+        pieces = (
+            ("serve_prefill_token_reduction",
+             "{v:.1f}x prefix-cache prefill reduction"),
+            ("serve_decode_step_reduction",
+             "{v:.1f}x speculative decode steps"),
+            ("serve_kv_page_capacity",
+             "{v:.1f}x int8 KV pages/byte"),
+            ("serve_disagg_tpot_p99_reduction",
+             "{v:.1f}x disaggregated TPOT p99"),
+            ("serve_router_goodput_gain",
+             "{v:.1f}x routed goodput-under-SLO vs round-robin"),
+        )
+        for key, fmt in pieces:
+            r = recs.get(key)
+            if r is not None:
+                parts.append(fmt.format(v=float(r["value"])))
+        if not parts:
+            return ""
+        return (f" Serving: {', '.join(parts)} "
+                f"(`BENCH_serve.json`).")
+    except Exception:
         return ""
 
 

@@ -224,31 +224,15 @@ def main():
 
 
 def write_records(path: str, records) -> None:
-    """Merge-by-metric JSONL (the BENCH_serve.json idiom): a partial
-    run refreshes ITS records without clobbering others', tolerating
-    individually corrupt lines in the old artifact. (Pre-PR-11
-    BENCH_search.json was one whole-file dict — such a line has no
-    "metric" key and is simply superseded.)"""
-    merged = {r["metric"]: r for r in records}
-    old = []
-    try:
-        with open(path) as f:
-            for ln in f:
-                ln = ln.strip()
-                if not ln:
-                    continue
-                try:
-                    r = json.loads(ln)
-                except ValueError:
-                    continue
-                if isinstance(r, dict) and "metric" in r:
-                    old.append(r)
-    except OSError:
-        pass
-    merged = {**{r["metric"]: r for r in old}, **merged}
-    with open(path, "w") as f:
-        f.write("\n".join(json.dumps(r) for r in merged.values())
-                + "\n")
+    """Merge-by-metric JSONL through the shared artifact writer
+    (tools/_bench_io.py — serve_bench writes BENCH_serve.json through
+    the same code): a partial run refreshes ITS records without
+    clobbering others', tolerating individually corrupt lines in the
+    old artifact. (Pre-PR-11 BENCH_search.json was one whole-file
+    dict — such a line has no "metric" key and is simply
+    superseded.)"""
+    from _bench_io import write_records as _write
+    _write(path, records)
 
 
 if __name__ == "__main__":

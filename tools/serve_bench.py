@@ -116,7 +116,7 @@ def main() -> int:
                     ">= 1.5x decode step reduction (spec)")
     ap.add_argument("--workload",
                     choices=("all", "base", "spec", "kv", "shard",
-                             "telemetry", "disagg"),
+                             "telemetry", "disagg", "router"),
                     default="all",
                     help="base = random + shared-prefix (ci.sh 1d), "
                     "spec = repetitive speculative decode (ci.sh 1f), "
@@ -130,7 +130,14 @@ def main() -> int:
                     "serving under mixed heavy-prefill + steady-decode "
                     "traffic at equal device count, gating >= 1.3x "
                     "TPOT-p99 reduction + exactness + zero recompiles "
-                    "(ci.sh 1m)")
+                    "(ci.sh 1m), "
+                    "router = multi-replica prefix-affinity routing "
+                    "vs round-robin on a multi-tenant prefix mix "
+                    "under seeded timed traffic, gating >= 1.3x "
+                    "goodput-under-SLO + token exactness vs a single "
+                    "replica + zero recompiles per replica + full "
+                    "page reclamation, plus autoscaler determinism "
+                    "(ci.sh 1n)")
     ap.add_argument("--trace-out", default="",
                     help="write the telemetry workload's Chrome "
                     "trace-event JSON here (Perfetto-loadable; default "
@@ -1025,6 +1032,221 @@ def main() -> int:
             },
         })
 
+    if args.workload in ("all", "router"):
+        # ---- workload 8: multi-replica routing A/B (tools/ci.sh step
+        # 1n, docs/serving.md "Multi-replica routing"). A simulated
+        # cluster of 3 ServeEngine replicas serves the SAME seeded
+        # multi-tenant traffic stream (serve/traffic.py: Poisson
+        # arrivals, Zipf tenants over shared prefixes, heavy-tailed
+        # tails/outputs, mid-generation cancels, seeded top-k
+        # sampling) twice: prefix-affinity routed vs round-robin.
+        # The geometry makes the structural argument: each replica's
+        # page pool is too small to MIRROR every tenant's prefix, so
+        # round-robin thrashes the prefix caches (every replica keeps
+        # re-prefilling every tenant) while affinity PARTITIONS
+        # tenants across replicas and hits stay hits — the aggregate-
+        # cache-capacity win that decides TTFT at scale. Virtual time
+        # is priced by the same cost stack the placement search uses
+        # (simulate_serve_step per step), so goodput-under-SLO
+        # (requests meeting both the TTFT and TPOT targets, per
+        # second) is deterministic at one seed. Gates (smoke):
+        # >= 1.3x affinity/round-robin goodput, every completed
+        # request token-identical to ONE reference engine serving the
+        # same stream ids (greedy AND sampled), zero recompiles per
+        # replica after its own warmup, full page reclamation after
+        # drain, and autoscaler decisions that replay identically.
+        from flexflow_tpu.serve.router import Autoscaler, ReplicaPool
+        from flexflow_tpu.serve.traffic import TrafficSpec, make_traffic
+        from flexflow_tpu.utils.profiling import router_report
+        from flexflow_tpu.utils.telemetry import Telemetry
+
+        r_ps = 8
+        r_cfg = FFConfig(
+            batch_size=1, kv_page_size=r_ps, kv_num_pages=1 + 40,
+            serve_max_seqs=4, serve_prefill_budget=r_ps,
+            serve_spec_decode=False)
+        r_ff = build_transformer_lm(
+            r_cfg, vocab_size=args.vocab, max_seq_len=128,
+            hidden=args.hidden, num_heads=args.heads,
+            num_layers=args.layers, ff_dim=4 * args.hidden)
+        r_reqs = max(48, args.requests)
+        r_replicas = 3
+
+        r_tel = Telemetry()
+        pool_aff = ReplicaPool(r_ff, r_replicas, policy="affinity",
+                               telemetry=r_tel)
+        # every rate/SLO below is a multiple of the PRICED step, so
+        # the workload scales with the engine instead of hardcoding
+        # seconds (the same simulate_serve_step the search prices)
+        price = pool_aff.price_probe(64)
+        slo_ttft_s = 6.0 * price   # an affinity hit prefills in ~2
+        slo_tpot_s = 2.0 * price   # steps; a cold 80-token prefix
+        #                            needs ~10 + queueing
+        spec = TrafficSpec(
+            requests=r_reqs, seed=args.seed + 1, arrival="poisson",
+            rate_rps=0.3 / price, tenants=6, prefix_tokens=80,
+            tail_mean=5.0, output_mean=6.0, max_prompt=96,
+            max_new_cap=12, cancel_frac=0.06, sample_frac=0.25,
+            top_k=4, vocab=args.vocab)
+        traffic = make_traffic(spec)
+
+        res_aff = pool_aff.run(traffic, slo_ttft_s=slo_ttft_s,
+                               slo_tpot_s=slo_tpot_s,
+                               sample_seed=args.seed)
+        print(router_report(res_aff, pool_aff.metrics),
+              file=sys.stderr)
+        pool_aff.assert_zero_recompiles()
+        pool_aff.check_drained()
+
+        pool_rr = ReplicaPool(r_ff, r_replicas, policy="round_robin")
+        res_rr = pool_rr.run(traffic, slo_ttft_s=slo_ttft_s,
+                             slo_tpot_s=slo_tpot_s,
+                             sample_seed=args.seed)
+        pool_rr.assert_zero_recompiles()
+        pool_rr.check_drained()
+
+        # token exactness vs a SINGLE replica serving the same stream
+        # ids: completed requests identical, aborted ones a prefix —
+        # for every routed arm (routing must never change tokens)
+        ref_eng = ServeEngine(r_ff, spec_tokens=0)
+        ref_eng.warmup()
+        ref = ref_eng.generate(
+            [t.prompt for t in traffic],
+            [t.max_new for t in traffic],
+            temperature=[t.temperature for t in traffic],
+            top_k=[t.top_k for t in traffic],
+            sample_seed=args.seed,
+            stream_ids=[t.stream_id for t in traffic])
+        for arm, res in (("affinity", res_aff),
+                         ("round_robin", res_rr)):
+            for rec, r in zip(res["requests"], ref):
+                if rec["outcome"] == "completed":
+                    assert rec["tokens"] == r, (
+                        f"{arm} stream {rec['stream_id']} diverged "
+                        f"from the single-replica reference")
+                else:
+                    assert rec["tokens"] == r[:len(rec["tokens"])], (
+                        f"{arm} aborted stream {rec['stream_id']} is "
+                        f"not a reference prefix")
+        # traffic-shape sanity: hard under --smoke (the CI seed is
+        # pinned), a warning on custom-seed sweeps — a seed whose
+        # draws happen not to cancel/sample must not abort the bench
+        for ok, msg in (
+                (any(rec["sampled"] and rec["outcome"] == "completed"
+                     for rec in res_aff["requests"]),
+                 "the exactness gate never saw a completed SAMPLED "
+                 "stream"),
+                (res_aff["cancelled"] > 0,
+                 "the cancel path never fired — cancel_frac too low")):
+            if not ok:
+                assert not args.smoke, msg
+                print(f"WARNING: {msg}", file=sys.stderr)
+
+        gain = (res_aff["goodput_per_s"]
+                / max(res_rr["goodput_per_s"], 1e-12))
+        if gain < 1.3:
+            msg = (f"prefix-affinity routing only {gain:.2f}x "
+                   f"round-robin goodput-under-SLO (want >= 1.3x)")
+            assert not args.smoke, msg
+            print(f"WARNING: {msg}", file=sys.stderr)
+
+        # ---- autoscaler: a 1-replica pool under a seeded BURSTY
+        # stream must scale up (decisions read only exported gauges,
+        # priced by the search's per-degree decode table), emit spans,
+        # and REPLAY identically — run twice, compare decision lists
+        try:
+            from flexflow_tpu.search.serve_place import optimize_serve
+            table = optimize_serve(
+                pool_rr.replicas[0].engine.serve_arch(), 1,
+                config=r_cfg).decode_by_degree
+        except Exception:
+            table = None
+        bspec = TrafficSpec(
+            requests=r_reqs, seed=args.seed + 2, arrival="bursty",
+            rate_rps=0.15 / price, burst_factor=6.0, tenants=6,
+            prefix_tokens=80, tail_mean=5.0, output_mean=8.0,
+            max_prompt=96, max_new_cap=16, vocab=args.vocab)
+        btraffic = make_traffic(bspec)
+        runs = []
+        scale_tel = None
+        for _trial in range(2):
+            scale_tel = Telemetry()
+            pool_a = ReplicaPool(r_ff, 1, policy="affinity",
+                                 telemetry=scale_tel)
+            scaler = Autoscaler(
+                pool_a.metrics, slo_ttft_s=slo_ttft_s,
+                slo_tpot_s=slo_tpot_s, min_replicas=1,
+                max_replicas=2, interval_s=20 * price,
+                up_patience=2, down_patience=6,
+                cooldown_s=40 * price, decode_table=table,
+                tensor_parallel=1,
+                decode_lanes=r_cfg.serve_max_seqs)
+            res_a = pool_a.run(btraffic, slo_ttft_s=slo_ttft_s,
+                               slo_tpot_s=slo_tpot_s,
+                               autoscaler=scaler,
+                               sample_seed=args.seed)
+            pool_a.assert_zero_recompiles()
+            pool_a.check_drained()
+            runs.append([(round(e["t"], 9), e["direction"],
+                          e["replica"]) for e in res_a["scale_events"]])
+            pool_a.close()
+        assert runs[0] == runs[1], (
+            f"autoscaler decisions did not replay: {runs[0]} vs "
+            f"{runs[1]}")
+        assert runs[0], "the bursty stream never triggered a scale-up"
+        scale_spans = [e for e in scale_tel.events
+                       if e[0] == "X" and e[2].startswith("scale_")]
+        assert scale_spans, "scale events emitted no telemetry spans"
+
+        gates.append(
+            f"router_goodput_gain={gain:.2f}x "
+            f"(aff {res_aff['goodput_per_s']:.0f}/s att "
+            f"{res_aff['slo_attainment']:.2f} vs rr "
+            f"{res_rr['goodput_per_s']:.0f}/s att "
+            f"{res_rr['slo_attainment']:.2f}), autoscale "
+            f"{len(runs[0])} deterministic decisions")
+
+        records.append({
+            "metric": "serve_router_goodput_gain",
+            "value": round(gain, 2),
+            "unit": "x",
+            "extra": {
+                "platform": jax.default_backend(),
+                "requests": r_reqs,
+                "replicas": r_replicas,
+                "tenants": spec.tenants,
+                "prefix_tokens": spec.prefix_tokens,
+                "priced_step_ms": round(price * 1e3, 6),
+                "slo_ttft_steps": 6.0, "slo_tpot_steps": 2.0,
+                "goodput_affinity_per_s": round(
+                    res_aff["goodput_per_s"], 2),
+                "goodput_round_robin_per_s": round(
+                    res_rr["goodput_per_s"], 2),
+                "slo_attainment_affinity": round(
+                    res_aff["slo_attainment"], 4),
+                "slo_attainment_round_robin": round(
+                    res_rr["slo_attainment"], 4),
+                "affinity_hits": res_aff["routing"]["affinity_hits"],
+                "fallbacks": res_aff["routing"]["fallbacks"],
+                "spills": res_aff["routing"]["spills"],
+                "cancelled": res_aff["cancelled"],
+                "sampled_requests": sum(
+                    1 for t in traffic if t.sampled),
+                "outputs_match_single_replica": True,
+                "zero_recompiles": True,
+                "pages_reclaimed": True,
+                "compile_counts": pool_aff.compile_counts(),
+                "autoscale_events": runs[0],
+                "autoscale_priced_by_decode_table": table is not None,
+                "virtual_makespan_ms_affinity": round(
+                    res_aff["makespan_s"] * 1e3, 4),
+                "virtual_makespan_ms_round_robin": round(
+                    res_rr["makespan_s"] * 1e3, 4),
+            },
+        })
+        pool_aff.close()
+        pool_rr.close()
+
     if args.workload in ("all", "telemetry"):
         # ---- workload 6: telemetry on/off A/B (tools/ci.sh step 1k).
         # The observability contract (docs/observability.md): a
@@ -1199,35 +1421,13 @@ def main() -> int:
 
     print("\n".join(json.dumps(r) for r in records))
     if args.out:
-        # merge by metric: a partial --workload run must refresh ITS
-        # lines without deleting the other workloads' records from the
-        # artifact (BENCH_serve.json is committed; clobbering it with a
-        # subset would silently drop metrics). Parse the old artifact
-        # LINE-BY-LINE, tolerating individually corrupt lines — the
-        # previous whole-file try/except dropped EVERY old record when
-        # any single line was unreadable, so a partial run over a
-        # damaged artifact silently clobbered the other workloads'
-        # numbers.
-        merged = {r["metric"]: r for r in records}
-        old = []
-        try:
-            with open(args.out) as f:
-                for ln in f:
-                    ln = ln.strip()
-                    if not ln:
-                        continue
-                    try:
-                        r = json.loads(ln)
-                    except ValueError:
-                        continue   # skip the bad line, keep the rest
-                    if isinstance(r, dict) and "metric" in r:
-                        old.append(r)
-        except OSError:
-            pass
-        merged = {**{r["metric"]: r for r in old}, **merged}
-        with open(args.out, "w") as f:
-            f.write("\n".join(json.dumps(r)
-                              for r in merged.values()) + "\n")
+        # merge-by-metric JSONL through the ONE shared writer
+        # (tools/_bench_io.py, the format BENCH_search.json shares):
+        # a partial --workload run refreshes ITS lines without
+        # deleting the other workloads' records, tolerating
+        # individually corrupt lines in the old artifact
+        from _bench_io import write_records
+        write_records(args.out, records)
     if args.smoke:
         print(f"serve smoke OK: {'; '.join(gates)}", file=sys.stderr)
     return 0
