@@ -134,6 +134,27 @@ class FFConfig:
     # on shared hosts); set "0.0.0.0" to expose it to a pod/host
     # network scraper. --metrics-host.
     metrics_host: str = "127.0.0.1"
+    # failure flight recorder (docs/observability.md "Failure flight
+    # recorder"): when set, ServeEngine (and the router/disagg tiers
+    # above it) auto-dump a bounded post-mortem bundle — last-N ring
+    # spans, metrics/drift snapshots, memory ledger, scheduler + KV
+    # pool state, fault accounting — into this directory on
+    # fault-abort, deadline storm, or rung-4 rejection (atomic
+    # tmp+rename; rate-limited; loadable by tools/postmortem.py).
+    # Setting it implies telemetry (the bundle needs the span ring).
+    # postmortem_events bounds the bundle's event payload.
+    # --postmortem-dir / --postmortem-events.
+    postmortem_dir: Optional[str] = None
+    postmortem_events: int = 2048
+    # SLO burn-rate monitor (utils/slo.py, rendered by
+    # tools/slo_report.py): the tolerated violation fraction of the
+    # slo_ttft_ms/slo_tpot_ms targets (0.01 = a 99% SLO). The
+    # ReplicaPool auto-arms the monitor whenever SLO targets are set
+    # (slo_monitor=False disarms); alerts fire on fast+slow windowed
+    # burn rates over exported counters only, deterministic at one
+    # seed. --slo-error-budget / --no-slo-monitor.
+    slo_error_budget: float = 0.01
+    slo_monitor: bool = True
 
     # ---- async/overlap training runtime (core/overlap.py) ----
     # bucketed, backward-overlapped gradient sync: the walk's weighted
@@ -638,6 +659,14 @@ class FFConfig:
             raise ValueError(
                 f"metrics_port must be None (off) or 0..65535 "
                 f"(0 = ephemeral), got {self.metrics_port}")
+        if self.postmortem_events < 1:
+            raise ValueError(
+                f"postmortem_events must be >= 1, got "
+                f"{self.postmortem_events}")
+        if not (0.0 < self.slo_error_budget <= 1.0):
+            raise ValueError(
+                f"slo_error_budget must be in (0, 1] (the tolerated "
+                f"violation fraction), got {self.slo_error_budget}")
         if self.fault_spec:
             # parse eagerly so a typo'd spec fails at config time, not
             # silently mid-chaos-run
@@ -722,6 +751,9 @@ class FFConfig:
         "--metrics-port": ("metrics_port", int),
         "--metrics-host": ("metrics_host", str),
         "--schedule-trace": ("schedule_trace_file", str),
+        "--postmortem-dir": ("postmortem_dir", str),
+        "--postmortem-events": ("postmortem_events", int),
+        "--slo-error-budget": ("slo_error_budget", float),
     }
     _BOOL_FLAGS = {
         "--profiling": "profiling",
@@ -755,6 +787,7 @@ class FFConfig:
         "--no-spec-decode": "serve_spec_decode",
         "--no-degrade-ladder": "serve_degrade_ladder",
         "--no-search-trace": "search_trace",
+        "--no-slo-monitor": "slo_monitor",
     }
 
     def parse_args(self, argv: Sequence[str]) -> None:

@@ -91,6 +91,11 @@ class PageShipment:
     head_dim: int
     kv_dtype: str
     stream_id: Optional[int] = None
+    # trace-context propagation (docs/observability.md): the request's
+    # trace id crosses the link WITH its pages, so the kv_handoff span
+    # and the decode role's spans land on the same causally-linked
+    # timeline the prefill role started
+    trace_id: Optional[int] = None
 
     def signature(self) -> tuple:
         return (self.page_size, self.num_layers, self.num_heads,
@@ -229,6 +234,9 @@ class DisaggCluster:
             "handoff_skipped": 0, "handoff_seconds": 0.0}
         self.last_stats: Optional[dict] = None
         self.placement = None   # set by from_config's "auto" path
+        # (trace_id, prefill Request, decode Request) triples of the
+        # last generate() — the cross-role explain_request source
+        self._last_traces: List[list] = []
         # the cluster-lifetime registry the per-role TTFT/TPOT split
         # folds into (serve_metrics role labels; disagg_report reads
         # it). With telemetry enabled it IS the bus's registry (the
@@ -362,7 +370,8 @@ class DisaggCluster:
             self.stats["handoff_skipped"] += 1
             if tel.enabled:
                 tel.instant(_CLUSTER_TRACK, "kv_handoff_skipped",
-                            args={"rid": rid, "pages": ship.num_pages})
+                            args={"rid": rid, "pages": ship.num_pages,
+                                  "trace": ship.trace_id})
             return
         before_dedup = eng.cache.stats["import_dedup_pages"]
         written = eng.import_kv(ship)
@@ -377,7 +386,8 @@ class DisaggCluster:
         if tel.enabled:
             tel.span(_CLUSTER_TRACK, "kv_handoff", t0, t0 + dt,
                      args={"rid": rid, "pages": written,
-                           "dedup_pages": dedup, "bytes": nbytes})
+                           "dedup_pages": dedup, "bytes": nbytes,
+                           "trace": ship.trace_id})
             tel.metrics.inc("kv_transfer_bytes_total", nbytes)
             tel.metrics.inc("kv_transfer_pages_total", written)
 
@@ -426,6 +436,16 @@ class DisaggCluster:
         t_start = time.perf_counter()
         tel = self.telemetry
         stats0 = dict(self.stats)  # lifetime counters: fold the DELTA
+        # ONE trace id per request for its WHOLE disaggregated life:
+        # the prefill-role spans, the kv_handoff span (via the
+        # PageShipment) and the decode-role spans all carry it, so the
+        # exported trace holds one causally-linked timeline per
+        # request across the split (docs/observability.md)
+        from ..utils.telemetry import next_trace_id
+        tids = [next_trace_id() for _ in range(n)]
+        # (trace_id, prefill Request, decode Request) per request —
+        # the explain_request / fold_attribution source
+        self._last_traces = [[tids[i], None, None] for i in range(n)]
 
         # ---- phase 1: prefill role (+ export at each finish) ----------
         # round-robin the batch over the prefill engines; every request
@@ -453,7 +473,8 @@ class DisaggCluster:
                         and req.out_tokens[-1] == eos_token):
                     return
                 _local[req.rid] = _eng.export_kv(
-                    req.slot, req.context, stream_id=req.stream_id)
+                    req.slot, req.context, stream_id=req.stream_id,
+                    trace_id=req.trace_id)
 
             # stream ids = GLOBAL request indices (the identity a
             # unified engine's rids would be), so sampled draws on
@@ -464,6 +485,7 @@ class DisaggCluster:
                 top_k=[tks[i] for i in idxs],
                 sample_seed=sample_seed, on_finish=grab,
                 stream_ids=list(idxs),
+                trace_ids=[tids[i] for i in idxs],
                 on_step=(None if on_step is None else
                          (lambda s, _w=w: on_step("prefill", _w, s))))
             for rid, i in enumerate(idxs):
@@ -472,6 +494,7 @@ class DisaggCluster:
                 # engine's empty output instead of crashing the batch
                 first[i] = out[rid][0] if out[rid] else None
                 ships[i] = local.get(rid)
+                self._last_traces[i][1] = eng._last_reqs.get(rid)
             pre_stats.append(eng.last_stats)
 
         # which requests actually continue to the decode role: done-at-
@@ -515,10 +538,12 @@ class DisaggCluster:
                 top_k=[tks[i] for i in idxs],
                 sample_seed=sample_seed,
                 stream_ids=list(idxs), stream_offset=1,
+                trace_ids=[tids[i] for i in idxs],
                 on_step=(None if on_step is None else
                          (lambda s, _w=w: on_step("decode", _w, s))))
             for j, i in enumerate(idxs):
                 results[i].extend(out[j])
+                self._last_traces[i][2] = eng._last_reqs.get(j)
             dec_stats.append(eng.last_stats)
 
         wall = time.perf_counter() - t_start
@@ -565,6 +590,81 @@ class DisaggCluster:
             m.inc("kv_transfer_bytes_total", delta("handoff_bytes"))
             m.inc("kv_transfer_pages_total", delta("handoff_pages"))
         return results
+
+    # ---------------- observability --------------------------------------
+    def explain_request(self, index: int) -> dict:
+        """Cross-role latency attribution for request `index` of the
+        last generate() (docs/observability.md): ONE trace id ties the
+        prefill-role spans, the kv_handoff transfer span and the
+        decode-role spans together, so the breakdown spans the whole
+        disaggregated life — measured from the prefill submit stamp to
+        the decode finish stamp (prefill finish when the request never
+        crossed the link). Batch-phase orchestration time (other
+        requests' waves) lands in ``other`` — honestly unattributable
+        to this request's critical path."""
+        if not self.telemetry.enabled:
+            raise RuntimeError(
+                "explain_request needs telemetry (pass telemetry= or "
+                "set --telemetry/--trace-out)")
+        if not (0 <= index < len(self._last_traces)):
+            raise KeyError(
+                f"request index {index} not in the last generate "
+                f"({len(self._last_traces)} requests)")
+        tid, pre, dec = self._last_traces[index]
+        if pre is None or not pre.t_finish:
+            raise ValueError(
+                f"request {index} has no terminated prefill-role "
+                f"request to attribute")
+        t_finish = dec.t_finish if dec is not None and dec.t_finish \
+            else pre.t_finish
+        out = self.telemetry.explain_request(tid, pre.t_submit,
+                                             t_finish)
+        out.update(index=index,
+                   outcome=(dec.outcome if dec is not None
+                            else pre.outcome),
+                   crossed_link=dec is not None)
+        return out
+
+    def fold_attribution(self, registry=None) -> dict:
+        """Fold every attributable request of the last generate() into
+        `registry` (default: the cluster registry) — the aggregate
+        `serve_latency_attribution_*` series (utils/telemetry
+        .fold_attribution)."""
+        from ..utils.telemetry import (REQUEST_COMPONENTS,
+                                       fold_attribution)
+        m = registry if registry is not None else self.metrics
+        totals = {c: 0.0 for c in REQUEST_COMPONENTS}
+        if not self.telemetry.enabled:
+            return totals   # no spans to attribute (router-fold rule)
+        for i in range(len(self._last_traces)):
+            try:
+                b = self.explain_request(i)
+            except (ValueError, KeyError):
+                continue
+            fold_attribution(b, m)
+            for c, v in b["components"].items():
+                totals[c] += v
+        return totals
+
+    def dump_postmortem(self, path: Optional[str] = None,
+                        reason: str = "manual",
+                        detail: Optional[dict] = None) -> str:
+        """Cluster flight-recorder dump: the lead prefill engine's
+        bundle (the roles share ONE telemetry bus, so its ring/metrics
+        ARE the cluster's) plus per-role KV-pool state and compile
+        counts, and the cluster's handoff accounting."""
+        from ..utils.telemetry import write_json_atomic
+        lead = self.prefill[0]
+        bundle = lead.postmortem_bundle(reason, detail)
+        bundle["mode"] = "disagg"
+        bundle["handoff"] = dict(self.stats)
+        bundle["roles"] = {
+            f"{role}{i}": {"kv_pool": eng.cache.debug_state(),
+                           "compile_counts": eng.compile_counts()}
+            for i, (role, eng) in enumerate(self.engines())}
+        if path is None:
+            path = lead._postmortem_path(reason)
+        return write_json_atomic(path, bundle)
 
     # ---------------- reference / ledger --------------------------------
     def generate_reference(self, prompts, max_new_tokens,

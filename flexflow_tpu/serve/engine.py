@@ -50,6 +50,7 @@ preemption/resume.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -161,6 +162,13 @@ class ServeEngine:
     # survives sampling. top_k > this cap is rejected at generate().
     TOPK_CAP = 64
 
+    # failure flight recorder thresholds: deadline expirations at ONE
+    # chunk-boundary sweep that count as a storm (auto post-mortem),
+    # and the minimum wall seconds between auto-triggered bundles (a
+    # sustained failure produces one black box, not a disk flood)
+    DEADLINE_STORM = 3
+    POSTMORTEM_MIN_INTERVAL_S = 5.0
+
     def __init__(self, model, *, max_seq_len: Optional[int] = None,
                  use_pallas: Optional[bool] = None, interpret: bool = False,
                  chunked_prefill: Optional[bool] = None,
@@ -245,6 +253,21 @@ class ServeEngine:
         self.max_retries = int(getattr(cfg, "serve_max_retries", 3))
         self.retry_backoff = float(
             getattr(cfg, "serve_retry_backoff_s", 0.02))
+        # failure flight recorder (docs/observability.md): when
+        # postmortem_dir is set (implies telemetry via telemetry_for),
+        # the engine dumps a bounded post-mortem bundle on fault-abort,
+        # deadline storm, or rung-4 rejection — rate-limited so a
+        # storm produces ONE bundle, not a disk flood. dump_postmortem
+        # is the explicit trigger and ignores the rate limit.
+        self.postmortem_dir = getattr(cfg, "postmortem_dir", None)
+        self.postmortem_events = int(
+            getattr(cfg, "postmortem_events", 2048))
+        self._postmortem_seq = 0
+        self._postmortem_last = -float("inf")
+        # requests of the most recent generate()/session run, kept for
+        # explain_request(rid) (rids restart per session, so this is
+        # the last run's namespace); trace ids stay globally unique
+        self._last_reqs: Dict[int, Request] = {}
         self.default_deadline = float(
             getattr(cfg, "serve_request_deadline", 0.0))
         self.degrade_ladder = bool(
@@ -435,7 +458,18 @@ class ServeEngine:
                         args={"site": f"serve.{name}",
                               "attempt": attempt})
                 if self.retry_backoff:
+                    tb = time.perf_counter()
                     time.sleep(self.retry_backoff * (2 ** (attempt - 1)))
+                    if self.telemetry.enabled:
+                        # the backoff is dead time EVERY request in
+                        # this step pays: a complete span (not an
+                        # instant) so explain_request can carve it out
+                        # of the covering chunk spans as "retry"
+                        self.telemetry.span(
+                            self._ENGINE_TRACK, "retry_backoff", tb,
+                            time.perf_counter(),
+                            args={"site": f"serve.{name}",
+                                  "attempt": attempt})
         # jit compiles synchronously at dispatch (only execution is
         # async), so any backend-compile event between the snapshots
         # belongs to THIS call
@@ -1058,7 +1092,8 @@ class ServeEngine:
         return idx
 
     def export_kv(self, slot: int, tokens: Sequence[int],
-                  stream_id: Optional[int] = None):
+                  stream_id: Optional[int] = None,
+                  trace_id: Optional[int] = None):
         """Ship `slot`'s full resident pages to the host: the
         prefill-engine half of a disaggregated handoff. Returns a
         PageShipment (serve/disagg.py) carrying the chain keys, the
@@ -1087,7 +1122,8 @@ class ServeEngine:
             v_scale_rows=host[3] if self.kv_quantized else None,
             page_size=c.page_size, num_layers=c.num_layers,
             num_heads=c.num_heads, head_dim=c.head_dim,
-            kv_dtype=c.kv_dtype, stream_id=stream_id)
+            kv_dtype=c.kv_dtype, stream_id=stream_id,
+            trace_id=trace_id)
 
     def import_kv(self, ship) -> int:
         """Adopt a PageShipment into this engine's pool: the
@@ -1488,6 +1524,7 @@ class ServeEngine:
         now = time.perf_counter()
         tel = self.telemetry
         live = list(sched.running.values()) + list(sched.waiting)
+        expired = 0
         for req in live:
             if req.rid in self._cancels:
                 # consume the mark either way: applied, or moot (the
@@ -1500,14 +1537,23 @@ class ServeEngine:
                     req.t_finish = now
                     if tel.enabled:
                         tel.instant(self._ENGINE_TRACK, "cancel",
-                                    t=now, args={"rid": req.rid})
+                                    t=now, args={"rid": req.rid,
+                                                 "trace": req.trace_id})
             elif req.t_deadline and now >= req.t_deadline:
                 if sched.abort(req, RequestOutcome.DEADLINE_EXPIRED):
                     req.t_finish = now
+                    expired += 1
                     if tel.enabled:
                         tel.instant(self._ENGINE_TRACK,
                                     "deadline_expired", t=now,
-                                    args={"rid": req.rid})
+                                    args={"rid": req.rid,
+                                          "trace": req.trace_id})
+        if expired >= self.DEADLINE_STORM:
+            # a deadline STORM (several requests expiring at one chunk
+            # boundary) is the latency-collapse signature an operator
+            # needs a black box for — one bounded bundle, rate-limited
+            self._auto_postmortem("deadline_storm", sched=sched,
+                                  detail={"expired_this_sweep": expired})
 
     def _fail_inflight(self, sched, reqs: Sequence[Request]) -> None:
         """Crash containment (replacing the PR-3-era hard brick): a
@@ -1520,10 +1566,16 @@ class ServeEngine:
         generate() serves normally on a pool that check_invariants
         vouches for."""
         now = time.perf_counter()
+        failed = 0
         for req in reqs:
             if req.state != RequestState.FINISHED:
                 if sched.abort(req, RequestOutcome.FAILED):
                     req.t_finish = now
+                    failed += 1
+        # black-box the crash BEFORE resetting pool state: the bundle
+        # must capture the scheduler/pool as the failure left them
+        self._auto_postmortem("fault_abort", sched=sched,
+                              detail={"failed_inflight": failed})
         self._reset_pool_state()
 
     def _reset_pool_state(self) -> None:
@@ -1615,7 +1667,7 @@ class ServeEngine:
                 ident = f"{req.rid}.{req.preemptions}"
                 evs.append(("b", self._QUEUE_TRACK, "requeue_wait",
                             req._t_requeue, 0.0, ident,
-                            {"rid": req.rid,
+                            {"rid": req.rid, "trace": req.trace_id,
                              "preemptions": req.preemptions}))
                 evs.append(("e", self._QUEUE_TRACK, "requeue_wait",
                             now, 0.0, ident, None))
@@ -1624,7 +1676,7 @@ class ServeEngine:
                 req.t_admit = now
                 evs.append(("b", self._QUEUE_TRACK, "queue_wait",
                             req.t_submit, 0.0, req.rid,
-                            {"rid": req.rid,
+                            {"rid": req.rid, "trace": req.trace_id,
                              "prompt_tokens": len(req.prompt)}))
                 evs.append(("e", self._QUEUE_TRACK, "queue_wait",
                             req.t_admit, 0.0, req.rid, None))
@@ -1632,6 +1684,7 @@ class ServeEngine:
             victim._t_requeue = now
             evs.append(("i", self._ENGINE_TRACK, "preempt", now, 0.0,
                         None, {"rid": victim.rid,
+                               "trace": victim.trace_id,
                                "preemptions": victim.preemptions}))
         drafted = 0
         for ch in plan.chunks:
@@ -1640,8 +1693,9 @@ class ServeEngine:
             drafted += len(ch.draft_tokens)
             evs.append(("X", self._slot_track(ch.req.slot), name,
                         t_start, dur,
-                        None, {"rid": ch.req.rid, "start": ch.start,
-                               "end": ch.end,
+                        None, {"rid": ch.req.rid,
+                               "trace": ch.req.trace_id,
+                               "start": ch.start, "end": ch.end,
                                "drafted": len(ch.draft_tokens)}))
         n_dec = plan.num_decode_lanes
         n_pre = plan.num_prefill_lanes
@@ -1667,6 +1721,184 @@ class ServeEngine:
                 tel.record_drift(
                     "serve", self._drift_regime(n_dec, pre_b, ctx_b),
                     pred[0], dt, breakdown=pred[1])
+
+    # ---------------- per-request latency attribution ------------------
+    def explain_request(self, rid: int) -> dict:
+        """Additive latency attribution for request `rid` of the most
+        recent generate()/session run (docs/observability.md
+        "Per-request latency attribution"): fold its spans into
+        ``{queue, routing, prefill, transfer, decode, preempt_stall,
+        retry, other}`` seconds summing to its measured wall latency
+        EXACTLY (gated within 1% in CI). Needs telemetry enabled and a
+        finished request; rids are ``last_stats['requests'][i]['rid']``.
+        Adds ``rid``/``outcome``/``tokens`` to the breakdown."""
+        if not self.telemetry.enabled:
+            raise RuntimeError(
+                "explain_request needs telemetry (pass telemetry= or "
+                "set --telemetry/--trace-out)")
+        req = self._last_reqs.get(rid)
+        if req is None:
+            raise KeyError(
+                f"rid {rid} is not in the last run "
+                f"({sorted(self._last_reqs)})")
+        if not req.t_finish:
+            raise ValueError(
+                f"request {rid} has no finish stamp (outcome "
+                f"{req.outcome!r}) — only terminated requests are "
+                f"attributable")
+        out = self.telemetry.explain_request(
+            req.trace_id, req.t_submit, req.t_finish)
+        out.update(rid=req.rid, outcome=req.outcome,
+                   tokens=len(req.out_tokens))
+        return out
+
+    def fold_attribution(self, registry=None) -> dict:
+        """Fold EVERY terminated request of the last run through
+        :meth:`explain_request` into `registry` (default: the engine's
+        lifetime registry) — the pool-level aggregate
+        (`serve_latency_attribution_seconds_total{component}` + the
+        derived fraction gauges). Returns the per-component second
+        totals of this fold. On-demand, never on the serving hot path
+        (the ≤1.03x overhead gate covers recording, not analysis)."""
+        from ..utils.telemetry import (REQUEST_COMPONENTS,
+                                       fold_attribution)
+        m = registry if registry is not None else self.telemetry.metrics
+        totals = {c: 0.0 for c in REQUEST_COMPONENTS}
+        if not self.telemetry.enabled:
+            # no spans to attribute — and the disabled singleton's
+            # registry is process-shared, so never write into it
+            return totals
+        for rid, req in sorted(self._last_reqs.items()):
+            if not req.t_finish:
+                continue
+            b = self.telemetry.explain_request(
+                req.trace_id, req.t_submit, req.t_finish)
+            fold_attribution(b, m)
+            for c, v in b["components"].items():
+                totals[c] += v
+        return totals
+
+    # ---------------- failure flight recorder ---------------------------
+    def postmortem_bundle(self, reason: str = "manual",
+                          detail: Optional[dict] = None,
+                          sched=None) -> dict:
+        """Assemble the bounded post-mortem bundle (docs/observability
+        "Failure flight recorder"): the last-N ring spans, metrics +
+        drift snapshots, the HBM memory ledger, scheduler and KV-pool
+        state, fault accounting and compile counts — everything an
+        operator needs to reconstruct a failure post-hoc, bounded so a
+        pathological run cannot produce an unbounded artifact. Every
+        sub-collector is individually guarded: a broken ledger must
+        not cost the spans."""
+        tel = self.telemetry
+        if sched is None:
+            sched = self._session.sched if self._session else None
+        bundle = {
+            "schema": "flexflow_tpu.postmortem/1",
+            "reason": str(reason),
+            "detail": dict(detail or {}),
+            "created_unix_s": time.time(),
+            "engine": {
+                "mode": "chunked" if self.chunked_prefill else "legacy",
+                "mixed_width": self.mixed_width,
+                "tensor_parallel": self.tp,
+                "kv_dtype": self.kv_dtype,
+                "max_seqs": self.cache_cfg.max_seqs,
+                "prefill_budget": self.prefill_budget,
+                "track_process": self._proc,
+            },
+            "compile_counts": self.compile_counts(),
+            "events": tel.events_tail(self.postmortem_events),
+            "events_dropped": tel.dropped_events,
+        }
+        for key, collect in (
+                ("metrics", lambda: tel.metrics.snapshot()),
+                ("drift", tel.drift_snapshot),
+                ("memory_ledger", self.memory_ledger),
+                ("scheduler", (sched.debug_state if sched is not None
+                               else lambda: None)),
+                ("kv_pool", self.cache.debug_state),
+                ("faults", lambda: {
+                    "fired": {s: dict(k) for s, k in
+                              getattr(self.faults, "fired",
+                                      {}).items()},
+                    "site_hits": dict(getattr(self.faults, "_count",
+                                              {}))}),
+                ("last_stats", lambda: self._trimmed_last_stats())):
+            try:
+                bundle[key] = collect()
+            except Exception as e:   # a collector bug loses ONE section
+                bundle[key] = {"error": f"{type(e).__name__}: {e}"}
+        return bundle
+
+    def _trimmed_last_stats(self) -> Optional[dict]:
+        st = self.last_stats
+        if not st:
+            return None
+        st = dict(st)
+        reqs = st.get("requests")
+        if isinstance(reqs, list) and len(reqs) > 64:
+            st["requests"] = reqs[-64:]
+            st["requests_trimmed"] = len(reqs) - 64
+        # per-step timing lists grow with the run — the bundle keeps
+        # the aggregates, tools/postmortem.py renders from those
+        for k in ("decode_step_times_s", "decode_widths",
+                  "prefill_times_s"):
+            v = st.get(k)
+            if isinstance(v, list) and len(v) > 256:
+                st[k] = v[-256:]
+        return st
+
+    def _postmortem_path(self, reason: str) -> str:
+        """THE bundle naming scheme — `postmortem-<reason>-<pid>-<n>
+        .json` under postmortem_dir (CWD when unset). One definition:
+        the pool/cluster dump_postmortem variants route through their
+        lead engine's counter here, and tools/postmortem.py's glob
+        patterns depend on it."""
+        base = self.postmortem_dir or "."
+        os.makedirs(base, exist_ok=True)
+        self._postmortem_seq += 1
+        return os.path.join(
+            base, f"postmortem-{reason}-{os.getpid()}-"
+                  f"{self._postmortem_seq}.json")
+
+    def dump_postmortem(self, path: Optional[str] = None,
+                        reason: str = "manual",
+                        detail: Optional[dict] = None,
+                        sched=None) -> str:
+        """Write the post-mortem bundle via atomic tmp+rename and
+        return the path (default: :meth:`_postmortem_path` under
+        ``postmortem_dir``, or the CWD when unset). Explicit trigger —
+        always writes, no rate limit. The bundle loads with
+        ``tools/postmortem.py``."""
+        from ..utils.telemetry import write_json_atomic
+        bundle = self.postmortem_bundle(reason, detail, sched=sched)
+        if path is None:
+            path = self._postmortem_path(reason)
+        return write_json_atomic(path, bundle)
+
+    def _auto_postmortem(self, reason: str, sched=None,
+                         detail: Optional[dict] = None) -> Optional[str]:
+        """Auto-triggered flight-recorder dump (fault-abort, deadline
+        storm, rung-4 rejection): only when ``postmortem_dir`` is
+        armed, rate-limited, and NEVER raises — a black-box failure
+        must not mask the failure it was recording."""
+        if not self.postmortem_dir or not self.telemetry.enabled:
+            return None
+        now = time.monotonic()
+        if now - self._postmortem_last < self.POSTMORTEM_MIN_INTERVAL_S:
+            return None
+        self._postmortem_last = now
+        try:
+            path = self.dump_postmortem(reason=reason, detail=detail,
+                                        sched=sched)
+            if self.telemetry.enabled:
+                self.telemetry.instant(
+                    self._ENGINE_TRACK, "postmortem_dump",
+                    args={"reason": reason, "path": path})
+            return path
+        except Exception:
+            return None
 
     # ---------------- memory ledger ------------------------------------
     def memory_ledger(self) -> dict:
@@ -1756,7 +1988,9 @@ class ServeEngine:
                  temperature=None, top_k=None, sample_seed: int = 0,
                  deadline_s=None, on_step=None, on_finish=None,
                  stream_ids: Optional[Sequence[int]] = None,
-                 stream_offset: int = 0) -> List[List[int]]:
+                 stream_offset: int = 0,
+                 trace_ids: Optional[Sequence[int]] = None
+                 ) -> List[List[int]]:
         """Decode a ragged batch under continuous batching.
         `max_new_tokens` is an int or a per-prompt sequence; greedy by
         default, per-request seeded temperature/top-k sampling when
@@ -1816,11 +2050,15 @@ class ServeEngine:
             raise ValueError(
                 f"stream_ids has {len(stream_ids)} entries for "
                 f"{len(prompts)} prompts")
+        if trace_ids is not None and len(trace_ids) != len(prompts):
+            raise ValueError(
+                f"trace_ids has {len(trace_ids)} entries for "
+                f"{len(prompts)} prompts")
         if self.chunked_prefill:
             return self._generate_session(
                 prompts, max_new_tokens, samples, eos_token,
                 deadline_s, stream_ids, stream_offset, on_step,
-                on_finish)
+                on_finish, trace_ids)
         # ---- legacy bucket path: its own scheduler + orphan recovery
         # (the chunked path's ServeSession owns both)
         if cache.free_slots != c.max_seqs:
@@ -1849,7 +2087,10 @@ class ServeEngine:
                              stream_id=(stream_ids[i]
                                         if stream_ids is not None
                                         else None),
-                             stream_offset=stream_offset)
+                             stream_offset=stream_offset,
+                             trace_id=(trace_ids[i]
+                                       if trace_ids is not None
+                                       else None))
             r.t_submit = time.perf_counter()
             if deadline_s is not None and deadline_s[i] \
                     and float(deadline_s[i]) > 0:
@@ -1917,6 +2158,7 @@ class ServeEngine:
         # finally above, so aborted runs get them too)
         if tel.enabled:
             serve_metrics(self.last_stats, registry=tel.metrics)
+        self._last_reqs = {r.rid: r for r in reqs}
         return [list(r.out_tokens) for r in reqs]
 
     def _build_stats(self, reqs, sched, *, wall, steps, retries0,
@@ -1932,7 +2174,8 @@ class ServeEngine:
         peak_util = float(np.max(util)) if util else 0.0
         return {
             "requests": [
-                {"rid": r.rid, "prompt_tokens": len(r.prompt),
+                {"rid": r.rid, "trace_id": r.trace_id,
+                 "prompt_tokens": len(r.prompt),
                  "new_tokens": len(r.out_tokens),
                  "preemptions": r.preemptions,
                  "outcome": r.outcome,
@@ -2028,8 +2271,8 @@ class ServeEngine:
 
     def _generate_session(self, prompts, max_new_tokens, samples,
                           eos_token, deadline_s, stream_ids,
-                          stream_offset, on_step, on_finish
-                          ) -> List[List[int]]:
+                          stream_offset, on_step, on_finish,
+                          trace_ids=None) -> List[List[int]]:
         """generate()'s chunked path: one ServeSession, every prompt
         submitted up front, stepped to drain — behavior-identical to
         the pre-session inline loop (same sweep/plan/dispatch order,
@@ -2044,7 +2287,9 @@ class ServeEngine:
                             else None),
                 stream_id=(stream_ids[i] if stream_ids is not None
                            else None),
-                stream_offset=stream_offset, on_finish=on_finish)
+                stream_offset=stream_offset, on_finish=on_finish,
+                trace_id=(trace_ids[i] if trace_ids is not None
+                          else None))
         tel = self.telemetry
         try:
             while True:
@@ -2271,6 +2516,7 @@ class ServeSession:
         self.prefill_times: List[Tuple[int, float]] = []
         self.util: List[float] = []
         self._retries0 = engine._retries
+        self._rejected_seen = 0   # flight-recorder rejection trigger
         self._t0 = time.perf_counter()
         engine._device_pages()
         engine._session = self
@@ -2281,16 +2527,19 @@ class ServeSession:
                sample: Optional[SampleParams] = None,
                deadline_s: Optional[float] = None,
                stream_id: Optional[int] = None,
-               stream_offset: int = 0, on_finish=None) -> Request:
+               stream_offset: int = 0, on_finish=None,
+               trace_id: Optional[int] = None) -> Request:
         """Queue one request (admission happens at the next step()).
         `sample` is a ready SampleParams (None = greedy); `stream_id`/
         `stream_offset` key its sampling stream (engine._pick_token);
-        `on_finish(req)` fires when THIS request completes, before its
-        slot releases."""
+        `trace_id` carries an upstream tier's trace context (router /
+        disagg — None mints a fresh one); `on_finish(req)` fires when
+        THIS request completes, before its slot releases."""
         r = self.sched.submit(prompt, int(max_new_tokens),
                               eos_token=eos_token, sample=sample,
                               stream_id=stream_id,
-                              stream_offset=stream_offset)
+                              stream_offset=stream_offset,
+                              trace_id=trace_id)
         r.t_submit = time.perf_counter()
         if deadline_s is None and self.eng.default_deadline > 0:
             deadline_s = self.eng.default_deadline
@@ -2358,8 +2607,9 @@ class ServeSession:
         if eng.telemetry.enabled:
             eng.telemetry.instant(
                 eng._slot_track(req.slot), "spec_verify",
-                args={"rid": req.rid, "drafted": k,
-                      "accepted": matched, "emitted": emitted})
+                args={"rid": req.rid, "trace": req.trace_id,
+                      "drafted": k, "accepted": matched,
+                      "emitted": emitted})
         ev.emitted.append((req, emitted))
         if req.is_done():
             self._finish(ev, req)
@@ -2381,6 +2631,12 @@ class ServeSession:
             return None
         plan = sched.schedule()
         ev = StepEvents(plan)
+        if sched.stats["rejected"] > self._rejected_seen:
+            # rung-4 structured rejection: the ladder refused service —
+            # exactly the state an operator wants black-boxed (one
+            # bundle per rate-limit window, not one per rejection)
+            self._rejected_seen = sched.stats["rejected"]
+            eng._auto_postmortem("rejection", sched=sched)
         if not plan.chunks:
             # every waiting request was rejected (rung 4) or the
             # running set was preempted whole under injected pressure;
@@ -2491,6 +2747,10 @@ class ServeSession:
         engine.cancel / _fail_inflight for abnormal teardown."""
         if self.eng._session is self:
             self.eng._session = None
+        if self.reqs:
+            # the closed session's requests become the engine's
+            # explain_request(rid) namespace (rids restart per session)
+            self.eng._last_reqs = {r.rid: r for r in self.reqs}
         for r in self.reqs:
             self.eng._active.pop(r.rid, None)
             self.eng._cancels.discard(r.rid)

@@ -354,6 +354,32 @@ class PagedKVCache:
     def ref(self, page: int) -> int:
         return int(self._ref[page])
 
+    def debug_state(self) -> dict:
+        """Bounded JSON-ready pool snapshot for the failure flight
+        recorder (docs/observability.md): page-state partition (free /
+        parked / mapped), slot residency, refcount spread, and the
+        lifetime stats — the numbers a post-mortem needs to answer
+        "was the pool wedged" without shipping the page tables."""
+        c = self.cfg
+        mapped = int(np.count_nonzero(self._ref))
+        return {
+            "usable_pages": c.usable_pages,
+            "free_pages": len(self._free),
+            "parked_pages": len(self._lru),
+            "mapped_pages": mapped,
+            "reclaimable_pages": self.free_pages,
+            "occupancy": 1.0 - self.free_pages / c.usable_pages,
+            "free_slots": self.free_slots,
+            "max_seqs": c.max_seqs,
+            "seq_lens": [int(n) for n in self.seq_lens],
+            "hashed_pages": len(self._page_of_hash),
+            "imported_resident": len(self._imported),
+            "max_page_ref": int(self._ref.max()) if mapped else 0,
+            "kv_dtype": c.kv_dtype,
+            "page_size": c.page_size,
+            "stats": dict(self.stats),
+        }
+
     # ---------------- prefix cache ------------------------------------
     def match_prefix(self, keys: Sequence[bytes]) -> List[int]:
         """Longest run of resident pages whose chain keys match `keys`

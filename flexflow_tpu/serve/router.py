@@ -312,6 +312,7 @@ class ReplicaPool:
         self._sample_seed = 0
         self._inflight: Dict[int, dict] = {}    # stream id -> tracked
         self._records: Dict[int, dict] = {}
+        self._req_refs: Dict[int, Request] = {}  # stream id -> Request
         self._w_first: deque = deque()   # (t_first, virtual ttft)
         self._w_done: deque = deque()    # (t_finish, tpot, tokens)
         self._next_eval = 0.0
@@ -490,6 +491,13 @@ class ReplicaPool:
                 or tr.stream_id in self._records:
             raise ValueError(
                 f"stream id {tr.stream_id} already submitted")
+        # trace context is minted HERE — the first tier that sees the
+        # request — and rides the Request into whichever replica wins,
+        # so the routing decision and every downstream engine span
+        # share one causally-linked timeline (docs/observability.md)
+        from ..utils.telemetry import next_trace_id
+        trace_id = next_trace_id()
+        t_route0 = time.perf_counter()
         replica, info = self.route(tr.prompt, tenant=tr.tenant)
         eng = replica.engine
         sample = None
@@ -503,10 +511,11 @@ class ReplicaPool:
             replica.clock_s = max(replica.clock_s, tr.t_arrival)
         req = replica.session.submit(
             tr.prompt, tr.max_new, eos_token=eos_token, sample=sample,
-            stream_id=tr.stream_id)
+            stream_id=tr.stream_id, trace_id=trace_id)
         tracked = {
             "stream_id": tr.stream_id, "tenant": tr.tenant,
             "replica": replica.idx, "req": req,
+            "trace_id": trace_id,
             "t_arrival": tr.t_arrival, "t_first": None,
             "t_finish": None, "tokens_emitted": 0,
             "cancel_after": tr.cancel_after_tokens,
@@ -533,9 +542,20 @@ class ReplicaPool:
             self.stats["spills"] += 1
             m.inc("router_spills_total")
         if self.telemetry.enabled:
+            # the routing decision is a SPAN (wall time the router
+            # spent matching/spilling, the "routing" component of
+            # explain_request) with the trace id every downstream
+            # engine span shares; the legacy "route" instant keeps its
+            # one-line decision record
+            self.telemetry.span(
+                _ROUTER_TRACK, "routing", t_route0,
+                time.perf_counter(),
+                args={"trace": trace_id, "stream": tr.stream_id,
+                      "replica": replica.idx})
             self.telemetry.instant(
                 _ROUTER_TRACK, "route",
                 args={"stream": tr.stream_id, "tenant": tr.tenant,
+                      "trace": trace_id,
                       "replica": replica.idx,
                       "matched_tokens": info["matched_tokens"],
                       "affinity": info["affinity_hit"],
@@ -609,6 +629,7 @@ class ReplicaPool:
         self._records[sid] = {
             "stream_id": sid, "tenant": tracked["tenant"],
             "replica": tracked["replica"],
+            "trace_id": tracked["trace_id"],
             "outcome": req.outcome, "tokens": tokens,
             "t_arrival": tracked["t_arrival"],
             "ttft_s": ttft, "tpot_s": tpot, "t_finish": t_end,
@@ -619,8 +640,30 @@ class ReplicaPool:
             "matched_tokens": tracked["matched_tokens"],
             "cancelled_by_router": tracked["cancel_sent"],
         }
+        self._req_refs[sid] = req   # explain_request / attribution
         self._w_done.append((t_end, tpot, len(tokens)))
         m = self.metrics
+        # SLO error-budget accounting (utils/slo.py reads ONLY these
+        # exported counters): every finalized request except a
+        # router-sent cancel (a user abandon is not the tier's error)
+        # enters the denominator; a violation is any counted request
+        # that missed — a completed one past target, or one the tier
+        # failed outright (rejected / deadline / failed), labeled by
+        # which bound (or outcome) it burned
+        if (slo_ttft_s or slo_tpot_s) \
+                and not tracked["cancel_sent"] \
+                and req.outcome != RequestOutcome.CANCELLED:
+            m.inc("serve_slo_requests_total")
+            if not slo_ok:
+                m.inc("serve_slo_violations_total")
+                if not completed:
+                    m.inc("serve_slo_violations_total", slo="outcome")
+                else:
+                    if slo_ttft_s and (ttft is None
+                                       or ttft > slo_ttft_s):
+                        m.inc("serve_slo_violations_total", slo="ttft")
+                    if slo_tpot_s and tpot > slo_tpot_s:
+                        m.inc("serve_slo_violations_total", slo="tpot")
         if ttft is not None:
             m.observe("serve_router_ttft_virtual_seconds", ttft)
             self._w_first.append((tracked["t_first"], ttft))
@@ -675,6 +718,13 @@ class ReplicaPool:
         toks = sum(n for _, _, n in self._w_done)
         m.set("serve_pool_decode_tokens_per_s_window",
               toks / self.window_s if self.window_s > 0 else 0.0)
+        # cumulative SLO attainment over the exported error-budget
+        # counters — the gauge tools/perf_report.py and slo_report
+        # read (1.0 until any request enters the denominator)
+        tot = m.counter("serve_slo_requests_total")
+        viol = m.counter("serve_slo_violations_total")
+        m.set("serve_pool_slo_attainment",
+              (tot - viol) / tot if tot > 0 else 1.0)
 
     def _default_autoscaler(self) -> Autoscaler:
         """The --autoscale autoscaler: SLOs/ceiling from FFConfig,
@@ -750,11 +800,33 @@ class ReplicaPool:
                            "priced_target":
                                decision.get("priced_target")})
 
+    def _default_slo_monitor(self, slo_ttft_s, slo_tpot_s
+                             ) -> "object":
+        """The auto-armed burn-rate monitor (utils/slo.py): windows
+        and cadence scaled off the priced virtual step exactly like
+        the autoscaler's, error budget from FFConfig.slo_error_budget
+        — a deterministic function of the exported counters, so its
+        alert transitions replay at one seed."""
+        from ..utils.slo import SLOBurnMonitor
+        price = self.price_probe(64)
+        interval = 20.0 * price
+        return SLOBurnMonitor(
+            self.metrics,
+            error_budget=float(getattr(self.config, "slo_error_budget",
+                                       0.01)),
+            fast_window_s=5.0 * interval,
+            slow_window_s=20.0 * interval,
+            interval_s=interval,
+            telemetry=self.telemetry,
+            slo={"ttft_s": slo_ttft_s or 0.0,
+                 "tpot_s": slo_tpot_s or 0.0})
+
     def run(self, traffic: Sequence[TrafficRequest], *,
             slo_ttft_s: Optional[float] = None,
             slo_tpot_s: Optional[float] = None,
             eos_token: Optional[int] = None,
             autoscaler: Optional[Autoscaler] = None,
+            slo_monitor=None,
             sample_seed: int = 0, on_step=None) -> dict:
         """Serve a timed traffic stream on the virtual clock and
         return the goodput-under-SLO accounting (also stashed on
@@ -784,8 +856,20 @@ class ReplicaPool:
             # ceiling from the flags, cadence off the priced step,
             # capacity off the placement search's decode table)
             autoscaler = self._default_autoscaler()
+        # slo_monitor=False disarms explicitly (the call-level spelling
+        # of FFConfig.slo_monitor=False); None = auto-arm with the SLOs
+        arm_default = slo_monitor is None
+        if not slo_monitor:
+            slo_monitor = None
+        if arm_default and (slo_ttft_s or slo_tpot_s) \
+                and bool(getattr(self.config, "slo_monitor", True)):
+            # burn-rate monitoring comes with the SLOs: a tier with
+            # latency targets but no budget alarm is flying blind
+            slo_monitor = self._default_slo_monitor(slo_ttft_s,
+                                                    slo_tpot_s)
         self._sample_seed = int(sample_seed)
         self._records = {}
+        self._req_refs = {}
         self._w_first.clear()
         self._w_done.clear()
         # per-run accounting: self.stats/scale_events stay LIFETIME
@@ -812,6 +896,8 @@ class ReplicaPool:
             self.window_s = max(self.window_s,
                                 2.0 * autoscaler.interval_s)
             self._next_eval = t0_virtual + autoscaler.interval_s
+        next_slo = (t0_virtual + slo_monitor.interval_s
+                    if slo_monitor is not None else None)
         i = 0
         t_virtual = t0_virtual
         while True:
@@ -892,6 +978,14 @@ class ReplicaPool:
                         autoscaler.evaluate(self._next_eval),
                         self._next_eval)
                     self._next_eval += autoscaler.interval_s
+            if slo_monitor is not None:
+                # the burn monitor ticks on the same virtual clock the
+                # autoscaler does — its counters are kept current by
+                # _finalize, so each tick is a pure function of the
+                # exported registry + monitor state (replayable)
+                while t_virtual >= next_slo:
+                    slo_monitor.observe(next_slo)
+                    next_slo += slo_monitor.interval_s
         # anything still tracked (a cancel that raced completion)
         for sid in list(self._inflight):
             self._finalize(self._inflight[sid], t_virtual,
@@ -899,6 +993,11 @@ class ReplicaPool:
         for r in self.replicas:
             self._maybe_park(r)
         self._export_gauges(t_virtual)
+        if slo_monitor is not None:
+            # one closing tick + episode close, so an alert burning at
+            # drain still transitions (and its span gets an end)
+            slo_monitor.observe(t_virtual)
+            slo_monitor.finish(t_virtual)
         records = [self._records[sid]
                    for sid in sorted(self._records)]
         makespan = max(1e-12, t_virtual - t0_virtual)
@@ -943,5 +1042,98 @@ class ReplicaPool:
                  "busy_virtual_s": r.busy_s,
                  "peak_occupancy": r.peak_occupancy}
                 for r in self.replicas],
+            "slo_attainment_budget": self.metrics.gauge(
+                "serve_pool_slo_attainment", 1.0),
+            "slo_alerts": (list(slo_monitor.events)
+                           if slo_monitor is not None else []),
         }
+        if self.telemetry.enabled:
+            # pool-level aggregate latency attribution: every finished
+            # request's span fold lands in the shared registry
+            # (serve_latency_attribution_* series) and the
+            # per-component WALL totals ride along in last_stats
+            self.last_stats["attribution"] = self.fold_attribution()
         return self.last_stats
+
+    # ---------------- per-request observability -------------------------
+    def explain_request(self, stream_id: int) -> dict:
+        """Cross-engine latency attribution for one routed request of
+        the last run, by stream id (docs/observability.md): the trace
+        id minted at submit ties the router's routing span, the
+        replica's queue_wait, its prefill/decode chunk spans and any
+        preempt/retry stalls into one additive WALL-clock breakdown
+        summing to the request's measured wall latency. (The virtual-
+        clock TTFT/TPOT in last_stats price the simulated cluster;
+        this explains where the real host/device time went.)"""
+        if not self.telemetry.enabled:
+            raise RuntimeError(
+                "explain_request needs telemetry (pass telemetry= or "
+                "set --telemetry/--trace-out)")
+        req = self._req_refs.get(stream_id)
+        if req is None:
+            raise KeyError(
+                f"stream id {stream_id} has no finalized request in "
+                f"the last run")
+        if not req.t_finish:
+            raise ValueError(
+                f"stream {stream_id} never terminated (outcome "
+                f"{req.outcome!r})")
+        out = self.telemetry.explain_request(
+            req.trace_id, req.t_submit, req.t_finish)
+        rec = self._records.get(stream_id) or {}
+        out.update(stream_id=stream_id, outcome=req.outcome,
+                   replica=rec.get("replica"),
+                   tokens=len(req.out_tokens))
+        return out
+
+    def fold_attribution(self, registry=None) -> dict:
+        """Fold every terminated request of the last run into
+        `registry` (default: the pool registry) — the pool-level
+        aggregate `serve_latency_attribution_*` series. Returns the
+        per-component second totals."""
+        from ..utils.telemetry import (REQUEST_COMPONENTS,
+                                       fold_attribution)
+        m = registry if registry is not None else self.metrics
+        totals = {c: 0.0 for c in REQUEST_COMPONENTS}
+        if not self.telemetry.enabled:
+            return totals
+        for sid in sorted(self._req_refs):
+            req = self._req_refs[sid]
+            if not req.t_finish:
+                continue
+            b = self.telemetry.explain_request(
+                req.trace_id, req.t_submit, req.t_finish)
+            fold_attribution(b, m)
+            for c, v in b["components"].items():
+                totals[c] += v
+        return totals
+
+    def dump_postmortem(self, path: Optional[str] = None,
+                        reason: str = "manual",
+                        detail: Optional[dict] = None) -> str:
+        """Pool flight-recorder dump: the lead replica engine's bundle
+        (the replicas share ONE telemetry bus, so its ring/metrics ARE
+        the tier's) plus the router's routing/scale state and every
+        replica's scheduler + KV-pool snapshot."""
+        from ..utils.telemetry import write_json_atomic
+        lead = self.replicas[0].engine
+        bundle = lead.postmortem_bundle(
+            reason, detail, sched=self.replicas[0].session.sched)
+        bundle["mode"] = "router"
+        bundle["router"] = {
+            "policy": self.policy,
+            "stats": dict(self.stats),
+            "inflight": len(self._inflight),
+            "scale_events": list(self.scale_events[-32:]),
+        }
+        bundle["replicas"] = {
+            f"replica{r.idx}": {
+                "live": r.live, "draining": r.draining,
+                "clock_virtual_s": r.clock_s,
+                "scheduler": r.session.sched.debug_state(),
+                "kv_pool": r.engine.cache.debug_state(),
+                "compile_counts": r.engine.compile_counts(),
+            } for r in self.replicas}
+        if path is None:
+            path = lead._postmortem_path(reason)
+        return write_json_atomic(path, bundle)

@@ -114,6 +114,14 @@ class Request:
     # pre-stream behavior, bit-identical).
     stream_id: Optional[int] = None
     stream_offset: int = 0
+    # trace-context propagation (docs/observability.md): the
+    # process-unique trace id every telemetry span of this request
+    # carries. Minted at the FIRST tier that sees the request (router
+    # submit / DisaggCluster generate / scheduler submit), and carried
+    # across engines — a disagg decode-role request REUSES the id its
+    # prefill-role twin was minted, so one causally-linked timeline
+    # covers the whole life. Never None after submit().
+    trace_id: int = 0
 
     state: RequestState = RequestState.WAITING
     slot: int = -1
@@ -281,7 +289,8 @@ class ContinuousBatchingScheduler:
                eos_token: Optional[int] = None,
                sample: Optional[SampleParams] = None,
                stream_id: Optional[int] = None,
-               stream_offset: int = 0) -> Request:
+               stream_offset: int = 0,
+               trace_id: Optional[int] = None) -> Request:
         if len(prompt) < 1:
             raise ValueError("empty prompt")
         if int(max_new_tokens) < 1:
@@ -297,12 +306,18 @@ class ContinuousBatchingScheduler:
             raise ValueError(
                 f"stream_id must be >= 0 (seed-sequence entries are "
                 f"unsigned), got {stream_id}")
+        from ..utils.telemetry import next_trace_id
         req = Request(rid=self._next_rid, prompt=list(prompt),
                       max_new_tokens=int(max_new_tokens),
                       eos_token=eos_token, sample=sample,
                       stream_id=(None if stream_id is None
                                  else int(stream_id)),
-                      stream_offset=int(stream_offset))
+                      stream_offset=int(stream_offset),
+                      # an upstream tier (router / disagg cluster)
+                      # passes the id it minted; a plain engine mints
+                      # here — either way every span carries ONE id
+                      trace_id=(next_trace_id() if trace_id is None
+                                else int(trace_id)))
         # speculation needs a deterministic per-lane pick to verify
         # against: greedy, or top_k=1 sampling (the already-drawn sample
         # is always the top-1 logit). Other sampling decodes with k=0.
@@ -644,6 +659,37 @@ class ContinuousBatchingScheduler:
             keys = self._keys_for(req, verified // ps)
             for idx in range(chunk.start // ps, verified // ps):
                 self.cache.commit_page(req.slot, idx, keys[idx])
+
+    def debug_state(self, max_requests: int = 32) -> dict:
+        """Bounded JSON-ready snapshot of the scheduler for the
+        failure flight recorder (docs/observability.md "Failure flight
+        recorder"): the waiting queue and running set (capped at
+        `max_requests` entries each — a post-mortem bundle must stay
+        bounded no matter how deep the queue was), the current
+        degradation rung, the lifetime stats dict, and the structured
+        rejections. Pure observation — never mutates."""
+        def row(r: Request) -> dict:
+            return {"rid": r.rid, "trace": r.trace_id,
+                    "state": r.state.value, "slot": r.slot,
+                    "prompt_tokens": len(r.prompt),
+                    "out_tokens": len(r.out_tokens),
+                    "num_computed": r.num_computed,
+                    "preemptions": r.preemptions,
+                    "outcome": r.outcome}
+        waiting = list(self.waiting)
+        running = sorted(self.running.values(), key=lambda r: r.rid)
+        return {
+            "rung": self.rung,
+            "waiting_depth": len(waiting),
+            "running_depth": len(running),
+            "waiting": [row(r) for r in waiting[:max_requests]],
+            "running": [row(r) for r in running[:max_requests]],
+            "stats": {k: (list(v) if isinstance(v, list) else v)
+                      for k, v in self.stats.items()},
+            "rejected_requests": [
+                {"rid": rr.rid, "reason": rr.reason}
+                for rr in self.rejected_requests[-max_requests:]],
+        }
 
     def finish(self, req: Request) -> None:
         """Evict a finished sequence: its slot's pages drop a refcount —

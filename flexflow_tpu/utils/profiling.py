@@ -359,6 +359,33 @@ def router_report(stats: dict, metrics=None) -> str:
                 f"({e.get('reason', '')})")
     elif stats.get("scale_events") is not None:
         lines.append("autoscale: no decisions (steady)")
+    # SLO error-budget burn (utils/slo.py): attainment over the
+    # exported counters + the burn monitor's alert transitions
+    if stats.get("slo_attainment_budget") is not None \
+            and (stats.get("slo_ttft_s") or stats.get("slo_tpot_s")):
+        line = (f"slo budget: attainment "
+                f"{stats['slo_attainment_budget']:.2%}")
+        if metrics is not None:
+            line += (f", burn fast="
+                     f"{metrics.gauge('slo_burn_rate', window='fast'):.2f}x "
+                     f"slow="
+                     f"{metrics.gauge('slo_burn_rate', window='slow'):.2f}x, "
+                     f"budget remaining "
+                     f"{metrics.gauge('slo_budget_remaining', 1.0):.1%}")
+        lines.append(line)
+        for a in stats.get("slo_alerts") or []:
+            lines.append(
+                f"  slo alert -> {a['state']} @ "
+                f"{a['t']*1e3:.2f} virtual ms "
+                f"(fast {a.get('burn_fast', 0):.1f}x, "
+                f"slow {a.get('burn_slow', 0):.1f}x)")
+    # pool-level latency attribution (per-request explain_request
+    # folds, wall seconds): where the tier's real time went
+    att = stats.get("attribution")
+    if att and sum(att.values()) > 0:
+        tot = sum(att.values())
+        lines.append("latency attribution: " + " ".join(
+            f"{c}={v / tot:.1%}" for c, v in att.items() if v > 0))
     return "\n".join(lines)
 
 

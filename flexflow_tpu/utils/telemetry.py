@@ -48,6 +48,7 @@ working under fault injection, so chaos runs become traceable.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import os
 import time
@@ -57,7 +58,29 @@ from typing import Dict, Iterable, List, Optional, Tuple
 __all__ = [
     "MetricsRegistry", "MetricsServer", "Telemetry", "telemetry_for",
     "pct", "pow2_bucket", "serve_metrics", "train_metrics",
+    "next_trace_id", "attribute_request", "fold_attribution",
+    "write_json_atomic", "REQUEST_COMPONENTS",
 ]
+
+
+# ---------------------------------------------------------------------------
+# Trace-context propagation (docs/observability.md "Trace-id
+# propagation"): one process-wide counter mints a per-request trace id
+# at the FIRST tier that sees the request — the router's submit, a
+# DisaggCluster's generate, or the scheduler itself for a plain engine
+# — and the id rides the Request / ServeSession / PageShipment through
+# every engine it crosses, so every span of one request's life carries
+# the same `trace` arg no matter which replica/role recorded it.
+# ---------------------------------------------------------------------------
+_TRACE_IDS = itertools.count(1)
+
+
+def next_trace_id() -> int:
+    """Mint a process-unique request trace id (monotonic int; `next`
+    on an itertools.count is atomic under the GIL). Host bookkeeping
+    only — minting never touches a jitted program, so the telemetry
+    on == off token-identity contract is untouched."""
+    return next(_TRACE_IDS)
 
 
 def pct(sorted_vals: List[float], q: float) -> float:
@@ -81,6 +104,147 @@ def pow2_bucket(n: int) -> int:
     if n <= 0:
         return 0
     return 1 << (n - 1).bit_length()
+
+
+def write_json_atomic(path: str, doc: dict) -> str:
+    """Write a JSON document via tmp + rename so no partially-written
+    artifact is ever visible (the checkpoint promote discipline applied
+    to observability artifacts: traces, post-mortem bundles, snapshot
+    dumps). Non-JSON-native values stringify rather than fail — a
+    flight recorder must never crash on its own payload."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Per-request critical-path attribution (docs/observability.md
+# "Per-request latency attribution"): fold one request's spans into an
+# additive breakdown of where its measured latency went. The fold is an
+# INTERVAL PARTITION of [t_submit, t_finish): every elementary segment
+# of the request's wall life is assigned to exactly one component (the
+# highest-priority interval covering it), so the components — plus the
+# explicit "other" bucket for host/scheduling time no span covers — sum
+# to the measured latency EXACTLY by construction (gated within 1%).
+# ---------------------------------------------------------------------------
+
+REQUEST_COMPONENTS = ("queue", "routing", "prefill", "transfer",
+                      "decode", "preempt_stall", "retry", "other")
+
+# span name -> component for trace-matched spans
+_SPAN_CLASS = {"prefill": "prefill", "decode": "decode",
+               "spec_decode": "decode", "kv_handoff": "transfer",
+               "routing": "routing"}
+# overlap priority (highest wins per elementary segment): compute beats
+# the queue-wait span that legitimately overlaps a request's FIRST
+# chunk (t_admit is stamped after the admitting step's dispatch), and
+# retry backoff carves time out of the compute span that covers it
+_CLASS_PRIORITY = {"retry": 7, "decode": 6, "prefill": 5,
+                   "transfer": 4, "preempt_stall": 3, "queue": 2,
+                   "routing": 1}
+
+
+def attribute_request(events: Iterable[tuple], trace_id,
+                      *, t_submit: float, t_finish: float) -> dict:
+    """Attribute one request's measured latency across
+    :data:`REQUEST_COMPONENTS` from raw telemetry ring tuples.
+
+    `events` are ``(ph, track, name, ts, dur, ident, args)`` tuples on
+    the TRACE clock; `t_submit` / `t_finish` must be on the same clock
+    (:meth:`Telemetry.explain_request` rebases the Request's raw
+    perf_counter stamps). Interval sources:
+
+      * trace-matched ``X`` spans — prefill / decode / spec_decode
+        chunk spans, ``kv_handoff`` transfer spans, the router's
+        ``routing`` span;
+      * trace-matched ``b``/``e`` async pairs — ``queue_wait`` (queue)
+        and ``requeue_wait`` (preempt_stall); a pair still open at
+        t_finish closes there (a request aborted while waiting);
+      * ``retry_backoff`` spans carry no trace (a step's retry stalls
+        every request in it) — their intersection with THIS request's
+        compute spans is attributed to ``retry``.
+
+    Returns ``{"trace_id", "latency_s", "components": {component:
+    seconds}, "attributed_s"}`` where ``sum(components.values()) ==
+    latency_s`` exactly (``other`` absorbs uncovered host time) and
+    ``attributed_s`` is the span-covered (non-``other``) total."""
+    t0, t1 = float(t_submit), float(t_finish)
+    comps = {c: 0.0 for c in REQUEST_COMPONENTS}
+    out = {"trace_id": trace_id, "latency_s": max(0.0, t1 - t0),
+           "components": comps, "attributed_s": 0.0}
+    if t1 <= t0:
+        return out
+    ivals: List[Tuple[str, float, float]] = []
+    retry_ivals: List[Tuple[float, float]] = []
+    open_async: Dict[Tuple[str, object], float] = {}
+    for ph, _track, name, ts, dur, ident, args in events:
+        tid = args.get("trace") if args else None
+        if ph == "X":
+            if name == "retry_backoff":
+                retry_ivals.append((ts, ts + dur))
+            cls = _SPAN_CLASS.get(name)
+            if cls is not None and tid == trace_id:
+                ivals.append((cls, ts, ts + dur))
+        elif ph == "b" and tid == trace_id \
+                and name in ("queue_wait", "requeue_wait"):
+            open_async[(name, ident)] = ts
+        elif ph == "e":
+            s = open_async.pop((name, ident), None)
+            if s is not None:
+                ivals.append(("queue" if name == "queue_wait"
+                              else "preempt_stall", s, ts))
+    for (name, _ident), s in open_async.items():
+        ivals.append(("queue" if name == "queue_wait"
+                      else "preempt_stall", s, t1))
+    clipped = [(cls, max(s, t0), min(e, t1))
+               for cls, s, e in ivals if min(e, t1) > max(s, t0)]
+    if retry_ivals:
+        compute = [(s, e) for cls, s, e in clipped
+                   if cls in ("prefill", "decode")]
+        for rs, re_ in retry_ivals:
+            for s, e in compute:
+                s2, e2 = max(rs, s), min(re_, e)
+                if e2 > s2:
+                    clipped.append(("retry", s2, e2))
+    bounds = sorted({t0, t1, *(x for _c, s, e in clipped
+                               for x in (s, e))})
+    for a, b in zip(bounds, bounds[1:]):
+        mid = (a + b) / 2.0
+        best = None
+        for cls, s, e in clipped:
+            if s <= mid < e and (best is None
+                                 or _CLASS_PRIORITY[cls]
+                                 > _CLASS_PRIORITY[best]):
+                best = cls
+        comps[best if best is not None else "other"] += b - a
+    out["attributed_s"] = sum(v for c, v in comps.items()
+                              if c != "other")
+    return out
+
+
+def fold_attribution(breakdown: dict, registry: "MetricsRegistry"
+                     ) -> None:
+    """Fold one request's attribution into a registry — the pool-level
+    aggregate (`serve_latency_attribution_seconds_total{component}` /
+    `serve_latency_attributed_requests_total` counters plus the
+    derived `serve_latency_attribution_fraction{component}` gauges),
+    so /metrics answers "where does this tier's latency GO" without
+    re-walking the trace."""
+    m = registry
+    m.inc("serve_latency_attributed_requests_total")
+    m.inc("serve_latency_attributed_seconds_total",
+          breakdown["latency_s"])
+    for comp, v in breakdown["components"].items():
+        m.inc("serve_latency_attribution_seconds_total", v,
+              component=comp)
+    total = m.counter("serve_latency_attributed_seconds_total")
+    for comp in REQUEST_COMPONENTS:
+        v = m.counter("serve_latency_attribution_seconds_total",
+                      component=comp)
+        m.set("serve_latency_attribution_fraction",
+              v / total if total > 0 else 0.0, component=comp)
 
 
 def _label_key(labels: Dict[str, object]) -> str:
@@ -536,6 +700,49 @@ class Telemetry:
                     f"{t['regimes']} regime(s))")
         return "\n".join(lines)
 
+    # ---------------- per-request views ---------------------------------
+    def request_events(self, trace_id) -> List[tuple]:
+        """Every buffered event of one request's causally-linked
+        timeline: events whose args carry this ``trace`` id, plus the
+        ``e`` closers of its async spans (which carry no args by
+        design). Order is buffer (emission) order — timestamps within
+        are on the ONE trace clock, so sorting by ts reconstructs the
+        cross-engine timeline (router route -> queue_wait -> prefill
+        chunks -> kv_handoff -> decode chunks) no matter which
+        replica/role recorded each span."""
+        out: List[tuple] = []
+        open_idents = set()
+        for ev in self.events:
+            ph, _track, name, _ts, _dur, ident, args = ev
+            if args is not None and args.get("trace") == trace_id:
+                out.append(ev)
+                if ph == "b":
+                    open_idents.add((name, ident))
+            elif ph == "e" and (name, ident) in open_idents:
+                out.append(ev)
+                open_idents.discard((name, ident))
+        return out
+
+    def explain_request(self, trace_id, t_submit: float,
+                        t_finish: float) -> dict:
+        """Per-request latency attribution over the buffered events
+        (:func:`attribute_request`); `t_submit` / `t_finish` are the
+        Request's RAW perf_counter stamps — rebased to the trace clock
+        here, so the caller never touches the clock epoch."""
+        return attribute_request(
+            list(self.events), trace_id,
+            t_submit=self._rel(t_submit), t_finish=self._rel(t_finish))
+
+    def events_tail(self, n: int = 2048) -> List[list]:
+        """The last `n` ring events in JSON-ready form (`[ph, [proc,
+        thread], name, ts, dur, ident, args]`) — the flight recorder's
+        bounded span payload."""
+        evs = list(self.events)
+        if n >= 0:
+            evs = evs[-n:] if n else []
+        return [[ph, list(track), name, ts, dur, ident, args]
+                for ph, track, name, ts, dur, ident, args in evs]
+
     # ---------------- fault observability ------------------------------
     def record_faults(self, injector) -> None:
         """Export a FaultInjector's lifetime accounting (fired sites by
@@ -594,11 +801,8 @@ class Telemetry:
         doc = {"traceEvents": meta + out, "displayTimeUnit": "ms"}
         if metadata:
             doc["metadata"] = dict(metadata)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-        os.replace(tmp, path)  # no partially-written trace is visible
-        return path
+        # tmp + rename: no partially-written trace is visible
+        return write_json_atomic(path, doc)
 
     def metrics_snapshot(self) -> dict:
         """The full machine-readable snapshot: metrics + drift + event
@@ -691,12 +895,16 @@ _DISABLED = Telemetry(enabled=False, max_events=1)
 def telemetry_for(config=None) -> Telemetry:
     """The Telemetry a subsystem should use (the ``injector_for``
     idiom): a FRESH enabled bus when ``config.telemetry``,
-    ``config.trace_out`` or ``config.metrics_port`` asks for one —
-    each engine/model gets its own buffer — else the shared disabled
-    instance (recording is a no-op attribute check)."""
+    ``config.trace_out``, ``config.metrics_port`` or
+    ``config.postmortem_dir`` asks for one — each engine/model gets
+    its own buffer — else the shared disabled instance (recording is
+    a no-op attribute check). The flight recorder implies telemetry:
+    a post-mortem bundle without the span ring would be a corpse with
+    no black box."""
     if config is not None and (
             getattr(config, "telemetry", False)
             or getattr(config, "trace_out", None)
+            or getattr(config, "postmortem_dir", None)
             or getattr(config, "metrics_port", None) is not None):
         return Telemetry(
             enabled=True,

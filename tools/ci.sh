@@ -159,6 +159,19 @@ echo "--- 1n. multi-replica router smoke (goodput-under-SLO + exactness gate)"
 env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload router \
     -o /tmp/ci_bench_serve_router.json || fail=1
 
+echo "--- 1o. SLO burn-rate + flight-recorder smoke (request-observability gate)"
+# the request-observability tentpole (docs/observability.md): the SLO
+# burn-rate monitor must fire AND clear on a deterministic outage
+# history, replay bit-identically, and export parseable burn gauges
+# (tools/slo_report.py --smoke, no jax — pure host python); the
+# failure flight recorder must leave a loadable, schema-valid
+# post-mortem bundle when a chaos-injected FATAL dispatch fault aborts
+# a real engine mid-batch (plus deadline-storm and explicit triggers),
+# with the engine still serving afterwards (tools/postmortem.py
+# --smoke). The 1k <=1.03x telemetry-overhead gate is unchanged.
+python tools/slo_report.py --smoke || fail=1
+env JAX_PLATFORMS=cpu python tools/postmortem.py --smoke || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \

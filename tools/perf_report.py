@@ -8,6 +8,7 @@ VERDICT r2 weak #2: a self-admittedly stale README table).
 """
 
 import json
+import math
 import os
 import sys
 
@@ -181,6 +182,29 @@ def serve_line() -> str:
             r = recs.get(key)
             if r is not None:
                 parts.append(fmt.format(v=float(r["value"])))
+        # SLO attainment from the EXPORTED pool registry gauge the
+        # router workload recorded (serve_pool_slo_attainment — not an
+        # ad-hoc stat string), and the worst simulator drift ratio
+        # from the base workload's exported drift snapshot — the PR 10
+        # render-from-metrics no-drift rule applied to the headline
+        router = recs.get("serve_router_goodput_gain")
+        if router is not None:
+            att = router.get("extra", {}).get("slo_attainment_gauge")
+            if att is None:
+                att = router.get("extra", {}).get(
+                    "slo_attainment_affinity")
+            if att is not None:
+                parts.append(f"{float(att):.0%} SLO attainment")
+        base = recs.get("serve_decode_tokens_per_sec")
+        if base is not None:
+            drift = (base.get("extra", {}).get("telemetry", {})
+                     or {}).get("drift_ratio_by_regime") or {}
+            ratios = [float(v) for v in drift.values() if v]
+            if ratios:
+                worst = max(ratios, key=lambda r: abs(math.log(r))
+                            if r > 0 else 0.0)
+                parts.append(f"worst sim-drift ratio {worst:.2f}x "
+                             f"over {len(ratios)} regimes")
         if not parts:
             return ""
         return (f" Serving: {', '.join(parts)} "

@@ -1,0 +1,259 @@
+"""Failure flight-recorder loader + the post-mortem CI gate (ci.sh 1o).
+
+Two modes:
+
+* Default: load a post-mortem bundle a ServeEngine / ReplicaPool /
+  DisaggCluster dumped (``--postmortem-dir``, or an explicit
+  ``dump_postmortem()``), validate its schema, and render the human
+  summary — reason, engine shape, the event-ring tail, scheduler and
+  KV-pool state at the failure, fault accounting.
+
+      python tools/postmortem.py /tmp/pm/postmortem-fault_abort-*.json
+
+* ``--smoke`` (tools/ci.sh step 1o): gates the flight recorder end to
+  end on a real engine — a chaos run (injected FATAL dispatch fault,
+  the PR-6 harness) aborts a generate mid-batch, the engine's
+  fault-abort trigger must leave a bundle in --postmortem-dir, and the
+  bundle must load, validate, and carry the failure's evidence (spans
+  in the ring, the fired fault site, the scheduler state). An explicit
+  dump and a deadline-storm trigger are gated alongside.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _platform import select_platform  # noqa: E402
+
+select_platform("POSTMORTEM_PLATFORM")
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+SCHEMA = "flexflow_tpu.postmortem/1"
+REQUIRED = ("schema", "reason", "created_unix_s", "engine",
+            "compile_counts", "events", "metrics", "drift", "kv_pool",
+            "faults")
+
+
+def validate(bundle: dict) -> list:
+    """Schema check: returns a list of problems (empty = valid)."""
+    problems = []
+    if bundle.get("schema") != SCHEMA:
+        problems.append(f"schema is {bundle.get('schema')!r}, "
+                        f"expected {SCHEMA!r}")
+    for key in REQUIRED:
+        if key not in bundle:
+            problems.append(f"missing key {key!r}")
+    evs = bundle.get("events")
+    if not isinstance(evs, list):
+        problems.append("events is not a list")
+    else:
+        for i, ev in enumerate(evs):
+            if not (isinstance(ev, list) and len(ev) == 7):
+                problems.append(
+                    f"event {i} is not a 7-field record: {ev!r}")
+                break
+    m = bundle.get("metrics")
+    if isinstance(m, dict) and "error" not in m:
+        for part in ("counters", "gauges", "histograms"):
+            if part not in m:
+                problems.append(f"metrics snapshot missing {part!r}")
+    kv = bundle.get("kv_pool")
+    if isinstance(kv, dict) and "error" not in kv:
+        for part in ("usable_pages", "free_pages", "occupancy"):
+            if part not in kv:
+                problems.append(f"kv_pool missing {part!r}")
+    sched = bundle.get("scheduler")
+    if isinstance(sched, dict) and "error" not in sched:
+        for part in ("rung", "waiting", "running", "stats"):
+            if part not in sched:
+                problems.append(f"scheduler state missing {part!r}")
+    return problems
+
+
+def render(bundle: dict, tail: int = 12) -> str:
+    """The human summary of a bundle."""
+    import datetime
+    eng = bundle.get("engine") or {}
+    when = datetime.datetime.fromtimestamp(
+        bundle.get("created_unix_s", 0),
+        tz=datetime.timezone.utc).isoformat()
+    lines = [
+        f"post-mortem: reason={bundle.get('reason')!r} at {when}",
+        f"engine: {eng.get('mode')} mixed_width="
+        f"{eng.get('mixed_width')} tp={eng.get('tensor_parallel')} "
+        f"kv={eng.get('kv_dtype')} track={eng.get('track_process')}",
+        f"detail: {bundle.get('detail')}",
+        f"compiled programs: {bundle.get('compile_counts')}",
+    ]
+    kv = bundle.get("kv_pool") or {}
+    if "error" not in kv:
+        lines.append(
+            f"kv pool: {kv.get('free_pages')} free + "
+            f"{kv.get('parked_pages')} parked / "
+            f"{kv.get('usable_pages')} usable "
+            f"(occupancy {kv.get('occupancy', 0.0):.1%}, "
+            f"{kv.get('free_slots')} free slots)")
+    sched = bundle.get("scheduler")
+    if isinstance(sched, dict) and "error" not in sched:
+        lines.append(
+            f"scheduler: rung {sched.get('rung')}, "
+            f"{sched.get('waiting_depth')} waiting / "
+            f"{sched.get('running_depth')} running, "
+            f"stats {sched.get('stats')}")
+    faults = bundle.get("faults") or {}
+    if faults.get("fired"):
+        lines.append(f"faults fired: {faults['fired']}")
+    for name, section in (("router", bundle.get("router")),
+                          ("handoff", bundle.get("handoff"))):
+        if section:
+            lines.append(f"{name}: {section}")
+    evs = bundle.get("events") or []
+    lines.append(f"event ring: {len(evs)} events buffered "
+                 f"({bundle.get('events_dropped', 0)} dropped); "
+                 f"last {min(tail, len(evs))}:")
+    for ph, track, name, ts, dur, ident, args in evs[-tail:]:
+        lines.append(
+            f"  [{track[0]}/{track[1]}] {ph} {name} @ {ts * 1e3:.3f}ms"
+            + (f" +{dur * 1e3:.3f}ms" if ph == "X" else "")
+            + (f" {args}" if args else ""))
+    return "\n".join(lines)
+
+
+def _build_engine(cfg_over: dict):
+    from flexflow_tpu import FFConfig
+    from flexflow_tpu.models.transformer import build_transformer_lm
+    from flexflow_tpu.serve import ServeEngine
+    cfg = FFConfig(batch_size=1, kv_page_size=8, kv_num_pages=73,
+                   serve_max_seqs=8, serve_prefill_budget=48,
+                   serve_retry_backoff_s=0.0, **cfg_over)
+    ff = build_transformer_lm(cfg, vocab_size=89, max_seq_len=64,
+                              hidden=32, num_heads=4, num_layers=2,
+                              ff_dim=64)
+    return ServeEngine(ff)
+
+
+def smoke() -> int:
+    import numpy as np
+    fails = []
+
+    def gate(name, ok, detail=""):
+        print(f"  {'PASS' if ok else 'FAIL'}: {name}"
+              + (f" ({detail})" if detail else ""))
+        if not ok:
+            fails.append(name)
+
+    with tempfile.TemporaryDirectory(prefix="ff_pm_") as pmdir:
+        # ---- 1. chaos-triggered bundle: a FATAL injected dispatch
+        # fault aborts the batch mid-flight (the PR-6 harness), and the
+        # fault-abort trigger must leave a loadable bundle behind
+        eng = _build_engine({"postmortem_dir": pmdir,
+                             "fault_spec": "serve.mixed:fatal@4"})
+        eng.warmup()
+        rng = np.random.RandomState(0)
+        prompts = [list(rng.randint(1, 89, size=rng.randint(6, 24)))
+                   for _ in range(6)]
+        raised = False
+        try:
+            eng.generate(prompts, 8)
+        except Exception as e:
+            raised = True
+            print(f"  (chaos generate aborted as injected: "
+                  f"{type(e).__name__})")
+        gate("injected fatal fault aborts the run", raised)
+        found = sorted(glob.glob(
+            os.path.join(pmdir, "postmortem-fault_abort-*.json")))
+        gate("fault-abort auto-dumps a bundle", len(found) == 1,
+             f"found={found}")
+        if not found:
+            return 1
+        with open(found[0]) as f:
+            bundle = json.load(f)
+        problems = validate(bundle)
+        gate("bundle validates", not problems, f"problems={problems}")
+        gate("bundle reason is fault_abort",
+             bundle.get("reason") == "fault_abort")
+        gate("ring spans captured",
+             len(bundle.get("events") or []) > 0)
+        gate("event payload bounded",
+             len(bundle["events"]) <= eng.postmortem_events)
+        fired = (bundle.get("faults") or {}).get("fired") or {}
+        gate("fired fault site recorded", "serve.mixed" in fired,
+             f"fired={fired}")
+        gate("scheduler state captured",
+             isinstance(bundle.get("scheduler"), dict)
+             and "rung" in bundle["scheduler"])
+        print()
+        print(render(bundle, tail=6))
+        print()
+
+        # ---- 2. the engine keeps serving after the abort (the @4
+        # hit-list clause fired once and never again), and an explicit
+        # dump works on the healthy engine
+        out = eng.generate(prompts[:2], 4)
+        gate("engine serves on after the black-boxed abort",
+             len(out) == 2 and all(len(o) == 4 for o in out))
+        p = eng.dump_postmortem(reason="manual",
+                                detail={"why": "smoke"})
+        with open(p) as f:
+            manual = json.load(f)
+        gate("explicit dump validates", not validate(manual))
+        gate("explicit dumps bypass the rate limit",
+             os.path.exists(p))
+
+        # ---- 3. deadline storm: several requests expiring at one
+        # chunk boundary trigger the storm bundle
+        eng2 = _build_engine({"postmortem_dir": pmdir})
+        eng2.warmup()
+        try:
+            eng2.generate(prompts, 16, deadline_s=1e-4)
+        except Exception:
+            pass
+        storms = glob.glob(
+            os.path.join(pmdir, "postmortem-deadline_storm-*.json"))
+        gate("deadline storm auto-dumps", len(storms) >= 1,
+             f"found={storms}")
+        if storms:
+            with open(storms[0]) as f:
+                gate("storm bundle validates",
+                     not validate(json.load(f)))
+    if fails:
+        print(f"\nPOSTMORTEM SMOKE FAILED: {fails}", file=sys.stderr)
+        return 1
+    print("\nPOSTMORTEM SMOKE PASSED")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("bundle", nargs="?",
+                    help="post-mortem bundle JSON to load + render")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the flight-recorder CI gate (ci.sh 1o)")
+    ap.add_argument("--tail", type=int, default=12,
+                    help="ring events to render (default 12)")
+    args = ap.parse_args()
+    if args.smoke:
+        return smoke()
+    if not args.bundle:
+        ap.print_help()
+        return 0
+    with open(args.bundle) as f:
+        bundle = json.load(f)
+    problems = validate(bundle)
+    if problems:
+        print("INVALID bundle:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print(render(bundle, tail=args.tail))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1226,6 +1226,19 @@ def main() -> int:
                     res_aff["slo_attainment"], 4),
                 "slo_attainment_round_robin": round(
                     res_rr["slo_attainment"], 4),
+                # the EXPORTED error-budget attainment gauge (read
+                # back from the pool registry, not re-derived from
+                # stat strings) + the burn monitor's replayable alert
+                # transitions and the pool-level latency attribution
+                # fold — what tools/perf_report.py renders from
+                "slo_attainment_gauge": round(
+                    pool_aff.metrics.gauge("serve_pool_slo_attainment",
+                                           1.0), 4),
+                "slo_alert_transitions": len(
+                    res_aff.get("slo_alerts") or []),
+                "latency_attribution_s": {
+                    c: round(v, 6) for c, v in
+                    (res_aff.get("attribution") or {}).items()},
                 "affinity_hits": res_aff["routing"]["affinity_hits"],
                 "fallbacks": res_aff["routing"]["fallbacks"],
                 "spills": res_aff["routing"]["spills"],

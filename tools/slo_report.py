@@ -1,0 +1,243 @@
+"""SLO burn-rate report + the burn-monitor CI gate (ci.sh step 1o).
+
+Two modes:
+
+* Default: render the SLO burn state from a metrics snapshot
+  (``Telemetry.metrics_snapshot()`` JSON or a registry ``snapshot()``)
+  — burn rates per window, budget remaining, violation split by
+  bound, attainment — the human view of what ``utils/slo.py``
+  exported.
+
+      python tools/slo_report.py --snapshot /tmp/snap.json
+
+* ``--smoke`` (tools/ci.sh step 1o): gates the burn-rate monitor's
+  contract with NO jax dependency (pure host Python, runs in
+  milliseconds):
+    1. a deterministic three-phase traffic history (healthy ->
+       outage -> recovery) drives a monitor through fire AND clear —
+       the alert transitions land at the expected ticks;
+    2. replay determinism: a second monitor fed the identical counter
+       history produces bit-identical transition events (the
+       replayable-alerts contract the ReplicaPool inherits by ticking
+       on its virtual clock);
+    3. alert telemetry: the episode emits slo_alert_fire /
+       slo_alert_clear instants and one complete slo_alert span on
+       the (serve, slo) track;
+    4. gauges: slo_burn_rate{window} / slo_budget_remaining /
+       slo_alert_firing are present and parse in the Prometheus text;
+    5. the healthy phase alone never fires (budget-level noise is not
+       an alert).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+from flexflow_tpu.utils.slo import SLO_DIMS, SLOBurnMonitor  # noqa: E402
+from flexflow_tpu.utils.telemetry import (REQUEST_COMPONENTS,  # noqa: E402
+                                          MetricsRegistry, Telemetry)
+
+
+def _g(gauges: dict, name: str, default=0.0, **labels):
+    key = name
+    if labels:
+        body = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+        key = f"{name}{{{body}}}"
+    return gauges.get(key, default)
+
+
+def render_snapshot(snap: dict) -> str:
+    """Render the burn state from a metrics snapshot (the ``metrics``
+    block of ``Telemetry.metrics_snapshot()``, or a bare registry
+    snapshot)."""
+    m = snap.get("metrics", snap)
+    gauges = m.get("gauges", {})
+    counters = m.get("counters", {})
+    total = _g(counters, "serve_slo_requests_total")
+    viol = _g(counters, "serve_slo_violations_total")
+    lines = ["SLO burn-rate report"]
+    lines.append(
+        f"requests counted: {total:.0f}, violations: {viol:.0f} "
+        f"(attainment "
+        f"{(total - viol) / total if total else 1.0:.2%}, "
+        f"error budget "
+        f"{_g(gauges, 'slo_error_budget', 0.01):.2%})")
+    lines.append(
+        f"burn rate: fast="
+        f"{_g(gauges, 'slo_burn_rate', window='fast'):.2f}x "
+        f"slow={_g(gauges, 'slo_burn_rate', window='slow'):.2f}x, "
+        f"budget remaining "
+        f"{_g(gauges, 'slo_budget_remaining', 1.0):.1%}, "
+        f"alert "
+        f"{'FIRING' if _g(gauges, 'slo_alert_firing') else 'ok'}")
+    split = ", ".join(
+        f"{d}={_g(counters, 'serve_slo_violations_total', slo=d):.0f}"
+        for d in SLO_DIMS)
+    lines.append(f"violations by bound: {split}")
+    fired = _g(counters, "slo_alerts_total", direction="fire")
+    cleared = _g(counters, "slo_alerts_total", direction="clear")
+    if fired or cleared:
+        lines.append(f"alert episodes: {fired:.0f} fired / "
+                     f"{cleared:.0f} cleared")
+    att = {c: _g(counters, "serve_latency_attribution_seconds_total",
+                 component=c)
+           for c in REQUEST_COMPONENTS}
+    if any(att.values()):
+        tot = sum(att.values())
+        lines.append("latency attribution: " + " ".join(
+            f"{c}={v / tot:.1%}" for c, v in att.items() if v > 0))
+    return "\n".join(lines)
+
+
+def render_monitor(mon: SLOBurnMonitor) -> str:
+    """Render a live monitor: the snapshot view plus its transition
+    history (virtual-time, replay-exact)."""
+    s = mon.snapshot()
+    lines = [
+        f"SLO: ttft<={s['slo'].get('ttft_s', 0) * 1e3:.2f}ms "
+        f"tpot<={s['slo'].get('tpot_s', 0) * 1e3:.3f}ms, "
+        f"error budget {s['error_budget']:.2%} "
+        f"(windows {s['fast_window_s']:.3g}s/{s['slow_window_s']:.3g}s, "
+        f"thresholds {s['fast_burn_threshold']:.1f}x/"
+        f"{s['slow_burn_threshold']:.1f}x)"]
+    lines.append(
+        f"state: {s['state']} ({s['episodes']} episode(s)), "
+        f"burn fast={s['burn_fast']:.2f}x slow={s['burn_slow']:.2f}x, "
+        f"budget remaining {s['budget_remaining']:.1%}")
+    lines.append(
+        f"requests {s['requests']:.0f} / violations "
+        f"{s['violations']:.0f} "
+        f"({', '.join(f'{d}={v:.0f}' for d, v in s['violations_by_slo'].items())})")
+    for e in s["events"]:
+        lines.append(
+            f"  t={e['t']:.4f} -> {e['state']} "
+            f"(fast {e.get('burn_fast', 0):.1f}x, "
+            f"slow {e.get('burn_slow', 0):.1f}x, "
+            f"budget {e.get('budget_remaining', 0):.1%})")
+    return "\n".join(lines)
+
+
+def _drive(mon: SLOBurnMonitor, history) -> None:
+    """Replay a (t, total, viol, viol_ttft, viol_tpot) counter history
+    through a monitor: counters are absolute-set before each tick, so
+    the monitor observes exactly the exported-registry path."""
+    m = mon.registry
+    for t, total, viol, vt, vp in history:
+        m.counter_set("serve_slo_requests_total", total)
+        m.counter_set("serve_slo_violations_total", viol)
+        m.counter_set("serve_slo_violations_total", vt, slo="ttft")
+        m.counter_set("serve_slo_violations_total", vp, slo="tpot")
+        m.counter_set("serve_slo_violations_total", 0, slo="outcome")
+        mon.observe(t)
+
+
+def _history():
+    """The deterministic three-phase outage story: 200 ticks at 1s,
+    ~20 req/tick. Healthy (0.5% violations — half the 1% budget),
+    outage at t in [60, 90) (50% violations), recovery after."""
+    hist = []
+    total = viol = vt = 0
+    for t in range(1, 201):
+        total += 20
+        if 60 <= t < 90:
+            viol += 10
+            vt += 10
+        elif t % 10 == 0:
+            viol += 1
+            vt += 1
+        hist.append((float(t), total, viol, vt, 0))
+    return hist
+
+
+def smoke() -> int:
+    fails = []
+
+    def gate(name, ok, detail=""):
+        print(f"  {'PASS' if ok else 'FAIL'}: {name}"
+              + (f" ({detail})" if detail else ""))
+        if not ok:
+            fails.append(name)
+
+    def monitor(tel=None):
+        reg = tel.metrics if tel is not None else MetricsRegistry()
+        return SLOBurnMonitor(
+            reg, error_budget=0.01, fast_window_s=10.0,
+            slow_window_s=40.0, fast_burn=14.4, slow_burn=6.0,
+            interval_s=1.0, telemetry=tel,
+            slo={"ttft_s": 0.1, "tpot_s": 0.01})
+
+    hist = _history()
+    tel = Telemetry()
+    mon = monitor(tel)
+    _drive(mon, hist)
+    mon.finish(hist[-1][0])
+
+    # 1. fire AND clear at the outage boundaries
+    states = [e["state"] for e in mon.events]
+    gate("alert fires and clears", states == ["firing", "ok"],
+         f"events={mon.events}")
+    if mon.events:
+        t_fire = mon.events[0]["t"]
+        gate("fires inside the outage window", 60 <= t_fire < 90,
+             f"t_fire={t_fire}")
+    # 2. replay determinism
+    mon2 = monitor()
+    _drive(mon2, hist)
+    mon2.finish(hist[-1][0])
+    gate("transitions replay bit-identically",
+         mon.events == mon2.events)
+    # 3. telemetry spans
+    names = [ev[2] for ev in tel.events]
+    gate("fire/clear instants + episode span emitted",
+         "slo_alert_fire" in names and "slo_alert_clear" in names
+         and "slo_alert" in names, f"names={sorted(set(names))}")
+    # 4. gauges + Prometheus text
+    g = mon.registry.gauges
+    need = ['slo_burn_rate{window="fast"}',
+            'slo_burn_rate{window="slow"}', "slo_budget_remaining",
+            "slo_alert_firing"]
+    gate("burn gauges exported", all(k in g for k in need),
+         f"missing={[k for k in need if k not in g]}")
+    text = mon.registry.to_prometheus()
+    gate("prometheus text carries slo series",
+         "slo_burn_rate" in text and "slo_budget_remaining" in text)
+    # 5. the healthy phase alone never fires
+    mon3 = monitor()
+    _drive(mon3, [h for h in hist if h[0] < 60])
+    gate("healthy traffic never alerts", mon3.events == [])
+
+    print()
+    print(render_monitor(mon))
+    print()
+    print(render_snapshot({"metrics": mon.registry.snapshot()}))
+    if fails:
+        print(f"\nSLO REPORT SMOKE FAILED: {fails}", file=sys.stderr)
+        return 1
+    print("\nSLO REPORT SMOKE PASSED")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the burn-monitor CI gate (ci.sh 1o)")
+    ap.add_argument("--snapshot", metavar="PATH",
+                    help="render a metrics snapshot JSON "
+                         "(Telemetry.metrics_snapshot() output)")
+    args = ap.parse_args()
+    if args.smoke:
+        return smoke()
+    if args.snapshot:
+        with open(args.snapshot) as f:
+            print(render_snapshot(json.load(f)))
+        return 0
+    ap.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
