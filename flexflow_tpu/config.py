@@ -487,6 +487,20 @@ class FFConfig:
     slo_tpot_ms: float = 0.0
     serve_autoscale: bool = False
     serve_autoscale_max: int = 0
+    # multi-tenant LoRA adapter serving (serve/adapters.py,
+    # docs/serving.md "Multi-tenant adapters"): adapter_rank > 0 arms
+    # the HBM-resident adapter pool — fixed rank-padded (A, B) slab
+    # pairs, one slot per resident tenant, gathered per lane inside
+    # the ONE mixed program so tenant-heterogeneous batches decode in
+    # one fixed-shape step (zero recompiles; needs chunked prefill).
+    # adapter_pool_mb sizes the slot count by per-device byte budget
+    # (the kv_pool_mb idiom; 0 = 1 + serve_max_seqs slots).
+    # tenant_adapters is the synthetic tenant count traffic mixes and
+    # the lora bench register (tenants 1..N, serve/traffic.py).
+    # --adapter-rank / --adapter-pool-mb / --tenant-adapters.
+    adapter_rank: int = 0
+    adapter_pool_mb: float = 0.0
+    tenant_adapters: int = 4
 
     # synthetic input when no dataset is provided (reference: config.h:131)
     synthetic_input: bool = False
@@ -578,6 +592,23 @@ class FFConfig:
             raise ValueError(
                 f"serve_prefill_budget must be >= 1, got "
                 f"{self.serve_prefill_budget}")
+        if self.adapter_rank < 0:
+            raise ValueError(
+                f"adapter_rank must be >= 0 (0 = adapters unarmed), "
+                f"got {self.adapter_rank}")
+        if self.adapter_pool_mb < 0:
+            raise ValueError(
+                f"adapter_pool_mb must be >= 0 (0 = size by "
+                f"serve_max_seqs), got {self.adapter_pool_mb}")
+        if self.tenant_adapters < 0:
+            raise ValueError(
+                f"tenant_adapters must be >= 0, got "
+                f"{self.tenant_adapters}")
+        if self.adapter_rank > 0 and not self.serve_chunked_prefill:
+            raise ValueError(
+                "adapter_rank > 0 needs chunked prefill (the per-lane "
+                "adapter gather lives in the ONE mixed program); drop "
+                "--no-chunked-prefill")
         if not 0.0 <= self.serve_admit_watermark < 1.0:
             raise ValueError(
                 f"serve_admit_watermark must be in [0, 1), got "
@@ -728,6 +759,9 @@ class FFConfig:
         "--serve-attn-block-kv": ("serve_attn_block_kv", int),
         "--serve-max-seqs": ("serve_max_seqs", int),
         "--serve-prefill-budget": ("serve_prefill_budget", int),
+        "--adapter-rank": ("adapter_rank", int),
+        "--adapter-pool-mb": ("adapter_pool_mb", float),
+        "--tenant-adapters": ("tenant_adapters", int),
         "--serve-admit-watermark": ("serve_admit_watermark", float),
         "--spec-tokens": ("serve_spec_tokens", int),
         "--fault-spec": ("fault_spec", str),

@@ -37,7 +37,12 @@ from .machine_model import TPUMachineModel
 # itemsize + scale rows; serve_step_tasks transfer_tokens) and the
 # prefill:decode ratio search over per-role tensor degrees
 # (serve_place.optimize_serve_disagg).
-COST_MODEL_VERSION = 5
+# v6: multi-tenant LoRA serving — ServeArch carries adapter_rank /
+# adapter_slots, serve_step_tasks prices the per-lane slab gather and
+# the low-rank delta flops on every adapted projection, and
+# serve_device_bytes adds the adapter-pool HBM term so --serve-mesh
+# auto trades tensor degree against adapter residency.
+COST_MODEL_VERSION = 6
 
 BWD_FLOP_FACTOR = 2.0  # dX and dW GEMMs ≈ 2x fwd (reference bwd = 2 GEMMs)
 # per-op-type overrides: attention bwd recomputes probabilities from the
@@ -584,6 +589,12 @@ class ServeArch:
     # program dispatches decode_lanes + THIS many lanes every step, so
     # the ratio search must price that width, not bare decode_lanes
     handoff_stub_lanes: int = 32
+    # multi-tenant LoRA pool (serve/adapters.py): the fixed slab rank
+    # and the pool's slot count (0 = adapters unarmed). Both are
+    # signature() fields, so arming adapters — or resizing the pool —
+    # is a guaranteed cost-cache miss.
+    adapter_rank: int = 0
+    adapter_slots: int = 0
     kv_dtype: str = "float32"
     kv_itemsize: float = 4.0
     kv_scales: bool = False      # quantized pools stream f32 scale rows
@@ -687,14 +698,36 @@ def serve_step_tasks(arch: ServeArch, tensor_parallel: int,
                 name, "collective", mm.all_reduce(nbytes, t, axis),
                 deps))
 
+    # multi-tenant LoRA deltas (serve/adapters.py): every lane gathers
+    # its tenant's (A, B) slabs by slot index and adds
+    # (x @ A) @ B * scale on each adapted projection. The gather's HBM
+    # traffic streams at most min(lanes, slots) distinct slots' slabs
+    # (the A factors and replicated-output B factors replicate; the
+    # head/ff-sharded factors divide by t); the delta flops ride the
+    # projection tasks they extend.
+    r = max(0, int(arch.adapter_rank))
+    lora_qkv = lora_wo = lora_ffn = 0.0
+    if r > 0:
+        n_ad = min(T, max(1, int(arch.adapter_slots)))
+        rep_slab = arch.num_layers * (3 * e * r + 3 * r * e) * act
+        shd_slab = arch.num_layers * (3 * r * hd + hd * r
+                                      + r * f + f * r) * act / t
+        lora_qkv = 3 * (2 * T * e * r + 2 * T * r * hd / t)
+        lora_wo = 2 * T * (hd / t) * r + 2 * T * r * e
+        lora_ffn = (2 * T * e * r + 2 * T * r * f / t
+                    + 2 * T * (f / t) * r + 2 * T * r * e)
     # vocab-row-sharded embedding: gather T rows locally, ONE exact
     # psum assembles them (engine._embed_tp)
     compute("embed", 0.0, T * e * act, ())
     all_reduce("embed_psum", T * e * act, ("embed",))
     prev = tasks[-1].name
+    if r > 0:
+        compute("adapter_gather", 0.0, n_ad * (rep_slab + shd_slab),
+                (prev,))
+        prev = "adapter_gather"
     for i in range(arch.num_layers):
         # head-column-parallel qkv (each device its H/t heads)
-        compute(f"l{i}.qkv", 2 * 3 * T * e * hd / t,
+        compute(f"l{i}.qkv", 2 * 3 * T * e * hd / t + lora_qkv,
                 (3 * e * hd * p) / t + T * e * act
                 + 3 * T * hd * act / t, (prev,))
         # paged ragged attention: QK^T + PV over each lane's context,
@@ -706,11 +739,11 @@ def serve_step_tasks(arch: ServeArch, tensor_parallel: int,
         compute(f"l{i}.attn", 4 * T * ctx * hd / t, kv_bytes,
                 (f"l{i}.qkv",))
         # head-row-parallel wo: partial sums complete in the all-reduce
-        compute(f"l{i}.wo", 2 * T * hd * e / t,
+        compute(f"l{i}.wo", 2 * T * hd * e / t + lora_wo,
                 (hd * e * p) / t + T * e * act, (f"l{i}.attn",))
         all_reduce(f"l{i}.ar_attn", T * e * act, (f"l{i}.wo",))
         # column->row-parallel FFN, one all-reduce before the bias
-        compute(f"l{i}.ffn", 2 * 2 * T * e * f / t,
+        compute(f"l{i}.ffn", 2 * 2 * T * e * f / t + lora_ffn,
                 (2 * e * f * p) / t + 2 * T * e * act,
                 (tasks[-1].name,))
         all_reduce(f"l{i}.ar_ffn", T * e * act, (f"l{i}.ffn",))
@@ -733,8 +766,12 @@ def serve_step_tasks(arch: ServeArch, tensor_parallel: int,
 
 def serve_device_bytes(arch: ServeArch, tensor_parallel: int) -> float:
     """Per-device resident bytes under head/vocab sharding: the weight
-    shard plus each decode lane's context KV shard — what the memory
-    penalty (and the auto placement's HBM fit) sees."""
+    shard plus each decode lane's context KV shard plus the LoRA
+    adapter pool — what the memory penalty (and the auto placement's
+    HBM fit) sees. The adapter term mirrors AdapterConfig.
+    pool_device_bytes (serve/adapters.py): per slot, the replicated
+    A / output-B factors plus the head/ff-sharded factors over t, at
+    the activation itemsize, plus the f32 scale."""
     t = max(1, int(tensor_parallel))
     kv = (2 * arch.decode_lanes * arch.context
           * (arch.num_heads * arch.head_dim / t) * arch.num_layers
@@ -742,4 +779,13 @@ def serve_device_bytes(arch: ServeArch, tensor_parallel: int) -> float:
     if arch.kv_scales:
         kv += (2 * arch.decode_lanes * arch.context
                * (arch.num_heads / t) * arch.num_layers * 4.0)
-    return arch.weight_bytes() / t + kv
+    adapters = 0.0
+    r = max(0, int(arch.adapter_rank))
+    if r > 0 and arch.adapter_slots > 0:
+        e, f = arch.hidden, arch.ff_dim
+        hd = arch.num_heads * arch.head_dim
+        rep = arch.num_layers * (3 * e * r + 3 * r * e)
+        shd = arch.num_layers * (3 * r * hd + hd * r + r * f + f * r)
+        adapters = arch.adapter_slots * (
+            (rep + shd / t) * arch.act_itemsize + 4.0)
+    return arch.weight_bytes() / t + kv + adapters

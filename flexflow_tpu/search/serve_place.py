@@ -96,11 +96,15 @@ def axis_assignments(mm: TPUMachineModel, t: int) -> List[Tuple[int, ...]]:
 
 
 def _serve_fingerprint(mm: TPUMachineModel, arch: ServeArch) -> str:
+    # serve_v2: LoRA adapter pricing (adapter_rank/adapter_slots fold
+    # in) — rows priced by the pre-adapter formulas can never
+    # resurrect into an adapter-aware search, and vice versa
     from .cost_cache import machine_fingerprint
     return machine_fingerprint(
-        mm, serve=("serve_v1", arch.kv_dtype, arch.act_dtype,
+        mm, serve=("serve_v2", arch.kv_dtype, arch.act_dtype,
                    arch.kv_itemsize, arch.act_itemsize,
-                   arch.param_itemsize))
+                   arch.param_itemsize, arch.adapter_rank,
+                   arch.adapter_slots))
 
 
 def price_placement(arch: ServeArch, t: int, mm: TPUMachineModel,

@@ -91,6 +91,12 @@ class PageShipment:
     head_dim: int
     kv_dtype: str
     stream_id: Optional[int] = None
+    # multi-tenant adapter serving (serve/adapters.py): the tenant
+    # whose adapter the request decodes under crosses the link WITH
+    # its pages — the decode role must admit the continuation under
+    # the same tenant (salted prefix chain, adapter slot) or the
+    # imported pages could never match
+    tenant_id: int = 0
     # trace-context propagation (docs/observability.md): the request's
     # trace id crosses the link WITH its pages, so the kv_handoff span
     # and the decode role's spans land on the same causally-linked
@@ -329,6 +335,16 @@ class DisaggCluster:
     def check_invariants(self) -> None:
         for _, eng in self.engines():
             eng.cache.check_invariants()
+            if eng.adapters is not None:
+                eng.adapters.check_invariants()
+
+    def register_adapter(self, tenant_id: int, weights, *,
+                         scale: float = 1.0) -> None:
+        """Register a tenant's LoRA adapter on EVERY role engine: a
+        request may prefill on any prefill engine and decode on any
+        decode engine, so the registry must be cluster-uniform."""
+        for _, eng in self.engines():
+            eng.register_adapter(tenant_id, weights, scale=scale)
 
     def close(self) -> None:
         server, self.metrics_server = self.metrics_server, None
@@ -395,7 +411,9 @@ class DisaggCluster:
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens, eos_token: Optional[int] = None,
                  temperature=None, top_k=None, sample_seed: int = 0,
-                 on_step=None) -> List[List[int]]:
+                 on_step=None,
+                 tenant_ids: Optional[Sequence[int]] = None
+                 ) -> List[List[int]]:
         """Serve a batch disaggregated: prefill engines compute every
         prompt and its FIRST token, finished pages hand off to decode
         engines, which emit the rest. Token-identical to the unified
@@ -420,6 +438,11 @@ class DisaggCluster:
 
         temps = per_req(temperature, "temperature")
         tks = per_req(top_k, "top_k")
+        # tenancy crosses the split with the request: the prefill role
+        # computes the salted chain + adapted K/V, the shipment stamps
+        # the tenant, and the decode role re-admits under the same id
+        tens = per_req(0 if tenant_ids is None else list(tenant_ids),
+                       "tenant_ids")
         if isinstance(max_new_tokens, int):
             max_new_tokens = [max_new_tokens] * n
         if len(max_new_tokens) != n:
@@ -474,7 +497,7 @@ class DisaggCluster:
                     return
                 _local[req.rid] = _eng.export_kv(
                     req.slot, req.context, stream_id=req.stream_id,
-                    trace_id=req.trace_id)
+                    trace_id=req.trace_id, tenant_id=req.tenant_id)
 
             # stream ids = GLOBAL request indices (the identity a
             # unified engine's rids would be), so sampled draws on
@@ -486,6 +509,7 @@ class DisaggCluster:
                 sample_seed=sample_seed, on_finish=grab,
                 stream_ids=list(idxs),
                 trace_ids=[tids[i] for i in idxs],
+                tenant_ids=[tens[i] for i in idxs],
                 on_step=(None if on_step is None else
                          (lambda s, _w=w: on_step("prefill", _w, s))))
             for rid, i in enumerate(idxs):
@@ -539,6 +563,7 @@ class DisaggCluster:
                 sample_seed=sample_seed,
                 stream_ids=list(idxs), stream_offset=1,
                 trace_ids=[tids[i] for i in idxs],
+                tenant_ids=[tens[i] for i in idxs],
                 on_step=(None if on_step is None else
                          (lambda s, _w=w: on_step("decode", _w, s))))
             for j, i in enumerate(idxs):
@@ -683,8 +708,8 @@ class DisaggCluster:
         tel = self.telemetry
         roles = {}
         totals = {"params_bytes": 0.0, "kv_pool_bytes": 0.0,
-                  "activation_est_bytes": 0.0, "total_bytes": 0.0,
-                  "live_bytes": 0.0}
+                  "activation_est_bytes": 0.0, "adapter_bytes": 0.0,
+                  "total_bytes": 0.0, "live_bytes": 0.0}
         for i, (role, eng) in enumerate(self.engines()):
             led = eng.memory_ledger()
             roles[f"{role}{i}"] = led
@@ -692,7 +717,7 @@ class DisaggCluster:
                 totals[k] += float(led.get(k) or 0.0)
             if tel.enabled:
                 for comp in ("params", "kv_pool", "activation_est",
-                             "total", "live"):
+                             "adapter", "total", "live"):
                     tel.metrics.set("serve_hbm_bytes",
                                     led[f"{comp}_bytes"],
                                     component=comp, role=f"{role}{i}")

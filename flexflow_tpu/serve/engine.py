@@ -338,6 +338,54 @@ class ServeEngine:
         self._v_pages = None
         self._k_scales = None
         self._v_scales = None
+        # multi-tenant LoRA adapter pool (serve/adapters.py): fixed
+        # rank-padded HBM slabs managed like the KV pool, slot 0 the
+        # reserved all-zero base slab so base and adapted lanes mix in
+        # the ONE mixed program. Armed by adapter_rank > 0; the slabs
+        # flow READ-ONLY through the mixed step (gathered per lane,
+        # never donated) and tenant loads run through one jitted
+        # donating scatter ("adapter" in the compile accounting).
+        self.adapters = None
+        self.adapter_cfg = None
+        self._adapter_slabs = None     # device pytree, lazy like pages
+        self._adapter_specs = None     # PartitionSpec dict (tp > 1)
+        self._adapter_shardings = None
+        if int(getattr(cfg, "adapter_rank", 0) or 0) > 0:
+            if not self.chunked_prefill:
+                raise ValueError(
+                    "adapter_rank > 0 needs the chunked mixed program "
+                    "(the per-lane adapter gather lives in the mixed "
+                    "step); the legacy bucket path serves base-only")
+            from .adapters import AdapterConfig, AdapterPool
+            self.adapter_cfg = AdapterConfig.from_ff(
+                cfg, num_layers=self.num_layers, hidden=self.hidden,
+                num_heads=self.num_heads, head_dim=self.head_dim,
+                ff_dim=self._ff_pad,
+                act_itemsize=int(self.act_dtype.itemsize),
+                tensor_parallel=self.tp)
+            self.adapters = AdapterPool(self.adapter_cfg)
+            if self.tp > 1:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+                # B factors shard where their output dim does (heads /
+                # padded ff), A factors contracting a sharded dim
+                # (wo's heads, ff2's ff) shard on it; the rank-side
+                # rest replicates — per-device deltas are then local
+                # partials the existing psums complete exactly
+                self._adapter_specs = {
+                    "a_qkv": P(),
+                    "b_qkv": P(None, None, None, None, TENSOR, None),
+                    "a_wo": P(None, None, TENSOR, None, None),
+                    "b_wo": P(),
+                    "a_ff1": P(),
+                    "b_ff1": P(None, None, None, TENSOR),
+                    "a_ff2": P(None, None, TENSOR, None),
+                    "b_ff2": P(),
+                    "scale": P(),
+                }
+                self._adapter_shardings = {
+                    k: NamedSharding(self.tp_mesh, s)
+                    for k, s in self._adapter_specs.items()}
         # prompt-length buckets (legacy path + generate_reference):
         # powers of two from one page up to the serveable length. The
         # page-table ceiling rounds UP to whole pages, but a bucket
@@ -372,6 +420,13 @@ class ServeEngine:
                                     donate_argnums=(1, 2))
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2))
         self._forward_jit = jax.jit(self._forward_logits)  # naive reference
+        if self.adapters is not None:
+            # the on-demand tenant load: donate-in-place row write into
+            # the slabs, ONE program for every (slot, tenant) — the
+            # admission stall is a dispatch, never a recompile
+            self._adapter_load_jit = jax.jit(
+                self._adapter_load_impl, donate_argnums=(0,),
+                out_shardings=self._adapter_shardings)
         # disaggregated page handoff (serve/disagg.py): fixed-shape
         # gather/scatter programs moving whole page rows (values +
         # scale rows on quantized pools) between this engine's pool
@@ -403,12 +458,13 @@ class ServeEngine:
         self._events_ok = _CompileEvents.install()
         self._compiles: Dict[str, int] = {"prefill": 0, "decode": 0,
                                           "mixed": 0, "export": 0,
-                                          "import": 0}
+                                          "import": 0, "adapter": 0}
         self._shapes_seen: Dict[str, set] = {"prefill": set(),
                                              "decode": set(),
                                              "mixed": set(),
                                              "export": set(),
-                                             "import": set()}
+                                             "import": set(),
+                                             "adapter": set()}
         self.last_stats: Optional[dict] = None
         # live scrape endpoint (--metrics-port, docs/observability.md):
         # /metrics serves the engine-lifetime registry as Prometheus
@@ -562,6 +618,19 @@ class ServeEngine:
         cfg = self.config
         kv_name = str(getattr(cfg, "kv_dtype", "float32"))
         from .kv_cache import QUANTIZED_KV_DTYPES
+        # adapter-pool pricing terms: the armed engine's true pool
+        # geometry, or (on the serve_mesh=auto path, which prices the
+        # arch BEFORE the pool exists) an unsharded estimate from the
+        # same from_ff sizing — the search sees the residency cost it
+        # is trading tensor degree against
+        acfg = getattr(self, "adapter_cfg", None)
+        if acfg is None and int(getattr(cfg, "adapter_rank", 0) or 0) > 0:
+            from .adapters import AdapterConfig
+            acfg = AdapterConfig.from_ff(
+                cfg, num_layers=self.num_layers, hidden=self.hidden,
+                num_heads=self.num_heads, head_dim=self.head_dim,
+                ff_dim=self.ff_dim,
+                act_itemsize=int(self.act_dtype.itemsize))
         return ServeArch(
             num_layers=self.num_layers, hidden=self.hidden,
             num_heads=self.num_heads, head_dim=self.head_dim,
@@ -574,7 +643,9 @@ class ServeEngine:
             kv_itemsize=float(kv_storage_dtype(kv_name).itemsize),
             kv_scales=kv_name in QUANTIZED_KV_DTYPES,
             act_itemsize=float(self.act_dtype.itemsize),
-            act_dtype=str(self.act_dtype.name))
+            act_dtype=str(self.act_dtype.name),
+            adapter_rank=acfg.rank if acfg is not None else 0,
+            adapter_slots=acfg.num_slots if acfg is not None else 0)
 
     def _shard_params(self):
         """Shard (and where needed pad) the LM parameters over the
@@ -715,6 +786,16 @@ class ServeEngine:
         args += (lane, lane, lane, lane,
                  jnp.zeros((c.max_seqs, c.pages_per_seq), i32),
                  lane, lane)
+        if self.adapters is not None:
+            slabs = {
+                key: jax.ShapeDtypeStruct(
+                    shape,
+                    jnp.float32 if key == "scale" else self.act_dtype,
+                    sharding=(self._adapter_shardings or {}).get(key))
+                for key, shape in self._adapter_slab_shapes().items()}
+            args += (lane, slabs)
+        else:
+            args += (None, None)
         try:
             ca = jitted.lower(*args).compile().cost_analysis()
         except (NotImplementedError, jax.errors.JaxRuntimeError):
@@ -738,15 +819,35 @@ class ServeEngine:
                       mode="clip")
         return (te + pe).astype(self.act_dtype)
 
-    def _attn_qkv(self, p, h):
-        """h (..., E) -> q, k, v (..., H, D)."""
+    def _attn_qkv(self, p, h, lora=None):
+        """h (..., E) -> q, k, v (..., H, D). `lora` (mixed step only,
+        h is (T, E)) is the lanes' gathered per-layer adapter rows
+        (a_qkv (T, 3, E, r), b_qkv (T, 3, r, H[/t], D), scale (T,)):
+        each lane adds ITS tenant's low-rank delta; slot-0 lanes gather
+        the zero slab and their delta is exactly 0.0."""
         q = jnp.einsum("...e,ehd->...hd", h, p["wq"].astype(h.dtype))
         k = jnp.einsum("...e,ehd->...hd", h, p["wk"].astype(h.dtype))
         v = jnp.einsum("...e,ehd->...hd", h, p["wv"].astype(h.dtype))
+        if lora is not None:
+            aq, bq, s = lora
+            u = jnp.einsum("te,tjer->tjr", h, aq.astype(h.dtype))
+            d = jnp.einsum("tjr,tjrhd->tjhd", u, bq.astype(h.dtype))
+            d = d * s.astype(h.dtype)[:, None, None, None]
+            q = q + d[:, 0]
+            k = k + d[:, 1]
+            v = v + d[:, 2]
         return q, k, v
 
-    def _attn_out(self, p, o, x, psum_axis=None):
+    def _attn_out(self, p, o, x, psum_axis=None, lora=None):
         y = jnp.einsum("...hd,hde->...e", o, p["wo"].astype(o.dtype))
+        if lora is not None:
+            # a_wo contracts the (sharded) head dim, so under tp the
+            # delta is a local partial the psum below completes —
+            # exact by linearity
+            a, b, s = lora
+            u = jnp.einsum("thd,thdr->tr", o, a.astype(o.dtype))
+            y = y + jnp.einsum("tr,tre->te", u, b.astype(o.dtype)) \
+                * s.astype(o.dtype)[:, None]
         if psum_axis is not None:
             # head-row-parallel wo: each device contracted its H/t
             # heads; the all-reduce completes the sum (Megatron)
@@ -755,12 +856,38 @@ class ServeEngine:
             y = y + p["bo"].astype(y.dtype)
         return x + y
 
-    def _ffn(self, params, i, x, psum_axis=None):
+    def _ffn(self, params, i, x, psum_axis=None, lora=None):
         h = _ln(params[f"layer{i}_ln2"], x, self.ln_eps) \
             if self.layer_norm else x
-        h = _dense(params[f"layer{i}_ff1"], h, activation="relu")
-        h = _dense(params[f"layer{i}_ff2"], h, psum_axis=psum_axis)
-        return x + h
+        if lora is None:
+            h = _dense(params[f"layer{i}_ff1"], h, activation="relu")
+            h = _dense(params[f"layer{i}_ff2"], h, psum_axis=psum_axis)
+            return x + h
+        # adapted FFN: ff1's delta lands PRE-activation (the merged
+        # reference folds A@B into the kernel, which relu then sees)
+        # and ff2's delta is a pre-psum local partial like wo's
+        a1, b1, a2, b2, s = lora
+        s = s.astype(h.dtype)
+        p1 = params[f"layer{i}_ff1"]
+        z = jnp.dot(h, p1["kernel"].astype(h.dtype),
+                    preferred_element_type=jnp.float32).astype(h.dtype)
+        u1 = jnp.einsum("te,ter->tr", h, a1.astype(h.dtype))
+        z = z + jnp.einsum("tr,trf->tf", u1, b1.astype(h.dtype)) \
+            * s[:, None]
+        if "bias" in p1:
+            z = z + p1["bias"].astype(z.dtype)
+        h2 = jax.nn.relu(z)
+        p2 = params[f"layer{i}_ff2"]
+        y = jnp.dot(h2, p2["kernel"].astype(h2.dtype),
+                    preferred_element_type=jnp.float32).astype(h2.dtype)
+        u2 = jnp.einsum("tf,tfr->tr", h2, a2.astype(h2.dtype))
+        y = y + jnp.einsum("tr,tre->te", u2, b2.astype(h2.dtype)) \
+            * s[:, None]
+        if psum_axis is not None:
+            y = jax.lax.psum(y, psum_axis)
+        if "bias" in p2:
+            y = y + p2["bias"].astype(y.dtype)
+        return x + y
 
     def _head(self, params, x):
         if self.layer_norm:
@@ -853,7 +980,7 @@ class ServeEngine:
     # ---------------- the mixed step (chunked prefill + decode) --------
     def _mixed_impl(self, params, k_pages, v_pages, tokens, positions,
                     write_pages, write_offs, page_tables, lane_slots,
-                    lane_lens):
+                    lane_lens, lane_adapters=None, adapters=None):
         """ONE serving step over `mixed_width` LANES. Per lane (all
         (T,) int32, HOST-built): the token to embed, its position, the
         physical (page, offset) its K/V lands in (inactive lanes aim at
@@ -871,12 +998,14 @@ class ServeEngine:
         host-side seeded sampling without shipping (T, vocab) logits."""
         out, (k_pages, v_pages) = self._mixed_body(
             params, k_pages, v_pages, None, None, tokens, positions,
-            write_pages, write_offs, page_tables, lane_slots, lane_lens)
+            write_pages, write_offs, page_tables, lane_slots, lane_lens,
+            lane_adapters=lane_adapters, adapters=adapters)
         return (*out, k_pages, v_pages)
 
     def _mixed_q_impl(self, params, k_pages, v_pages, k_scales, v_scales,
                       tokens, positions, write_pages, write_offs,
-                      page_tables, lane_slots, lane_lens):
+                      page_tables, lane_slots, lane_lens,
+                      lane_adapters=None, adapters=None):
         """The mixed step over an int8 page pool: identical lane
         contract, but every lane's K/V row quantizes on write (per-row
         amax scale into the per-page scale arrays) and the ragged
@@ -885,7 +1014,7 @@ class ServeEngine:
         out, (k_pages, v_pages, k_scales, v_scales) = self._mixed_body(
             params, k_pages, v_pages, k_scales, v_scales, tokens,
             positions, write_pages, write_offs, page_tables, lane_slots,
-            lane_lens)
+            lane_lens, lane_adapters=lane_adapters, adapters=adapters)
         return (*out, k_pages, v_pages, k_scales, v_scales)
 
     # ---------------- the sharded mixed step ---------------------------
@@ -902,6 +1031,11 @@ class ServeEngine:
         if quantized:
             ins += (scl, scl)
         ins += (rep,) * 7
+        # adapter operands: lane slot indices replicated; the slab
+        # dict per _adapter_specs (unarmed engines pass None — an
+        # empty pytree any prefix spec matches)
+        ins += (rep, self._adapter_specs
+                if self._adapter_specs is not None else rep)
         outs = (rep, rep, rep, page, page)
         if quantized:
             outs += (scl, scl)
@@ -909,7 +1043,7 @@ class ServeEngine:
 
     def _mixed_tp_impl(self, params, k_pages, v_pages, tokens, positions,
                        write_pages, write_offs, page_tables, lane_slots,
-                       lane_lens):
+                       lane_lens, lane_adapters=None, adapters=None):
         """The mixed step shard_map'd over the serve mesh: identical
         lane contract and donation; each device runs _mixed_body on its
         H/t heads of the params and pages (tp_axis threads the psums /
@@ -919,19 +1053,26 @@ class ServeEngine:
         from ..parallel._compat import shard_map
         ins, outs = self._tp_step_specs(False)
 
-        def body(params, kp, vp, *rest):
+        def body(params, kp, vp, tokens, positions, write_pages,
+                 write_offs, page_tables, lane_slots, lane_lens,
+                 lane_adapters, adapters):
             out, (kp, vp) = self._mixed_body(
-                params, kp, vp, None, None, *rest, tp_axis=TENSOR)
+                params, kp, vp, None, None, tokens, positions,
+                write_pages, write_offs, page_tables, lane_slots,
+                lane_lens, lane_adapters=lane_adapters,
+                adapters=adapters, tp_axis=TENSOR)
             return (*out, kp, vp)
 
         return shard_map(body, mesh=self.tp_mesh, in_specs=ins,
                          out_specs=outs, check_vma=False)(
             params, k_pages, v_pages, tokens, positions, write_pages,
-            write_offs, page_tables, lane_slots, lane_lens)
+            write_offs, page_tables, lane_slots, lane_lens,
+            lane_adapters, adapters)
 
     def _mixed_q_tp_impl(self, params, k_pages, v_pages, k_scales,
                          v_scales, tokens, positions, write_pages,
-                         write_offs, page_tables, lane_slots, lane_lens):
+                         write_offs, page_tables, lane_slots, lane_lens,
+                         lane_adapters=None, adapters=None):
         """The quantized mixed step over the serve mesh: scale arrays
         shard on the same head axis as the pages, and per-row
         quantization is per-head — so each device's quantized rows are
@@ -940,20 +1081,26 @@ class ServeEngine:
         from ..parallel._compat import shard_map
         ins, outs = self._tp_step_specs(True)
 
-        def body(params, kp, vp, ks, vs, *rest):
+        def body(params, kp, vp, ks, vs, tokens, positions, write_pages,
+                 write_offs, page_tables, lane_slots, lane_lens,
+                 lane_adapters, adapters):
             out, (kp, vp, ks, vs) = self._mixed_body(
-                params, kp, vp, ks, vs, *rest, tp_axis=TENSOR)
+                params, kp, vp, ks, vs, tokens, positions, write_pages,
+                write_offs, page_tables, lane_slots, lane_lens,
+                lane_adapters=lane_adapters, adapters=adapters,
+                tp_axis=TENSOR)
             return (*out, kp, vp, ks, vs)
 
         return shard_map(body, mesh=self.tp_mesh, in_specs=ins,
                          out_specs=outs, check_vma=False)(
             params, k_pages, v_pages, k_scales, v_scales, tokens,
             positions, write_pages, write_offs, page_tables, lane_slots,
-            lane_lens)
+            lane_lens, lane_adapters, adapters)
 
     def _mixed_body(self, params, k_pages, v_pages, k_scales, v_scales,
                     tokens, positions, write_pages, write_offs,
-                    page_tables, lane_slots, lane_lens, tp_axis=None):
+                    page_tables, lane_slots, lane_lens,
+                    lane_adapters=None, adapters=None, tp_axis=None):
         """Shared mixed-step body. Storage-dtype handling per layer:
         f32 pages store activation values exactly (the bit-exactness
         path); bf16 pages round on the scatter (the .at[].set cast);
@@ -975,11 +1122,26 @@ class ServeEngine:
              if tp_axis else
              self._embed(params, tokens, positions))     # (T, E)
         scale = 1.0 / np.sqrt(self.head_dim)
+        # multi-tenant adapters (serve/adapters.py): ONE gather pulls
+        # each lane's whole (A, B) stack — slab (S, L, ...) rows by
+        # the lane's slot index — so the per-layer loop just slices.
+        # Slot 0 is the reserved zero slab: base-model and inactive
+        # lanes add exactly 0.0. Under shard_map the gather runs on
+        # each device's local slab shard (replicated lane indices).
+        ad = ad_s = None
+        if adapters is not None:
+            ad = {key: jnp.take(arr, lane_adapters, axis=0)
+                  for key, arr in adapters.items() if key != "scale"}
+            ad_s = jnp.take(adapters["scale"], lane_adapters, axis=0)
         for i in range(self.num_layers):
             p = params[f"layer{i}_attn"]
             h = _ln(params[f"layer{i}_ln1"], x, self.ln_eps) \
                 if self.layer_norm else x
-            q, k, v = self._attn_qkv(p, h)                # (T, H[/t], D)
+            la = None if ad is None else {
+                key: arr[:, i] for key, arr in ad.items()}
+            q, k, v = self._attn_qkv(
+                p, h, lora=None if la is None else
+                (la["a_qkv"], la["b_qkv"], ad_s))         # (T, H[/t], D)
             if quantized:
                 kq, ksc = quantize_kv_rows(k, self._kv_store_dtype)
                 vq, vsc = quantize_kv_rows(v, self._kv_store_dtype)
@@ -1001,8 +1163,15 @@ class ServeEngine:
                 k_scales=k_scales[i] if quantized else None,
                 v_scales=v_scales[i] if quantized else None,
                 block_kv=self.attn_block_kv)
-            x = self._attn_out(p, o, x, psum_axis=tp_axis)
-            x = self._ffn(params, i, x, psum_axis=tp_axis)
+            x = self._attn_out(
+                p, o, x, psum_axis=tp_axis,
+                lora=None if la is None else
+                (la["a_wo"], la["b_wo"], ad_s))
+            x = self._ffn(
+                params, i, x, psum_axis=tp_axis,
+                lora=None if la is None else
+                (la["a_ff1"], la["b_ff1"], la["a_ff2"], la["b_ff2"],
+                 ad_s))
         logits = (self._head_tp(params, x, tp_axis) if tp_axis
                   else self._head(params, x))            # (T, V[pad])
         topv, topi = jax.lax.top_k(logits, self.topk_cap)
@@ -1093,7 +1262,8 @@ class ServeEngine:
 
     def export_kv(self, slot: int, tokens: Sequence[int],
                   stream_id: Optional[int] = None,
-                  trace_id: Optional[int] = None):
+                  trace_id: Optional[int] = None,
+                  tenant_id: int = 0):
         """Ship `slot`'s full resident pages to the host: the
         prefill-engine half of a disaggregated handoff. Returns a
         PageShipment (serve/disagg.py) carrying the chain keys, the
@@ -1102,8 +1272,10 @@ class ServeEngine:
         has no full page yet (the importer simply recomputes). Must
         run while the slot is still mapped (DisaggCluster exports from
         generate's on_finish hook, before the slot is freed)."""
+        from .adapters import tenant_prefix_salt
         from .disagg import PageShipment
-        pages, keys, ntokens = self.cache.export_pages(slot, tokens)
+        pages, keys, ntokens = self.cache.export_pages(
+            slot, tokens, prev=tenant_prefix_salt(tenant_id))
         if not pages:
             return None
         self._device_pages()
@@ -1123,7 +1295,7 @@ class ServeEngine:
             page_size=c.page_size, num_layers=c.num_layers,
             num_heads=c.num_heads, head_dim=c.head_dim,
             kv_dtype=c.kv_dtype, stream_id=stream_id,
-            trace_id=trace_id)
+            trace_id=trace_id, tenant_id=int(tenant_id))
 
     def import_kv(self, ship) -> int:
         """Adopt a PageShipment into this engine's pool: the
@@ -1279,7 +1451,7 @@ class ServeEngine:
         return {name: max(self._compiles[name],
                           len(self._shapes_seen[name]))
                 for name in ("prefill", "decode", "mixed", "export",
-                             "import")}
+                             "import", "adapter")}
 
     def _device_pages(self):
         page_sh, scale_sh = self._page_shardings()
@@ -1293,14 +1465,102 @@ class ServeEngine:
                                            self._v_scales)
         return self._k_pages, self._v_pages
 
-    def _dispatch_mixed(self, kp, vp, *args):
+    # ---------------- adapter pool: device half ------------------------
+    def _adapter_slab_shapes(self):
+        """{slab: (num_slots,) + per-slot shape} of the device pool —
+        the stacked form of adapters._weight_shapes at the pool's
+        padded rank/ff, plus the (S,) f32 per-slot scale."""
+        from .adapters import _weight_shapes
+        ac = self.adapter_cfg
+        shapes = {k: (ac.num_slots,) + s for k, s in _weight_shapes(
+            ac, ac.rank, ac.ff_dim).items()}
+        shapes["scale"] = (ac.num_slots,)
+        return shapes
+
+    def _device_adapters(self):
+        """The resident slab pytree (lazy, like _device_pages): A/B
+        factors at the activation dtype, per-slot scales f32, all
+        zeros until tenants load — so slot 0 stays the zero base slab
+        forever (nothing ever writes it)."""
+        if self.adapters is None:
+            return None
+        if self._adapter_slabs is None:
+            slabs = {}
+            for key, shape in self._adapter_slab_shapes().items():
+                dt = jnp.float32 if key == "scale" else self.act_dtype
+                arr = jnp.zeros(shape, dt)
+                if self._adapter_shardings is not None:
+                    arr = jax.device_put(arr,
+                                         self._adapter_shardings[key])
+                slabs[key] = arr
+            self._adapter_slabs = slabs
+        return self._adapter_slabs
+
+    def _adapter_load_impl(self, slabs, slot, rows):
+        """Scatter ONE tenant's (A, B, scale) rows into its slot —
+        slabs donated in place, rows host-built replicated arrays."""
+        return jax.tree.map(
+            lambda s, r: s.at[slot].set(r.astype(s.dtype)), slabs,
+            rows)
+
+    def register_adapter(self, tenant_id: int, weights, *,
+                         scale: float = 1.0) -> None:
+        """Register a tenant's LoRA weights with the pool (host copy;
+        the device load happens on demand at admission). `weights` is
+        the adapters.ADAPTER_SLABS dict at the MODEL's ff width and
+        any rank <= the pool rank (zero-padded — exact)."""
+        if self.adapters is None:
+            raise RuntimeError(
+                "engine has no adapter pool (set adapter_rank > 0)")
+        self.adapters.register(tenant_id, weights, scale=scale,
+                               ff_dim=self.ff_dim)
+
+    def adapter_resident(self, tenant_id: int) -> bool:
+        """Whether a tenant's adapter already holds a slab slot — the
+        router's adapter-affinity signal (routing to a resident
+        replica skips the load stall)."""
+        return self.adapters is not None \
+            and self.adapters.resident(tenant_id)
+
+    def _drain_adapter_loads(self) -> int:
+        """Run every pending tenant load through the jitted scatter —
+        the session calls this BEFORE each mixed dispatch, so a lane
+        never gathers a slab its tenant has not landed in. Returns
+        the number of loads dispatched (a planning-visible stall,
+        never a recompile)."""
+        if self.adapters is None:
+            return 0
+        pending = self.adapters.take_pending()
+        for slot, tenant in pending:
+            w, sc = self.adapters.host_weights(tenant)
+            rows = {k: jnp.asarray(v) for k, v in w.items()}
+            rows["scale"] = jnp.asarray(np.float32(sc))
+            self._adapter_slabs = self._call_counted(
+                "adapter", self._adapter_load_jit,
+                self._device_adapters(), jnp.int32(slot), rows)
+            if self.telemetry.enabled:
+                self.telemetry.instant(
+                    self._ENGINE_TRACK, "adapter_load",
+                    args={"tenant": tenant, "slot": slot})
+        return len(pending)
+
+    def _dispatch_mixed(self, kp, vp, *args, lane_adapters=None):
         """One mixed-step dispatch through the right jitted program for
         the pool format, threading (and re-capturing) the donated scale
         arrays on quantized pools. Returns (greedy, topv, topi, kp, vp);
         the page AND scale arrays are re-stashed on self each step so a
         mid-run audit (check_kv_scales from an `on_step` callback, when
         sequences are actually resident) reads THIS step's content, not
-        the pre-run allocation."""
+        the pre-run allocation. On an adapter-armed engine the lanes'
+        slot indices + the slabs ride along (read-only — the slabs are
+        NOT donated); unarmed engines pass None (an empty pytree, zero
+        trace cost, numerics untouched)."""
+        if self.adapters is not None:
+            la = lane_adapters if lane_adapters is not None \
+                else jnp.zeros((self.mixed_width,), jnp.int32)
+            args = args + (la, self._device_adapters())
+        else:
+            args = args + (None, None)
         if self.kv_quantized:
             greedy, topv, topi, kp, vp, ks, vs = self._call_counted(
                 "mixed", self._mixed_q_jit, self._step_params, kp, vp,
@@ -1324,6 +1584,17 @@ class ServeEngine:
             pts = jnp.zeros((c.max_seqs, c.pages_per_seq), jnp.int32)
             _, _, _, kp, vp = self._dispatch_mixed(
                 kp, vp, z, z, z, z, pts, z, jnp.ones((t,), jnp.int32))
+            if self.adapters is not None:
+                # compile the adapter-load scatter on an all-zero row
+                # set aimed at the base slot (zeros into zeros — a
+                # no-op on content), host-built f32 exactly like a
+                # real load (the registered host weights are f32) so
+                # the first tenant miss reuses this program
+                rows = {k: jnp.asarray(np.zeros(s[1:], np.float32))
+                        for k, s in self._adapter_slab_shapes().items()}
+                self._adapter_slabs = self._call_counted(
+                    "adapter", self._adapter_load_jit,
+                    self._device_adapters(), jnp.int32(0), rows)
         else:
             pt_row = jnp.zeros((c.pages_per_seq,), jnp.int32)
             for b in self.buckets:
@@ -1818,6 +2089,9 @@ class ServeEngine:
                 ("scheduler", (sched.debug_state if sched is not None
                                else lambda: None)),
                 ("kv_pool", self.cache.debug_state),
+                ("adapter_pool", lambda: (
+                    self.adapters.debug_state()
+                    if self.adapters is not None else None)),
                 ("faults", lambda: {
                     "fired": {s: dict(k) for s, k in
                               getattr(self.faults, "fired",
@@ -1925,12 +2199,16 @@ class ServeEngine:
         activations = float(self.mixed_width) * act_itemsize * (
             self.hidden + 3.0 * self.num_heads * self.head_dim / t
             + float(self._ff_pad) / t + float(self._vocab_pad) / t)
-        adapter = 0.0
+        # adapter slab pool (serve/adapters.py): the config-derived
+        # per-device bytes; 0.0 unarmed (the pre-adapter headroom line)
+        adapter = (float(self.adapter_cfg.pool_device_bytes)
+                   if self.adapter_cfg is not None else 0.0)
         total = params + kv_pool + activations + adapter
         pools_live = self._k_pages is not None
+        adapters_live = self._adapter_slabs is not None
         live = params + pytree_device_bytes(
             (self._k_pages, self._v_pages,
-             self._k_scales, self._v_scales))
+             self._k_scales, self._v_scales, self._adapter_slabs))
         arch = self.serve_arch()
         sim_input = float(serve_device_bytes(arch, t))
         ledger = {
@@ -1944,8 +2222,11 @@ class ServeEngine:
             # pools); pools allocate lazily on the first generate()
             "live_bytes": live,
             "pools_live": pools_live,
-            "ledger_vs_live": ((params + kv_pool) / live
-                               if pools_live and live > 0 else None),
+            "adapters_live": adapters_live,
+            "ledger_vs_live": (
+                (params + kv_pool
+                 + (adapter if adapters_live else 0.0)) / live
+                if pools_live and live > 0 else None),
             # the simulator's HBM-penalty input for this engine's arch
             # (steady-state context KV, not the allocated pool)
             "sim_hbm_input_bytes": sim_input,
@@ -1989,7 +2270,8 @@ class ServeEngine:
                  deadline_s=None, on_step=None, on_finish=None,
                  stream_ids: Optional[Sequence[int]] = None,
                  stream_offset: int = 0,
-                 trace_ids: Optional[Sequence[int]] = None
+                 trace_ids: Optional[Sequence[int]] = None,
+                 tenant_ids: Optional[Sequence[int]] = None
                  ) -> List[List[int]]:
         """Decode a ragged batch under continuous batching.
         `max_new_tokens` is an int or a per-prompt sequence; greedy by
@@ -2054,11 +2336,20 @@ class ServeEngine:
             raise ValueError(
                 f"trace_ids has {len(trace_ids)} entries for "
                 f"{len(prompts)} prompts")
+        if tenant_ids is not None and len(tenant_ids) != len(prompts):
+            raise ValueError(
+                f"tenant_ids has {len(tenant_ids)} entries for "
+                f"{len(prompts)} prompts")
+        if tenant_ids is not None and any(tenant_ids) \
+                and self.adapters is None:
+            raise ValueError(
+                "tenant_ids != 0 need an armed adapter pool "
+                "(adapter_rank > 0); this engine serves base-only")
         if self.chunked_prefill:
             return self._generate_session(
                 prompts, max_new_tokens, samples, eos_token,
                 deadline_s, stream_ids, stream_offset, on_step,
-                on_finish, trace_ids)
+                on_finish, trace_ids, tenant_ids)
         # ---- legacy bucket path: its own scheduler + orphan recovery
         # (the chunked path's ServeSession owns both)
         if cache.free_slots != c.max_seqs:
@@ -2175,6 +2466,7 @@ class ServeEngine:
         return {
             "requests": [
                 {"rid": r.rid, "trace_id": r.trace_id,
+                 "tenant": int(getattr(r, "tenant_id", 0)),
                  "prompt_tokens": len(r.prompt),
                  "new_tokens": len(r.out_tokens),
                  "preemptions": r.preemptions,
@@ -2254,6 +2546,15 @@ class ServeEngine:
                         max(1, self.attn_block_kv // c.page_size)
                     ).items()} if self.chunked_prefill else None,
             },
+            # multi-tenant adapter pool (None unarmed): slot geometry,
+            # residency, and the hit/evict/load/stall counters the
+            # tenant-labeled metrics fold reads (serve/adapters.py)
+            "adapter_pool": (
+                {**self.adapters.pool_report(),
+                 **{k: int(v) for k, v in self.adapters.stats.items()},
+                 "blocked_steps":
+                     sched.stats["adapter_blocked_steps"]}
+                if self.adapters is not None else None),
         }
 
     def start_session(self) -> "ServeSession":
@@ -2272,26 +2573,32 @@ class ServeEngine:
     def _generate_session(self, prompts, max_new_tokens, samples,
                           eos_token, deadline_s, stream_ids,
                           stream_offset, on_step, on_finish,
-                          trace_ids=None) -> List[List[int]]:
+                          trace_ids=None,
+                          tenant_ids=None) -> List[List[int]]:
         """generate()'s chunked path: one ServeSession, every prompt
         submitted up front, stepped to drain — behavior-identical to
         the pre-session inline loop (same sweep/plan/dispatch order,
         same stats, same failure containment)."""
         session = self.start_session()
         reqs = session.reqs
-        for i, (prompt, mnt, sp) in enumerate(
-                zip(prompts, max_new_tokens, samples)):
-            session.submit(
-                prompt, mnt, eos_token=eos_token, sample=sp,
-                deadline_s=(deadline_s[i] if deadline_s is not None
-                            else None),
-                stream_id=(stream_ids[i] if stream_ids is not None
-                           else None),
-                stream_offset=stream_offset, on_finish=on_finish,
-                trace_id=(trace_ids[i] if trace_ids is not None
-                          else None))
         tel = self.telemetry
         try:
+            # submits inside the containment: a submit-time rejection
+            # (e.g. an unregistered adapter tenant) must fail the
+            # batch AND close the session, not orphan it open
+            for i, (prompt, mnt, sp) in enumerate(
+                    zip(prompts, max_new_tokens, samples)):
+                session.submit(
+                    prompt, mnt, eos_token=eos_token, sample=sp,
+                    deadline_s=(deadline_s[i] if deadline_s is not None
+                                else None),
+                    stream_id=(stream_ids[i] if stream_ids is not None
+                               else None),
+                    stream_offset=stream_offset, on_finish=on_finish,
+                    trace_id=(trace_ids[i] if trace_ids is not None
+                              else None),
+                    tenant_id=(int(tenant_ids[i])
+                               if tenant_ids is not None else 0))
             while True:
                 ev = session.step()
                 if ev is None:
@@ -2508,7 +2815,8 @@ class ServeSession:
             spec_tokens=engine.spec_tokens, drafter=engine.drafter,
             faults=engine.faults,
             degrade_ladder=engine.degrade_ladder,
-            reject_stalls=engine.reject_stalls)
+            reject_stalls=engine.reject_stalls,
+            adapter_pool=engine.adapters)
         self.reqs: List[Request] = []
         self._on_finish: Dict[int, object] = {}
         self.decode_times: List[float] = []
@@ -2528,18 +2836,22 @@ class ServeSession:
                deadline_s: Optional[float] = None,
                stream_id: Optional[int] = None,
                stream_offset: int = 0, on_finish=None,
-               trace_id: Optional[int] = None) -> Request:
+               trace_id: Optional[int] = None,
+               tenant_id: int = 0) -> Request:
         """Queue one request (admission happens at the next step()).
         `sample` is a ready SampleParams (None = greedy); `stream_id`/
         `stream_offset` key its sampling stream (engine._pick_token);
         `trace_id` carries an upstream tier's trace context (router /
         disagg — None mints a fresh one); `on_finish(req)` fires when
-        THIS request completes, before its slot releases."""
+        THIS request completes, before its slot releases; `tenant_id`
+        selects the tenant's registered LoRA adapter (0 = the base
+        model — the only tenant an unarmed engine serves)."""
         r = self.sched.submit(prompt, int(max_new_tokens),
                               eos_token=eos_token, sample=sample,
                               stream_id=stream_id,
                               stream_offset=stream_offset,
-                              trace_id=trace_id)
+                              trace_id=trace_id,
+                              tenant_id=tenant_id)
         r.t_submit = time.perf_counter()
         if deadline_s is None and self.eng.default_deadline > 0:
             deadline_s = self.eng.default_deadline
@@ -2651,12 +2963,16 @@ class ServeSession:
         write_offs = np.zeros((t_w,), np.int32)
         lane_slots = np.zeros((t_w,), np.int32)
         lane_lens = np.ones((t_w,), np.int32)      # NaN-free padding
+        # inactive lanes gather adapter slot 0 (the zero base slab)
+        lane_adapters = np.zeros((t_w,), np.int32) \
+            if eng.adapters is not None else None
         lane = 0
         emitters: List[Tuple[ChunkPlan, int]] = []
         spec_emitters: List[Tuple[ChunkPlan, int]] = []
         for ch in plan.chunks:
             ctx = ch.req.context
             row = cache.page_tables[ch.req.slot]
+            aslot = int(getattr(ch.req, "adapter_slot", 0) or 0)
             for pos in range(ch.start, ch.end):
                 tokens[lane] = ctx[pos]
                 positions[lane] = pos
@@ -2664,6 +2980,8 @@ class ServeSession:
                 write_offs[lane] = pos % ps
                 lane_slots[lane] = ch.req.slot
                 lane_lens[lane] = pos + 1
+                if lane_adapters is not None:
+                    lane_adapters[lane] = aslot
                 lane += 1
             if ch.draft_tokens:
                 spec_emitters.append((ch, lane - 1))
@@ -2675,18 +2993,25 @@ class ServeSession:
                     write_offs[lane] = pos % ps
                     lane_slots[lane] = ch.req.slot
                     lane_lens[lane] = pos + 1
+                    if lane_adapters is not None:
+                        lane_adapters[lane] = aslot
                     lane += 1
             elif ch.emits:
                 emitters.append((ch, lane - 1))
         assert lane <= t_w, (
             f"scheduler packed {lane} lanes into a {t_w}-lane step")
+        # land any adapters this plan admitted BEFORE their lanes
+        # dispatch — the planning-visible load stall, not a recompile
+        eng._drain_adapter_loads()
         tp = time.perf_counter()
         greedy, topv, topi, _, _ = eng._dispatch_mixed(
             eng._k_pages, eng._v_pages,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(write_pages), jnp.asarray(write_offs),
             jnp.asarray(cache.page_tables), jnp.asarray(lane_slots),
-            jnp.asarray(lane_lens))
+            jnp.asarray(lane_lens),
+            lane_adapters=(None if lane_adapters is None
+                           else jnp.asarray(lane_adapters)))
         greedy = np.asarray(greedy)
         topv = np.asarray(topv)
         topi = np.asarray(topi)

@@ -482,7 +482,8 @@ class PagedKVCache:
     # through ServeEngine.export_kv/import_kv (this class never
     # touches device memory).
 
-    def export_pages(self, slot: int, tokens: Sequence[int]
+    def export_pages(self, slot: int, tokens: Sequence[int], *,
+                     prev: bytes = b""
                      ) -> Tuple[List[int], List[bytes], int]:
         """(pages, chain keys, covered tokens) for every FULL page of
         `slot`'s resident sequence — the transfer unit of a
@@ -492,7 +493,10 @@ class PagedKVCache:
         identity). The partial tail page is never exported: like
         prefix sharing, only whole pages have a content identity —
         the importer recomputes the tail (< page_size tokens), exactly
-        as a prefix-cache hit would."""
+        as a prefix-cache hit would. `prev` seeds the chain — the
+        tenant prefix salt (serve/adapters.tenant_prefix_salt): an
+        adapted tenant's pages carry tenant-disjoint keys, so a
+        handoff can never alias one tenant's K/V to another's."""
         ps = self.cfg.page_size
         full = int(self.seq_lens[slot]) // ps
         if full * ps > len(tokens):
@@ -504,7 +508,7 @@ class PagedKVCache:
             raise RuntimeError(
                 f"slot {slot} table is not a mapped prefix over its "
                 f"resident length")
-        keys = prefix_page_keys(tokens, ps, full)
+        keys = prefix_page_keys(tokens, ps, full, prev=prev)
         self.stats["exported_pages"] += len(pages)
         return pages, keys, full * ps
 

@@ -59,6 +59,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..utils.telemetry import (MetricsRegistry, Telemetry, pct,
                                pow2_bucket, serve_metrics,
                                telemetry_for)
+from .adapters import tenant_prefix_salt
 from .engine import ServeEngine, ServeSession, StepEvents
 from .kv_cache import prefix_page_keys
 from .scheduler import Request, RequestOutcome
@@ -306,6 +307,11 @@ class ReplicaPool:
         self.spill_occupancy = float(spill_occupancy)
         self.window_s = float(window_s)
         self._engine_kwargs = dict(engine_kwargs or {})
+        # pool-wide adapter registry (tenant -> (weights, scale)):
+        # replayed onto every replica — including engines the
+        # autoscaler builds later — so any replica can serve any
+        # registered tenant (serve/adapters.py)
+        self._adapter_registry: Dict[int, tuple] = {}
         self.replicas: List[Replica] = []
         self._pins: List[Dict[bytes, int]] = []
         self._rr_next = 0
@@ -317,7 +323,8 @@ class ReplicaPool:
         self._w_done: deque = deque()    # (t_finish, tpot, tokens)
         self._next_eval = 0.0
         self.scale_events: List[dict] = []
-        self.stats = {"routed": 0, "affinity_hits": 0, "spills": 0,
+        self.stats = {"routed": 0, "affinity_hits": 0,
+                      "adapter_affinity_hits": 0, "spills": 0,
                       "fallbacks": 0, "cancels_sent": 0,
                       "scale_ups": 0, "scale_downs": 0}
         self.last_stats: Optional[dict] = None
@@ -359,6 +366,8 @@ class ReplicaPool:
                 r.clock_s = max(r.clock_s, t_now)
                 return r
         eng = self._new_engine()
+        for t, (w, sc) in sorted(self._adapter_registry.items()):
+            eng.register_adapter(t, w, scale=sc)
         eng.set_track_process(f"replica{len(self.replicas)}")
         eng.warmup()
         r = Replica(len(self.replicas), eng)
@@ -366,6 +375,17 @@ class ReplicaPool:
         self.replicas.append(r)
         self._pins.append({})
         return r
+
+    def register_adapter(self, tenant_id: int, weights, *,
+                         scale: float = 1.0) -> None:
+        """Register a tenant's LoRA adapter on EVERY replica (and on
+        replicas the autoscaler activates later): the router may land
+        the tenant anywhere, so the registry must be pool-uniform —
+        residency (which replica holds the tenant's slab SLOT) is what
+        adapter-affinity routing differentiates, not registration."""
+        self._adapter_registry[int(tenant_id)] = (weights, float(scale))
+        for r in self.replicas:
+            r.engine.register_adapter(tenant_id, weights, scale=scale)
 
     def routable(self) -> List[Replica]:
         return [r for r in self.replicas if r.routable()]
@@ -423,10 +443,23 @@ class ReplicaPool:
             raise RuntimeError("no routable replicas")
         ps = live[0].engine.cache_cfg.page_size
         npages = max(0, (len(prompt) - 1) // ps)
-        keys = prefix_page_keys(prompt, ps, npages) if npages else []
-        info = {"tenant": int(tenant), "matched_tokens": 0,
-                "affinity_hit": False, "fallback": False,
-                "spilled": False, "keys": keys}
+        # a tenant is an ADAPTER tenant only if the pool registered
+        # one; otherwise the id is a pure routing-affinity key and the
+        # lane serves the base model (PR 14 semantics, tenant_id=0)
+        adapted = int(tenant) != 0 and int(tenant) in \
+            self._adapter_registry
+        # the probe keys carry the tenant's prefix salt — an adapted
+        # tenant's pages hash on a disjoint chain (adapters.
+        # tenant_prefix_salt), so the router's registry probe matches
+        # exactly the pages admission would attach
+        keys = prefix_page_keys(
+            prompt, ps, npages,
+            prev=tenant_prefix_salt(tenant) if adapted else b"") \
+            if npages else []
+        info = {"tenant": int(tenant), "adapted": adapted,
+                "matched_tokens": 0,
+                "affinity_hit": False, "adapter_affinity": False,
+                "fallback": False, "spilled": False, "keys": keys}
         if self.policy == "round_robin":
             target = live[self._rr_next % len(live)]
             self._rr_next += 1
@@ -446,8 +479,23 @@ class ReplicaPool:
             info["affinity_hit"] = True
             info["matched_tokens"] = best_pages * ps
         else:
-            target = live[_tenant_hash(tenant) % len(live)]
-            info["fallback"] = True
+            # adapter affinity, the tier between prefix affinity and
+            # the blind hash: with no page match, a replica where the
+            # tenant's adapter is already RESIDENT (slab loaded —
+            # mapped or LRU-parked) skips the admission load stall.
+            # Ties to the least-loaded such replica; the plain
+            # tenant-sticky hash only when no replica holds it.
+            resident = [r for r in live
+                        if adapted
+                        and r.engine.adapter_resident(tenant)]
+            if resident:
+                target = min(resident, key=lambda x: (x.occupancy(),
+                                                      x.queue_depth(),
+                                                      x.idx))
+                info["adapter_affinity"] = True
+            else:
+                target = live[_tenant_hash(tenant) % len(live)]
+                info["fallback"] = True
         if len(live) > 1 and (target.rung() >= self.spill_rung
                               or target.occupancy()
                               >= self.spill_occupancy):
@@ -511,7 +559,8 @@ class ReplicaPool:
             replica.clock_s = max(replica.clock_s, tr.t_arrival)
         req = replica.session.submit(
             tr.prompt, tr.max_new, eos_token=eos_token, sample=sample,
-            stream_id=tr.stream_id, trace_id=trace_id)
+            stream_id=tr.stream_id, trace_id=trace_id,
+            tenant_id=tr.tenant if info["adapted"] else 0)
         tracked = {
             "stream_id": tr.stream_id, "tenant": tr.tenant,
             "replica": replica.idx, "req": req,
@@ -521,6 +570,7 @@ class ReplicaPool:
             "cancel_after": tr.cancel_after_tokens,
             "cancel_sent": False, "sampled": tr.sampled,
             "affinity_hit": info["affinity_hit"],
+            "adapter_affinity": info["adapter_affinity"],
             "spilled": info["spilled"], "fallback": info["fallback"],
             "matched_tokens": info["matched_tokens"],
             "keys": info["keys"], "pins_released": False,
@@ -535,6 +585,9 @@ class ReplicaPool:
         if info["affinity_hit"]:
             self.stats["affinity_hits"] += 1
             m.inc("router_affinity_hits_total")
+        if info["adapter_affinity"]:
+            self.stats["adapter_affinity_hits"] += 1
+            m.inc("router_adapter_affinity_hits_total")
         if info["fallback"]:
             self.stats["fallbacks"] += 1
             m.inc("router_fallback_total")
@@ -635,6 +688,7 @@ class ReplicaPool:
             "ttft_s": ttft, "tpot_s": tpot, "t_finish": t_end,
             "slo_ok": slo_ok, "sampled": tracked["sampled"],
             "affinity_hit": tracked["affinity_hit"],
+            "adapter_affinity": tracked["adapter_affinity"],
             "spilled": tracked["spilled"],
             "fallback": tracked["fallback"],
             "matched_tokens": tracked["matched_tokens"],

@@ -49,6 +49,7 @@ from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence
 
 from ..utils.faults import FaultInjector
+from .adapters import AdapterPool, tenant_prefix_salt
 from .kv_cache import PagedKVCache, prefix_page_keys
 from .speculative import DraftControl, Drafter, PromptLookupDrafter
 
@@ -114,6 +115,13 @@ class Request:
     # pre-stream behavior, bit-identical).
     stream_id: Optional[int] = None
     stream_offset: int = 0
+    # multi-tenant adapter serving (serve/adapters.py): the tenant
+    # whose LoRA adapter this request decodes under (0 = the base
+    # model, no adapter). adapter_slot is the pool slot the request
+    # holds from admission to finish/abort/preempt (None while
+    # waiting or for tenant 0) — the lane's slab gather index.
+    tenant_id: int = 0
+    adapter_slot: Optional[int] = None
     # trace-context propagation (docs/observability.md): the
     # process-unique trace id every telemetry span of this request
     # carries. Minted at the FIRST tier that sees the request (router
@@ -251,8 +259,14 @@ class ContinuousBatchingScheduler:
                  drafter: Optional[Drafter] = None,
                  faults: Optional[FaultInjector] = None,
                  degrade_ladder: bool = True,
-                 reject_stalls: int = 0):
+                 reject_stalls: int = 0,
+                 adapter_pool: Optional[AdapterPool] = None):
         self.cache = cache
+        # multi-tenant LoRA pool (serve/adapters.py): admission
+        # acquires the tenant's slot (possibly queueing a device load)
+        # and finish/abort/preempt release it — the same lifecycle as
+        # KV pages. None = single-tenant serving (tenant 0 only).
+        self.adapters = adapter_pool
         self.faults = faults if faults is not None else FaultInjector()
         self.degrade_ladder = bool(degrade_ladder)
         self.reject_stalls = int(reject_stalls)
@@ -280,6 +294,9 @@ class ContinuousBatchingScheduler:
                       # robustness counters (serve_report)
                       "cancelled": 0, "deadline_expired": 0,
                       "rejected": 0, "failed": 0, "spec_shed_steps": 0,
+                      # adapter-pool admission stalls (head-of-line
+                      # blocks because every usable slot was mapped)
+                      "adapter_blocked_steps": 0,
                       "degradation_rung_max": 0,
                       "rung_steps": [0, 0, 0, 0, 0]}
         self.rejected_requests: List[RejectedRequest] = []
@@ -290,9 +307,26 @@ class ContinuousBatchingScheduler:
                sample: Optional[SampleParams] = None,
                stream_id: Optional[int] = None,
                stream_offset: int = 0,
-               trace_id: Optional[int] = None) -> Request:
+               trace_id: Optional[int] = None,
+               tenant_id: int = 0) -> Request:
         if len(prompt) < 1:
             raise ValueError("empty prompt")
+        tenant_id = int(tenant_id)
+        if tenant_id < 0:
+            raise ValueError(f"tenant_id must be >= 0, got {tenant_id}")
+        if tenant_id != 0:
+            # fail fast at submit, not at admission: an unarmed engine
+            # or an unregistered tenant can never be served, and
+            # admission-time failure would poison the queue head
+            if self.adapters is None:
+                raise ValueError(
+                    f"tenant {tenant_id} needs an adapter pool "
+                    f"(--adapter-rank > 0), but this engine serves "
+                    f"the base model only")
+            if tenant_id not in self.adapters.registered():
+                raise ValueError(
+                    f"tenant {tenant_id} has no registered adapter "
+                    f"(engine.register_adapter first)")
         if int(max_new_tokens) < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1 (got {max_new_tokens}): "
@@ -317,7 +351,8 @@ class ContinuousBatchingScheduler:
                       # passes the id it minted; a plain engine mints
                       # here — either way every span carries ONE id
                       trace_id=(next_trace_id() if trace_id is None
-                                else int(trace_id)))
+                                else int(trace_id)),
+                      tenant_id=tenant_id)
         # speculation needs a deterministic per-lane pick to verify
         # against: greedy, or top_k=1 sampling (the already-drawn sample
         # is always the top-1 logit). Other sampling decodes with k=0.
@@ -337,12 +372,18 @@ class ContinuousBatchingScheduler:
         extended INCREMENTALLY from the last cached key (hashing is
         O(pages) per sequence, not O(pages^2) across chunk steps) and
         kept across preemptions (the context tokens a key commits to
-        never change)."""
+        never change). The chain is SEEDED with the tenant's prefix
+        salt: an adapted lane's K/V is a function of its adapter, so
+        equal tokens under different tenants must hash to disjoint
+        keys — tenant 0 keeps the unsalted chain (adapters.
+        tenant_prefix_salt)."""
         keys = req._page_keys
         if len(keys) < npages:
             keys.extend(prefix_page_keys(
                 req.context, self.cache.cfg.page_size, npages,
-                start=len(keys), prev=keys[-1] if keys else b""))
+                start=len(keys),
+                prev=(keys[-1] if keys
+                      else tenant_prefix_salt(req.tenant_id))))
         return keys[:npages]
 
     # ---------------- the policy --------------------------------------
@@ -532,6 +573,23 @@ class ContinuousBatchingScheduler:
                 else:
                     req.stalled = 0
                 break
+            # adapter admission gate (serve/adapters.py): attach the
+            # tenant's pool slot — possibly queueing a device load the
+            # session drains before dispatch — BEFORE the request
+            # leaves the queue. None means every usable slot is mapped
+            # by OTHER running tenants: head-of-line block, exactly
+            # like KV page exhaustion (a release at finish/abort/
+            # preempt unblocks a later schedule()). The stall is
+            # planning-visible, never a recompile. Cannot deadlock:
+            # with nothing running no slot holds refs, so the forced-
+            # progress head always acquires.
+            if self.adapters is not None and req.tenant_id != 0 \
+                    and req.adapter_slot is None:
+                aslot = self.adapters.acquire(req.tenant_id)
+                if aslot is None:
+                    self.stats["adapter_blocked_steps"] += 1
+                    break
+                req.adapter_slot = aslot
             req.stalled = 0
             self.waiting.popleft()
             slot = cache.alloc_slot()
@@ -568,6 +626,7 @@ class ContinuousBatchingScheduler:
         raising out of the whole batch."""
         assert self.waiting and self.waiting[0] is req
         self.waiting.popleft()
+        self._release_adapter(req)
         req.state = RequestState.FINISHED
         req.outcome = RequestOutcome.REJECTED
         self.stats["rejected"] += 1
@@ -592,11 +651,22 @@ class ContinuousBatchingScheduler:
                 return False
         else:
             return False
+        self._release_adapter(req)
         req.state = RequestState.FINISHED
         req.outcome = outcome
         if outcome in self.stats:
             self.stats[outcome] += 1
         return True
+
+    def _release_adapter(self, req: Request) -> None:
+        """Drop the request's adapter-pool reference (no-op for the
+        base tenant / a never-admitted request). The slot parks in the
+        pool's LRU at refcount 0 — still loaded, so re-admission of
+        the same tenant (including a preempted request's own return)
+        re-attaches without a device load."""
+        if req.adapter_slot is not None and self.adapters is not None:
+            self.adapters.release(req.tenant_id)
+        req.adapter_slot = None
 
     def _preempt(self, victim: Request) -> None:
         """Evict a running request back to the FRONT of the waiting
@@ -606,6 +676,7 @@ class ContinuousBatchingScheduler:
         history from the prefix cache instead of recomputing it."""
         del self.running[victim.slot]
         self.cache.free_slot(victim.slot)
+        self._release_adapter(victim)
         victim.slot = -1
         victim.state = RequestState.WAITING
         victim.num_computed = 0
@@ -671,6 +742,8 @@ class ContinuousBatchingScheduler:
         def row(r: Request) -> dict:
             return {"rid": r.rid, "trace": r.trace_id,
                     "state": r.state.value, "slot": r.slot,
+                    "tenant": r.tenant_id,
+                    "adapter_slot": r.adapter_slot,
                     "prompt_tokens": len(r.prompt),
                     "out_tokens": len(r.out_tokens),
                     "num_computed": r.num_computed,
@@ -701,4 +774,5 @@ class ContinuousBatchingScheduler:
         req.outcome = RequestOutcome.COMPLETED
         del self.running[req.slot]
         self.cache.free_slot(req.slot)
+        self._release_adapter(req)
         req.slot = -1

@@ -85,6 +85,11 @@ class TrafficSpec:
     burst_factor: float = 4.0         # in-burst rate multiplier
     burst_len: int = 8                # mean requests per burst window
     # ---- tenants / prefix mix ----
+    # Tenant ids double as adapter names when the engine arms a LoRA
+    # pool (serve/adapters.py): tenant 0 is the base model, tenants
+    # 1..N-1 must each have a registered adapter before traffic for
+    # them is submitted.  The Zipf head (tenant 0) therefore exercises
+    # the base path while the tail exercises pool churn.
     tenants: int = 4
     tenant_zipf: float = 1.1          # Zipf skew over tenant draw
     prefix_tokens: int = 48           # shared per-tenant prefix length

@@ -216,6 +216,24 @@ def serve_report(stats: dict) -> str:
                 f"{pool.get('attn_block_kv', 0)} tokens, "
                 f"{dp['v2']} grid steps vs {dp['v1']} at v1 per-page "
                 f"dispatch ({red:.1f}x fewer)")
+    # adapter pool: multi-tenant LoRA slab residency + churn counters
+    # (serve/adapters.pool_report); None / absent when unarmed
+    ad = stats.get("adapter_pool")
+    if ad:
+        lines.append(
+            f"adapter pool: rank {ad.get('rank', 0)}, "
+            f"{ad.get('usable_slots', 0)} slots x "
+            f"{ad.get('bytes_per_slot', 0) / 2**20:.2f} MiB "
+            f"({ad.get('pool_bytes', 0) / 2**20:.2f} MiB), "
+            f"{ad.get('resident_tenants', 0)}/"
+            f"{ad.get('registered_tenants', 0)} tenants resident, "
+            f"occupancy {ad.get('occupancy', 0.0):.1%}")
+        lines.append(
+            f"adapter churn: {ad.get('hits', 0)} hits / "
+            f"{ad.get('misses', 0)} misses, {ad.get('loads', 0)} "
+            f"loads, {ad.get('evictions', 0)} evictions, "
+            f"{ad.get('blocked_admissions', 0)} blocked admissions "
+            f"({ad.get('blocked_steps', 0)} stalled steps)")
     # tensor-parallel sharding block (ServeEngine._sharding_stats;
     # None / absent on single-device engines)
     sh = stats.get("sharding")
@@ -325,6 +343,7 @@ def router_report(stats: dict, metrics=None) -> str:
     lines.append(
         f"routing: {r.get('affinity_hits', 0)} affinity hits / "
         f"{r.get('routed', 0)} routed, "
+        f"{r.get('adapter_affinity_hits', 0)} adapter-affinity, "
         f"{r.get('fallbacks', 0)} tenant-sticky fallbacks, "
         f"{r.get('spills', 0)} load spills, "
         f"{r.get('cancels_sent', 0)} cancels")

@@ -925,7 +925,8 @@ def telemetry_for(config=None) -> Telemetry:
 def serve_metrics(stats: dict,
                   registry: Optional[MetricsRegistry] = None,
                   role: Optional[str] = None,
-                  replica: Optional[str] = None) -> MetricsRegistry:
+                  replica: Optional[str] = None,
+                  tenant: Optional[str] = None) -> MetricsRegistry:
     """Fold one ServeEngine.last_stats dict into a MetricsRegistry:
     counters for tokens/requests/robustness events, gauges for
     rates/occupancy, histograms for TTFT / TPOT (per-token decode
@@ -945,13 +946,19 @@ def serve_metrics(stats: dict,
     same no-double-counting fold for both label axes, which is what
     lets the autoscaler and disagg_report/router_report read
     per-engine latency from ONE registry instead of scraping engines
-    individually (docs/observability.md)."""
+    individually (docs/observability.md). ``tenant`` is the third
+    label axis (multi-tenant adapter serving, serve/adapters.py):
+    fold a tenant-filtered stats dict under ``{tenant=...}`` to split
+    latency and token counters per adapter tenant without touching
+    the unlabeled aggregates."""
     m = registry if registry is not None else MetricsRegistry()
     lab = {}
     if role is not None:
         lab["role"] = str(role)
     if replica is not None:
         lab["replica"] = str(replica)
+    if tenant is not None:
+        lab["tenant"] = str(tenant)
     if lab:
         for r in stats.get("requests", []):
             m.inc("serve_requests_total",
@@ -1024,6 +1031,20 @@ def serve_metrics(stats: dict,
     for k, v in (stats.get("cache") or {}).items():
         if isinstance(v, (int, float)):
             m.counter_set(f"serve_prefix_cache_{k}_total", v)
+    # adapter-pool counters/gauges (multi-tenant LoRA serving,
+    # serve/adapters.py) — block absent when the pool is unarmed
+    ad = stats.get("adapter_pool") or {}
+    for k in ("hits", "misses", "loads", "evictions", "releases",
+              "blocked_admissions", "blocked_steps"):
+        if k in ad:
+            m.counter_set(f"serve_adapter_{k}_total", ad[k])
+    if ad:
+        m.set("serve_adapter_pool_occupancy",
+              float(ad.get("occupancy", 0.0)))
+        m.set("serve_adapter_resident_tenants",
+              ad.get("resident_tenants", 0))
+        m.set("serve_adapter_registered_tenants",
+              ad.get("registered_tenants", 0))
     return m
 
 

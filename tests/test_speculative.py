@@ -402,7 +402,7 @@ def test_spec_zero_recompiles_after_warmup(spec_engine):
     shapes, no new programs, on any workload in this suite."""
     counts = spec_engine.compile_counts()
     assert counts == {"prefill": 0, "decode": 0, "mixed": 1,
-                      "export": 0, "import": 0}
+                      "export": 0, "import": 0, "adapter": 0}
 
 
 # ------------------------------------------------- compile-event counter

@@ -172,6 +172,18 @@ echo "--- 1o. SLO burn-rate + flight-recorder smoke (request-observability gate)
 python tools/slo_report.py --smoke || fail=1
 env JAX_PLATFORMS=cpu python tools/postmortem.py --smoke || fail=1
 
+echo "--- 1p. multi-tenant LoRA smoke (batched-pool goodput + exactness gate)"
+# batched multi-tenant adapter serving vs a sequential per-tenant
+# weight-swap server on a Zipf tenant mix: fails unless the batched
+# pool's goodput (mixed steps for the same token set) is >= 1.5x the
+# swap server's, every stream is token-identical to its tenant's
+# merged-weight reference, and nothing compiles after warmup on
+# either arm — adapter loads are dispatches of the one scatter
+# program, never recompiles (tools/serve_bench.py --workload lora,
+# docs/serving.md "Multi-tenant adapters")
+env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload lora \
+    -o /tmp/ci_bench_serve_lora.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \

@@ -177,11 +177,18 @@ def serve_line() -> str:
              "{v:.1f}x disaggregated TPOT p99"),
             ("serve_router_goodput_gain",
              "{v:.1f}x routed goodput-under-SLO vs round-robin"),
+            ("serve_lora_goodput_gain",
+             "{v:.1f}x batched-LoRA goodput vs weight swap"),
         )
         for key, fmt in pieces:
             r = recs.get(key)
             if r is not None:
                 parts.append(fmt.format(v=float(r["value"])))
+        lora = recs.get("serve_lora_goodput_gain")
+        if lora is not None:
+            tenants = lora.get("extra", {}).get("tenants")
+            if tenants:
+                parts[-1] += f" ({int(tenants)} tenants)"
         # SLO attainment from the EXPORTED pool registry gauge the
         # router workload recorded (serve_pool_slo_attainment — not an
         # ad-hoc stat string), and the worst simulator drift ratio

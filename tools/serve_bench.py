@@ -116,7 +116,7 @@ def main() -> int:
                     ">= 1.5x decode step reduction (spec)")
     ap.add_argument("--workload",
                     choices=("all", "base", "spec", "kv", "shard",
-                             "telemetry", "disagg", "router"),
+                             "telemetry", "disagg", "router", "lora"),
                     default="all",
                     help="base = random + shared-prefix (ci.sh 1d), "
                     "spec = repetitive speculative decode (ci.sh 1f), "
@@ -137,7 +137,12 @@ def main() -> int:
                     "goodput-under-SLO + token exactness vs a single "
                     "replica + zero recompiles per replica + full "
                     "page reclamation, plus autoscaler determinism "
-                    "(ci.sh 1n)")
+                    "(ci.sh 1n), "
+                    "lora = batched multi-tenant LoRA pool vs a "
+                    "sequential per-tenant weight-swap server on a "
+                    "Zipf tenant mix, gating >= 1.5x goodput (mixed "
+                    "steps) + token exactness vs the merged-weight "
+                    "references + zero recompiles (ci.sh 1p)")
     ap.add_argument("--trace-out", default="",
                     help="write the telemetry workload's Chrome "
                     "trace-event JSON here (Perfetto-loadable; default "
@@ -1429,6 +1434,153 @@ def main() -> int:
                 "drift_ratio_by_regime": {
                     reg: round(d["ratio"], 2)
                     for reg, d in drift["serve"].items()},
+            },
+        })
+
+    # ---- workload: batched LoRA pool vs sequential weight swap ------
+    if args.workload in ("all", "lora"):
+        from flexflow_tpu.serve.adapters import (
+            make_tenant_adapters, merge_adapter_params)
+        TENANTS = 4                       # adapters; tenant 0 = base
+        lora_rank = 4
+        lora_reqs = max(args.requests, 12)
+        lora_new = args.max_new
+        head_dim = args.hidden // args.heads
+
+        def lora_cfg(rank):
+            return FFConfig(
+                batch_size=1, kv_page_size=args.page_size,
+                kv_num_pages=1 + pages_per_seq * args.max_seqs,
+                serve_max_seqs=args.max_seqs,
+                serve_prefill_budget=max(args.page_size,
+                                         args.max_seq_len // 2),
+                adapter_rank=rank)
+
+        def lora_engine(rank):
+            m = build_transformer_lm(
+                lora_cfg(rank), vocab_size=args.vocab,
+                max_seq_len=args.max_seq_len, hidden=args.hidden,
+                num_heads=args.heads, num_layers=args.layers,
+                ff_dim=4 * args.hidden)
+            # speculation off in both arms: the A/B measures tenant
+            # batching, and drafts would skew the step counts
+            return ServeEngine(m, spec_tokens=0)
+
+        adapters = make_tenant_adapters(
+            num_layers=args.layers, hidden=args.hidden,
+            num_heads=args.heads, head_dim=head_dim,
+            ff_dim=4 * args.hidden, rank=lora_rank, tenants=TENANTS,
+            seed=args.seed + 5)
+        # Zipf-skewed tenant mix over 0..TENANTS (0 = base lanes), the
+        # traffic-harness shape (serve/traffic.py): a few tenants
+        # dominate, the tail churns the pool
+        w = np.array([1.0 / (t + 1) ** 1.1 for t in range(TENANTS + 1)])
+        w /= w.sum()
+        tenant_mix = [int(rng.choice(TENANTS + 1, p=w))
+                      for _ in range(lora_reqs)]
+        if len(set(tenant_mix) - {0}) < 3:   # the gate needs >= 3
+            tenant_mix[:3] = [1, 2, 3]       # adapters in one batch
+        prompt_cap = max(9, (args.max_seq_len - lora_new) // 2)
+        lora_prompts = [list(rng.randint(
+            1, args.vocab, size=rng.randint(8, prompt_cap)))
+            for _ in range(lora_reqs)]
+
+        # arm A: ONE engine, every tenant batched through the adapter
+        # pool in the one mixed program
+        eng_a = lora_engine(lora_rank)
+        counts_a = eng_a.warmup()
+        for t, (wts, sc) in adapters.items():
+            eng_a.register_adapter(t, wts, scale=sc)
+        t0 = time.perf_counter()
+        out_a = eng_a.generate(lora_prompts, lora_new,
+                               tenant_ids=tenant_mix)
+        wall_a = time.perf_counter() - t0
+        st_a = eng_a.last_stats
+        print(serve_report(st_a), file=sys.stderr)
+        assert eng_a.compile_counts() == counts_a, (
+            f"lora batched arm recompiled: "
+            f"{counts_a} -> {eng_a.compile_counts()}")
+        eng_a.cache.check_invariants()
+        eng_a.adapters.check_invariants()
+
+        # arm B: a weight-swap server — serve tenants SEQUENTIALLY,
+        # merging each tenant's delta into the weights (same shapes,
+        # so the swap itself never recompiles) and flushing the
+        # prefix cache between tenants (unsalted tenant-0 chains
+        # would otherwise serve one tenant another's pages)
+        eng_b = lora_engine(0)
+        counts_b = eng_b.warmup()
+        base_params = eng_b.params
+        merged = {0: base_params}
+        for t, (wts, sc) in adapters.items():
+            merged[t] = merge_adapter_params(base_params, wts, sc)
+        out_b = [None] * lora_reqs
+        steps_b = 0
+        wall_b = 0.0
+        for t in sorted(set(tenant_mix)):
+            idxs = [i for i, ti in enumerate(tenant_mix) if ti == t]
+            eng_b.params = eng_b._step_params = merged[t]
+            eng_b.cache.clear_prefix()
+            t0 = time.perf_counter()
+            group = eng_b.generate([lora_prompts[i] for i in idxs],
+                                   lora_new)
+            wall_b += time.perf_counter() - t0
+            steps_b += eng_b.last_stats["steps"]
+            for i, o in zip(idxs, group):
+                out_b[i] = o
+        eng_b.params = eng_b._step_params = base_params
+        assert eng_b.compile_counts() == counts_b, (
+            f"lora swap arm recompiled: "
+            f"{counts_b} -> {eng_b.compile_counts()}")
+
+        # exactness: both arms equal the per-tenant merged-weight
+        # references (the swap arm IS the merged server, so arm A ==
+        # arm B is the tenant-isolation gate)
+        assert out_a == out_b, (
+            "batched adapter serving diverged from the weight-swap "
+            "server")
+        for i in (0, 1, 2, lora_reqs - 1):
+            eng_b.params = merged[tenant_mix[i]]
+            ref = eng_b.generate_reference([lora_prompts[i]],
+                                           [lora_new])[0]
+            assert out_a[i] == ref, (
+                f"request {i} (tenant {tenant_mix[i]}) diverged from "
+                f"its merged-weight reference")
+        eng_b.params = base_params
+
+        steps_a = st_a["steps"]
+        gain = steps_b / max(steps_a, 1)
+        wall_gain = wall_b / max(wall_a, 1e-9)
+        if gain < 1.5:
+            msg = (f"lora goodput gain {gain:.2f}x < 1.5x "
+                   f"(batched {steps_a} steps vs swap {steps_b})")
+            assert not args.smoke, msg
+            print(f"WARNING: {msg}", file=sys.stderr)
+        gates.append(f"lora_goodput={gain:.2f}x>=1.5x exact "
+                     f"0 recompiles")
+
+        pool = st_a["adapter_pool"]
+        records.append({
+            "metric": "serve_lora_goodput_gain",
+            "value": round(gain, 2),
+            "unit": "x",
+            "extra": {
+                "platform": jax.default_backend(),
+                "requests": lora_reqs,
+                "max_new_tokens": lora_new,
+                "tenants": TENANTS,
+                "adapter_rank": lora_rank,
+                "adapter_slots": pool["usable_slots"],
+                "steps_batched": steps_a,
+                "steps_swap": steps_b,
+                "wall_gain": round(wall_gain, 2),
+                "wall_ms_batched": round(wall_a * 1e3, 1),
+                "wall_ms_swap": round(wall_b * 1e3, 1),
+                "adapter_loads": pool["loads"],
+                "adapter_hits": pool["hits"],
+                "adapter_evictions": pool["evictions"],
+                "outputs_identical": True,
+                "compile_counts": eng_a.compile_counts(),
             },
         })
 
