@@ -487,6 +487,21 @@ class FFConfig:
     slo_tpot_ms: float = 0.0
     serve_autoscale: bool = False
     serve_autoscale_max: int = 0
+    # wall-clock serving fabric (docs/serving.md "Wall-clock mode"):
+    # serve_wall_clock switches ReplicaPool.run to real time — each
+    # replica steps on its own worker thread, arrivals pace on the
+    # wall clock, and goodput-under-SLO is a measured wall number
+    # (tokens stay identical to the virtual-clock run at one seed;
+    # the autoscaler stays virtual-only). --wall-clock.
+    # serve_transport moves disagg PageShipments across a
+    # length-prefixed socket ("tcp"; "" = in-process handoff) with
+    # the receiver enforcing the SAME serve_admit_watermark
+    # backpressure; host/port pick the loopback receiver's bind
+    # (port 0 = ephemeral). --transport / --transport-port.
+    serve_wall_clock: bool = False
+    serve_transport: str = ""
+    serve_transport_host: str = "127.0.0.1"
+    serve_transport_port: int = 0
     # multi-tenant LoRA adapter serving (serve/adapters.py,
     # docs/serving.md "Multi-tenant adapters"): adapter_rank > 0 arms
     # the HBM-resident adapter pool — fixed rank-padded (A, B) slab
@@ -667,6 +682,18 @@ class FFConfig:
             raise ValueError(
                 f"serve_autoscale_max must be >= 0 (0 = 2x "
                 f"serve_replicas), got {self.serve_autoscale_max}")
+        if str(self.serve_transport or "").strip() not in ("", "tcp"):
+            raise ValueError(
+                f"serve_transport must be '' (in-process) or 'tcp', "
+                f"got {self.serve_transport!r}")
+        if not 0 <= int(self.serve_transport_port) <= 65535:
+            raise ValueError(
+                f"serve_transport_port must be 0..65535 (0 = "
+                f"ephemeral), got {self.serve_transport_port}")
+        if self.serve_wall_clock and self.serve_autoscale:
+            raise ValueError(
+                "--wall-clock and --autoscale are mutually exclusive: "
+                "the autoscaler replays on the virtual clock only")
         sm = str(self.serve_mesh or "").strip()
         if sm and sm != "auto":
             try:
@@ -778,6 +805,9 @@ class FFConfig:
         "--slo-ttft-ms": ("slo_ttft_ms", float),
         "--slo-tpot-ms": ("slo_tpot_ms", float),
         "--autoscale-max": ("serve_autoscale_max", int),
+        "--transport": ("serve_transport", str),
+        "--transport-host": ("serve_transport_host", str),
+        "--transport-port": ("serve_transport_port", int),
         "--trace-out": ("trace_out", str),
         "--trace-dir": ("trace_dir", str),
         "--telemetry-buffer": ("telemetry_buffer_events", int),
@@ -809,6 +839,7 @@ class FFConfig:
         "--telemetry": "telemetry",
         "--serve-disagg": "serve_disagg",
         "--autoscale": "serve_autoscale",
+        "--wall-clock": "serve_wall_clock",
     }
     _NEG_BOOL_FLAGS = {
         "--no-overlap-sync": "search_overlap_backward_sync",

@@ -25,9 +25,14 @@ from .scheduler import (ChunkPlan, ContinuousBatchingScheduler,
                         RequestState, SampleParams, StepPlan)
 from .speculative import DraftControl, Drafter, PromptLookupDrafter
 from .engine import ServeEngine, ServeSession, StepEvents
-from .disagg import DisaggCluster, PageShipment, engine_for
+from .disagg import (DisaggCluster, PageShipment, engine_for,
+                     normalize_on_step)
 from .router import Autoscaler, Replica, ReplicaPool
-from .traffic import TrafficRequest, TrafficSpec, make_traffic
+from .traffic import (TrafficRequest, TrafficSpec, make_traffic,
+                      rescale_arrivals)
+from .transport import (ShipmentReceiver, ShipmentSender,
+                        ShipmentWireError, dumps_shipment,
+                        loads_shipment)
 
 __all__ = [
     "Autoscaler",
@@ -38,9 +43,16 @@ __all__ = [
     "TrafficRequest",
     "TrafficSpec",
     "make_traffic",
+    "rescale_arrivals",
     "DisaggCluster",
     "PageShipment",
     "engine_for",
+    "normalize_on_step",
+    "ShipmentReceiver",
+    "ShipmentSender",
+    "ShipmentWireError",
+    "dumps_shipment",
+    "loads_shipment",
     "KVCacheConfig",
     "PagedKVCache",
     "prefix_page_keys",

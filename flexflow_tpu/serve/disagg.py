@@ -132,16 +132,54 @@ def engine_for(model, **kw):
 
     The SHARED surface a flag-agnostic driver may use: ``warmup()``,
     ``generate(prompts, max_new_tokens, eos_token=, temperature=,
-    top_k=, sample_seed=)``, ``generate_reference()``, ``last_stats``,
-    ``close()`` / context manager. Anything beyond it is type-specific
-    — engine-only constructor kwargs (``mesh``/``faults``/...) or the
-    differing ``on_step`` signatures (``on_step(step)`` vs
-    ``on_step(role, engine_idx, step)``) — and ``**kw`` goes verbatim
-    to whichever type the flag selects, so pass only kwargs valid for
-    that type."""
+    top_k=, sample_seed=, on_step=)``, ``generate_reference()``,
+    ``last_stats``, ``close()`` / context manager. ``on_step`` is
+    arity-normalized (:func:`normalize_on_step`): the cluster accepts
+    BOTH the engine's ``on_step(step)`` and its own
+    ``on_step(role, engine_idx, step)``, so a hook written for one
+    type cannot silently receive the wrong arguments from the other.
+    Anything beyond the shared surface is type-specific — engine-only
+    constructor kwargs (``mesh``/``faults``/...) — and ``**kw`` goes
+    verbatim to whichever type the flag selects, so pass only kwargs
+    valid for that type."""
     if getattr(model.config, "serve_disagg", False):
         return DisaggCluster.from_config(model, **kw)
     return ServeEngine(model, **kw)
+
+
+def normalize_on_step(on_step):
+    """Normalize a step hook to the cluster's canonical
+    ``cb(role, engine_idx, step)`` form, accepting either arity:
+
+      * ``on_step(step)`` — the ``ServeEngine.generate`` signature; the
+        role/index context is dropped on the adapter's floor;
+      * ``on_step(role, engine_idx, step)`` — the cluster-native form.
+
+    Arity is resolved by signature binding (bound methods, partials
+    and ``*args`` callables all work; a callable binding both forms is
+    taken as 3-ary — the richer one). Anything that binds neither
+    raises here, at arming time, instead of detonating mid-serve on
+    the first step."""
+    if on_step is None:
+        return None
+    import inspect
+    try:
+        sig = inspect.signature(on_step)
+    except (TypeError, ValueError):
+        return on_step   # uninspectable (builtin): trust 3-ary
+    def binds(k):
+        try:
+            sig.bind(*(None,) * k)
+            return True
+        except TypeError:
+            return False
+    if binds(3):
+        return on_step
+    if binds(1):
+        return lambda _role, _idx, step: on_step(step)
+    raise TypeError(
+        "on_step must accept (step) or (role, engine_idx, step); "
+        f"got signature {sig}")
 
 
 class DisaggCluster:
@@ -264,6 +302,47 @@ class DisaggCluster:
             self.metrics_server = MetricsServer(
                 self.metrics.to_prometheus, port=int(mport),
                 host=str(getattr(cfg, "metrics_host", "127.0.0.1")))
+        # --transport tcp: shipments leave generate() as length-
+        # prefixed socket frames (serve/transport.py) instead of
+        # in-process handoffs. The cluster arms BOTH ends on loopback —
+        # the receiver imports into this cluster's own decode pool
+        # (same watermark gate, via _import_shipment) — so one process
+        # exercises the full wire path; a multi-host deployment points
+        # the sender at another host's receiver (open_receiver()).
+        self._receiver = None
+        self._sender = None
+        tname = str(getattr(cfg, "serve_transport", "") or "").strip()
+        if tname:
+            if tname != "tcp":
+                raise ValueError(
+                    f"unknown serve transport {tname!r} (supported: "
+                    f"'tcp', '' = in-process handoff)")
+            from .transport import ShipmentSender
+            self._receiver = self.open_receiver(
+                host=str(getattr(cfg, "serve_transport_host",
+                                 "127.0.0.1")),
+                port=int(getattr(cfg, "serve_transport_port", 0) or 0))
+            self._sender = ShipmentSender(self._receiver.host,
+                                          self._receiver.port)
+
+    def open_receiver(self, *, host: str = "127.0.0.1",
+                      port: int = 0):
+        """Start a :class:`~.transport.ShipmentReceiver` importing
+        into THIS cluster's decode pool — the listening end a remote
+        prefill tier's ``ShipmentSender`` targets. Admission is the
+        same watermark gate as the in-process handoff; the import runs
+        on the receiver's connection thread while the sender blocks on
+        the ack, so at most one import mutates an engine at a time."""
+        from .transport import ShipmentReceiver
+        return ShipmentReceiver(self._import_shipment, host=host,
+                                port=int(port))
+
+    def _import_shipment(self, ship: PageShipment) -> dict:
+        """Receiver-side import: decode-engine choice keys on the
+        shipment's stream id (== the request's global index, the same
+        round-robin the in-process handoff uses), so the wire path is
+        placement-identical to the in-process one."""
+        return self._handoff(ship, int(ship.stream_id or 0))
 
     @classmethod
     def from_config(cls, model, *, num_devices: Optional[int] = None,
@@ -350,6 +429,12 @@ class DisaggCluster:
         server, self.metrics_server = self.metrics_server, None
         if server is not None:
             server.close()
+        sender, self._sender = self._sender, None
+        if sender is not None:
+            sender.close()
+        receiver, self._receiver = self._receiver, None
+        if receiver is not None:
+            receiver.close()
         for _, eng in self.engines():
             eng.close()
 
@@ -371,14 +456,29 @@ class DisaggCluster:
         need = sum(1 for k in ship.keys
                    if not eng.cache.key_resident(k))
         headroom = eng.cache.free_pages - need
-        wm = int(eng.admit_watermark * eng.cache_cfg.usable_pages)
+        from .scheduler import watermark_pages
+        wm = watermark_pages(eng.admit_watermark,
+                             eng.cache_cfg.usable_pages)
         return headroom >= max(wm, 1)
 
-    def _handoff(self, ship: Optional[PageShipment], rid) -> None:
-        """Move one shipment prefill -> decode (round-robin by rid),
-        emitting the kv_handoff span + transfer counters."""
+    def _ship(self, ship: Optional[PageShipment], rid) -> None:
+        """Route one shipment toward the decode pool: over the armed
+        socket transport when --transport is set (send blocks for the
+        receiver's ack — the wire's backpressure), else the in-process
+        handoff."""
         if ship is None:
             return
+        if self._sender is not None:
+            self._sender.send(ship)
+        else:
+            self._handoff(ship, rid)
+
+    def _handoff(self, ship: Optional[PageShipment], rid) -> dict:
+        """Move one shipment prefill -> decode (round-robin by rid),
+        emitting the kv_handoff span + transfer counters. Returns the
+        ack dict the socket receiver forwards to its sender."""
+        if ship is None:
+            return {"accepted": False, "pages_written": 0}
         eng = self.decode[rid % len(self.decode)]
         tel = self.telemetry
         t0 = time.perf_counter()
@@ -388,7 +488,7 @@ class DisaggCluster:
                 tel.instant(_CLUSTER_TRACK, "kv_handoff_skipped",
                             args={"rid": rid, "pages": ship.num_pages,
                                   "trace": ship.trace_id})
-            return
+            return {"accepted": False, "pages_written": 0}
         before_dedup = eng.cache.stats["import_dedup_pages"]
         written = eng.import_kv(ship)
         dt = time.perf_counter() - t0
@@ -406,6 +506,7 @@ class DisaggCluster:
                            "trace": ship.trace_id})
             tel.metrics.inc("kv_transfer_bytes_total", nbytes)
             tel.metrics.inc("kv_transfer_pages_total", written)
+        return {"accepted": True, "pages_written": written}
 
     # ---------------- the serving loop ---------------------------------
     def generate(self, prompts: Sequence[Sequence[int]],
@@ -419,9 +520,12 @@ class DisaggCluster:
         engines, which emit the rest. Token-identical to the unified
         ``ServeEngine.generate`` on lossless pools (the quantized
         contract relaxes exactly as it does everywhere else). Greedy /
-        top_k=1 only (see class docstring). ``on_step(role, engine_idx,
-        step)`` observes every role engine's steps (the per-pool
-        invariant hook of the property tests)."""
+        top_k=1 only (see class docstring). ``on_step`` observes every
+        role engine's steps (the per-pool invariant hook of the
+        property tests) — either arity, ``on_step(step)`` or
+        ``on_step(role, engine_idx, step)``, via
+        :func:`normalize_on_step`."""
+        on_step = normalize_on_step(on_step)
         n = len(prompts)
 
         def per_req(x, name):
@@ -534,7 +638,7 @@ class DisaggCluster:
 
         # ---- phase 2: page handoff (with backpressure) ----------------
         for i in decode_idx:
-            self._handoff(ships[i], i)
+            self._ship(ships[i], i)
 
         # ---- phase 3: decode role -------------------------------------
         # each surviving request continues as prompt + [first token]
@@ -575,6 +679,9 @@ class DisaggCluster:
         total_new = sum(len(r) for r in results)
         self.last_stats = {
             "mode": "disagg",
+            "pipelined": False,
+            "transport": ("tcp" if self._sender is not None
+                          else "inproc"),
             "prefill_engines": len(self.prefill),
             "decode_engines": len(self.decode),
             "decode_budget": self.decode_budget,
@@ -612,6 +719,202 @@ class DisaggCluster:
         if not tel.enabled:
             # with telemetry on, _handoff already counted these on the
             # (same) registry per shipment
+            m.inc("kv_transfer_bytes_total", delta("handoff_bytes"))
+            m.inc("kv_transfer_pages_total", delta("handoff_pages"))
+        return results
+
+    # ---------------- the pipelined serving loop ------------------------
+    def generate_pipelined(self, prompts: Sequence[Sequence[int]],
+                           max_new_tokens,
+                           eos_token: Optional[int] = None,
+                           temperature=None, top_k=None,
+                           sample_seed: int = 0, on_step=None,
+                           tenant_ids: Optional[Sequence[int]] = None
+                           ) -> List[List[int]]:
+        """Serve the batch with CONTINUOUS prefill/decode pipelining:
+        one event loop drives every role engine's steppable
+        ``ServeSession``, so the moment a request's prefill finishes
+        its pages hand off and its continuation is admitted to a
+        decode engine — while the remaining prefills are still
+        running. Both roles' programs stay busy concurrently instead
+        of the phased generate()'s prefill-wave -> handoff ->
+        decode-wave barriers; per-request TTFT stops paying for the
+        rest of the batch's prefill wave.
+
+        TOKEN-IDENTICAL to the phased ``generate`` (and the unified
+        engine) by the same construction: stream ids are the global
+        request indices, the decode continuation resumes each stream
+        at offset 1, and the handoff/admission path is byte-for-byte
+        the one the phased loop uses — the loop only reorders WHEN
+        steps run, never what they compute. With ``--transport tcp``
+        each shipment crosses the socket (the ack blocks this loop, so
+        the receiver's import never races a decode step).
+
+        ``on_step`` accepts either hook arity (normalize_on_step)."""
+        on_step = normalize_on_step(on_step)
+        n = len(prompts)
+
+        def per_req(x, name):
+            if x is None or np.isscalar(x):
+                return [x] * n
+            x = list(x)
+            if len(x) != n:
+                raise ValueError(
+                    f"{name} has {len(x)} entries for {n} prompts")
+            return x
+
+        tens = per_req(0 if tenant_ids is None else list(tenant_ids),
+                       "tenant_ids")
+        if isinstance(max_new_tokens, int):
+            max_new_tokens = [max_new_tokens] * n
+        if len(max_new_tokens) != n:
+            raise ValueError(
+                f"max_new_tokens has {len(max_new_tokens)} entries "
+                f"for {n} prompts")
+        for mnt in max_new_tokens:
+            if int(mnt) < 1:
+                raise ValueError(
+                    f"max_new_tokens must be >= 1, got {mnt}")
+        lead = self.prefill[0]
+        samples = lead._sample_params(temperature, top_k, sample_seed,
+                                      n, lead.topk_cap)
+        t_start = time.perf_counter()
+        tel = self.telemetry
+        stats0 = dict(self.stats)
+        from ..utils.telemetry import next_trace_id
+        tids = [next_trace_id() for _ in range(n)]
+        self._last_traces = [[tids[i], None, None] for i in range(n)]
+
+        first: List[Optional[int]] = [None] * n
+        ships: List[Optional[PageShipment]] = [None] * n
+        dreqs: Dict[int, object] = {}
+        psess = [eng.start_session() for eng in self.prefill]
+        dsess = [eng.start_session() for eng in self.decode]
+        try:
+            for i in range(n):
+                w = i % len(self.prefill)
+
+                def grab(req, _eng=self.prefill[w], _i=i):
+                    # export at the finish boundary, slot still
+                    # mapped — skipped for requests the decode role
+                    # will never see (phased generate's rule)
+                    if max_new_tokens[_i] <= 1 or (
+                            eos_token is not None and req.out_tokens
+                            and req.out_tokens[-1] == eos_token):
+                        return
+                    ships[_i] = _eng.export_kv(
+                        req.slot, req.context,
+                        stream_id=req.stream_id,
+                        trace_id=req.trace_id,
+                        tenant_id=req.tenant_id)
+
+                psess[w].submit(
+                    prompts[i], 1, eos_token=eos_token,
+                    sample=samples[i], stream_id=i,
+                    trace_id=tids[i], tenant_id=tens[i],
+                    on_finish=grab)
+
+            def step_role(role, engines, sessions):
+                """One step on every busy engine of a role; returns
+                the finished requests per engine index."""
+                fins = []
+                for w, eng in enumerate(engines):
+                    s = sessions[w]
+                    if not s.has_work():
+                        continue
+                    try:
+                        ev = s.step()
+                    except Exception:
+                        # contain per engine, phased-generate style:
+                        # fail its in-flight requests, keep the rest
+                        # of the cluster serving
+                        eng._fail_inflight(s.sched, s.reqs)
+                        s.close()
+                        sessions[w] = eng.start_session()
+                        continue
+                    if ev is None:
+                        continue
+                    if on_step is not None:
+                        on_step(role, w, ev)
+                    for req in ev.finished:
+                        fins.append(req)
+                return fins
+
+            while any(s.has_work() for s in psess) \
+                    or any(s.has_work() for s in dsess):
+                for req in step_role("prefill", self.prefill, psess):
+                    i = req.stream_id
+                    ft = req.out_tokens[0] if req.out_tokens else None
+                    first[i] = ft
+                    self._last_traces[i][1] = req
+                    if ft is None or max_new_tokens[i] <= 1 or (
+                            eos_token is not None
+                            and ft == eos_token):
+                        continue
+                    # the pipelining: handoff + decode admission NOW,
+                    # not after the whole prefill wave
+                    self._ship(ships[i], i)
+                    d = i % len(self.decode)
+                    dreqs[i] = dsess[d].submit(
+                        list(prompts[i]) + [ft],
+                        int(max_new_tokens[i]) - 1,
+                        eos_token=eos_token, sample=samples[i],
+                        stream_id=i, stream_offset=1,
+                        trace_id=tids[i], tenant_id=tens[i])
+                    self._last_traces[i][2] = dreqs[i]
+                step_role("decode", self.decode, dsess)
+            pre_stats = [s.stats_dict() for s in psess if s.reqs]
+            dec_stats = [s.stats_dict() for s in dsess if s.reqs]
+        finally:
+            for s in psess + dsess:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+        results: List[List[int]] = []
+        for i in range(n):
+            if first[i] is None:
+                results.append([])
+            elif i in dreqs:
+                results.append([first[i]]
+                               + list(dreqs[i].out_tokens))
+            else:
+                results.append([first[i]])
+        wall = time.perf_counter() - t_start
+        total_new = sum(len(r) for r in results)
+        self.last_stats = {
+            "mode": "disagg",
+            "pipelined": True,
+            "transport": ("tcp" if self._sender is not None
+                          else "inproc"),
+            "prefill_engines": len(self.prefill),
+            "decode_engines": len(self.decode),
+            "decode_budget": self.decode_budget,
+            "wall_s": wall,
+            "total_new_tokens": total_new,
+            "tokens_per_sec": total_new / wall if wall > 0 else 0.0,
+            "handoff": {k: self.stats[k] - stats0[k]
+                        for k in self.stats},
+            "roles": {"prefill": pre_stats, "decode": dec_stats},
+            "compile_counts": self.compile_counts(),
+        }
+        # sessions never auto-fold (unlike generate(), where each role
+        # engine folds its unlabeled aggregates after its wave), so
+        # fold both the aggregate and the role-labeled series here
+        m = self.metrics
+        for st in pre_stats:
+            serve_metrics(st, registry=m)
+            serve_metrics(st, registry=m, role="prefill")
+        for st in dec_stats:
+            serve_metrics(st, registry=m)
+            serve_metrics(st, registry=m, role="decode")
+
+        def delta(k):
+            return self.stats[k] - stats0[k]
+
+        m.inc("kv_handoff_requests_total", delta("handoff_requests"))
+        m.inc("kv_handoff_skipped_total", delta("handoff_skipped"))
+        if not tel.enabled:
             m.inc("kv_transfer_bytes_total", delta("handoff_bytes"))
             m.inc("kv_transfer_pages_total", delta("handoff_pages"))
         return results

@@ -52,6 +52,8 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import queue
+import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -102,6 +104,12 @@ class Replica:
         self.draining = False       # not routable; steps until empty
         self.inflight: set = set()  # stream ids tracked on this replica
         self._plan_only = 0
+        # wall-clock mode: the step/submit mutual exclusion (the
+        # worker thread holds it across session.step(), the router
+        # thread across session.submit()) and the measured wall
+        # seconds this replica's steps consumed
+        self.lock = threading.Lock()
+        self.busy_wall_s = 0.0
         # the zero-recompile baseline: compile counts right after
         # warmup — the router gate compares against THIS snapshot
         self.warm_counts = engine.compile_counts()
@@ -319,8 +327,13 @@ class ReplicaPool:
         self._inflight: Dict[int, dict] = {}    # stream id -> tracked
         self._records: Dict[int, dict] = {}
         self._req_refs: Dict[int, Request] = {}  # stream id -> Request
-        self._w_first: deque = deque()   # (t_first, virtual ttft)
+        self._w_first: deque = deque()   # (t_first, ttft)
         self._w_done: deque = deque()    # (t_finish, tpot, tokens)
+        # which clock the CURRENT run's latency stamps are on
+        # ("virtual" | "wall") — _finalize labels its exported
+        # histograms with it, so wall-mode samples can never pollute
+        # the serve_router_*_virtual_seconds series (and vice versa)
+        self._clock = "virtual"
         self._next_eval = 0.0
         self.scale_events: List[dict] = []
         self.stats = {"routed": 0, "affinity_hits": 0,
@@ -554,8 +567,10 @@ class ReplicaPool:
                 tr.temperature, tr.top_k, self._sample_seed, 1,
                 eng.topk_cap)[0]
         # an idle replica starts serving at the arrival instant, not
-        # at whatever its clock last drained to
-        if not replica.session.has_work():
+        # at whatever its clock last drained to (virtual mode only —
+        # wall mode never reads clock_s, and stamping traffic-plan
+        # times into it would corrupt a later virtual run's clocks)
+        if self._clock == "virtual" and not replica.session.has_work():
             replica.clock_s = max(replica.clock_s, tr.t_arrival)
         req = replica.session.submit(
             tr.prompt, tr.max_new, eos_token=eos_token, sample=sample,
@@ -719,10 +734,12 @@ class ReplicaPool:
                     if slo_tpot_s and tpot > slo_tpot_s:
                         m.inc("serve_slo_violations_total", slo="tpot")
         if ttft is not None:
-            m.observe("serve_router_ttft_virtual_seconds", ttft)
+            m.observe(f"serve_router_ttft_{self._clock}_seconds",
+                      ttft)
             self._w_first.append((tracked["t_first"], ttft))
         if tpot:
-            m.observe("serve_router_tpot_virtual_seconds", tpot)
+            m.observe(f"serve_router_tpot_{self._clock}_seconds",
+                      tpot)
         m.inc("router_requests_finished_total", outcome=req.outcome)
 
     def _sweep_terminal(self, replica: Replica, t_end: float,
@@ -881,28 +898,64 @@ class ReplicaPool:
             eos_token: Optional[int] = None,
             autoscaler: Optional[Autoscaler] = None,
             slo_monitor=None,
-            sample_seed: int = 0, on_step=None) -> dict:
-        """Serve a timed traffic stream on the virtual clock and
-        return the goodput-under-SLO accounting (also stashed on
-        ``last_stats``).
+            sample_seed: int = 0, on_step=None,
+            wall_clock: Optional[bool] = None,
+            wall_threads: bool = True,
+            time_scale: float = 1.0,
+            dwell_s: float = 0.0) -> dict:
+        """Serve a timed traffic stream and return the
+        goodput-under-SLO accounting (also stashed on ``last_stats``).
 
-        Event loop: the next event is the earlier of (the next
+        Two clocks (docs/serving.md "Wall-clock mode"). The default
+        VIRTUAL mode prices each step with the cost stack and replays
+        deterministically at one seed — authoritative for search
+        A/Bs and autoscaler replay. ``wall_clock=True`` (or
+        ``--wall-clock``) serves the SAME traffic in real time:
+        arrivals pace on the wall clock (``tr.t_arrival * time_scale``
+        seconds after run start) and each replica runs its session
+        step loop on its own worker thread (``wall_threads=False``
+        steps them round-robin from one thread — the A/B baseline),
+        so goodput-under-SLO becomes a measured wall number. TOKENS
+        are identical across all modes: sampling keys on stream ids,
+        never on the clock. ``dwell_s`` enforces a minimum wall
+        duration per dispatched step — the device-dwell stand-in for
+        CPU-inline hosts, where XLA "device" time is host time and
+        the overlap a real accelerator exposes has nothing to hide
+        behind.
+
+        Virtual event loop: the next event is the earlier of (the next
         arrival, the busy replica with the smallest clock). Arrivals
         route + submit (an idle target's clock jumps to the arrival
         instant); a replica step advances its clock by the priced
         step time and stamps first-token/finish times at the step's
         END. The autoscaler (when given) ticks every ``interval_s``
-        of virtual time off the freshly exported gauges. Everything
-        here is a deterministic function of (traffic, seed, pool
-        shape) — same inputs, same goodput, same scale decisions.
+        of virtual time off the freshly exported gauges.
         ``on_step(replica, ev)`` observes every replica step (the
-        chaos tests' cluster-wide invariant hook)."""
+        chaos tests' cluster-wide invariant hook; called from the
+        router thread in every mode)."""
         if slo_ttft_s is None:
             ms = float(getattr(self.config, "slo_ttft_ms", 0.0))
             slo_ttft_s = ms / 1e3 if ms > 0 else None
         if slo_tpot_s is None:
             ms = float(getattr(self.config, "slo_tpot_ms", 0.0))
             slo_tpot_s = ms / 1e3 if ms > 0 else None
+        if wall_clock is None:
+            wall_clock = bool(getattr(self.config, "serve_wall_clock",
+                                      False))
+        if wall_clock:
+            if autoscaler is not None or bool(
+                    getattr(self.config, "serve_autoscale", False)):
+                raise ValueError(
+                    "the autoscaler replays on the virtual clock "
+                    "only (its decisions must be reproducible at one "
+                    "seed) — run wall-clock without --autoscale")
+            return self._run_wall(
+                traffic, slo_ttft_s=slo_ttft_s,
+                slo_tpot_s=slo_tpot_s, eos_token=eos_token,
+                slo_monitor=slo_monitor, sample_seed=sample_seed,
+                on_step=on_step, threaded=bool(wall_threads),
+                time_scale=float(time_scale), dwell_s=float(dwell_s))
+        self._clock = "virtual"
         if autoscaler is None and bool(getattr(self.config,
                                                "serve_autoscale",
                                                False)):
@@ -1106,6 +1159,309 @@ class ReplicaPool:
             # request's span fold lands in the shared registry
             # (serve_latency_attribution_* series) and the
             # per-component WALL totals ride along in last_stats
+            self.last_stats["attribution"] = self.fold_attribution()
+        return self.last_stats
+
+    # ---------------- wall-clock serving --------------------------------
+    def _wall_apply(self, r: Replica, ev, t_end: float, busy: float,
+                    slo_ttft_s, slo_tpot_s, on_step) -> None:
+        """Apply one replica step's outcome to the pool's tracking
+        state. Wall mode's single mutation point for router state:
+        workers only step sessions and report here, so first-token
+        stamps, cancels, finalization, and ``on_step`` all happen on
+        the router thread — same ordering discipline as the virtual
+        loop, just fed from a queue."""
+        if ev is None:
+            self._sweep_terminal(r, t_end, slo_ttft_s, slo_tpot_s)
+            self._maybe_park(r)
+            return
+        if not ev.dispatched:
+            r._plan_only += 1
+            if r._plan_only > _MAX_PLAN_ONLY:
+                raise RuntimeError(
+                    f"replica{r.idx} re-planned {_MAX_PLAN_ONLY} "
+                    f"steps without dispatching — scheduler wedged")
+            self._sweep_terminal(r, t_end, slo_ttft_s, slo_tpot_s)
+            return
+        r._plan_only = 0
+        r.busy_wall_s += busy
+        r.steps += 1
+        r.peak_occupancy = max(r.peak_occupancy, r.occupancy())
+        for req, n in ev.emitted:
+            tracked = self._inflight.get(req.stream_id)
+            if tracked is None:
+                continue
+            if tracked["tokens_emitted"] == 0:
+                tracked["t_first"] = t_end
+            tracked["tokens_emitted"] += n
+            r.tokens += n
+            ca = tracked["cancel_after"]
+            if ca is not None and not tracked["cancel_sent"] \
+                    and tracked["tokens_emitted"] >= ca:
+                # engine.cancel is thread-safe by contract (the worker
+                # may be mid-step); the abort lands at the request's
+                # next chunk boundary exactly as in virtual mode
+                self.cancel(req.stream_id)
+        self._sweep_terminal(r, t_end, slo_ttft_s, slo_tpot_s)
+        self._maybe_park(r)
+        if on_step is not None:
+            on_step(r, ev)
+
+    def _wall_step(self, r: Replica, w_start: float, dwell_s: float):
+        """One locked session step + the device-dwell floor, returning
+        ``(kind, ev, t_end, busy_s)``. The dwell sleep happens OUTSIDE
+        the lock: it models time the host is blocked on the device,
+        during which the router may submit into this replica."""
+        t0 = time.perf_counter()
+        with r.lock:
+            try:
+                ev = r.session.step()
+            except Exception:
+                # contain exactly as the virtual loop: fail the
+                # in-flight requests, reopen the session, keep the
+                # rest of the pool serving
+                r.engine._fail_inflight(r.session.sched,
+                                        r.session.reqs)
+                r.session.close()
+                r.session = r.engine.start_session()
+                return ("fail", None,
+                        time.perf_counter() - w_start, 0.0)
+        elapsed = time.perf_counter() - t0
+        if ev is not None and ev.dispatched and dwell_s > elapsed:
+            time.sleep(dwell_s - elapsed)
+            elapsed = dwell_s
+        return ("step", ev, time.perf_counter() - w_start, elapsed)
+
+    def _run_wall(self, traffic: Sequence[TrafficRequest], *,
+                  slo_ttft_s, slo_tpot_s, eos_token, slo_monitor,
+                  sample_seed, on_step, threaded: bool,
+                  time_scale: float, dwell_s: float) -> dict:
+        """Serve the traffic stream in real time (docs/serving.md
+        "Wall-clock mode"). Arrivals pace on the wall clock —
+        request i submits ``(t_arrival - t0) * time_scale`` wall
+        seconds after run start — and timestamps (t_arrival, t_first,
+        t_finish) are run-relative wall seconds on ONE clock, so
+        ``explain_request`` still sums exactly to measured latency.
+
+        ``threaded=True``: each replica's session step loop runs on
+        its own worker thread; the worker holds ``replica.lock``
+        across ``session.step()`` (the router thread holds it across
+        ``session.submit()``) and reports completed steps into a
+        queue the router thread drains — all router state mutates on
+        the router thread. ``threaded=False`` steps busy replicas
+        round-robin from the router thread: the A/B baseline the
+        fabric bench's >= 1.3x goodput gate divides by.
+
+        No autoscaler here (it replays on the virtual clock), and no
+        auto-armed SLO monitor — pass one explicitly to tick it on
+        wall time. Tokens are identical to the virtual run at the
+        same seed: sampling keys on stream ids, never on the
+        clock."""
+        slo_monitor = slo_monitor or None
+        self._sample_seed = int(sample_seed)
+        self._records = {}
+        self._req_refs = {}
+        self._w_first.clear()
+        self._w_done.clear()
+        stats0 = dict(self.stats)
+        events0 = len(self.scale_events)
+        self._rr_next = 0
+        for r in self.replicas:
+            if r.session.reqs and not r.session.has_work():
+                r.session.close()
+                r.session = r.engine.start_session()
+        n_start = len(self.routable())
+        arrivals = sorted(traffic,
+                          key=lambda r: (r.t_arrival, r.stream_id))
+        t0_virtual = arrivals[0].t_arrival if arrivals else 0.0
+        sched = [(tr.t_arrival - t0_virtual) * time_scale
+                 for tr in arrivals]
+        self._clock = "wall"
+        done_q: "queue.Queue" = queue.Queue()
+        stop = threading.Event()
+        wakes = [threading.Event() for _ in self.replicas]
+        workers: List[threading.Thread] = []
+        w_start = time.perf_counter()
+
+        def _worker(r: Replica, wake: threading.Event) -> None:
+            while not stop.is_set():
+                if not r.has_work():
+                    wake.wait(0.005)
+                    wake.clear()
+                    continue
+                kind, ev, t_end, busy = self._wall_step(
+                    r, w_start, dwell_s)
+                done_q.put((kind, r.idx, ev, t_end, busy))
+
+        try:
+            if threaded:
+                for r, wake in zip(self.replicas, wakes):
+                    t = threading.Thread(
+                        target=_worker, args=(r, wake),
+                        name=f"replica{r.idx}-step", daemon=True)
+                    t.start()
+                    workers.append(t)
+            next_slo = (slo_monitor.interval_s
+                        if slo_monitor is not None else None)
+            i = 0
+            rr = 0
+            t_now = 0.0
+            last_progress = time.perf_counter()
+            while True:
+                t_now = time.perf_counter() - w_start
+                while i < len(arrivals) and sched[i] <= t_now + 1e-9:
+                    tr = arrivals[i]
+                    # submit holds EVERY replica lock (idx order):
+                    # route() reads all replicas' queue/cache state
+                    # and session.submit mutates the winner — both
+                    # must not interleave with a worker's step
+                    for r in self.replicas:
+                        r.lock.acquire()
+                    try:
+                        tracked = self.submit(tr, eos_token=eos_token)
+                    finally:
+                        for r in reversed(self.replicas):
+                            r.lock.release()
+                    # SLOs measure from the SCHEDULED wall arrival —
+                    # router lag between the pacer and submit() is
+                    # queueing delay the tier must answer for
+                    tracked["t_arrival"] = sched[i]
+                    if threaded:
+                        wakes[tracked["replica"]].set()
+                    i += 1
+                    last_progress = time.perf_counter()
+                if i >= len(arrivals) and not self._inflight:
+                    break
+                if threaded:
+                    timeout = 0.05 if i >= len(arrivals) else \
+                        min(0.05, max(0.0, sched[i] - t_now))
+                    try:
+                        item = done_q.get(timeout=timeout) \
+                            if timeout > 0 else done_q.get_nowait()
+                    except queue.Empty:
+                        if i >= len(arrivals) \
+                                and not any(r.has_work()
+                                            for r in self.replicas):
+                            break  # drained: a raced cancel's record
+                        if time.perf_counter() - last_progress > 60.0:
+                            raise RuntimeError(
+                                "wall-clock pool made no progress "
+                                "for 60s with work pending")
+                        continue
+                    while item is not None:
+                        kind, idx, ev, t_end, busy = item
+                        r = self.replicas[idx]
+                        if kind == "fail":
+                            self._sweep_terminal(r, t_end, slo_ttft_s,
+                                                 slo_tpot_s)
+                        else:
+                            self._wall_apply(r, ev, t_end, busy,
+                                             slo_ttft_s, slo_tpot_s,
+                                             on_step)
+                        last_progress = time.perf_counter()
+                        try:
+                            item = done_q.get_nowait()
+                        except queue.Empty:
+                            item = None
+                else:
+                    busy_rs = [r for r in self.replicas
+                               if r.has_work()]
+                    if not busy_rs:
+                        if i < len(arrivals):
+                            time.sleep(
+                                min(0.05,
+                                    max(0.0, sched[i] - t_now)))
+                            continue
+                        break  # drained: a raced cancel's record
+                    r = busy_rs[rr % len(busy_rs)]
+                    rr += 1
+                    kind, ev, t_end, busy = self._wall_step(
+                        r, w_start, dwell_s)
+                    if kind == "fail":
+                        self._sweep_terminal(r, t_end, slo_ttft_s,
+                                             slo_tpot_s)
+                    else:
+                        self._wall_apply(r, ev, t_end, busy,
+                                         slo_ttft_s, slo_tpot_s,
+                                         on_step)
+                    last_progress = time.perf_counter()
+                if slo_monitor is not None:
+                    t_now = time.perf_counter() - w_start
+                    while t_now >= next_slo:
+                        slo_monitor.observe(next_slo)
+                        next_slo += slo_monitor.interval_s
+        finally:
+            stop.set()
+            for wake in wakes:
+                wake.set()
+            for t in workers:
+                t.join(timeout=5.0)
+            self._clock = "virtual"
+        t_final = time.perf_counter() - w_start
+        # drain-time finalization still belongs to the wall run (the
+        # finally above restored the label for the exception paths)
+        self._clock = "wall"
+        for sid in list(self._inflight):
+            self._finalize(self._inflight[sid], t_final, slo_ttft_s,
+                           slo_tpot_s)
+        for r in self.replicas:
+            self._maybe_park(r)
+        self._export_gauges(t_final)
+        self._clock = "virtual"
+        if slo_monitor is not None:
+            slo_monitor.observe(t_final)
+            slo_monitor.finish(t_final)
+        records = [self._records[sid]
+                   for sid in sorted(self._records)]
+        makespan = max(1e-12, t_final)
+        ok = sum(1 for rec in records if rec["slo_ok"])
+        completed = sum(1 for rec in records
+                        if rec["outcome"] == RequestOutcome.COMPLETED)
+        for r in self.replicas:
+            st = r.session.stats_dict()
+            serve_metrics(st, registry=self.metrics)
+            serve_metrics(st, registry=self.metrics,
+                          replica=str(r.idx))
+        self.last_stats = {
+            "mode": "router",
+            "clock": "wall",
+            "wall_threads": threaded,
+            "time_scale": time_scale,
+            "dwell_s": dwell_s,
+            "policy": self.policy,
+            "autoscaled": False,
+            "replicas_start": n_start,
+            "replicas_end": len(self.routable()),
+            "replicas_total": len(self.replicas),
+            "requests": records,
+            "goodput_per_s": ok / makespan,
+            "slo_attainment": ok / len(records) if records else 0.0,
+            "slo_ttft_s": slo_ttft_s, "slo_tpot_s": slo_tpot_s,
+            "makespan_s": makespan,
+            "completed": completed,
+            "slo_ok": ok,
+            "cancelled": sum(
+                1 for rec in records
+                if rec["outcome"] == RequestOutcome.CANCELLED),
+            "tokens_total": sum(len(rec["tokens"])
+                                for rec in records),
+            "routing": {k: self.stats[k] - stats0[k]
+                        for k in self.stats},
+            "scale_events": list(self.scale_events[events0:]),
+            "per_replica": [
+                {"replica": r.idx, "live": r.live,
+                 "assigned": r.assigned, "steps": r.steps,
+                 "tokens": r.tokens,
+                 "busy_virtual_s": r.busy_s,
+                 "busy_wall_s": r.busy_wall_s,
+                 "peak_occupancy": r.peak_occupancy}
+                for r in self.replicas],
+            "slo_attainment_budget": self.metrics.gauge(
+                "serve_pool_slo_attainment", 1.0),
+            "slo_alerts": (list(slo_monitor.events)
+                           if slo_monitor is not None else []),
+        }
+        if self.telemetry.enabled:
             self.last_stats["attribution"] = self.fold_attribution()
         return self.last_stats
 

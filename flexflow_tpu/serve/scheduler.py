@@ -227,6 +227,17 @@ class StepPlan:
         return sum(1 for c in self.chunks if c.is_decode)
 
 
+def watermark_pages(admit_watermark: float, usable_pages: int) -> int:
+    """The admission watermark as a page count: the floor of
+    reclaimable pages admission must leave standing. ONE formula,
+    shared by every consumer of the backpressure signal — the
+    scheduler's waiting-queue admissions, the disagg handoff's
+    shipment gate, and the cross-process shipment receiver — so
+    "above the watermark" means the same thing in-process and across
+    the wire."""
+    return int(float(admit_watermark) * int(usable_pages))
+
+
 class ContinuousBatchingScheduler:
     # graceful-degradation ladder: page-pool utilization (1 - the
     # reclaimable fraction) at which each rung arms. Rung 1 sheds
@@ -282,8 +293,8 @@ class ContinuousBatchingScheduler:
         self.spec_tokens = int(spec_tokens) if self.chunked_prefill else 0
         self.drafter = drafter if drafter is not None \
             else (PromptLookupDrafter() if self.spec_tokens > 0 else None)
-        self.watermark_pages = int(admit_watermark
-                                   * cache.cfg.usable_pages)
+        self.watermark_pages = watermark_pages(
+            admit_watermark, cache.cfg.usable_pages)
         self.waiting: Deque[Request] = deque()
         self.running: Dict[int, Request] = {}  # slot -> request
         self._next_rid = 0

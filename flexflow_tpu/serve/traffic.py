@@ -45,7 +45,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["TrafficRequest", "TrafficSpec", "make_traffic",
-           "tenant_prefixes"]
+           "rescale_arrivals", "tenant_prefixes"]
 
 
 @dataclasses.dataclass
@@ -138,6 +138,21 @@ def tenant_prefixes(spec: TrafficSpec) -> Dict[int, List[int]]:
     return {t: rng.integers(1, spec.vocab,
                             size=spec.prefix_tokens).tolist()
             for t in range(spec.tenants)}
+
+
+def rescale_arrivals(traffic: List[TrafficRequest],
+                     scale: float) -> List[TrafficRequest]:
+    """A copy of the stream with every arrival time multiplied by
+    ``scale`` — wall-clock pacing's rate knob (docs/serving.md
+    "Wall-clock mode"): the same requests (prompts, tenants, sampling,
+    cancels untouched, so token identity across arms holds) arriving
+    ``1/scale`` times faster. A wall-clock bench shrinks a
+    virtual-authoritative stream's timeline to something measurable
+    without re-synthesizing the workload."""
+    if not scale > 0.0:
+        raise ValueError(f"scale must be > 0, got {scale}")
+    return [dataclasses.replace(t, t_arrival=t.t_arrival * scale)
+            for t in traffic]
 
 
 def _heavy(rng, mean: float, a: float, lo: int, hi: int) -> int:

@@ -320,15 +320,18 @@ def router_report(stats: dict, metrics=None) -> str:
     and counter lines read from the pool's exported registry when
     given (``pool.metrics`` — the PR 10 no-drift rule: the report
     renders what the autoscaler and /metrics scrapes actually see);
-    virtual-clock numbers (goodput, makespan) come from the stats
-    dict — they ARE the exported accounting."""
+    clock numbers (goodput, makespan) come from the stats dict —
+    they ARE the exported accounting — labeled by the run's clock
+    (virtual, or wall for a ``wall_clock=True`` run: docs/serving.md
+    "Wall-clock mode")."""
+    clock = stats.get("clock", "virtual")
     lines = [
         f"router: policy={stats.get('policy')}, "
         f"{stats.get('replicas_start', 0)} -> "
         f"{stats.get('replicas_end', 0)} replicas "
         f"({stats.get('replicas_total', 0)} built), "
         f"{len(stats.get('requests', []))} requests in "
-        f"{stats.get('makespan_s', 0.0)*1e3:.2f} virtual ms"]
+        f"{stats.get('makespan_s', 0.0)*1e3:.2f} {clock} ms"]
     slo_t = stats.get("slo_ttft_s")
     slo_p = stats.get("slo_tpot_s")
     lines.append(
@@ -348,12 +351,12 @@ def router_report(stats: dict, metrics=None) -> str:
         f"{r.get('spills', 0)} load spills, "
         f"{r.get('cancels_sent', 0)} cancels")
     if metrics is not None:
-        t50 = metrics.quantile("serve_router_ttft_virtual_seconds", 50)
-        t99 = metrics.quantile("serve_router_ttft_virtual_seconds", 99)
-        p50 = metrics.quantile("serve_router_tpot_virtual_seconds", 50)
-        p99 = metrics.quantile("serve_router_tpot_virtual_seconds", 99)
+        t50 = metrics.quantile(f"serve_router_ttft_{clock}_seconds", 50)
+        t99 = metrics.quantile(f"serve_router_ttft_{clock}_seconds", 99)
+        p50 = metrics.quantile(f"serve_router_tpot_{clock}_seconds", 50)
+        p99 = metrics.quantile(f"serve_router_tpot_{clock}_seconds", 99)
         lines.append(
-            f"virtual latency: ttft p50={t50*1e3:.3f} "
+            f"{clock} latency: ttft p50={t50*1e3:.3f} "
             f"p99={t99*1e3:.3f} ms, tpot p50={p50*1e3:.4f} "
             f"p99={p99*1e3:.4f} ms")
     per = stats.get("per_replica") or []
@@ -367,7 +370,7 @@ def router_report(stats: dict, metrics=None) -> str:
                 f"{p['replica']:>8d} {state:>8s} "
                 f"{p['assigned']:>6d} {p['steps']:>7d} "
                 f"{p['tokens']:>7d} "
-                f"{p['busy_virtual_s']*1e3:>9.2f} "
+                f"{p.get('busy_wall_s', 0.0)*1e3 if clock == 'wall' else p['busy_virtual_s']*1e3:>9.2f} "
                 f"{p['peak_occupancy']:>9.1%}")
     ev = stats.get("scale_events") or []
     if ev:

@@ -51,6 +51,7 @@ import contextlib
 import itertools
 import json
 import os
+import threading
 import time
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -261,40 +262,53 @@ class MetricsRegistry:
     Histograms keep exact count/sum totals plus a bounded window of
     recent samples (the quantile source — nearest-rank over the
     window, the same :func:`pct` the reports use). Everything is plain
-    host Python; no locks needed for the GIL-atomic dict/list ops the
-    recording paths perform."""
+    host Python. Mutation is guarded by ONE lock (`_lock`) so the
+    wall-clock fabric's replica worker threads can increment shared
+    counters without losing read-modify-write races; single-threaded
+    behavior is unchanged (an uncontended acquire is ~100ns, inside
+    the <= 3% recording-overhead gate). Readers take the same lock
+    only for whole-registry exports (snapshot/to_prometheus) — point
+    reads stay lock-free dict gets."""
 
     HIST_WINDOW = 4096
 
-    def __init__(self):
+    def __init__(self, lock: Optional[threading.Lock] = None):
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, float] = {}
         self._hists: Dict[str, dict] = {}
+        # shared with the owning Telemetry when there is one, so the
+        # whole recording surface serializes on a single lock
+        self._lock = lock if lock is not None else threading.Lock()
 
     # ---------------- recording ---------------------------------------
     def inc(self, name: str, value: float = 1.0, **labels) -> None:
         key = name + _label_key(labels)
-        self.counters[key] = self.counters.get(key, 0.0) + float(value)
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0.0) \
+                + float(value)
 
     def counter_set(self, name: str, value: float, **labels) -> None:
         """Absolute-set a counter — for sources that track their own
         cumulative totals (compile counts, fault-injector fired
         counts), where re-adding each snapshot would double-count."""
-        self.counters[name + _label_key(labels)] = float(value)
+        with self._lock:
+            self.counters[name + _label_key(labels)] = float(value)
 
     def set(self, name: str, value: float, **labels) -> None:
-        self.gauges[name + _label_key(labels)] = float(value)
+        with self._lock:
+            self.gauges[name + _label_key(labels)] = float(value)
 
     def observe(self, name: str, value: float, **labels) -> None:
         key = name + _label_key(labels)
-        h = self._hists.get(key)
-        if h is None:
-            h = self._hists[key] = {
-                "count": 0, "sum": 0.0,
-                "window": deque(maxlen=self.HIST_WINDOW)}
-        h["count"] += 1
-        h["sum"] += float(value)
-        h["window"].append(float(value))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = {
+                    "count": 0, "sum": 0.0,
+                    "window": deque(maxlen=self.HIST_WINDOW)}
+            h["count"] += 1
+            h["sum"] += float(value)
+            h["window"].append(float(value))
 
     # ---------------- reading -----------------------------------------
     def counter(self, name: str, default: float = 0.0, **labels) -> float:
@@ -318,24 +332,34 @@ class MetricsRegistry:
         """JSON-ready snapshot: every counter/gauge value plus each
         histogram's count/sum/min/max and p50/p90/p99 (nearest-rank
         over the retained window)."""
+        with self._lock:
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+            hwins = {key: (h["count"], h["sum"], list(h["window"]))
+                     for key, h in self._hists.items()}
         hists = {}
-        for key, h in self._hists.items():
-            win = sorted(h["window"])
+        for key, (count, total, window) in hwins.items():
+            win = sorted(window)
             hists[key] = {
-                "count": h["count"], "sum": h["sum"],
+                "count": count, "sum": total,
                 "min": win[0] if win else 0.0,
                 "max": win[-1] if win else 0.0,
                 "p50": pct(win, 50), "p90": pct(win, 90),
                 "p99": pct(win, 99),
             }
-        return {"counters": dict(self.counters),
-                "gauges": dict(self.gauges),
+        return {"counters": counters,
+                "gauges": gauges,
                 "histograms": hists}
 
     def to_prometheus(self) -> str:
         """Prometheus text exposition format (one ``# TYPE`` line per
         metric family; histogram quantiles as `{quantile="..."}`
         summary series plus `_count`/`_sum`)."""
+        with self._lock:
+            counters = dict(self.counters)
+            gauges = dict(self.gauges)
+            hists = {key: (h["count"], h["sum"], list(h["window"]))
+                     for key, h in self._hists.items()}
         lines: List[str] = []
         fams = set()
 
@@ -348,18 +372,18 @@ class MetricsRegistry:
                 fams.add(fam)
                 lines.append(f"# TYPE {fam} {typ}")
 
-        for key in sorted(self.counters):
+        for key in sorted(counters):
             type_line(key, "counter")
-            lines.append(f"{key} {self.counters[key]:g}")
-        for key in sorted(self.gauges):
+            lines.append(f"{key} {counters[key]:g}")
+        for key in sorted(gauges):
             type_line(key, "gauge")
-            lines.append(f"{key} {self.gauges[key]:g}")
-        for key in sorted(self._hists):
-            h = self._hists[key]
+            lines.append(f"{key} {gauges[key]:g}")
+        for key in sorted(hists):
+            count, total, window = hists[key]
             fam, _, tail = key.partition("{")
             base_labels = ("{" + tail) if tail else ""
             type_line(key, "summary")
-            win = sorted(h["window"])
+            win = sorted(window)
             for q in (0.5, 0.9, 0.99):
                 if base_labels:
                     series = (f"{fam}{base_labels[:-1]},"
@@ -367,8 +391,8 @@ class MetricsRegistry:
                 else:
                     series = f'{fam}{{quantile="{q}"}}'
                 lines.append(f"{series} {pct(win, q * 100):g}")
-            lines.append(f"{fam}_count{base_labels} {h['count']}")
-            lines.append(f"{fam}_sum{base_labels} {h['sum']:g}")
+            lines.append(f"{fam}_count{base_labels} {count}")
+            lines.append(f"{fam}_sum{base_labels} {total:g}")
         return "\n".join(lines) + "\n"
 
 
@@ -409,7 +433,12 @@ class Telemetry:
         self.max_events = int(max_events)
         self.drift_threshold = float(drift_threshold)
         self.events: deque = deque(maxlen=self.max_events)
-        self.metrics = MetricsRegistry()
+        # ONE lock serializes every mutation on this bus — metric
+        # read-modify-writes, ring eviction accounting, drift-stat
+        # accumulation — so replica worker threads (serve/router.py
+        # wall-clock mode) share a Telemetry without losing updates
+        self._lock = threading.Lock()
+        self.metrics = MetricsRegistry(lock=self._lock)
         self.dropped_events = 0
         self._drift: Dict[Tuple[str, str], _DriftStat] = {}
         self.drift_regimes_dropped = 0
@@ -434,21 +463,24 @@ class Telemetry:
         """Complete span [t_start, t_end) (perf_counter stamps)."""
         if not self.enabled:
             return
-        if len(self.events) == self.max_events:
-            self.dropped_events += 1
-        self.events.append(("X", track, name, self._rel(t_start),
-                            max(0.0, t_end - t_start), None, args))
+        with self._lock:
+            if len(self.events) == self.max_events:
+                self.dropped_events += 1
+            self.events.append(("X", track, name, self._rel(t_start),
+                                max(0.0, t_end - t_start), None, args))
 
     def instant(self, track: Tuple[str, str], name: str,
                 t: Optional[float] = None,
                 args: Optional[dict] = None) -> None:
         if not self.enabled:
             return
-        if len(self.events) == self.max_events:
-            self.dropped_events += 1
-        self.events.append(("i", track, name,
-                            self.now() if t is None else self._rel(t),
-                            0.0, None, args))
+        with self._lock:
+            if len(self.events) == self.max_events:
+                self.dropped_events += 1
+            self.events.append(
+                ("i", track, name,
+                 self.now() if t is None else self._rel(t),
+                 0.0, None, args))
 
     def async_span(self, track: Tuple[str, str], name: str, ident,
                    t_start: float, t_end: float,
@@ -458,15 +490,16 @@ class Telemetry:
         waiting requests)."""
         if not self.enabled:
             return
-        n = len(self.events)
-        if n >= self.max_events:        # both appends evict
-            self.dropped_events += 2
-        elif n == self.max_events - 1:  # the second append evicts
-            self.dropped_events += 1
-        self.events.append(("b", track, name, self._rel(t_start), 0.0,
-                            ident, args))
-        self.events.append(("e", track, name, self._rel(t_end), 0.0,
-                            ident, None))
+        with self._lock:
+            n = len(self.events)
+            if n >= self.max_events:        # both appends evict
+                self.dropped_events += 2
+            elif n == self.max_events - 1:  # the second append evicts
+                self.dropped_events += 1
+            self.events.append(("b", track, name, self._rel(t_start),
+                                0.0, ident, args))
+            self.events.append(("e", track, name, self._rel(t_end),
+                                0.0, ident, None))
 
     def counter(self, track: Tuple[str, str], name: str, value: float,
                 t: Optional[float] = None) -> None:
@@ -474,11 +507,13 @@ class Telemetry:
         line — pool occupancy, degradation rung)."""
         if not self.enabled:
             return
-        if len(self.events) == self.max_events:
-            self.dropped_events += 1
-        self.events.append(("C", track, name,
-                            self.now() if t is None else self._rel(t),
-                            float(value), None, None))
+        with self._lock:
+            if len(self.events) == self.max_events:
+                self.dropped_events += 1
+            self.events.append(
+                ("C", track, name,
+                 self.now() if t is None else self._rel(t),
+                 float(value), None, None))
 
     def emit(self, events: Iterable[tuple]) -> None:
         """Bulk raw-event append — the per-step hot path of
@@ -495,10 +530,11 @@ class Telemetry:
         t0 = self._t0
         evs = [(ph, tr, nm, ts - t0, d, i, a)
                for ph, tr, nm, ts, d, i, a in events]
-        over = len(self.events) + len(evs) - self.max_events
-        if over > 0:
-            self.dropped_events += over
-        self.events.extend(evs)
+        with self._lock:
+            over = len(self.events) + len(evs) - self.max_events
+            if over > 0:
+                self.dropped_events += over
+            self.events.extend(evs)
 
     @contextlib.contextmanager
     def timed(self, track: Tuple[str, str], name: str,
@@ -527,21 +563,22 @@ class Telemetry:
         if not self.enabled:
             return
         key = (str(domain), str(regime))
-        st = self._drift.get(key)
-        if st is None:
-            if len(self._drift) >= self.MAX_DRIFT_REGIMES:
-                self.drift_regimes_dropped += 1
-                return
-            st = self._drift[key] = _DriftStat()
-        st.predicted_s += float(predicted_s)
-        st.measured_s += float(measured_s)
-        st.count += 1
-        if breakdown:
-            if st.breakdown is None:
-                st.breakdown = {}
-            b = st.breakdown
-            for cls, v in breakdown.items():
-                b[cls] = b.get(cls, 0.0) + float(v)
+        with self._lock:
+            st = self._drift.get(key)
+            if st is None:
+                if len(self._drift) >= self.MAX_DRIFT_REGIMES:
+                    self.drift_regimes_dropped += 1
+                    return
+                st = self._drift[key] = _DriftStat()
+            st.predicted_s += float(predicted_s)
+            st.measured_s += float(measured_s)
+            st.count += 1
+            if breakdown:
+                if st.breakdown is None:
+                    st.breakdown = {}
+                b = st.breakdown
+                for cls, v in breakdown.items():
+                    b[cls] = b.get(cls, 0.0) + float(v)
 
     def drift_snapshot(self, threshold: Optional[float] = None) -> dict:
         """Per-regime predicted/measured accounting:
@@ -554,7 +591,9 @@ class Telemetry:
         thr = self.drift_threshold if threshold is None else float(
             threshold)
         out: Dict[str, dict] = {}
-        for (domain, regime), st in self._drift.items():
+        with self._lock:
+            drift = dict(self._drift)
+        for (domain, regime), st in drift.items():
             pred = st.predicted_s / st.count if st.count else 0.0
             meas = st.measured_s / st.count if st.count else 0.0
             ratio = (meas / pred) if pred > 0 else 0.0
@@ -587,7 +626,9 @@ class Telemetry:
         "share"). Only regimes recorded WITH a breakdown
         participate."""
         by_domain: Dict[str, list] = {}
-        for (domain, _regime), st in self._drift.items():
+        with self._lock:
+            drift = dict(self._drift)
+        for (domain, _regime), st in drift.items():
             if st.breakdown and st.count:
                 by_domain.setdefault(domain, []).append(st)
         out: Dict[str, dict] = {}
@@ -712,7 +753,9 @@ class Telemetry:
         replica/role recorded each span."""
         out: List[tuple] = []
         open_idents = set()
-        for ev in self.events:
+        with self._lock:
+            evs = list(self.events)
+        for ev in evs:
             ph, _track, name, _ts, _dur, ident, args = ev
             if args is not None and args.get("trace") == trace_id:
                 out.append(ev)
@@ -729,15 +772,18 @@ class Telemetry:
         (:func:`attribute_request`); `t_submit` / `t_finish` are the
         Request's RAW perf_counter stamps — rebased to the trace clock
         here, so the caller never touches the clock epoch."""
+        with self._lock:
+            evs = list(self.events)
         return attribute_request(
-            list(self.events), trace_id,
+            evs, trace_id,
             t_submit=self._rel(t_submit), t_finish=self._rel(t_finish))
 
     def events_tail(self, n: int = 2048) -> List[list]:
         """The last `n` ring events in JSON-ready form (`[ph, [proc,
         thread], name, ts, dur, ident, args]`) — the flight recorder's
         bounded span payload."""
-        evs = list(self.events)
+        with self._lock:
+            evs = list(self.events)
         if n >= 0:
             evs = evs[-n:] if n else []
         return [[ph, list(track), name, ts, dur, ident, args]
@@ -773,7 +819,9 @@ class Telemetry:
         pids: Dict[str, int] = {}
         tids: Dict[Tuple[str, str], int] = {}
         out: List[dict] = []
-        for ph, track, name, ts, dur, ident, args in list(self.events):
+        with self._lock:
+            evs = list(self.events)
+        for ph, track, name, ts, dur, ident, args in evs:
             proc, thread = track
             pid = pids.setdefault(proc, len(pids) + 1)
             tid = tids.setdefault(track, len(tids) + 1)
@@ -820,8 +868,9 @@ class Telemetry:
         return self.metrics.to_prometheus()
 
     def clear(self) -> None:
-        self.events.clear()
-        self.dropped_events = 0
+        with self._lock:
+            self.events.clear()
+            self.dropped_events = 0
 
 
 class MetricsServer:

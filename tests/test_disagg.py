@@ -573,3 +573,98 @@ def test_disagg_transfer_cost_cache_miss_on_dtype_flip(tmp_path):
                             extra=arch_f.signature())
     assert key_q != key_f
     assert cache.get(fp_q, key_f) is None
+
+
+# =======================================================================
+# continuous pipelining + cross-process transport (wall-clock fabric)
+# =======================================================================
+def test_disagg_pipelined_token_identity_and_hook_arities():
+    """generate_pipelined drives BOTH roles' steppable sessions from
+    one event loop (no batch wave barrier) yet stays token-identical
+    to the phased path and the unified engine — only WHEN steps run
+    changes, never what they compute. Both on_step arities work via
+    normalize_on_step; a 2-arg hook is rejected at arming time."""
+    from flexflow_tpu.serve import normalize_on_step
+    rng = np.random.RandomState(11)
+    ff = _lm(pool_pages=64)
+    prompts = _prompts(rng, 8)
+    max_new = [int(x) for x in rng.randint(1, 8, size=8)]
+    temps = [0.8 if i % 3 == 0 else None for i in range(8)]
+    tks = [3 if i % 3 == 0 else None for i in range(8)]
+    uni = ServeEngine(_lm(pool_pages=64))
+    ref = uni.generate(prompts, max_new, temperature=temps,
+                       top_k=tks, sample_seed=5)
+    uni.close()
+    with DisaggCluster(ff, prefill_engines=2, decode_engines=2) as cl:
+        phased = cl.generate(prompts, max_new, temperature=temps,
+                             top_k=tks, sample_seed=5)
+        assert phased == ref
+        assert cl.last_stats["pipelined"] is False
+        steps = []
+        piped = cl.generate_pipelined(
+            prompts, max_new, temperature=temps, top_k=tks,
+            sample_seed=5, on_step=lambda role, w, s: (
+                steps.append((role, w)), cl.check_invariants()))
+        assert piped == ref
+        assert cl.last_stats["pipelined"] is True
+        assert cl.last_stats["handoff"]["handoff_requests"] == 8
+        assert {r for r, _ in steps} == {"prefill", "decode"}
+        # 1-arg hook through the same adapter
+        one = []
+        piped2 = cl.generate_pipelined(prompts, max_new,
+                                       temperature=temps, top_k=tks,
+                                       sample_seed=5,
+                                       on_step=lambda s: one.append(1))
+        assert piped2 == ref and len(one) > 0
+        # max_new == 1 everywhere: pipelined must not submit empty
+        # decode work (prefill emits the only token)
+        assert cl.generate_pipelined(prompts, 1, sample_seed=5) \
+            == cl.generate(prompts, 1, sample_seed=5)
+        cl.check_invariants()
+        for _, eng in cl.engines():
+            assert eng.cache.free_pages == eng.cache_cfg.usable_pages
+    with pytest.raises(TypeError, match="on_step"):
+        normalize_on_step(lambda a, b: None)
+    assert normalize_on_step(None) is None
+
+
+def test_disagg_tcp_transport_token_identity():
+    """--transport tcp: shipments really cross a loopback socket
+    (length-prefixed frames, CRC, synchronous acks) and the cluster
+    stays token-identical to the in-process handoff on BOTH the
+    phased and pipelined paths — including quantized pages with
+    scale rows."""
+    rng = np.random.RandomState(13)
+    prompts = _prompts(rng, 6)
+    max_new = [int(x) for x in rng.randint(2, 7, size=6)]
+    temps = [0.8 if i % 2 == 0 else None for i in range(6)]
+    tks = [3 if i % 2 == 0 else None for i in range(6)]
+    with DisaggCluster(_lm(pool_pages=64)) as cl:
+        ref = cl.generate(prompts, max_new, temperature=temps,
+                          top_k=tks, sample_seed=2)
+        assert cl.last_stats["transport"] == "inproc"
+    ff = _lm(pool_pages=64, serve_transport="tcp")
+    with DisaggCluster(ff) as cl:
+        assert cl._receiver is not None and cl._sender is not None
+        out = cl.generate(prompts, max_new, temperature=temps,
+                          top_k=tks, sample_seed=2)
+        assert out == ref
+        assert cl.last_stats["transport"] == "tcp"
+        frames0 = cl._receiver.stats["frames"]
+        assert frames0 > 0
+        assert cl._receiver.stats["accepted"] == frames0
+        assert cl._receiver.stats["wire_errors"] == 0
+        piped = cl.generate_pipelined(prompts, max_new,
+                                      temperature=temps, top_k=tks,
+                                      sample_seed=2)
+        assert piped == ref
+        assert cl._receiver.stats["frames"] > frames0
+        cl.check_invariants()
+    # quantized pages cross the socket bit-exactly (scale rows ride
+    # in the same frame)
+    with DisaggCluster(_lm("int8", pool_pages=64)) as cl:
+        ref_q = cl.generate(prompts, max_new, sample_seed=2)
+    ffq = _lm("int8", pool_pages=64, serve_transport="tcp")
+    with DisaggCluster(ffq) as cl:
+        assert cl.generate(prompts, max_new, sample_seed=2) == ref_q
+        assert cl._receiver.stats["wire_errors"] == 0

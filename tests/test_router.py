@@ -604,3 +604,119 @@ def test_router_config_flags_and_validation():
     pool = ReplicaPool.from_config(ff)
     assert len(pool.replicas) == 2 and pool.policy == "round_robin"
     pool.close()
+
+
+# =======================================================================
+# wall-clock fabric
+# =======================================================================
+def test_wall_clock_token_identity_both_modes():
+    """The fabric's core contract: the SAME traffic serves
+    token-identically on the virtual clock, the threaded wall clock,
+    and the single-threaded wall baseline — sampling keys on stream
+    ids, never on the clock (cancel-free traffic: abandon points are
+    clock-dependent by design)."""
+    traffic = _traffic(n=14, seed=4, sample_frac=0.3, tenants=2,
+                       cancel_frac=0.0, rate_rps=300.0)
+
+    def toks(res):
+        return {r["stream_id"]: r["tokens"] for r in res["requests"]}
+
+    pool = ReplicaPool(_lm(), 2, policy="affinity")
+    virt = pool.run(traffic, sample_seed=3)
+    assert all(r["outcome"] == "completed" for r in virt["requests"])
+    pool.close()
+
+    pool = ReplicaPool(_lm(), 2, policy="affinity")
+    wall = pool.run(traffic, sample_seed=3, wall_clock=True,
+                    time_scale=0.2, dwell_s=0.002)
+    assert toks(wall) == toks(virt)
+    assert wall["clock"] == "wall" and wall["wall_threads"]
+    # one coherent clock: every record's stamps are ordered and the
+    # makespan covers them (satellite: no wall/virtual mixing)
+    for rec in wall["requests"]:
+        assert rec["t_arrival"] <= rec["t_finish"] \
+            <= wall["makespan_s"] + 1e-9
+        if rec["ttft_s"] is not None:
+            assert rec["ttft_s"] >= 0.0
+    # wall runs label their OWN histogram series; the virtual series
+    # stays untouched on this pool
+    assert pool.metrics.hist_count(
+        "serve_router_ttft_wall_seconds") > 0
+    assert pool.metrics.hist_count(
+        "serve_router_ttft_virtual_seconds") == 0
+    assert any(p["busy_wall_s"] > 0 for p in wall["per_replica"])
+    pool.assert_zero_recompiles()
+    pool.check_drained()
+    # the same pool replays VIRTUAL after a wall run, identically
+    virt2 = pool.run(traffic, sample_seed=3)
+    assert toks(virt2) == toks(virt)
+    pool.close()
+
+    pool = ReplicaPool(_lm(), 2, policy="affinity")
+    single = pool.run(traffic, sample_seed=3, wall_clock=True,
+                      wall_threads=False, time_scale=0.2,
+                      dwell_s=0.002)
+    assert toks(single) == toks(virt)
+    assert single["clock"] == "wall" and not single["wall_threads"]
+    pool.close()
+
+
+def test_wall_clock_attribution_sums_to_measured_latency():
+    """Satellite bugfix gate: explain_request must still sum exactly
+    to measured latency when the run is wall-clock — every span and
+    the request stamps live on ONE clock (time.perf_counter)."""
+    from flexflow_tpu.utils.telemetry import REQUEST_COMPONENTS
+    tel = Telemetry()
+    pool = ReplicaPool(_lm(), 2, policy="affinity", telemetry=tel)
+    traffic = _traffic(n=10, seed=6, cancel_frac=0.0,
+                       rate_rps=300.0)
+    res = pool.run(traffic, sample_seed=1, wall_clock=True,
+                   time_scale=0.2, dwell_s=0.002)
+    att = res["attribution"]
+    assert set(att) == set(REQUEST_COMPONENTS)
+    for rec in res["requests"][:4]:
+        b = pool.explain_request(rec["stream_id"])
+        assert b["replica"] == rec["replica"]
+        assert abs(sum(b["components"].values()) - b["latency_s"]) \
+            <= 1e-9 + 0.01 * b["latency_s"]
+    pool.close()
+
+
+def test_wall_clock_refuses_autoscaler_and_reads_config():
+    traffic = _traffic(n=4, seed=0, cancel_frac=0.0)
+    pool = ReplicaPool(_lm(), 2)
+    price = pool.price_probe(64)
+    with pytest.raises(ValueError, match="virtual clock"):
+        pool.run(traffic, wall_clock=True,
+                 autoscaler=_scaler(pool, price))
+    pool.close()
+    # --wall-clock dispatches run() to the wall loop via config
+    ff = _lm(serve_wall_clock=True)
+    pool = ReplicaPool(ff, 2)
+    res = pool.run(traffic, sample_seed=0, time_scale=0.1)
+    assert res["clock"] == "wall"
+    pool.close()
+    cfg = FFConfig(batch_size=1, argv=["--wall-clock", "--transport",
+                                       "tcp", "--transport-port",
+                                       "0"])
+    assert cfg.serve_wall_clock and cfg.serve_transport == "tcp"
+    with pytest.raises(ValueError, match="serve_transport"):
+        FFConfig(batch_size=1, serve_transport="udp")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        FFConfig(batch_size=1, serve_wall_clock=True,
+                 serve_autoscale=True)
+
+
+def test_rescale_arrivals_preserves_identity_fields():
+    from flexflow_tpu.serve import rescale_arrivals
+    traffic = _traffic(n=8, seed=2, cancel_frac=0.2, sample_frac=0.3)
+    fast = rescale_arrivals(traffic, 0.25)
+    assert [t.t_arrival * 0.25 for t in traffic] == \
+        [t.t_arrival for t in fast]
+    assert [(t.stream_id, t.prompt, t.max_new, t.temperature,
+             t.cancel_after_tokens) for t in traffic] == \
+        [(t.stream_id, t.prompt, t.max_new, t.temperature,
+          t.cancel_after_tokens) for t in fast]
+    assert traffic[0] is not fast[0]    # copies, originals untouched
+    with pytest.raises(ValueError, match="scale"):
+        rescale_arrivals(traffic, 0.0)

@@ -81,6 +81,63 @@ def test_metrics_registry_semantics():
     assert snap["histograms"]["h_seconds"]["p99"] == pct(win, 99)
 
 
+def test_metrics_thread_safety_hammer():
+    """The wall-clock fabric's contract: counter/gauge/histogram
+    mutation and ring-buffer emission are lock-guarded — N threads
+    hammering the SAME telemetry bus lose no counts, and concurrent
+    snapshot/scrape reads never see a mid-iteration mutation."""
+    import threading
+
+    tel = Telemetry(max_events=256)
+    m = tel.metrics
+    n_threads, n_iter = 8, 400
+    stop = threading.Event()
+    read_errs = []
+
+    def reader():
+        # concurrent scrapes (the MetricsServer's live behavior):
+        # any "dict changed size during iteration" lands here
+        while not stop.is_set():
+            try:
+                m.snapshot()
+                m.to_prometheus()
+                tel.drift_snapshot()
+            except Exception as e:
+                read_errs.append(e)
+                return
+
+    def writer(t):
+        for i in range(n_iter):
+            m.inc("hammer_total")
+            m.inc("hammer_total", 2, thread=str(t))
+            m.set("hammer_gauge", float(i), thread=str(t))
+            m.observe("hammer_seconds", i / n_iter)
+            tel.span(("p", f"t{t}"), "s", 0.0, 1.0)
+            tel.record_drift("hammer", "r", 1.0, 1.0 + i % 3)
+
+    rt = threading.Thread(target=reader, daemon=True)
+    rt.start()
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    stop.set()
+    rt.join(timeout=10.0)
+    assert not read_errs, read_errs
+    # no lost counts, anywhere
+    assert m.counter("hammer_total") == n_threads * n_iter
+    for t in range(n_threads):
+        assert m.counter("hammer_total", thread=str(t)) == 2 * n_iter
+    assert m.hist_count("hammer_seconds") == n_threads * n_iter
+    # ring stayed bounded, and drops were accounted exactly
+    assert len(tel.events) == 256
+    assert tel.dropped_events == n_threads * n_iter - 256
+    d = tel.drift_snapshot()["hammer"]["r"]
+    assert d["count"] == n_threads * n_iter
+
+
 def test_prometheus_text_parses():
     import re
     m = MetricsRegistry()

@@ -1,0 +1,249 @@
+"""Cross-process PageShipment transport (serve/transport.py).
+
+Layers:
+  * wire — dumps_shipment/loads_shipment round-trips bit-exactly:
+    every page byte (float32, int8, fp8 storage), scale rows, chain
+    keys, geometry stamp and stream/tenant/trace ids; any malformed
+    frame (truncated, bad magic/version, flipped payload byte, header
+    overrun, trailing bytes) raises ShipmentWireError instead of
+    admitting garbage pages.
+  * socket — ShipmentSender/ShipmentReceiver move frames over a real
+    TCP connection with synchronous acks; the receiver's import_fn is
+    the admission authority (watermark skip and import failure both
+    come back as acks, never as wedged streams).
+  * cluster — a DisaggCluster with --transport tcp serves
+    token-identically to the in-process handoff (asserted in
+    test_disagg.py; here the loopback endpoints are exercised raw).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.serve import (PageShipment, ShipmentReceiver,
+                                ShipmentSender, ShipmentWireError,
+                                dumps_shipment, loads_shipment)
+from flexflow_tpu.serve.transport import (_CRC, _HDR, MAGIC,
+                                          WIRE_VERSION)
+
+# --------------------------------------------------------------- helpers
+_GEOM = dict(layers=2, pages=3, page=4, heads=2, hd=8)
+
+
+def _rows(rng, dtype):
+    g = _GEOM
+    shape = (g["layers"], g["pages"], g["page"], g["heads"], g["hd"])
+    if dtype == "int8":
+        return rng.integers(-128, 128, size=shape).astype(np.int8)
+    if dtype.startswith("float8"):
+        import ml_dtypes
+        return rng.standard_normal(shape).astype(
+            np.dtype(ml_dtypes.float8_e4m3fn))
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def _ship(dtype="float32", *, scales=False, seed=0, stream_id=7,
+          tenant_id=2, trace_id=12345):
+    rng = np.random.default_rng(seed)
+    g = _GEOM
+    scale = None
+    if scales:
+        scale = rng.standard_normal(
+            (g["layers"], g["pages"], g["page"], g["heads"])
+        ).astype(np.float32)
+    return PageShipment(
+        keys=[bytes([i] * 16) for i in range(g["pages"])],
+        ntokens=g["pages"] * g["page"] - 1,
+        k_rows=_rows(rng, dtype), v_rows=_rows(rng, dtype),
+        k_scale_rows=scale,
+        v_scale_rows=None if scale is None else scale * 2.0,
+        page_size=g["page"], num_layers=g["layers"],
+        num_heads=g["heads"], head_dim=g["hd"], kv_dtype=dtype,
+        stream_id=stream_id, tenant_id=tenant_id, trace_id=trace_id)
+
+
+def _bits(a):
+    """Bit-exact comparison view (NaN-safe for fp8/float payloads)."""
+    return np.asarray(a).view(np.uint8)
+
+
+def _assert_identical(a: PageShipment, b: PageShipment) -> None:
+    assert b.keys == a.keys
+    assert b.ntokens == a.ntokens
+    assert b.signature() == a.signature()
+    assert (b.stream_id, b.tenant_id, b.trace_id) == \
+        (a.stream_id, a.tenant_id, a.trace_id)
+    assert b.k_rows.dtype == a.k_rows.dtype
+    assert b.k_rows.shape == a.k_rows.shape
+    assert np.array_equal(_bits(b.k_rows), _bits(a.k_rows))
+    assert np.array_equal(_bits(b.v_rows), _bits(a.v_rows))
+    for name in ("k_scale_rows", "v_scale_rows"):
+        sa, sb = getattr(a, name), getattr(b, name)
+        assert (sa is None) == (sb is None)
+        if sa is not None:
+            assert sb.dtype == sa.dtype
+            assert np.array_equal(_bits(sb), _bits(sa))
+
+
+# =======================================================================
+# wire round trip
+# =======================================================================
+@pytest.mark.parametrize("dtype,scales", [
+    ("float32", False),
+    ("int8", True),
+    ("float8_e4m3fn", True),
+])
+def test_wire_round_trip_bit_exact(dtype, scales):
+    ship = _ship(dtype, scales=scales)
+    back = loads_shipment(dumps_shipment(ship))
+    _assert_identical(ship, back)
+    # decoded arrays own writable storage (frombuffer views don't)
+    back.k_rows[0, 0, 0, 0, 0] = back.k_rows[0, 0, 0, 0, 0]
+
+
+def test_wire_none_ids_and_nbytes():
+    ship = _ship(stream_id=None, trace_id=None, tenant_id=0)
+    back = loads_shipment(dumps_shipment(ship))
+    assert back.stream_id is None and back.trace_id is None
+    assert back.nbytes == ship.nbytes
+    assert back.num_pages == ship.num_pages
+
+
+def test_wire_rejects_malformed_frames():
+    frame = bytearray(dumps_shipment(_ship("int8", scales=True)))
+    # truncation at several depths
+    for cut in (0, 3, _HDR.size, _HDR.size + 10, len(frame) - 1):
+        with pytest.raises(ShipmentWireError):
+            loads_shipment(bytes(frame[:cut]))
+    # bad magic
+    bad = bytes(b"XXXX") + bytes(frame[4:])
+    with pytest.raises(ShipmentWireError, match="magic"):
+        loads_shipment(bad)
+    # future version
+    bad = bytearray(frame)
+    bad[4] = WIRE_VERSION + 1
+    with pytest.raises(ShipmentWireError, match="version"):
+        loads_shipment(bytes(bad))
+    # a flipped payload byte must fail the CRC, not import garbage
+    bad = bytearray(frame)
+    bad[len(bad) - _CRC.size - 5] ^= 0x40
+    with pytest.raises(ShipmentWireError, match="CRC"):
+        loads_shipment(bytes(bad))
+    # trailing bytes after the declared envelope
+    with pytest.raises(ShipmentWireError):
+        loads_shipment(bytes(frame) + b"\x00")
+    # sanity: the untouched frame still decodes
+    loads_shipment(bytes(frame))
+
+
+def test_wire_header_must_describe_payload():
+    import json
+    from flexflow_tpu.serve.transport import _LEN
+    frame = dumps_shipment(_ship())
+    _magic, _ver, body_len = _HDR.unpack_from(frame, 0)
+    body = bytearray(frame[_HDR.size:_HDR.size + body_len])
+    (hlen,) = _LEN.unpack_from(bytes(body), 0)
+    header = json.loads(bytes(body[_LEN.size:_LEN.size + hlen]))
+    # declare a wider array than the payload carries
+    header["arrays"]["v_rows"]["shape"][1] += 7
+    hjson = json.dumps(header, separators=(",", ":")).encode()
+    body2 = _LEN.pack(len(hjson)) + hjson \
+        + bytes(body[_LEN.size + hlen:])
+    import zlib
+    frame2 = (_HDR.pack(MAGIC, WIRE_VERSION, len(body2)) + body2
+              + _CRC.pack(zlib.crc32(body2) & 0xFFFFFFFF))
+    with pytest.raises(ShipmentWireError):
+        loads_shipment(frame2)
+
+
+# =======================================================================
+# socket endpoints
+# =======================================================================
+def test_socket_round_trip_and_acks():
+    got = []
+
+    def import_fn(ship):
+        got.append(ship)
+        return {"accepted": True, "pages_written": ship.num_pages}
+
+    with ShipmentReceiver(import_fn) as rx:
+        with ShipmentSender(rx.host, rx.port) as tx:
+            for seed in range(3):
+                ship = _ship("int8", scales=True, seed=seed,
+                             stream_id=seed)
+                ack = tx.send(ship)
+                assert ack["accepted"] is True
+                assert ack["pages_written"] == ship.num_pages
+        assert len(got) == 3
+        for seed, back in enumerate(got):
+            _assert_identical(_ship("int8", scales=True, seed=seed,
+                                    stream_id=seed), back)
+        assert rx.stats["frames"] == 3
+        assert rx.stats["accepted"] == 3
+        assert rx.stats["wire_errors"] == 0
+
+
+def test_socket_receiver_backpressure_and_errors():
+    """The receiver's import_fn is the admission authority: a
+    watermark skip and an import crash BOTH come back as acks — the
+    stream stays usable and nothing imports."""
+    verdicts = iter([
+        {"accepted": False, "pages_written": 0},   # watermark skip
+        RuntimeError("pool exploded"),             # import crash
+        {"accepted": True, "pages_written": 3},
+    ])
+
+    def import_fn(ship):
+        v = next(verdicts)
+        if isinstance(v, Exception):
+            raise v
+        return v
+
+    with ShipmentReceiver(import_fn) as rx:
+        with ShipmentSender(rx.host, rx.port) as tx:
+            a1 = tx.send(_ship())
+            assert a1["accepted"] is False
+            a2 = tx.send(_ship())
+            assert a2["accepted"] is False
+            assert "pool exploded" in a2["error"]
+            a3 = tx.send(_ship())
+            assert a3["accepted"] is True and a3["pages_written"] == 3
+        assert rx.stats["skipped"] == 2 and rx.stats["accepted"] == 1
+
+
+def test_socket_concurrent_senders():
+    """Per-connection receiver threads: N senders shipping in parallel
+    all get correct acks and every frame lands exactly once."""
+    seen = []
+    lock = threading.Lock()
+
+    def import_fn(ship):
+        with lock:
+            seen.append(ship.stream_id)
+        return {"accepted": True, "pages_written": ship.num_pages}
+
+    n = 4
+    with ShipmentReceiver(import_fn) as rx:
+        errs = []
+
+        def one(sid):
+            try:
+                with ShipmentSender(rx.host, rx.port) as tx:
+                    for j in range(5):
+                        ack = tx.send(_ship(seed=sid * 10 + j,
+                                            stream_id=sid))
+                        assert ack["accepted"] is True
+            except Exception as e:   # surface in the main thread
+                errs.append(e)
+
+        threads = [threading.Thread(target=one, args=(sid,))
+                   for sid in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not errs
+        assert sorted(seen) == sorted(
+            [sid for sid in range(n) for _ in range(5)])
+        assert rx.stats["frames"] == n * 5

@@ -184,6 +184,21 @@ echo "--- 1p. multi-tenant LoRA smoke (batched-pool goodput + exactness gate)"
 env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload lora \
     -o /tmp/ci_bench_serve_lora.json || fail=1
 
+echo "--- 1q. wall-clock fabric smoke (wall==virtual identity + concurrency gate)"
+# the wall-clock twin of the serving tier: the same seeded traffic on
+# the virtual clock vs the threaded and single-threaded wall clock —
+# fails unless all three arms are token-identical at one seed
+# (sampling keys on stream ids, never on the clock), the threaded
+# wall goodput-under-SLO is >= 1.3x the single-threaded baseline
+# (per-step device dwell overlapping across replica worker threads),
+# and the disaggregated cluster's continuous-pipelined and
+# --transport tcp (loopback socket PageShipment frames) arms match
+# the phased in-process handoff token-for-token
+# (tools/serve_bench.py --workload fabric, docs/serving.md
+# "Wall-clock mode")
+env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload fabric \
+    -o /tmp/ci_bench_serve_fabric.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \

@@ -179,6 +179,8 @@ def serve_line() -> str:
              "{v:.1f}x routed goodput-under-SLO vs round-robin"),
             ("serve_lora_goodput_gain",
              "{v:.1f}x batched-LoRA goodput vs weight swap"),
+            ("serve_fabric_wall_goodput_gain",
+             "{v:.1f}x threaded wall-clock goodput (wall==virtual)"),
         )
         for key, fmt in pieces:
             r = recs.get(key)

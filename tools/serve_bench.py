@@ -62,6 +62,7 @@ the Pallas kernels. Usage:
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -116,7 +117,8 @@ def main() -> int:
                     ">= 1.5x decode step reduction (spec)")
     ap.add_argument("--workload",
                     choices=("all", "base", "spec", "kv", "shard",
-                             "telemetry", "disagg", "router", "lora"),
+                             "telemetry", "disagg", "router", "lora",
+                             "fabric"),
                     default="all",
                     help="base = random + shared-prefix (ci.sh 1d), "
                     "spec = repetitive speculative decode (ci.sh 1f), "
@@ -142,7 +144,14 @@ def main() -> int:
                     "sequential per-tenant weight-swap server on a "
                     "Zipf tenant mix, gating >= 1.5x goodput (mixed "
                     "steps) + token exactness vs the merged-weight "
-                    "references + zero recompiles (ci.sh 1p)")
+                    "references + zero recompiles (ci.sh 1p), "
+                    "fabric = wall-clock serving fabric: the same "
+                    "seeded traffic on the virtual clock vs the "
+                    "threaded and single-threaded wall clock, gating "
+                    "token identity across all arms + >= 1.3x "
+                    "threaded/single wall goodput, plus disagg "
+                    "pipelined + --transport tcp token identity "
+                    "(ci.sh 1q)")
     ap.add_argument("--trace-out", default="",
                     help="write the telemetry workload's Chrome "
                     "trace-event JSON here (Perfetto-loadable; default "
@@ -1264,6 +1273,195 @@ def main() -> int:
         })
         pool_aff.close()
         pool_rr.close()
+
+    if args.workload in ("all", "fabric"):
+        # ---- workload 9: wall-clock concurrent serving fabric
+        # (tools/ci.sh step 1q, docs/serving.md "Wall-clock mode").
+        # The SAME seeded, cancel-free traffic stream serves three
+        # times on a 2-replica pool: on the virtual clock (the
+        # deterministic authority every other workload gates on), on
+        # the threaded wall clock (each replica stepping its session
+        # on its own worker thread), and on the single-threaded wall
+        # baseline. Sampling keys on stream ids, never on the clock,
+        # so all three arms must be TOKEN-IDENTICAL — the property
+        # that makes the wall twin debuggable by virtual replay.
+        # Goodput-under-SLO becomes a measured wall number; the
+        # threaded arm must clear >= 1.3x the single-threaded one
+        # (per-step device dwell overlaps across replicas — on a
+        # 1-core CI host `dwell_s` models the device time a real
+        # accelerator spends off-host, which is exactly the time
+        # threading overlaps). The disaggregated cluster rides along:
+        # continuous pipelined generation and the --transport tcp
+        # loopback socket must both match the phased in-process
+        # handoff token-for-token.
+        from flexflow_tpu.serve import DisaggCluster
+        from flexflow_tpu.serve.router import ReplicaPool
+        from flexflow_tpu.serve.traffic import TrafficSpec, make_traffic
+
+        f_ps = 8
+        f_cfg = FFConfig(
+            batch_size=1, kv_page_size=f_ps, kv_num_pages=1 + 40,
+            serve_max_seqs=4, serve_prefill_budget=2 * f_ps,
+            serve_spec_decode=False)
+        f_ff = build_transformer_lm(
+            f_cfg, vocab_size=args.vocab, max_seq_len=128,
+            hidden=args.hidden, num_heads=args.heads,
+            num_layers=args.layers, ff_dim=4 * args.hidden)
+        f_reqs = max(24, args.requests)
+        f_replicas = 2
+        f_dwell = 0.008           # per-step wall floor (device dwell)
+        f_scale = 0.1             # arrival compression: load-bound
+
+        pool_v = ReplicaPool(f_ff, f_replicas, policy="affinity")
+        price = pool_v.price_probe(64)
+        fspec = TrafficSpec(
+            requests=f_reqs, seed=args.seed + 3, arrival="poisson",
+            rate_rps=0.3 / price, tenants=4, prefix_tokens=24,
+            tail_mean=5.0, output_mean=6.0, max_prompt=64,
+            max_new_cap=8, cancel_frac=0.0, sample_frac=0.25,
+            top_k=4, vocab=args.vocab)
+        ftraffic = make_traffic(fspec)
+        step_wall = f_dwell + price        # one dispatched wall step
+        f_ttft = 40.0 * step_wall
+        f_tpot = 6.0 * step_wall
+
+        def _toks(res):
+            return {r["stream_id"]: r["tokens"]
+                    for r in res["requests"]}
+
+        res_v = pool_v.run(ftraffic, slo_ttft_s=6.0 * price,
+                           slo_tpot_s=2.0 * price,
+                           sample_seed=args.seed)
+        pool_v.assert_zero_recompiles()
+        pool_v.check_drained()
+        pool_v.close()
+
+        pool_t = ReplicaPool(f_ff, f_replicas, policy="affinity")
+        res_t = pool_t.run(ftraffic, slo_ttft_s=f_ttft,
+                           slo_tpot_s=f_tpot, sample_seed=args.seed,
+                           wall_clock=True, wall_threads=True,
+                           time_scale=f_scale, dwell_s=f_dwell)
+        pool_t.assert_zero_recompiles()
+        pool_t.check_drained()
+        pool_t.close()
+
+        pool_s = ReplicaPool(f_ff, f_replicas, policy="affinity")
+        res_s = pool_s.run(ftraffic, slo_ttft_s=f_ttft,
+                           slo_tpot_s=f_tpot, sample_seed=args.seed,
+                           wall_clock=True, wall_threads=False,
+                           time_scale=f_scale, dwell_s=f_dwell)
+        pool_s.assert_zero_recompiles()
+        pool_s.check_drained()
+        pool_s.close()
+
+        # THE identity gate: wall == virtual, token for token, at one
+        # seed — threaded interleaving and wall pacing change when
+        # steps run, never what they compute
+        assert _toks(res_t) == _toks(res_v), (
+            "threaded wall-clock run diverged from the virtual-clock "
+            "replay of the same traffic")
+        assert _toks(res_s) == _toks(res_v), (
+            "single-threaded wall-clock run diverged from the "
+            "virtual-clock replay")
+        assert res_t["clock"] == "wall" and res_t["wall_threads"]
+        assert res_s["clock"] == "wall" and not res_s["wall_threads"]
+
+        wall_gain = (res_t["goodput_per_s"]
+                     / max(res_s["goodput_per_s"], 1e-12))
+        if wall_gain < 1.3:
+            msg = (f"threaded wall goodput only {wall_gain:.2f}x the "
+                   f"single-threaded baseline (want >= 1.3x)")
+            assert not args.smoke, msg
+            print(f"WARNING: {msg}", file=sys.stderr)
+
+        # ---- disagg: continuous pipelining + cross-process shipment
+        d_cfg = FFConfig(
+            batch_size=1, kv_page_size=f_ps, kv_num_pages=1 + 64,
+            serve_max_seqs=4, serve_prefill_budget=4 * f_ps,
+            serve_spec_decode=False)
+        d_ff = build_transformer_lm(
+            d_cfg, vocab_size=args.vocab, max_seq_len=128,
+            hidden=args.hidden, num_heads=args.heads,
+            num_layers=args.layers, ff_dim=4 * args.hidden)
+        d_prompts = [list(rng.randint(1, args.vocab,
+                                      size=rng.randint(8, 41)))
+                     for _ in range(6)]
+        d_new = [int(x) for x in rng.randint(2, 7, size=6)]
+        d_temps = [0.8 if i % 2 == 0 else None for i in range(6)]
+        d_tks = [4 if i % 2 == 0 else None for i in range(6)]
+        with DisaggCluster(d_ff) as d_cl:
+            d_ref = d_cl.generate(d_prompts, d_new,
+                                  temperature=d_temps, top_k=d_tks,
+                                  sample_seed=args.seed)
+            d_piped = d_cl.generate_pipelined(
+                d_prompts, d_new, temperature=d_temps, top_k=d_tks,
+                sample_seed=args.seed)
+            assert d_piped == d_ref, (
+                "pipelined disagg diverged from the phased path")
+        d_ff_tcp = build_transformer_lm(
+            dataclasses.replace(d_cfg, serve_transport="tcp"),
+            vocab_size=args.vocab, max_seq_len=128,
+            hidden=args.hidden, num_heads=args.heads,
+            num_layers=args.layers, ff_dim=4 * args.hidden)
+        with DisaggCluster(d_ff_tcp) as d_cl:
+            d_tcp = d_cl.generate_pipelined(
+                d_prompts, d_new, temperature=d_temps, top_k=d_tks,
+                sample_seed=args.seed)
+            assert d_tcp == d_ref, (
+                "--transport tcp disagg diverged from the in-process "
+                "handoff")
+            tcp_stats = dict(d_cl._receiver.stats)
+            assert tcp_stats["wire_errors"] == 0
+            assert tcp_stats["accepted"] > 0
+
+        gates.append(
+            f"fabric_wall_goodput_gain={wall_gain:.2f}x (thr "
+            f"{res_t['goodput_per_s']:.1f}/s vs sgl "
+            f"{res_s['goodput_per_s']:.1f}/s), wall==virtual, "
+            f"pipelined+tcp==inproc")
+
+        records.append({
+            "metric": "serve_fabric_wall_goodput_gain",
+            "value": round(wall_gain, 2),
+            "unit": "x",
+            "extra": {
+                "platform": jax.default_backend(),
+                "requests": f_reqs,
+                "replicas": f_replicas,
+                "dwell_ms": round(f_dwell * 1e3, 3),
+                "time_scale": f_scale,
+                "priced_step_ms": round(price * 1e3, 6),
+                "wall_slo_ttft_ms": round(f_ttft * 1e3, 3),
+                "wall_slo_tpot_ms": round(f_tpot * 1e3, 3),
+                "goodput_wall_threaded_per_s": round(
+                    res_t["goodput_per_s"], 2),
+                "goodput_wall_single_per_s": round(
+                    res_s["goodput_per_s"], 2),
+                "goodput_virtual_per_s": round(
+                    res_v["goodput_per_s"], 2),
+                "slo_attainment_wall_threaded": round(
+                    res_t["slo_attainment"], 4),
+                "slo_attainment_wall_single": round(
+                    res_s["slo_attainment"], 4),
+                "wall_makespan_ms_threaded": round(
+                    res_t["makespan_s"] * 1e3, 1),
+                "wall_makespan_ms_single": round(
+                    res_s["makespan_s"] * 1e3, 1),
+                "busy_wall_s_threaded": [
+                    round(p["busy_wall_s"], 4)
+                    for p in res_t["per_replica"]],
+                "sampled_requests": sum(
+                    1 for t in ftraffic if t.sampled),
+                "wall_matches_virtual": True,
+                "pipelined_matches_phased": True,
+                "tcp_matches_inproc": True,
+                "tcp_frames": tcp_stats["frames"],
+                "tcp_accepted": tcp_stats["accepted"],
+                "tcp_wire_errors": tcp_stats["wire_errors"],
+                "zero_recompiles": True,
+                "pages_reclaimed": True,
+            },
+        })
 
     if args.workload in ("all", "telemetry"):
         # ---- workload 6: telemetry on/off A/B (tools/ci.sh step 1k).
