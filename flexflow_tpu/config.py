@@ -365,6 +365,16 @@ class FFConfig:
     # rungs) automatically sees the larger effective pool. 0 = use
     # kv_num_pages directly. --kv-pool-mb.
     kv_pool_mb: float = 0.0
+    # hierarchical prefix-cache tier (serve/host_tier.py): byte budget
+    # of the host-RAM page store below the HBM pool. When > 0 (and
+    # serve_host_tier is on), LRU pages evicted under pressure spill
+    # their bytes to host memory instead of being discarded, and a
+    # later prefix match re-imports them when the priced DMA time
+    # (TPUMachineModel.host_transfer) beats recompute. A ReplicaPool
+    # shares ONE store across replicas. 0 = tier unarmed.
+    # --host-tier-mb / --no-host-tier.
+    host_tier_mb: float = 0.0
+    serve_host_tier: bool = True
     # ragged-attention kv-block shape (kernels/paged_ragged_v2.py): KV
     # tokens each flattened (lane, kv-block) work item covers (rounded
     # to whole pages). 0 = the autotune-by-shape table
@@ -596,6 +606,10 @@ class FFConfig:
             raise ValueError(
                 f"kv_pool_mb must be >= 0 (0 = size by kv_num_pages), "
                 f"got {self.kv_pool_mb}")
+        if self.host_tier_mb < 0:
+            raise ValueError(
+                f"host_tier_mb must be >= 0 (0 = host tier unarmed), "
+                f"got {self.host_tier_mb}")
         if self.serve_attn_block_kv < 0:
             raise ValueError(
                 f"serve_attn_block_kv must be >= 0 (0 = autotune), "
@@ -783,6 +797,7 @@ class FFConfig:
         "--kv-num-pages": ("kv_num_pages", int),
         "--kv-dtype": ("kv_dtype", str),
         "--kv-pool-mb": ("kv_pool_mb", float),
+        "--host-tier-mb": ("host_tier_mb", float),
         "--serve-attn-block-kv": ("serve_attn_block_kv", int),
         "--serve-max-seqs": ("serve_max_seqs", int),
         "--serve-prefill-budget": ("serve_prefill_budget", int),
@@ -849,6 +864,7 @@ class FFConfig:
         "--no-cost-cache": "search_cost_cache",
         "--no-chunked-prefill": "serve_chunked_prefill",
         "--no-prefix-cache": "serve_prefix_cache",
+        "--no-host-tier": "serve_host_tier",
         "--no-spec-decode": "serve_spec_decode",
         "--no-degrade-ladder": "serve_degrade_ladder",
         "--no-search-trace": "search_trace",

@@ -177,7 +177,7 @@ class ServeEngine:
                  drafter=None, faults: Optional[FaultInjector] = None,
                  mesh=None, tensor_parallel: Optional[int] = None,
                  telemetry: Optional[Telemetry] = None,
-                 config=None):
+                 host_tier=None, config=None):
         if model.state is None:
             model.compile(comp_mode=CompMode.INFERENCE)
         self.model = model
@@ -334,6 +334,31 @@ class ServeEngine:
         # pays off if committed pages outlive the batch that wrote them
         self.cache = PagedKVCache(self.cache_cfg,
                                   prefix_cache=self.prefix_cache)
+        # hierarchical prefix-cache tier (serve/host_tier.py): a
+        # byte-budgeted host-RAM store below the HBM page pool. An
+        # explicit `host_tier` (the ReplicaPool's SHARED store) wins;
+        # else --host-tier-mb arms a private one. Needs the prefix
+        # cache (a spilled page is reachable only through its chain
+        # key). Eviction then QUEUES spills the session drains through
+        # the fixed-shape export gather, and admission re-imports
+        # priced host hits through the import scatter — zero new
+        # compiles either way (warmup warms both programs).
+        self.host_tier = None
+        if self.prefix_cache and bool(
+                getattr(cfg, "serve_host_tier", True)):
+            if host_tier is not None:
+                self.host_tier = host_tier
+            elif float(getattr(cfg, "host_tier_mb", 0.0) or 0.0) > 0:
+                from .host_tier import HostPageStore
+                self.host_tier = HostPageStore(float(cfg.host_tier_mb))
+        self.cache.host_tier = self.host_tier
+        self._host_mm = None      # lazy machine model for DMA pricing
+        self._host_reload_s = 0.0  # priced DMA seconds, pending step
+        self._host_reload_stats = {"reload_events": 0,
+                                   "reload_pages": 0,
+                                   "spilled_pages": 0,
+                                   "recompute_chosen": 0,
+                                   "reload_priced_s": 0.0}
         self._k_pages = None
         self._v_pages = None
         self._k_scales = None
@@ -1367,6 +1392,148 @@ class ServeEngine:
         self._restash_pools(pools)
         return self.compile_counts()
 
+    # ---------------- hierarchical host tier ---------------------------
+    def _drain_spills(self) -> int:
+        """Ship queued evicted-page content to the host tier through
+        the fixed-shape export gather (the disagg program — zero new
+        compiles). MUST run before any dispatch that writes the device
+        pools: a queued page may already be remapped to a new slot,
+        and its old rows survive only until the next jitted write. The
+        session calls this right before each mixed dispatch; a reload
+        drains before its import scatter for the same reason."""
+        store = self.host_tier
+        if store is None:
+            return 0
+        pending = self.cache.take_pending_spills()
+        if not pending:
+            return 0
+        latest = {}          # a page queued twice keeps its newest key
+        for page, key in pending:
+            latest[page] = key
+        todo = [(p, k) for p, k in latest.items()
+                if not store.contains(k)]
+        if not todo:
+            return 0
+        self._device_pages()
+        c = self.cache_cfg
+        shipped = 0
+        for i in range(0, len(todo), c.pages_per_seq):
+            batch = todo[i:i + c.pages_per_seq]
+            rows = self._call_counted(
+                "export", self._export_jit, self._n_pools,
+                *self._pool_args(),
+                jnp.asarray(self._pad_idx([p for p, _ in batch])))
+            host = [np.asarray(r) for r in rows]
+            for j, (_, key) in enumerate(batch):
+                if store.put(key, [h[:, j] for h in host]):
+                    shipped += 1
+        self._host_reload_stats["spilled_pages"] += shipped
+        if self.telemetry.enabled and shipped:
+            self.telemetry.instant(self._ENGINE_TRACK, "host_spill",
+                                   args={"pages": shipped})
+        return shipped
+
+    def _host_step_price(self, ctx_len: int) -> float:
+        """Predicted seconds of ONE mixed step at this context — the
+        recompute side of the spill-vs-recompute decision, from the
+        same cost stack the drift calibrator prices; the analytic
+        fallback mirrors the router's virtual-clock price."""
+        pred = self._drift_predicted(pow2_bucket(max(1, ctx_len)))
+        if pred is not None:
+            return float(pred[0])
+        return 1e-4 * (1.0 + self.mixed_width / 512.0) \
+            * (1.0 + ctx_len / 2048.0)
+
+    def _host_reload(self, req, keys, cached_pages,
+                     max_pages: int) -> int:
+        """The scheduler's admission hook when the host tier is armed:
+        extend an HBM prefix match with host-resident pages IF the
+        priced DMA beats recomputing those tokens through the prefill
+        roofline (TPUMachineModel.host_transfer vs the cost model's
+        step price — the paper's priced-placement loop applied to the
+        memory hierarchy). Reloaded pages park exactly like a disagg
+        import (hashed, refcount 0), so the scheduler's re-match picks
+        them up; `free_pages` is unchanged (free -> parked), so the
+        admission watermark math the caller already did stays valid.
+        Returns the pages made resident; the decision — either way —
+        is recorded on the request for explain_request."""
+        store, cache = self.host_tier, self.cache
+        resident = len(cached_pages)
+        run = cache.match_prefix_host(keys, resident)
+        if run <= 0:
+            return 0
+        c = self.cache_cfg
+        m = min(run, int(max_pages))
+        decision = {"host_matched_pages": int(run),
+                    "reloaded_pages": 0, "dma_s": 0.0,
+                    "recompute_s": 0.0, "chose": "none"}
+        req.host_reload = decision
+        if m <= 0:
+            return 0
+        if self._host_mm is None:
+            from ..search.machine_model import default_machine_model
+            self._host_mm = default_machine_model(mesh=self.tp_mesh)
+        dma_s = float(self._host_mm.host_transfer(
+            float(m) * float(c.page_bytes)))
+        steps = -(-(m * c.page_size) // max(1, self.prefill_budget))
+        recompute_s = steps * self._host_step_price(len(req.prompt))
+        decision.update(dma_s=dma_s, recompute_s=recompute_s)
+        if dma_s >= recompute_s:
+            decision["chose"] = "recompute"
+            self._host_reload_stats["recompute_chosen"] += 1
+            return 0
+        # protect the HBM-matched refcount-0 run from the import's
+        # eviction cascade (allocation evicts LRU-oldest)
+        cache.touch(cached_pages)
+        t0 = time.perf_counter()
+        # fetch rows FIRST: on the SHARED store another replica's puts
+        # may have evicted part of the matched run since the probe
+        fetched = []
+        for key in keys[resident:resident + m]:
+            rows = store.get(key)
+            if rows is None:
+                break
+            fetched.append(rows)
+        val_shape = (c.num_layers, c.page_size, c.num_heads,
+                     c.head_dim)
+        if not fetched or tuple(fetched[0][0].shape) != val_shape:
+            decision["chose"] = "store_miss"  # raced away / foreign
+            return 0                          # geometry: never scatter
+        todo = cache.import_pages(keys[resident:resident + len(fetched)])
+        if not todo:
+            decision["chose"] = "store_miss"
+            return 0
+        # the allocation above may have queued evictions of its own —
+        # their content must ship before the scatter overwrites it
+        self._drain_spills()
+        self._device_pages()
+        idx = self._pad_idx([page for _, page in todo])
+        rows_dev = []
+        for pool_i in range(self._n_pools):
+            src0 = fetched[0][pool_i]
+            buf = np.zeros((src0.shape[0], c.pages_per_seq)
+                           + src0.shape[1:], src0.dtype)
+            for j, (chain_i, _) in enumerate(todo):
+                buf[:, j] = fetched[chain_i][pool_i]
+            rows_dev.append(jnp.asarray(buf))
+        pools = self._call_counted(
+            "import", self._import_jit, self._n_pools,
+            *self._pool_args(), *rows_dev, jnp.asarray(idx))
+        self._restash_pools(pools)
+        n = len(todo)
+        decision.update(chose="reload", reloaded_pages=n)
+        self._host_reload_stats["reload_events"] += 1
+        self._host_reload_stats["reload_pages"] += n
+        self._host_reload_stats["reload_priced_s"] += dma_s
+        self._host_reload_s += dma_s
+        if self.telemetry.enabled:
+            self.telemetry.span(
+                self._ENGINE_TRACK, "host_reload", t0,
+                time.perf_counter(),
+                args={"trace": req.trace_id, "rid": req.rid,
+                      "pages": n, "dma_s": dma_s})
+        return n
+
     # ---------------- legacy prefill -----------------------------------
     def _prefill_impl(self, params, k_pages, v_pages, tokens, length,
                       pt_row):
@@ -1595,6 +1762,14 @@ class ServeEngine:
                 self._adapter_slabs = self._call_counted(
                     "adapter", self._adapter_load_jit,
                     self._device_adapters(), jnp.int32(0), rows)
+            if self.host_tier is not None:
+                # spill/reload traffic runs the handoff programs —
+                # warm them here or the first eviction under load
+                # would compile after the pool snapshots warm counts.
+                # The import donates (and restashes) the pools: the
+                # locals this method stashes at the end are dead now
+                self.warmup_handoff()
+                kp, vp = self._k_pages, self._v_pages
         else:
             pt_row = jnp.zeros((c.pages_per_seq,), jnp.int32)
             for b in self.buckets:
@@ -1855,7 +2030,8 @@ class ServeEngine:
         content in device arrays an interrupted batch lost (or donation
         consumed), so drop it wholesale, and reallocate the page pools
         lazily when the interrupted dispatch ate them."""
-        self.cache.clear_prefix()
+        self.cache.clear_prefix()   # also drops queued host spills
+        self._host_reload_s = 0.0
         if self._k_pages is not None and \
                 getattr(self._k_pages, "is_deleted", lambda: False)():
             self._k_pages = self._v_pages = None  # realloc on next use
@@ -2020,7 +2196,13 @@ class ServeEngine:
         out = self.telemetry.explain_request(
             req.trace_id, req.t_submit, req.t_finish)
         out.update(rid=req.rid, outcome=req.outcome,
-                   tokens=len(req.out_tokens))
+                   tokens=len(req.out_tokens),
+                   # the admission-time spill-vs-recompute decision
+                   # (None when the host tier never matched this
+                   # request): priced dma_s vs recompute_s and what
+                   # was chosen — next to the host_reload component
+                   # the span fold attributes
+                   host_reload=getattr(req, "host_reload", None))
         return out
 
     def fold_attribution(self, registry=None) -> dict:
@@ -2546,6 +2728,15 @@ class ServeEngine:
                         max(1, self.attn_block_kv // c.page_size)
                     ).items()} if self.chunked_prefill else None,
             },
+            # hierarchical host tier (None unarmed): the shared
+            # store's occupancy + spill/reload/hit counters plus THIS
+            # engine's reload accounting (a ReplicaPool's replicas
+            # report one store, each with its own engine counters)
+            "host_tier": (
+                {**self.host_tier.report(),
+                 **{k: (float(v) if isinstance(v, float) else int(v))
+                    for k, v in self._host_reload_stats.items()}}
+                if self.host_tier is not None else None),
             # multi-tenant adapter pool (None unarmed): slot geometry,
             # residency, and the hit/evict/load/stall counters the
             # tenant-labeled metrics fold reads (serve/adapters.py)
@@ -2761,7 +2952,7 @@ class StepEvents:
     converges)."""
 
     __slots__ = ("dispatched", "step_index", "plan", "emitted",
-                 "finished", "ctx_mean", "wall_s")
+                 "finished", "ctx_mean", "wall_s", "host_reload_s")
 
     def __init__(self, plan=None):
         self.dispatched = False
@@ -2771,6 +2962,10 @@ class StepEvents:
         self.finished: List[Request] = []
         self.ctx_mean = 0
         self.wall_s = 0.0
+        # priced host-tier DMA seconds this step's admissions spent
+        # (the router adds it to the virtual clock; wall mode measures
+        # it inside the step wall time naturally)
+        self.host_reload_s = 0.0
 
 
 class ServeSession:
@@ -2816,7 +3011,9 @@ class ServeSession:
             faults=engine.faults,
             degrade_ladder=engine.degrade_ladder,
             reject_stalls=engine.reject_stalls,
-            adapter_pool=engine.adapters)
+            adapter_pool=engine.adapters,
+            host_reload=(engine._host_reload
+                         if engine.host_tier is not None else None))
         self.reqs: List[Request] = []
         self._on_finish: Dict[int, object] = {}
         self.decode_times: List[float] = []
@@ -2943,6 +3140,9 @@ class ServeSession:
             return None
         plan = sched.schedule()
         ev = StepEvents(plan)
+        # claim the priced host-tier DMA this plan's admissions spent
+        # (carried even on planning-only iterations)
+        ev.host_reload_s, eng._host_reload_s = eng._host_reload_s, 0.0
         if sched.stats["rejected"] > self._rejected_seen:
             # rung-4 structured rejection: the ladder refused service —
             # exactly the state an operator wants black-boxed (one
@@ -3003,6 +3203,9 @@ class ServeSession:
         # land any adapters this plan admitted BEFORE their lanes
         # dispatch — the planning-visible load stall, not a recompile
         eng._drain_adapter_loads()
+        # ship queued evictions to the host tier BEFORE the dispatch
+        # overwrites their pages (the spill-safety window)
+        eng._drain_spills()
         tp = time.perf_counter()
         greedy, topv, topi, _, _ = eng._dispatch_mixed(
             eng._k_pages, eng._v_pages,

@@ -322,6 +322,15 @@ class PagedKVCache:
         # imported page the registry stopped vouching for would be
         # unreachable garbage (check_invariants)
         self._imported: set = set()
+        # hierarchical prefix cache (serve/host_tier.HostPageStore):
+        # when armed, eviction queues (page, key) here instead of
+        # silently dropping the identity; the ENGINE drains the queue —
+        # DMAing the still-resident device rows into the store — before
+        # every dispatch that could overwrite pages (the device pools
+        # only mutate through jitted dispatches, so a queued page's
+        # content stays valid exactly until then)
+        self.host_tier = None
+        self._pending_spills: List[Tuple[int, bytes]] = []
         # serving metrics, merged into ServeEngine.last_stats
         self.stats = {"prefix_hit_pages": 0, "prefix_evictions": 0,
                       "pages_committed": 0, "shared_attaches": 0,
@@ -377,6 +386,14 @@ class PagedKVCache:
             "max_page_ref": int(self._ref.max()) if mapped else 0,
             "kv_dtype": c.kv_dtype,
             "page_size": c.page_size,
+            # eviction order (oldest first, bounded): what rung-2 /
+            # allocation pressure would shed next — the view rung
+            # post-mortems were missing
+            "lru_order": [int(p) for p in list(self._lru)[:64]],
+            "lru_truncated": max(0, len(self._lru) - 64),
+            "pending_spills": len(self._pending_spills),
+            "host_tier": (self.host_tier.debug_state()
+                          if self.host_tier is not None else None),
             "stats": dict(self.stats),
         }
 
@@ -395,6 +412,35 @@ class PagedKVCache:
                 break
             pages.append(p)
         return pages
+
+    def match_prefix_host(self, keys: Sequence[bytes],
+                          resident: int) -> int:
+        """The host-tier fall-through of `match_prefix`: how many keys
+        BEYOND the `resident` HBM-matched run are held by the armed
+        host store (0 when no tier). The pages are NOT reloaded here —
+        the scheduler prices DMA-vs-recompute first and only then asks
+        the engine to re-import (ServeEngine._host_reload)."""
+        if self.host_tier is None or not self.prefix_enabled:
+            return 0
+        return self.host_tier.match_chain(list(keys[resident:]))
+
+    def touch(self, pages: Sequence[int]) -> None:
+        """Refresh parked pages to most-recently-used, so an imminent
+        allocation burst (a host-tier reload's import) cannot evict
+        the very HBM run an admission just matched."""
+        for p in pages:
+            p = int(p)
+            if p in self._lru:
+                self._lru.move_to_end(p)
+
+    def take_pending_spills(self) -> List[Tuple[int, bytes]]:
+        """Claim the queued (page, chain key) spill records, clearing
+        the queue. The engine calls this immediately before any
+        dispatch that writes the device pools and ships each page's
+        rows to the host tier — past that point the queued pages may
+        be overwritten and the records would vouch for garbage."""
+        out, self._pending_spills = self._pending_spills, []
+        return out
 
     def commit_page(self, slot: int, page_idx: int, key: bytes) -> bool:
         """Register a COMPLETED page of `slot` under its content chain
@@ -423,14 +469,31 @@ class PagedKVCache:
         # content — it is just a free/garbage page again
         self._imported.discard(page)
 
+    def _pop_parked(self, *, spill: bool = True) -> int:
+        """Retire the least-recently-parked cached page — the ONE
+        eviction primitive `_take_page` and `shrink_lru` share. Split
+        into two halves: reclaiming CAPACITY (pop from the LRU) and
+        forgetting IDENTITY (unregister the hash) — when `spill` and a
+        host tier is armed, the identity is queued as a pending spill
+        instead of dropped, so the engine can DMA the page's
+        still-resident device rows into the host store before anything
+        overwrites them ("spill instead of discard")."""
+        page, _ = self._lru.popitem(last=False)
+        if spill and self.host_tier is not None:
+            key = self._hash_of_page.get(page)
+            if key is not None:
+                self._pending_spills.append((page, key))
+        self._unregister(page)
+        return page
+
     def _take_page(self) -> int:
         """A writable page: the free list first, then evict the
-        least-recently-parked cached page (dropping its hash)."""
+        least-recently-parked cached page (spilling its identity to
+        the host tier when one is armed, else dropping its hash)."""
         if self._free:
             return self._free.pop()
         if self._lru:
-            page, _ = self._lru.popitem(last=False)
-            self._unregister(page)
+            page = self._pop_parked()
             self.stats["prefix_evictions"] += 1
             return page
         raise RuntimeError(
@@ -451,20 +514,25 @@ class PagedKVCache:
             self._free.append(page)
         for page in list(self._hash_of_page):
             self._unregister(page)
+        # queued spills point at the same stale/consumed device rows —
+        # shipping them to the host tier would vouch for garbage
+        self._pending_spills.clear()
         return n
 
-    def shrink_lru(self, keep: int) -> int:
-        """Evict parked (refcount-0, hashed) pages oldest-first until at
-        most `keep` remain, returning them to the plain free list with
-        their hashes dropped. The degradation ladder's rung-2 action:
-        under page pressure a parked page is a liability — a prefix
-        attach would pin it at refcount > 0 right when admissions need
-        every reclaimable page — so the registry stops vouching for
-        them. Returns the number of pages shed."""
+    def shrink_lru(self, keep: int, *, spill: bool = True) -> int:
+        """Reclaim capacity: evict parked (refcount-0, hashed) pages
+        oldest-first until at most `keep` remain, returning them to the
+        plain free list. The degradation ladder's rung-2 action: under
+        page pressure a parked page is a liability — a prefix attach
+        would pin it at refcount > 0 right when admissions need every
+        reclaimable page. Whether the IDENTITY is also forgotten is the
+        `_pop_parked` split: with a host tier armed (and `spill` left
+        on) rung 2 becomes "spill instead of discard" — the key and
+        content move down a tier instead of being recomputed from
+        tokens later. Returns the number of pages shed."""
         shed = 0
         while len(self._lru) > max(0, int(keep)):
-            page, _ = self._lru.popitem(last=False)
-            self._unregister(page)
+            page = self._pop_parked(spill=spill)
             self._free.append(page)
             shed += 1
         self.stats["lru_shed_pages"] += shed

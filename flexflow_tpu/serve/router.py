@@ -63,6 +63,7 @@ from ..utils.telemetry import (MetricsRegistry, Telemetry, pct,
                                telemetry_for)
 from .adapters import tenant_prefix_salt
 from .engine import ServeEngine, ServeSession, StepEvents
+from .host_tier import HostPageStore
 from .kv_cache import prefix_page_keys
 from .scheduler import Request, RequestOutcome
 from .traffic import TrafficRequest
@@ -315,6 +316,21 @@ class ReplicaPool:
         self.spill_occupancy = float(spill_occupancy)
         self.window_s = float(window_s)
         self._engine_kwargs = dict(engine_kwargs or {})
+        # ONE shared host tier for the whole pool (hierarchical
+        # prefix cache, serve/host_tier.py): every replica spills
+        # into and reloads from the same store, so a tenant's
+        # preamble crosses HBM once per replica instead of once per
+        # request. An explicit engine_kwargs["host_tier"] wins (tests
+        # inject a store); otherwise --host-tier-mb arms it.
+        ht = self._engine_kwargs.get("host_tier")
+        if ht is None \
+                and bool(getattr(cfg, "serve_host_tier", True)) \
+                and float(getattr(cfg, "host_tier_mb", 0.0)
+                          or 0.0) > 0:
+            ht = HostPageStore(float(cfg.host_tier_mb))
+        self.host_tier: Optional[HostPageStore] = ht
+        if ht is not None:
+            self._engine_kwargs["host_tier"] = ht
         # pool-wide adapter registry (tenant -> (weights, scale)):
         # replayed onto every replica — including engines the
         # autoscaler builds later — so any replica can serve any
@@ -337,6 +353,7 @@ class ReplicaPool:
         self._next_eval = 0.0
         self.scale_events: List[dict] = []
         self.stats = {"routed": 0, "affinity_hits": 0,
+                      "host_hits": 0,
                       "adapter_affinity_hits": 0, "spills": 0,
                       "fallbacks": 0, "cancels_sent": 0,
                       "scale_ups": 0, "scale_downs": 0}
@@ -471,7 +488,8 @@ class ReplicaPool:
             if npages else []
         info = {"tenant": int(tenant), "adapted": adapted,
                 "matched_tokens": 0,
-                "affinity_hit": False, "adapter_affinity": False,
+                "affinity_hit": False, "host_hit": False,
+                "adapter_affinity": False,
                 "fallback": False, "spilled": False, "keys": keys}
         if self.policy == "round_robin":
             target = live[self._rr_next % len(live)]
@@ -501,7 +519,23 @@ class ReplicaPool:
             resident = [r for r in live
                         if adapted
                         and r.engine.adapter_resident(tenant)]
-            if resident:
+            # host-tier affinity, the second tier below an HBM hit:
+            # the SHARED store can reload the prefix into ANY
+            # replica (priced DMA vs recompute at admission), so
+            # land on the least-loaded one — preferring a replica
+            # where the tenant's adapter is already resident
+            host_pages = (self.host_tier.probe_chain(keys)
+                          if self.host_tier is not None and keys
+                          else 0)
+            if host_pages > 0:
+                pool = resident if resident else live
+                target = min(pool, key=lambda x: (x.occupancy(),
+                                                  x.queue_depth(),
+                                                  x.idx))
+                info["host_hit"] = True
+                info["adapter_affinity"] = bool(resident)
+                info["matched_tokens"] = host_pages * ps
+            elif resident:
                 target = min(resident, key=lambda x: (x.occupancy(),
                                                       x.queue_depth(),
                                                       x.idx))
@@ -585,6 +619,7 @@ class ReplicaPool:
             "cancel_after": tr.cancel_after_tokens,
             "cancel_sent": False, "sampled": tr.sampled,
             "affinity_hit": info["affinity_hit"],
+            "host_hit": info["host_hit"],
             "adapter_affinity": info["adapter_affinity"],
             "spilled": info["spilled"], "fallback": info["fallback"],
             "matched_tokens": info["matched_tokens"],
@@ -600,6 +635,9 @@ class ReplicaPool:
         if info["affinity_hit"]:
             self.stats["affinity_hits"] += 1
             m.inc("router_affinity_hits_total")
+        if info["host_hit"]:
+            self.stats["host_hits"] += 1
+            m.inc("router_host_hits_total")
         if info["adapter_affinity"]:
             self.stats["adapter_affinity_hits"] += 1
             m.inc("router_adapter_affinity_hits_total")
@@ -675,6 +713,33 @@ class ReplicaPool:
         ev.ctx_mean = int(ctx)
         return self._price(self.replicas[0], ev)
 
+    def _host_tier_block(self) -> Optional[dict]:
+        """The pool-level host-tier block of last_stats: the SHARED
+        store's lifetime report merged with the per-engine reload
+        decision counters summed across replicas (each engine prices
+        its own reloads; the store is one). Also corrects the
+        registry: the per-replica serve_metrics folds counter_set the
+        per-engine reload counters, so the last replica's value would
+        otherwise shadow the rest — re-set the pool-wide sums."""
+        if self.host_tier is None:
+            return None
+        host = dict(self.host_tier.report())
+        for k in ("reload_events", "reload_pages", "spilled_pages",
+                  "recompute_chosen"):
+            host[k] = sum(
+                int(r.engine._host_reload_stats.get(k, 0))
+                for r in self.replicas)
+        host["reload_priced_s"] = sum(
+            float(r.engine._host_reload_stats.get(
+                "reload_priced_s", 0.0))
+            for r in self.replicas)
+        m = self.metrics
+        m.counter_set("serve_host_tier_reload_pages_total",
+                      host["reload_pages"])
+        m.counter_set("serve_host_tier_recompute_chosen_total",
+                      host["recompute_chosen"])
+        return host
+
     # ---------------- the serving loop ---------------------------------
     def _finalize(self, tracked: dict, t_end: float,
                   slo_ttft_s: Optional[float],
@@ -703,6 +768,7 @@ class ReplicaPool:
             "ttft_s": ttft, "tpot_s": tpot, "t_finish": t_end,
             "slo_ok": slo_ok, "sampled": tracked["sampled"],
             "affinity_hit": tracked["affinity_hit"],
+            "host_hit": tracked["host_hit"],
             "adapter_affinity": tracked["adapter_affinity"],
             "spilled": tracked["spilled"],
             "fallback": tracked["fallback"],
@@ -1051,7 +1117,11 @@ class ReplicaPool:
                                          slo_tpot_s)
                     continue
                 r._plan_only = 0
-                price = self._price(r, ev)
+                # the priced host-tier DMA rides the same virtual
+                # clock the step does: a reload is not free, it is
+                # host_transfer seconds the admission already judged
+                # cheaper than recompute (engine._host_reload)
+                price = self._price(r, ev) + ev.host_reload_s
                 r.clock_s += price
                 r.busy_s += price
                 r.steps += 1
@@ -1141,6 +1211,7 @@ class ReplicaPool:
                                 for rec in records),
             "routing": {k: self.stats[k] - stats0[k]
                         for k in self.stats},
+            "host_tier": self._host_tier_block(),
             "scale_events": list(self.scale_events[events0:]),
             "per_replica": [
                 {"replica": r.idx, "live": r.live,
@@ -1447,6 +1518,7 @@ class ReplicaPool:
                                 for rec in records),
             "routing": {k: self.stats[k] - stats0[k]
                         for k in self.stats},
+            "host_tier": self._host_tier_block(),
             "scale_events": list(self.scale_events[events0:]),
             "per_replica": [
                 {"replica": r.idx, "live": r.live,
@@ -1535,6 +1607,8 @@ class ReplicaPool:
             "stats": dict(self.stats),
             "inflight": len(self._inflight),
             "scale_events": list(self.scale_events[-32:]),
+            "host_tier": (self.host_tier.debug_state()
+                          if self.host_tier is not None else None),
         }
         bundle["replicas"] = {
             f"replica{r.idx}": {

@@ -154,6 +154,11 @@ class Request:
     t_admit: float = 0.0
     t_first_token: float = 0.0
     t_finish: float = 0.0
+    # host-tier spill-vs-recompute decision recorded at admission
+    # (ServeEngine._host_reload; explain_request surfaces it) — None
+    # until the armed tier matches this request's prefix
+    host_reload: Optional[dict] = dataclasses.field(default=None,
+                                                    repr=False)
     # preemption stamp for the telemetry requeue_wait span (set at
     # eviction, cleared at re-admission; telemetry-only bookkeeping)
     _t_requeue: Optional[float] = dataclasses.field(default=None,
@@ -271,8 +276,16 @@ class ContinuousBatchingScheduler:
                  faults: Optional[FaultInjector] = None,
                  degrade_ladder: bool = True,
                  reject_stalls: int = 0,
-                 adapter_pool: Optional[AdapterPool] = None):
+                 adapter_pool: Optional[AdapterPool] = None,
+                 host_reload=None):
         self.cache = cache
+        # hierarchical host tier (serve/host_tier.py): the engine's
+        # priced reload hook `host_reload(req, keys, cached_pages,
+        # max_pages) -> pages made resident`. None = no tier; the
+        # scheduler only decides WHEN to ask (rung < 2, HBM match
+        # exhausted, room below the watermark) — the engine prices
+        # DMA-vs-recompute and moves the bytes.
+        self.host_reload = host_reload
         # multi-tenant LoRA pool (serve/adapters.py): admission
         # acquires the tenant's slot (possibly queueing a device load)
         # and finish/abort/preempt release it — the same lifecycle as
@@ -528,6 +541,20 @@ class ContinuousBatchingScheduler:
                 # partial tail page is never shared anyway
                 keys = self._keys_for(req, (ctx_len - 1) // ps)
                 cached_pages = cache.match_prefix(keys)
+                # host-tier fall-through: when the HBM run ends short
+                # of the chain, ask the engine to extend it from the
+                # host store — capped so the import cannot eat the
+                # watermark or the matched run's own reclaimability.
+                # Reloaded pages park hashed/refcount-0, so free_pages
+                # (and the admission math below) is unchanged.
+                if self.host_reload is not None \
+                        and len(cached_pages) < len(keys):
+                    lru0 = sum(1 for p in cached_pages
+                               if cache.ref(p) == 0)
+                    room = eff_free() - lru0 - wm
+                    if room > 0 and self.host_reload(
+                            req, keys, cached_pages, room) > 0:
+                        cached_pages = cache.match_prefix(keys)
                 k = len(cached_pages)
                 while k < len(keys) and keys[k] in pending:
                     cached_pages.append(pending[keys[k]])

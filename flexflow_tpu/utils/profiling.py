@@ -195,6 +195,20 @@ def serve_report(stats: dict) -> str:
             f"(max refs {cc('max_page_refs'):.0f}), "
             f"{cc('prefix_evictions'):.0f} evictions, "
             f"{cc('rollback_pages'):.0f} rolled-back pages")
+    # host tier: hierarchical prefix cache below the HBM pool
+    # (serve/host_tier.py); None / absent when unarmed
+    ht = stats.get("host_tier")
+    if ht:
+        lines.append(
+            f"host tier: {ht.get('pages', 0)} pages / "
+            f"{ht.get('bytes', 0) / 2**20:.2f} of "
+            f"{ht.get('budget_bytes', 0) / 2**20:.2f} MiB "
+            f"({ht.get('occupancy', 0.0):.1%}), "
+            f"{ht.get('spills', 0)} spills, "
+            f"{ht.get('reloads', 0)} reloads "
+            f"({ht.get('reload_pages', 0)} pages re-imported, "
+            f"{ht.get('recompute_chosen', 0)} priced to recompute), "
+            f"{ht.get('evictions', 0)} host evictions")
     # KV pool: storage format + itemsize-derived byte accounting and
     # the quantized-capacity multiplier (serve/kv_cache.pool_report);
     # absent from pre-quantization stats dicts — key-guarded
@@ -346,10 +360,24 @@ def router_report(stats: dict, metrics=None) -> str:
     lines.append(
         f"routing: {r.get('affinity_hits', 0)} affinity hits / "
         f"{r.get('routed', 0)} routed, "
+        f"{r.get('host_hits', 0)} host-tier hits, "
         f"{r.get('adapter_affinity_hits', 0)} adapter-affinity, "
         f"{r.get('fallbacks', 0)} tenant-sticky fallbacks, "
         f"{r.get('spills', 0)} load spills, "
         f"{r.get('cancels_sent', 0)} cancels")
+    # the SHARED host tier (hierarchical prefix cache): one store
+    # for the whole pool, reload decisions summed across replicas
+    ht = stats.get("host_tier")
+    if ht:
+        lines.append(
+            f"host tier (shared): {ht.get('pages', 0)} pages / "
+            f"{ht.get('bytes', 0) / 2**20:.2f} of "
+            f"{ht.get('budget_bytes', 0) / 2**20:.2f} MiB, "
+            f"{ht.get('spills', 0)} spills, "
+            f"{ht.get('reload_pages', 0)} pages re-imported "
+            f"({ht.get('recompute_chosen', 0)} priced to recompute, "
+            f"{ht.get('reload_priced_s', 0.0)*1e3:.2f} ms DMA), "
+            f"{ht.get('evictions', 0)} host evictions")
     if metrics is not None:
         t50 = metrics.quantile(f"serve_router_ttft_{clock}_seconds", 50)
         t99 = metrics.quantile(f"serve_router_ttft_{clock}_seconds", 99)

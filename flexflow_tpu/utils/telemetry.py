@@ -132,19 +132,22 @@ def write_json_atomic(path: str, doc: dict) -> str:
 # ---------------------------------------------------------------------------
 
 REQUEST_COMPONENTS = ("queue", "routing", "prefill", "transfer",
-                      "decode", "preempt_stall", "retry", "other")
+                      "decode", "preempt_stall", "retry",
+                      "host_reload", "other")
 
 # span name -> component for trace-matched spans
 _SPAN_CLASS = {"prefill": "prefill", "decode": "decode",
                "spec_decode": "decode", "kv_handoff": "transfer",
-               "routing": "routing"}
+               "host_reload": "host_reload", "routing": "routing"}
 # overlap priority (highest wins per elementary segment): compute beats
 # the queue-wait span that legitimately overlaps a request's FIRST
-# chunk (t_admit is stamped after the admitting step's dispatch), and
-# retry backoff carves time out of the compute span that covers it
-_CLASS_PRIORITY = {"retry": 7, "decode": 6, "prefill": 5,
-                   "transfer": 4, "preempt_stall": 3, "queue": 2,
-                   "routing": 1}
+# chunk (t_admit is stamped after the admitting step's dispatch), a
+# host-tier page reload (serve/host_tier.py) likewise happens inside
+# the admitting schedule() pass so it must beat queue, and retry
+# backoff carves time out of the compute span that covers it
+_CLASS_PRIORITY = {"retry": 8, "decode": 7, "prefill": 6,
+                   "transfer": 5, "host_reload": 4,
+                   "preempt_stall": 3, "queue": 2, "routing": 1}
 
 
 def attribute_request(events: Iterable[tuple], trace_id,
@@ -1080,6 +1083,22 @@ def serve_metrics(stats: dict,
     for k, v in (stats.get("cache") or {}).items():
         if isinstance(v, (int, float)):
             m.counter_set(f"serve_prefix_cache_{k}_total", v)
+    # host-tier counters/gauges (hierarchical prefix cache,
+    # serve/host_tier.py) — block absent when the tier is unarmed;
+    # the store tracks its own lifetime totals, so counter_set
+    ht = stats.get("host_tier") or {}
+    for k in ("spills", "reloads", "hits", "misses", "evictions"):
+        if k in ht:
+            m.counter_set(f"serve_host_tier_{k}_total", ht[k])
+    if ht:
+        m.set("serve_host_tier_bytes", float(ht.get("bytes", 0)))
+        m.set("serve_host_tier_occupancy",
+              float(ht.get("occupancy", 0.0)))
+        m.set("serve_host_tier_pages", ht.get("pages", 0))
+        m.counter_set("serve_host_tier_reload_pages_total",
+                      ht.get("reload_pages", 0))
+        m.counter_set("serve_host_tier_recompute_chosen_total",
+                      ht.get("recompute_chosen", 0))
     # adapter-pool counters/gauges (multi-tenant LoRA serving,
     # serve/adapters.py) — block absent when the pool is unarmed
     ad = stats.get("adapter_pool") or {}

@@ -1,0 +1,407 @@
+"""Hierarchical prefix-cache tier (serve/host_tier.py + the spill /
+reload wiring; docs/serving.md "Hierarchical prefix cache").
+
+Layered like the subsystem:
+  * store — HostPageStore is a byte-budgeted, geometry-pinned LRU:
+    budget eviction from the cold end, re-put refresh, chain matching
+    stops at the first gap, and the router's probe_chain is PURE
+    (no LRU touch, no stat count).
+  * engine — spill -> evict -> reload churn on f32/int8/fp8 pools is
+    token-identical to an ample-pool reference with
+    check_invariants (and scale audits) at every step, spills and
+    priced reloads actually happen, and the decision lands on the
+    request for explain_request.
+  * config — the --host-tier-mb / --no-host-tier flags arm and
+    disarm the tier; an explicit shared store wins over the config.
+  * router — a ReplicaPool shares ONE store across replicas, a host
+    hit routes below an HBM prefix hit (least-loaded target), and
+    route() never perturbs the store.
+  * telemetry — host_reload is an attribution component; the span
+    class tables stay consistent and the breakdown still sums.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.models.transformer import build_transformer_lm
+from flexflow_tpu.serve import ReplicaPool, ServeEngine
+from flexflow_tpu.serve.host_tier import HostPageStore
+from flexflow_tpu.serve.kv_cache import prefix_page_keys
+from flexflow_tpu.utils.telemetry import (REQUEST_COMPONENTS,
+                                          Telemetry,
+                                          _CLASS_PRIORITY,
+                                          _SPAN_CLASS)
+
+
+# --------------------------------------------------------------- helpers
+def _rows(seed=0, scale=False, shape=(2, 4, 4, 8)):
+    """One page's export rows: (k, v) f32, plus f32 scale rows when
+    `scale` (the quantized-pool layout)."""
+    rng = np.random.RandomState(seed)
+    out = [rng.randn(*shape).astype(np.float32) for _ in range(2)]
+    if scale:
+        out += [rng.randn(*shape[:-1]).astype(np.float32)
+                for _ in range(2)]
+    return tuple(out)
+
+
+def _lm(kv_dtype="float32", *, page_size=4, pool_pages=20, budget=8,
+        max_seqs=2, max_seq_len=64, spec=True, **cfg_kw):
+    cfg = FFConfig(batch_size=1, kv_page_size=page_size,
+                   kv_num_pages=1 + pool_pages, kv_dtype=kv_dtype,
+                   serve_max_seqs=max_seqs,
+                   serve_prefill_budget=budget,
+                   serve_spec_decode=spec, **cfg_kw)
+    return build_transformer_lm(cfg, vocab_size=61,
+                                max_seq_len=max_seq_len, hidden=32,
+                                num_heads=4, num_layers=2, ff_dim=64)
+
+
+def _prompts(rng, n, lo=30, hi=40):
+    return [list(rng.randint(1, 61, size=rng.randint(lo, hi)))
+            for _ in range(n)]
+
+
+# =======================================================================
+# store
+# =======================================================================
+def test_store_budget_lru_eviction():
+    rows = _rows()
+    page_b = sum(r.nbytes for r in rows)
+    store = HostPageStore(3 * page_b / (1 << 20))
+    keys = [bytes([i]) * 8 for i in range(5)]
+    for i, k in enumerate(keys):
+        assert store.put(k, _rows(i))
+    # budget holds 3 pages: the two oldest fell off the cold end
+    assert len(store) == 3 and store.bytes_used == 3 * page_b
+    assert store.stats["evictions"] == 2
+    assert [store.contains(k) for k in keys] == \
+        [False, False, True, True, True]
+    rep = store.report()
+    assert rep["pages"] == 3 and rep["spills"] == 5
+    assert rep["occupancy"] == pytest.approx(
+        store.bytes_used / store.budget_bytes)
+    dbg = store.debug_state(max_keys=2)
+    assert dbg["lru_keys"] == [keys[2].hex()[:16], keys[3].hex()[:16]]
+    assert dbg["lru_truncated"] == 1
+
+
+def test_store_rejects_geometry_drift_and_oversize():
+    store = HostPageStore(1.0)
+    assert store.put(b"a" * 8, _rows())
+    # the first put pinned (shape, dtype); anything else is refused
+    assert not store.put(b"b" * 8, _rows(shape=(2, 8, 4, 8)))
+    assert not store.put(b"c" * 8, tuple(
+        r.astype(np.float16) for r in _rows()))
+    assert store.stats["rejects"] == 2 and len(store) == 1
+    # a single page larger than the whole budget can never be held
+    big = HostPageStore(1e-5)
+    assert not big.put(b"d" * 8, _rows())
+    assert big.stats["rejects"] == 1 and len(big) == 0
+
+
+def test_store_match_chain_stops_at_gap_probe_is_pure():
+    store = HostPageStore(1.0)
+    keys = [bytes([i]) * 8 for i in range(4)]
+    for i, k in enumerate(keys):
+        store.put(k, _rows(i))
+    store.discard([keys[2]])
+    before = dict(store.stats)
+    # probe: longest leading run, NO stat movement, NO LRU touch
+    assert store.probe_chain(keys) == 2
+    assert store.probe_chain([b"x" * 8] + keys) == 0
+    assert dict(store.stats) == before
+    lru_before = store.debug_state()["lru_keys"]
+    store.probe_chain(keys)
+    assert store.debug_state()["lru_keys"] == lru_before
+    # match: same run, but counts hits/misses and refreshes recency
+    assert store.match_chain(keys) == 2
+    assert store.stats["hits"] == before["hits"] + 2
+    assert store.stats["misses"] == before["misses"] + 1
+    assert store.debug_state()["lru_keys"][-1] == keys[1].hex()[:16]
+
+
+def test_store_reput_refreshes_and_discard_is_not_eviction():
+    rows = _rows()
+    page_b = sum(r.nbytes for r in rows)
+    store = HostPageStore(2 * page_b / (1 << 20))
+    store.put(b"a" * 8, _rows(0))
+    store.put(b"b" * 8, _rows(1))
+    # re-putting the old key moves it to MRU without double-counting
+    store.put(b"a" * 8, _rows(2))
+    assert store.bytes_used == 2 * page_b
+    store.put(b"c" * 8, _rows(3))   # evicts "b", the true LRU
+    assert store.contains(b"a" * 8) and not store.contains(b"b" * 8)
+    assert store.discard([b"a" * 8, b"zz"]) == 1
+    assert store.stats["evictions"] == 1   # the "b" budget eviction
+    got = store.get(b"c" * 8)
+    assert all(np.array_equal(a, b) for a, b in zip(got, _rows(3)))
+    assert store.get(b"a" * 8) is None
+
+
+def test_store_budget_must_be_positive():
+    with pytest.raises(ValueError):
+        HostPageStore(0.0)
+    with pytest.raises(ValueError):
+        HostPageStore(-1.0)
+
+
+# =======================================================================
+# engine: spill -> evict -> reload churn
+# =======================================================================
+@pytest.mark.parametrize("kv_dtype",
+                         ["float32", "int8", "float8_e4m3"])
+def test_spill_reload_token_identity_under_churn(kv_dtype):
+    """The acceptance property: alternating working sets over a pool
+    too small to hold both force parked chains through the full
+    spill -> host-evict -> reload cycle, interleaved with preemption
+    (tight pool) and speculation rollback — and every emitted token
+    stays identical to an ample-pool engine that never spills, with
+    pool invariants (and, on quantized pools, the scale-row audit)
+    holding after every step."""
+    rng = np.random.RandomState(3)
+    a, b = _prompts(rng, 2), _prompts(rng, 2)
+
+    ref = ServeEngine(_lm(kv_dtype, pool_pages=64, max_seqs=2,
+                          spec=False, serve_host_tier=False),
+                      spec_tokens=0)
+    ref.warmup()
+
+    eng = ServeEngine(_lm(kv_dtype, pool_pages=20, max_seqs=2,
+                          host_tier_mb=4.0), spec_tokens=3)
+    counts = eng.warmup()
+    assert eng.host_tier is not None
+    # pin the recompute price above the DMA so every host match
+    # reloads: this property is about the MACHINERY (spill -> evict
+    # -> reload never changes a token), not the pricing threshold —
+    # the toy model's real per-step price sits near the PCIe latency
+    # floor and would flip decisions on margins, not correctness
+    eng._host_step_price = lambda ctx: 1e-3
+
+    def audit(_):
+        eng.cache.check_invariants()
+        if kv_dtype != "float32":
+            eng.check_kv_scales()
+
+    for round_i, prompts in enumerate((a, b, a, b, a)):
+        expect = ref.generate(prompts, 6)
+        assert eng.generate(prompts, 6, on_step=audit) == expect, \
+            f"round {round_i} diverged on {kv_dtype}"
+        eng.cache.check_invariants()
+
+    host = eng.last_stats["host_tier"]
+    assert host["spills"] > 0, "pool never spilled a parked chain"
+    assert host["reload_pages"] > 0, "no repeat ever reloaded"
+    assert eng.compile_counts() == counts, \
+        "spill/reload must reuse the warmed export/import programs"
+    # quantized pools ship their f32 scale rows with the page
+    n_rows = {"float32": 2}.get(kv_dtype, 4)
+    rows = next(iter(eng.host_tier._pages.values()))
+    assert len(rows) == n_rows
+    if n_rows == 4:
+        assert rows[2].dtype == np.float32 \
+            and rows[3].dtype == np.float32
+
+
+def test_priced_decision_recorded_and_counted():
+    """Every host-tier consult leaves the priced decision on the
+    request (the explain_request surface), both sides non-negative
+    and consistent with the choice — and on this toy model the REAL
+    price correctly refuses the DMA (a ~5us PCIe latency floor beats
+    five sub-microsecond prefill steps), while a pinned expensive
+    recompute flips the same match to a reload that shows up in the
+    engine's stats block."""
+    rng = np.random.RandomState(5)
+    a, b = _prompts(rng, 2), _prompts(rng, 2)
+    eng = ServeEngine(_lm(pool_pages=20, host_tier_mb=4.0),
+                      spec_tokens=0)
+    eng.warmup()
+    for prompts in (a, b, a):
+        eng.generate(prompts, 6)
+    decisions = [getattr(r, "host_reload", None)
+                 for r in eng._last_reqs.values()]
+    decisions = [d for d in decisions if d]
+    assert decisions, "the repeat round never consulted the tier"
+    for d in decisions:
+        assert d["dma_s"] >= 0.0 and d["recompute_s"] >= 0.0
+        assert d["chose"] in ("none", "reload", "recompute",
+                              "store_miss")
+        if d["chose"] == "recompute":
+            assert d["dma_s"] >= d["recompute_s"], d
+    # the honest direction on the tiny model: recompute wins
+    assert any(d["chose"] == "recompute" for d in decisions)
+    assert eng._host_reload_stats["reload_events"] == 0
+
+    # same store content, recompute priced expensive: reload wins
+    eng._host_step_price = lambda ctx: 1e-3
+    eng.generate(b, 6)
+    eng.generate(a, 6)
+    decisions = [d for d in (getattr(r, "host_reload", None)
+                             for r in eng._last_reqs.values()) if d]
+    assert any(d["chose"] == "reload" for d in decisions)
+    for d in decisions:
+        if d["chose"] == "reload":
+            assert d["dma_s"] < d["recompute_s"], d
+    st = eng._host_reload_stats
+    assert st["reload_events"] > 0
+    # the engine counters are lifetime; decisions are last-run only
+    assert st["reload_pages"] >= \
+        sum(d["reloaded_pages"] for d in decisions) > 0
+    assert st["reload_priced_s"] > 0.0
+    # the stats block merges store report + engine reload counters
+    host = eng.last_stats["host_tier"]
+    assert host["reload_pages"] == st["reload_pages"]
+    assert host["spilled_pages"] == st["spilled_pages"]
+    # and the post-mortem debug view carries LRU-ordered keys
+    dbg = eng.cache.debug_state()
+    assert dbg["host_tier"]["pages"] == host["pages"]
+    assert dbg["host_tier"]["lru_keys"]
+
+
+# =======================================================================
+# config / arming
+# =======================================================================
+def test_flags_and_arming_matrix():
+    cfg = FFConfig()
+    assert cfg.host_tier_mb == 0.0 and cfg.serve_host_tier
+    cfg.parse_args(["--host-tier-mb", "64", "--no-host-tier"])
+    assert cfg.host_tier_mb == 64.0 and not cfg.serve_host_tier
+    with pytest.raises(ValueError):
+        FFConfig(host_tier_mb=-1.0).validate()
+
+    # mb=0 (the default) leaves the tier off
+    eng0 = ServeEngine(_lm(pool_pages=16, spec=False), spec_tokens=0)
+    assert eng0.host_tier is None
+    # --no-host-tier disarms even with a budget
+    eng1 = ServeEngine(_lm(pool_pages=16, spec=False,
+                           host_tier_mb=8.0, serve_host_tier=False),
+                       spec_tokens=0)
+    assert eng1.host_tier is None
+    # an explicit (shared) store wins over the config budget
+    shared = HostPageStore(1.0)
+    eng2 = ServeEngine(_lm(pool_pages=16, spec=False,
+                           host_tier_mb=8.0),
+                       spec_tokens=0, host_tier=shared)
+    assert eng2.host_tier is shared
+    assert eng2.cache.host_tier is shared
+
+
+# =======================================================================
+# router: one shared store, host-hit affinity tier
+# =======================================================================
+def test_pool_shares_one_store_and_routes_host_hits():
+    lm = _lm(pool_pages=24, max_seqs=2, spec=False, host_tier_mb=4.0)
+    pool = ReplicaPool(lm, 2, policy="affinity")
+    try:
+        assert pool.host_tier is not None
+        for r in pool.replicas:
+            assert r.engine.host_tier is pool.host_tier
+            assert r.engine.cache.host_tier is pool.host_tier
+
+        prompt = list(np.random.RandomState(0).randint(
+            1, 61, size=33))
+        ps = pool.replicas[0].engine.cache_cfg.page_size
+        keys = prefix_page_keys(prompt, ps, (len(prompt) - 1) // ps)
+        # nothing anywhere: tenant-hash fallback
+        _, info = pool.route(prompt, tenant=7)
+        assert info["fallback"] and not info["host_hit"]
+        # seed the SHARED store under the same chain keys the router
+        # probes: the host tier is now the best (and only) affinity
+        for i, k in enumerate(keys):
+            pool.host_tier.put(k, _rows(i))
+        before = dict(pool.host_tier.stats)
+        target, info = pool.route(prompt, tenant=7)
+        assert info["host_hit"] and not info["fallback"]
+        assert info["matched_tokens"] == len(keys) * ps
+        assert target.idx == min(
+            r.idx for r in pool.routable())   # least-loaded tie -> 0
+        # route() is pure observation on the store too
+        assert dict(pool.host_tier.stats) == before
+        assert pool.stats["host_hits"] == 0   # counted at submit()
+    finally:
+        pool.close()
+
+
+def test_pool_run_spills_and_reloads_across_replicas():
+    """A 2-replica pool under alternating tenant working sets: the
+    shared store absorbs both replicas' spills, repeats reload, the
+    router counts host-tier hits, and the pool still drains to full
+    page reclamation with zero recompiles and exact tokens."""
+    from flexflow_tpu.serve import TrafficSpec, make_traffic
+    lm = _lm(pool_pages=26, max_seqs=2, spec=False, max_seq_len=96,
+             host_tier_mb=4.0)
+    pool = ReplicaPool(lm, 2, policy="affinity")
+    try:
+        for r in pool.replicas:   # make every host match reload
+            r.engine._host_step_price = lambda ctx: 1e-3
+        price = pool.price_probe(48)
+        traffic = make_traffic(TrafficSpec(
+            requests=24, seed=2, arrival="poisson",
+            rate_rps=0.08 / price, tenants=4, prefix_tokens=48,
+            tail_mean=4.0, output_mean=4.0, max_prompt=72,
+            max_new_cap=6, vocab=61))
+        res = pool.run(traffic, slo_ttft_s=15 * price,
+                       slo_tpot_s=8 * price)
+        pool.assert_zero_recompiles()
+        pool.check_drained()
+        host = res["host_tier"]
+        assert host is not None and host["spills"] > 0
+        assert host["reload_pages"] > 0
+        # single-engine token identity (the chaos-test gate)
+        ref = ServeEngine(_lm(pool_pages=64, max_seqs=2, spec=False,
+                              max_seq_len=96), spec_tokens=0)
+        ref.warmup()
+        expect = ref.generate(
+            [t.prompt for t in traffic],
+            [t.max_new for t in traffic],
+            stream_ids=[t.stream_id for t in traffic])
+        for rec, want in zip(res["requests"], expect):
+            if rec["outcome"] == "completed":
+                assert rec["tokens"] == want
+    finally:
+        pool.close()
+
+
+# =======================================================================
+# telemetry
+# =======================================================================
+def test_host_reload_attribution_component():
+    """host_reload is a first-class attribution component: the class
+    tables agree, priorities stay distinct (the fold compares with
+    strict >), and an armed engine's breakdown still sums to the
+    measured latency with the reload DMA attributed."""
+    assert "host_reload" in REQUEST_COMPONENTS
+    assert _SPAN_CLASS["host_reload"] == "host_reload"
+    assert set(_SPAN_CLASS.values()) <= set(_CLASS_PRIORITY)
+    prios = list(_CLASS_PRIORITY.values())
+    assert len(prios) == len(set(prios))
+    # the reload span overlaps queue_wait: it must outrank "queue"
+    assert _CLASS_PRIORITY["host_reload"] > _CLASS_PRIORITY["queue"]
+
+    rng = np.random.RandomState(9)
+    a, b = _prompts(rng, 2), _prompts(rng, 2)
+    tel = Telemetry()
+    eng = ServeEngine(_lm(pool_pages=20, host_tier_mb=4.0,
+                          spec=False),
+                      spec_tokens=0, telemetry=tel)
+    eng.warmup()
+    eng._host_step_price = lambda ctx: 1e-3
+    for prompts in (a, b, a):
+        eng.generate(prompts, 6)
+    reloaded = total = 0.0
+    for row in eng.last_stats["requests"]:
+        bd = eng.explain_request(row["rid"])
+        assert set(bd["components"]) == set(REQUEST_COMPONENTS)
+        lat = bd["latency_s"]
+        assert abs(sum(bd["components"].values()) - lat) \
+            <= 1e-9 + 0.01 * lat
+        reloaded += bd["components"]["host_reload"]
+        total += lat
+        if bd["host_reload"] and \
+                bd["host_reload"]["chose"] == "reload":
+            assert bd["components"]["host_reload"] > 0.0
+    assert eng._host_reload_stats["reload_events"] > 0
+    assert 0.0 < reloaded < total

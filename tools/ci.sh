@@ -199,6 +199,22 @@ echo "--- 1q. wall-clock fabric smoke (wall==virtual identity + concurrency gate
 env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload fabric \
     -o /tmp/ci_bench_serve_fabric.json || fail=1
 
+echo "--- 1r. host-tier prefix-cache smoke (spill-vs-recompute goodput gate)"
+# the hierarchical prefix-cache tier (serve/host_tier.py): on a
+# working-set-larger-than-pool multi-tenant stream, pages evicted
+# under HBM pressure spill their bytes to a shared host-RAM store and
+# reload through the existing fixed-shape import scatter when the
+# DMA priced by TPUMachineModel.host_transfer beats prefill recompute
+# — fails unless the host-tier arm's goodput-under-SLO is >= 1.3x
+# BOTH plain eviction and rung-3-style no-match degradation, every
+# completed request is token-identical to a single reference engine,
+# nothing compiles after warmup (spill/reload reuse the export/import
+# handoff programs), and spills + priced reload decisions actually
+# happened (tools/serve_bench.py --workload spill, docs/serving.md
+# "Hierarchical prefix cache")
+env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload spill \
+    -o /tmp/ci_bench_serve_spill.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \

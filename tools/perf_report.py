@@ -181,6 +181,8 @@ def serve_line() -> str:
              "{v:.1f}x batched-LoRA goodput vs weight swap"),
             ("serve_fabric_wall_goodput_gain",
              "{v:.1f}x threaded wall-clock goodput (wall==virtual)"),
+            ("serve_host_tier_goodput_gain",
+             "{v:.1f}x host-tier goodput vs eviction"),
         )
         for key, fmt in pieces:
             r = recs.get(key)
