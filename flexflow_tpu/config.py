@@ -380,6 +380,14 @@ class FFConfig:
     # to whole pages). 0 = the autotune-by-shape table
     # (choose_block_kv). --serve-attn-block-kv.
     serve_attn_block_kv: int = 0
+    # AOT program cache directory (core/programs.py): serving engines
+    # snapshot their compiled executables here keyed by a program
+    # fingerprint (arch + lane widths + kv geometry + adapter + tp +
+    # jax/backend version), and a cold engine — an autoscaler scale-up
+    # with no parked replica, a fresh process — deserializes them
+    # before the first request instead of paying the compile storm.
+    # None = compile per process. --program-cache-dir.
+    program_cache_dir: Optional[str] = None
     # continuous-batching scheduler caps (serve/scheduler.py): at most
     # serve_max_seqs sequences hold decode slots at once (this is also
     # the decode-lane reserve of the engine's single mixed step), and
@@ -798,6 +806,7 @@ class FFConfig:
         "--kv-dtype": ("kv_dtype", str),
         "--kv-pool-mb": ("kv_pool_mb", float),
         "--host-tier-mb": ("host_tier_mb", float),
+        "--program-cache-dir": ("program_cache_dir", str),
         "--serve-attn-block-kv": ("serve_attn_block_kv", int),
         "--serve-max-seqs": ("serve_max_seqs", int),
         "--serve-prefill-budget": ("serve_prefill_budget", int),

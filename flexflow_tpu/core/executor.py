@@ -143,6 +143,14 @@ class Executor:
         self._eval_step_multi = None
         self._sparse_ops_cache = None
         self._sparse_cache_key = None
+        # the shared program registry (core/programs.py): train-step
+        # dispatch resolves through it, so fit's compiled steps get the
+        # same exact compile counting + AOT snapshot/warm-boot story as
+        # the serving programs (--program-cache-dir). Lazy: built on
+        # first dispatch, None after a construction failure (direct jit
+        # dispatch is the fallback — training never depends on it)
+        self._programs = None
+        self._programs_failed = False
         self._last_aux_losses = []
         # lower device-explicit placements (strategy device_ids) into
         # the stacked-embedding slot layout BEFORE any weight_specs()
@@ -906,6 +914,93 @@ class Executor:
                 "optimizer state); recompile with comp_mode=TRAINING "
                 "to train")
 
+    # ---------------- program registry ----------------
+    def _opt_sig(self):
+        """Stable token for the optimizer's PROGRAM identity: class +
+        scalar hyperparameters (they are baked into the compiled step
+        as constants — the runtime lr_scale is the only traced knob)."""
+        opt = self.optimizer
+        if opt is None:
+            return None
+        hp = {k: v for k, v in vars(opt).items()
+              if isinstance(v, (int, float, bool, str))}
+        return (type(opt).__name__, tuple(sorted(hp.items())))
+
+    def _train_fingerprint(self) -> dict:
+        """Cache identity of this executor's train programs — the
+        analog of ServeEngine._program_fingerprint for fit's step
+        (argument shapes/dtypes/shardings are keyed per call by the
+        registry; this folds what the arguments cannot express)."""
+        cfg = self.config
+        mesh_sig = None
+        if self.mesh is not None:
+            mesh_sig = tuple(sorted(
+                (str(k), int(v))
+                for k, v in dict(self.mesh.shape).items()))
+        arch = tuple((op.name, type(op).__name__)
+                     for op in self.model.ops)
+        return {
+            "kind": "train",
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "devices": jax.device_count(),
+            "arch": arch,
+            "mesh": mesh_sig,
+            "compute_dtype": str(self.compute_dtype),
+            "param_dtype": str(self.param_dtype),
+            "loss": self.loss_name,
+            "metrics": tuple(self.metric_names),
+            "grad_bucket_mb": self._grad_bucket_mb,
+            "fusion": bool(cfg.perform_fusion),
+            "seq_length": cfg.iter_config.seq_length,
+        }
+
+    def _train_variant(self) -> str:
+        """Per-dispatch build-variant token folded into the registry
+        key: everything _sparse_table_ops / the multi-mode check can
+        rebuild the jitted step over WITHOUT any argument changing
+        shape. A stale-variant executable therefore can never be
+        resolved for a rebuilt step."""
+        mode = self.optimizer.sparse_mode() if self.optimizer else None
+        return repr((self.config.sparse_embedding_updates,
+                     self.config.sparse_embedding_lazy,
+                     self._opt_sig(), mode,
+                     self._train_step_multi_unroll))
+
+    def program_registry(self):
+        """The executor's ProgramRegistry, or None when construction
+        failed (training falls back to direct jit dispatch)."""
+        if self._programs is None and not self._programs_failed:
+            try:
+                from .programs import ProgramRegistry
+                self._programs = ProgramRegistry(
+                    self._train_fingerprint(),
+                    cache_dir=getattr(self.config,
+                                      "program_cache_dir", None))
+                self._programs.load_warm()
+            except Exception as e:
+                import warnings
+                warnings.warn(
+                    f"program registry unavailable for training ({e}); "
+                    f"dispatching through jit directly", stacklevel=2)
+                self._programs_failed = True
+        return self._programs
+
+    def compile_counts(self) -> dict:
+        """Exact per-family compile counts for the train programs
+        (registry query — empty dict before the first dispatch)."""
+        reg = self._programs
+        return {} if reg is None else reg.compile_counts()
+
+    def save_programs(self) -> int:
+        """Snapshot freshly compiled train executables to
+        config.program_cache_dir (no-op when unarmed/clean). fit calls
+        this at exit so the next process boots the step warm."""
+        reg = self._programs
+        if reg is None or not reg.cache_dir or not reg._dirty:
+            return 0
+        return reg.save()
+
     def _lr(self):
         """The runtime LR multiplier as a traced scalar input — a value
         change re-dispatches, never recompiles.
@@ -932,7 +1027,12 @@ class Executor:
         if self._train_step is None:
             self._train_step = self.build_train_step()
         jitted = self._train_step
-        return lambda st, b, r: jitted(st, b, r, self._lr())
+        reg = self.program_registry()
+        if reg is None:
+            return lambda st, b, r: jitted(st, b, r, self._lr())
+        var = self._train_variant()
+        return lambda st, b, r: reg.call(
+            "train_step", jitted, st, b, r, self._lr(), extra_key=var)
 
     @property
     def train_step_multi(self):
@@ -954,7 +1054,13 @@ class Executor:
         if self._train_step_multi is None:
             self._train_step_multi = self.build_train_step_multi()
         jitted = self._train_step_multi
-        return lambda st, bs, rs: jitted(st, bs, rs, self._lr())
+        reg = self.program_registry()
+        if reg is None:
+            return lambda st, bs, rs: jitted(st, bs, rs, self._lr())
+        var = self._train_variant()
+        return lambda st, bs, rs: reg.call(
+            "train_step_multi", jitted, st, bs, rs, self._lr(),
+            extra_key=var)
 
     @property
     def train_step_accum(self):
@@ -963,7 +1069,13 @@ class Executor:
         if self._train_step_accum is None:
             self._train_step_accum = self.build_train_step_accum()
         jitted = self._train_step_accum
-        return lambda st, bs, rs: jitted(st, bs, rs, self._lr())
+        reg = self.program_registry()
+        if reg is None:
+            return lambda st, bs, rs: jitted(st, bs, rs, self._lr())
+        var = self._train_variant()
+        return lambda st, bs, rs: reg.call(
+            "train_step_accum", jitted, st, bs, rs, self._lr(),
+            extra_key=var)
 
     @property
     def eval_step(self):

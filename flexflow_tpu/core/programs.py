@@ -1,0 +1,371 @@
+"""ProgramRegistry: one owner for every jitted program in the system.
+
+The stack's zero-recompile discipline used to be enforced ad hoc per
+subsystem — serve's fixed-shape mixed program snapshotted a process-wide
+jax.monitoring counter around each call, the executor cached jitted
+train steps on attributes, and `compile_counts()` was the max of two
+imperfect proxies (monitoring events and distinct shape signatures).
+None of that helped a COLD replica: an autoscaler scale-up with no
+parked replica, or a cross-process fabric worker, pays the full
+first-request compile storm.
+
+This module factors the discipline into one object:
+
+- ``register(name, static_argnums=...)`` declares a program family
+  (serve's "mixed"/"export"/..., the executor's "train_step[...]").
+- ``call(name, fn, *args)`` resolves the family + argument signature to
+  a compiled executable: cache hit -> dispatch, miss -> AOT
+  ``fn.lower(*args).compile()`` (timed, counted) then dispatch. The
+  count is EXACT per family — a compile cannot hide from it the way it
+  could from the monitoring snapshot (e.g. compiles triggered inside
+  warmup_handoff / adapter load on a jax without the monitoring module).
+- ``save(dir)`` / ``load_warm()`` serialize the compiled executables
+  (``jax.experimental.serialize_executable``) keyed by a program
+  FINGERPRINT folding model arch, lane widths, kv dtype/pool geometry,
+  adapter rank/slots, tp degree and jax/backend version — a cold
+  process deserializes its programs before the first request and boots
+  warm (compile_counts() == 0). Corrupt/truncated stores warn and fall
+  back to compiling, mirroring search/cost_cache.py's corrupt-store
+  discipline; a restored executable that rejects its first call (stale
+  cache from an incompatible runtime) is dropped and recompiled with a
+  warning, never crashing the engine.
+
+When a cache dir is armed the registry also points JAX's persistent
+compilation cache at ``<dir>/xla`` (best-effort) — the belt under the
+AOT braces: even a program the snapshot missed compiles from the XLA
+disk cache instead of from scratch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+import warnings
+from typing import Any, Dict, Optional
+
+import jax
+
+_STORE_VERSION = 1
+_STORE_SUFFIX = ".ffprog"
+
+# jax_compilation_cache_dir is process-global config: arm it once, for
+# the first registry that asks, and leave it alone after (two engines
+# with different dirs must not thrash the global)
+_xla_cache_armed = False
+
+
+def fingerprint_hash(fp: Dict[str, Any]) -> str:
+    """Stable short hash of a fingerprint dict (the cost_cache.py
+    machine_fingerprint idiom): canonical-JSON then sha256."""
+    blob = json.dumps(fp, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _leaf_sig(leaf) -> tuple:
+    """Signature of one flattened argument leaf. Arrays key on
+    (shape, dtype, weak_type, sharding spec) — what jit's own cache
+    keys on, minus the committed-device identity (a host numpy array
+    and an uncommitted device array lower identically). Non-array
+    leaves (static python scalars like the export/import n_pools) key
+    on their VALUE, exactly as static_argnums demands."""
+    if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+        sh = getattr(leaf, "sharding", None)
+        spec = getattr(sh, "spec", None)
+        if spec is None:
+            tok = ""
+        else:
+            # trailing None entries are implicit (PartitionSpec('x',)
+            # == PartitionSpec('x', None) to jit) — strip them so
+            # equivalent shardings key identically
+            t = tuple(spec)
+            while t and t[-1] is None:
+                t = t[:-1]
+            tok = str(t)
+        return ("a", tuple(leaf.shape), str(leaf.dtype),
+                bool(getattr(leaf, "weak_type", False)), tok)
+    return ("s", repr(leaf))
+
+
+class ProgramRegistry:
+    """Shape signatures, compile counting and AOT executable caching
+    for a set of named program families (one registry per engine /
+    executor; families are e.g. serve's six serving functions)."""
+
+    def __init__(self, fingerprint: Dict[str, Any],
+                 cache_dir: Optional[str] = None):
+        self.fingerprint = dict(fingerprint)
+        self.fp_hash = fingerprint_hash(self.fingerprint)
+        self.cache_dir = cache_dir
+        self._statics: Dict[str, tuple] = {}          # family -> argnums
+        self._compiled: Dict[tuple, Any] = {}         # (family, sig) ->
+        self._restored_keys: set = set()              # Compiled
+        self._compiles: Dict[str, int] = {}
+        self._restored: Dict[str, int] = {}
+        self._compile_s: Dict[str, float] = {}
+        self._dirty = False
+        if cache_dir:
+            self._arm_xla_cache(cache_dir)
+
+    @staticmethod
+    def _arm_xla_cache(cache_dir: str) -> None:
+        global _xla_cache_armed
+        if _xla_cache_armed:
+            return
+        try:
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.join(cache_dir, "xla"))
+            _xla_cache_armed = True
+        except Exception:   # config knob absent on this jax — AOT
+            pass            # serialization still covers warm boot
+
+    # ---------------- registration / resolution -----------------------
+    def register(self, name: str, *, static_argnums: tuple = ()) -> None:
+        self._statics[name] = tuple(static_argnums)
+        self._compiles.setdefault(name, 0)
+        self._restored.setdefault(name, 0)
+        self._compile_s.setdefault(name, 0.0)
+
+    def families(self) -> tuple:
+        return tuple(self._statics)
+
+    def signature(self, args, extra_key: Optional[str] = None) -> str:
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        parts = [str(treedef)]
+        parts.extend(repr(_leaf_sig(l)) for l in leaves)
+        if extra_key is not None:
+            parts.append(extra_key)
+        return hashlib.sha256(
+            "\x1f".join(parts).encode()).hexdigest()[:24]
+
+    def _compile(self, name: str, fn, args) -> Any:
+        t0 = time.perf_counter()
+        compiled = fn.lower(*args).compile()
+        self._compile_s[name] = self._compile_s.get(name, 0.0) \
+            + (time.perf_counter() - t0)
+        self._compiles[name] = self._compiles.get(name, 0) + 1
+        self._dirty = True
+        return compiled
+
+    def call(self, name: str, fn, *args, extra_key: Optional[str] = None):
+        """Resolve (family, signature) to a compiled executable and
+        dispatch it. New signature -> AOT compile (exact counting);
+        restored executable that rejects the call -> warn, drop, and
+        recompile (stale-cache rejection: a bad cache costs a compile
+        and a warning, never a crash). `extra_key` folds caller context
+        the arguments cannot express into the cache key — the executor
+        uses it for build-variant tokens (sparse routing, scan vs
+        unroll, optimizer hyperparameters) whose flip changes the
+        program without changing any argument shape."""
+        if name not in self._statics:
+            self.register(name)
+        statics = self._statics.get(name, ())
+        if not hasattr(fn, "lower"):   # not a jit wrapper: dispatch
+            return fn(*args)           # directly (fallback path)
+        key = (name, self.signature(args, extra_key))
+        compiled = self._compiled.get(key)
+        if compiled is None:
+            compiled = self._compile(name, fn, args)
+            self._compiled[key] = compiled
+        dyn = [a for i, a in enumerate(args) if i not in statics]
+        try:
+            return compiled(*dyn)
+        except (TypeError, ValueError) as e:
+            if key not in self._restored_keys:
+                raise
+            # deserialized from a snapshot whose runtime disagrees
+            # with ours in a way the fingerprint did not fold —
+            # reject the stale entry and compile fresh
+            warnings.warn(
+                f"program cache: restored {name!r} executable rejected "
+                f"its first call ({e}); recompiling", stacklevel=2)
+            self._restored_keys.discard(key)
+            self._restored[name] = max(0, self._restored.get(name, 1) - 1)
+            compiled = self._compile(name, fn, args)
+            self._compiled[key] = compiled
+            return compiled(*dyn)
+
+    # ---------------- accounting ---------------------------------------
+    def compile_counts(self) -> Dict[str, int]:
+        """EXACT compiles per registered family this process performed
+        (restored-from-snapshot executables count zero — that is the
+        warm-boot contract)."""
+        return {name: self._compiles.get(name, 0)
+                for name in self._statics}
+
+    def restored_counts(self) -> Dict[str, int]:
+        return {name: self._restored.get(name, 0)
+                for name in self._statics}
+
+    def compile_seconds(self) -> float:
+        return float(sum(self._compile_s.values()))
+
+    def boot_record(self) -> Dict[str, Any]:
+        """What booting this registry cost — the autoscaler's cold-vs-
+        warm price and the `replica_boot` span payload."""
+        return {
+            "fingerprint": self.fp_hash,
+            "restored": int(sum(self._restored.values())),
+            "compiles": int(sum(self._compiles.values())),
+            "compile_s": self.compile_seconds(),
+            "families": {n: {"compiles": self._compiles.get(n, 0),
+                             "restored": self._restored.get(n, 0),
+                             "compile_s": round(
+                                 self._compile_s.get(n, 0.0), 4)}
+                         for n in self._statics},
+        }
+
+    # ---------------- persistence --------------------------------------
+    def _store_path(self, cache_dir: Optional[str] = None) -> str:
+        d = cache_dir if cache_dir is not None else self.cache_dir
+        return os.path.join(d, self.fp_hash + _STORE_SUFFIX)
+
+    def save(self, cache_dir: Optional[str] = None) -> int:
+        """Serialize every compiled executable to
+        ``<dir>/<fp_hash>.ffprog`` (atomic temp-then-replace, the
+        checkpoint.py discipline) plus a human-readable manifest.
+        Merges with a valid existing store for the same fingerprint
+        (two engines over one dir each contribute their programs).
+        Returns the number of entries written."""
+        d = cache_dir if cache_dir is not None else self.cache_dir
+        if not d:
+            return 0
+        os.makedirs(d, exist_ok=True)
+        path = self._store_path(d)
+        entries: Dict[tuple, dict] = {}
+        old = self._read_store(path)
+        if old is not None:
+            for e in old.get("entries", []):
+                entries[(e["family"], e["sig"])] = e
+        from jax.experimental.serialize_executable import serialize
+        for (family, sig), compiled in self._compiled.items():
+            try:
+                payload, in_tree, out_tree = serialize(compiled)
+            except Exception as e:   # an unserializable executable is
+                warnings.warn(       # skipped, not fatal
+                    f"program cache: could not serialize {family!r} "
+                    f"({e}); skipping", stacklevel=2)
+                continue
+            entries[(family, sig)] = {
+                "family": family, "sig": sig,
+                "statics": list(self._statics.get(family, ())),
+                "payload": payload, "in_tree": in_tree,
+                "out_tree": out_tree,
+                "compile_s": self._compile_s.get(family, 0.0),
+            }
+        blob = pickle.dumps({
+            "version": _STORE_VERSION,
+            "fingerprint": self.fingerprint,
+            "fp_hash": self.fp_hash,
+            "jax": jax.__version__,
+            "entries": list(entries.values()),
+        })
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._write_manifest(d, len(entries))
+        self._dirty = False
+        return len(entries)
+
+    def _write_manifest(self, d: str, n_entries: int) -> None:
+        """Best-effort human-readable sidecar: which fingerprints live
+        in this dir and what they hold (the store itself is pickle)."""
+        path = os.path.join(d, "manifest.json")
+        try:
+            doc = {}
+            if os.path.exists(path):
+                with open(path) as f:
+                    doc = json.load(f)
+            if not isinstance(doc, dict):
+                doc = {}
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            doc = {}
+        doc[self.fp_hash] = {
+            "entries": n_entries,
+            "families": sorted(self._statics),
+            "jax": jax.__version__,
+            "fingerprint": {k: str(v)
+                            for k, v in self.fingerprint.items()},
+        }
+        try:
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def _read_store(self, path: str) -> Optional[dict]:
+        """Read + validate a store file. Any corruption (truncated
+        pickle, wrong type, wrong version, foreign fingerprint) warns
+        and returns None — the caller compiles cold. Mirrors
+        cost_cache.py: a bad cache costs a warning, never a crash."""
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "rb") as f:
+                doc = pickle.loads(f.read())
+            if (not isinstance(doc, dict)
+                    or doc.get("version") != _STORE_VERSION
+                    or not isinstance(doc.get("entries"), list)):
+                raise ValueError("malformed program store")
+            if doc.get("fp_hash") != self.fp_hash:
+                # a DIFFERENT program fingerprint under the same file
+                # name: treat as a miss (and as corrupt for merge —
+                # save() will overwrite wholesale)
+                return None
+        except Exception as e:
+            warnings.warn(
+                f"program cache: unreadable store {path!r} ({e}); "
+                f"booting cold", stacklevel=2)
+            return None
+        return doc
+
+    def load_warm(self, cache_dir: Optional[str] = None) -> int:
+        """Deserialize every stored executable for this fingerprint.
+        Returns the number restored (0 on miss/corruption — never
+        raises). Call AFTER register() so family static-argnums are
+        known."""
+        d = cache_dir if cache_dir is not None else self.cache_dir
+        if not d:
+            return 0
+        doc = self._read_store(self._store_path(d))
+        if doc is None:
+            return 0
+        from jax.experimental.serialize_executable import \
+            deserialize_and_load
+        n = 0
+        for e in doc["entries"]:
+            try:
+                family = e["family"]
+                key = (family, e["sig"])
+                compiled = deserialize_and_load(
+                    e["payload"], e["in_tree"], e["out_tree"])
+            except Exception as exc:
+                warnings.warn(
+                    f"program cache: could not deserialize a "
+                    f"{e.get('family')!r} executable ({exc}); it will "
+                    f"be recompiled", stacklevel=2)
+                continue
+            if family not in self._statics:
+                self.register(family,
+                              static_argnums=tuple(e.get("statics", ())))
+            self._compiled[key] = compiled
+            self._restored_keys.add(key)
+            self._restored[family] = self._restored.get(family, 0) + 1
+            n += 1
+        return n
+
+    @classmethod
+    def load(cls, cache_dir: str,
+             fingerprint: Dict[str, Any]) -> "ProgramRegistry":
+        """Build a registry for `fingerprint` and warm it from
+        `cache_dir` in one step (the cold-replica boot path)."""
+        reg = cls(fingerprint, cache_dir=cache_dir)
+        reg.load_warm()
+        return reg

@@ -601,6 +601,14 @@ class FFModel:
     def zero_gradients(self):
         pass  # gradients are pure values on TPU; nothing to zero
 
+    def compile_counts(self) -> Dict[str, int]:
+        """Exact compiles per train-program family this process
+        performed (the executor's ProgramRegistry query — the serving
+        engines' zero-recompile instrument, extended to fit). Empty
+        before the first train dispatch; a step resolved from a
+        --program-cache-dir snapshot counts zero."""
+        return self.executor.compile_counts()
+
     def train_batch(self, batch: Dict[str, np.ndarray]):
         """One optimizer step; returns metrics dict of scalars."""
         batch = self.executor.shard_batch(batch)
@@ -1037,6 +1045,14 @@ class FFModel:
                 ckptr.close()
             if fit_loader is not None:  # release the native prefetch
                 fit_loader.close()      # thread + double buffers
+            # snapshot freshly compiled train executables to
+            # --program-cache-dir (core/programs.py) so the next
+            # process over this config resolves fit's step from disk
+            # instead of recompiling (no-op when unarmed/clean)
+            try:
+                self.executor.save_programs()
+            except Exception:
+                pass  # an unwritable cache dir must not fail fit
         return history
 
     def _train_stats(self, win, gaps, n_dispatches, in_flight_at_exit):

@@ -474,22 +474,29 @@ class ServeEngine:
             self._import_jit = jax.jit(self._import_impl,
                                        static_argnums=(0,),
                                        donate_argnums=_imp_donate)
-        # per-function compile accounting: `_compiles` counts calls
-        # that triggered at least one real XLA backend compile
-        # (jax.monitoring events, see _CompileEvents); `_shapes_seen`
-        # counts distinct argument-shape signatures (each IS one
-        # program under jit) as the belt-and-braces floor on a jax
-        # without the monitoring API
-        self._events_ok = _CompileEvents.install()
-        self._compiles: Dict[str, int] = {"prefill": 0, "decode": 0,
-                                          "mixed": 0, "export": 0,
-                                          "import": 0, "adapter": 0}
-        self._shapes_seen: Dict[str, set] = {"prefill": set(),
-                                             "decode": set(),
-                                             "mixed": set(),
-                                             "export": set(),
-                                             "import": set(),
-                                             "adapter": set()}
+        # per-function compile accounting, owned by the ProgramRegistry
+        # (core/programs.py): every serving dispatch resolves through
+        # registry.call, which AOT-compiles on a new argument signature
+        # and counts EXACTLY — no monitoring-snapshot coverage gap on
+        # compiles inside warmup_handoff / adapter load — and which
+        # restores serialized executables from --program-cache-dir so a
+        # cold replica boots warm (zero compiles). `_compiles` stays
+        # the registry's live per-family dict (test/bench API compat);
+        # `_events_ok` is always True now that counting is exact.
+        from ..core.programs import ProgramRegistry
+        self.programs = ProgramRegistry(
+            self._program_fingerprint(),
+            cache_dir=getattr(cfg, "program_cache_dir", None))
+        for fam in ("prefill", "decode", "mixed", "adapter"):
+            self.programs.register(fam)
+        # export/import carry the pool count as a static argnum: its
+        # VALUE keys the cache and is stripped at executable dispatch
+        self.programs.register("export", static_argnums=(0,))
+        self.programs.register("import", static_argnums=(0,))
+        self.programs_restored = self.programs.load_warm()
+        self._events_ok = True
+        self._compiles = self.programs._compiles
+        self.boot_stats: Optional[dict] = None
         self.last_stats: Optional[dict] = None
         # live scrape endpoint (--metrics-port, docs/observability.md):
         # /metrics serves the engine-lifetime registry as Prometheus
@@ -507,19 +514,19 @@ class ServeEngine:
                 host=str(getattr(cfg, "metrics_host", "127.0.0.1")))
 
     def _call_counted(self, name, fn, *args):
-        self._shapes_seen[name].add(tuple(
-            (tuple(a.shape), str(a.dtype)) for a in args
-            if hasattr(a, "shape")))
         attempt = 0
         while True:
-            before = _CompileEvents.count
             try:
                 # fault-injection site: serve.mixed / serve.prefill /
                 # serve.decode, fired at the dispatch boundary (BEFORE
                 # the jitted call, so donated buffers are untouched
                 # when an injected fault raises)
                 self.faults.fire(f"serve.{name}")
-                out = fn(*args)
+                # the registry resolves (family, argument signature) to
+                # a compiled executable: hit -> dispatch (possibly an
+                # executable deserialized at boot — the warm path),
+                # miss -> AOT lower().compile(), timed and counted
+                out = self.programs.call(name, fn, *args)
                 break
             except TransientError:
                 # bounded retry-with-backoff: transient dispatch faults
@@ -551,12 +558,51 @@ class ServeEngine:
                             time.perf_counter(),
                             args={"site": f"serve.{name}",
                                   "attempt": attempt})
-        # jit compiles synchronously at dispatch (only execution is
-        # async), so any backend-compile event between the snapshots
-        # belongs to THIS call
-        if _CompileEvents.count > before:
-            self._compiles[name] += 1
         return out
+
+    def _program_fingerprint(self) -> Dict:
+        """The cache identity of this engine's program set: everything
+        that shapes or numbers a serving executable. Two engines with
+        equal fingerprints compile bit-identical programs (the AOT
+        snapshot in --program-cache-dir is keyed on its hash); flipping
+        ANY folded field — kv dtype, adapter rank, tp degree, the jax
+        version — must miss the cache (tests/test_programs.py pins
+        each)."""
+        c = self.cache_cfg
+        ac = self.adapter_cfg
+        return {
+            "kind": "serve",
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "devices": jax.device_count(),
+            "num_layers": self.num_layers,
+            "hidden": self.hidden,
+            "num_heads": self.num_heads,
+            "head_dim": self.head_dim,
+            "ff_pad": self._ff_pad,
+            "vocab": self.vocab_size,
+            "max_positions": self.max_positions,
+            "layer_norm": self.layer_norm,
+            "act_dtype": str(self.act_dtype),
+            "max_seq_len": self._max_seq_len,
+            "chunked_prefill": self.chunked_prefill,
+            "prefill_budget": self.prefill_budget,
+            "mixed_width": self.mixed_width,
+            "topk_cap": self.topk_cap,
+            "buckets": tuple(self.buckets),
+            "kv_dtype": self.kv_dtype,
+            "kv_store_dtype": str(self._kv_store_dtype),
+            "page_size": c.page_size,
+            "pages_per_seq": c.pages_per_seq,
+            "num_pages": c.num_pages,
+            "max_seqs": c.max_seqs,
+            "attn_block_kv": self.attn_block_kv,
+            "adapter_rank": 0 if ac is None else ac.rank,
+            "adapter_slots": 0 if ac is None else ac.num_slots,
+            "tp": self.tp,
+            "use_pallas": bool(self._use_pallas),
+            "interpret": bool(self._interpret),
+        }
 
     # ---------------- model introspection -----------------------------
     def _read_arch(self, model) -> None:
@@ -1607,18 +1653,15 @@ class ServeEngine:
         """Compiled-program count per serving function. After warmup()
         these must never grow — the zero-recompile serving contract
         (the chunked engine's whole hot path is the single `mixed`
-        program). Counted from jax.monitoring's backend-compile events
-        snapshotted around every jitted call (_CompileEvents) — real
-        compiles, not a private jit-cache API that moves across
-        versions — with the engine's distinct argument-shape-signature
-        count as the floor (each distinct signature is one XLA program;
-        the floor is what keeps the gate honest on a jax without the
-        monitoring module). The event count additionally catches a
-        SAME-signature recompile the shape count cannot see."""
-        return {name: max(self._compiles[name],
-                          len(self._shapes_seen[name]))
-                for name in ("prefill", "decode", "mixed", "export",
-                             "import", "adapter")}
+        program). Counted by the ProgramRegistry (core/programs.py),
+        which owns every serving dispatch: a count increments exactly
+        when the registry AOT-compiles a new argument signature, so
+        compiles inside warmup_handoff / adapter load can no longer
+        hide from it (the old monitoring-snapshot counter missed them
+        on a jax without the monitoring module). Executables restored
+        from --program-cache-dir count ZERO — a warm boot reports no
+        compiles, which is the point."""
+        return self.programs.compile_counts()
 
     def _device_pages(self):
         page_sh, scale_sh = self._page_shardings()
@@ -1741,8 +1784,15 @@ class ServeEngine:
         return greedy, topv, topi, kp, vp
 
     def warmup(self) -> Dict[str, int]:
-        """Compile the active path's programs once, on throwaway inputs
-        (all writes aim at the sink page). Returns compile_counts()."""
+        """Ready the active path's programs once, on throwaway inputs
+        (all writes aim at the sink page): compile on a cold boot, or
+        dispatch executables the registry restored from
+        --program-cache-dir on a warm one (zero compiles). Returns
+        compile_counts(); `boot_stats` records which boot this was and
+        what it cost (the `replica_boot` span payload), and a cold
+        engine with a cache dir armed writes its snapshot back so the
+        NEXT boot over this config is warm."""
+        t0 = time.perf_counter()
         c = self.cache_cfg
         kp, vp = self._device_pages()
         if self.chunked_prefill:
@@ -1785,6 +1835,16 @@ class ServeEngine:
                 "decode", self._decode_jit, self.params, kp, vp, toks,
                 pos, toks, pos, pts, sls)
         self._k_pages, self._v_pages = kp, vp
+        rec = self.programs.boot_record()
+        rec["boot_s"] = time.perf_counter() - t0
+        rec["warm"] = rec["compiles"] == 0 and rec["restored"] > 0
+        self.boot_stats = rec
+        if self.programs.cache_dir and self.programs._dirty:
+            # read-through write-back: the first (cold) engine over
+            # this fingerprint populates the snapshot, every later
+            # replica — in-process scale-up or a fresh process —
+            # deserializes instead of compiling
+            self.programs.save()
         return self.compile_counts()
 
     # ---------------- sampling -----------------------------------------

@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import queue
 import threading
 import time
@@ -140,7 +141,8 @@ class Autoscaler:
     shared :class:`MetricsRegistry` (serve_pool_ttft_p99_window_s,
     serve_pool_tpot_p99_window_s, serve_pool_occupancy_mean,
     serve_pool_queue_depth, serve_pool_decode_tokens_per_s_window,
-    serve_pool_replicas_live) — never private engine state — so a
+    serve_pool_replicas_live, serve_pool_boot_cost_s) — never private
+    engine state — so a
     decision is a pure function of (exported metrics, scaler state)
     and replays exactly at one seed. Hysteresis: scale up only after
     ``up_patience`` consecutive hot evaluations, down after
@@ -228,6 +230,14 @@ class Autoscaler:
         occ = m.gauge("serve_pool_occupancy_mean")
         queue = m.gauge("serve_pool_queue_depth")
         demand = m.gauge("serve_pool_decode_tokens_per_s_window")
+        # what the NEXT scale-up costs (serve_pool_boot_cost_s,
+        # ProgramRegistry-measured compile seconds): ~0 when a parked
+        # replica or a --program-cache-dir snapshot makes the boot
+        # warm, the measured compile storm when it would be cold —
+        # attached to the decision so the cost is planning-visible
+        # (it never gates the decision itself: an overloaded pool
+        # must still scale, just with its eyes open)
+        boot_s = m.gauge("serve_pool_boot_cost_s")
         target = self.target_replicas(demand)
 
         reasons = []
@@ -265,7 +275,8 @@ class Autoscaler:
             decision.update(
                 t=t_now, live=live, ttft_p99_s=ttft99,
                 tpot_p99_s=tpot99, occupancy=occ, queue_depth=queue,
-                demand_tokens_per_s=demand, priced_target=target)
+                demand_tokens_per_s=demand, priced_target=target,
+                boot_s=boot_s)
             self.events.append(decision)
             self._hot = self._cold = 0
             self._last_scale_t = t_now
@@ -358,6 +369,12 @@ class ReplicaPool:
                       "fallbacks": 0, "cancels_sent": 0,
                       "scale_ups": 0, "scale_downs": 0}
         self.last_stats: Optional[dict] = None
+        # most recent replica-boot record (_activate_replica): warm vs
+        # cold, wall seconds, and the registry's measured compile
+        # seconds — exported as serve_pool_boot_cost_s so the
+        # autoscaler's scale-up decision prices the boot it is about
+        # to pay
+        self._last_boot: Optional[dict] = None
         for _ in range(int(num_replicas)):
             self._activate_replica(0.0)
         # the pool owns the scrape endpoint (replica engines are built
@@ -385,21 +402,48 @@ class ReplicaPool:
                            **self._engine_kwargs)
 
     def _activate_replica(self, t_now: float) -> Replica:
-        """Scale-up primitive: reactivate a PARKED warm replica
-        (compiled programs intact — zero recompiles) or build + warm
-        a fresh one. Its clock fast-forwards to now (a replica cannot
-        serve the past)."""
+        """Scale-up primitive, cheapest boot first: reactivate a
+        PARKED warm replica (compiled programs intact — zero
+        recompiles); else build a fresh engine, which boots WARM from
+        --program-cache-dir when the ProgramRegistry snapshot covers
+        this config (executables deserialize instead of compiling) and
+        cold otherwise. Every non-parked boot emits a `replica_boot`
+        span labeled warm/cold with the registry's measured compile
+        seconds, and the latest boot cost feeds the
+        serve_pool_boot_cost_s gauge the autoscaler prices scale-ups
+        with. The new replica's clock fast-forwards to now (a replica
+        cannot serve the past)."""
         for r in self.replicas:
             if not r.live:
                 r.live = True
                 r.draining = False
                 r.clock_s = max(r.clock_s, t_now)
+                self._last_boot = {"warm": True, "parked": True,
+                                   "boot_s": 0.0, "compile_s": 0.0,
+                                   "restored": 0, "compiles": 0}
                 return r
+        w0 = time.perf_counter()
         eng = self._new_engine()
         for t, (w, sc) in sorted(self._adapter_registry.items()):
             eng.register_adapter(t, w, scale=sc)
         eng.set_track_process(f"replica{len(self.replicas)}")
         eng.warmup()
+        w1 = time.perf_counter()
+        bs = eng.boot_stats or {}
+        self._last_boot = {
+            "warm": bool(bs.get("warm")), "parked": False,
+            "boot_s": w1 - w0,
+            "compile_s": float(bs.get("compile_s", 0.0)),
+            "restored": int(bs.get("restored", 0)),
+            "compiles": int(bs.get("compiles", 0))}
+        if self.telemetry.enabled:
+            self.telemetry.span(
+                _SCALER_TRACK,
+                f"replica_boot_"
+                f"{'warm' if self._last_boot['warm'] else 'cold'}",
+                w0, w1,
+                args={"replica": len(self.replicas),
+                      "t_virtual": t_now, **self._last_boot})
         r = Replica(len(self.replicas), eng)
         r.clock_s = t_now
         self.replicas.append(r)
@@ -826,6 +870,7 @@ class ReplicaPool:
         routable = self.routable()
         m.set("serve_pool_replicas_live", float(len(routable)))
         m.set("serve_pool_replicas_total", float(len(self.replicas)))
+        m.set("serve_pool_boot_cost_s", self._next_boot_cost_s())
         occs = []
         for r in self.replicas:
             occ = r.occupancy() if r.live else 0.0
@@ -862,6 +907,29 @@ class ReplicaPool:
         viol = m.counter("serve_slo_violations_total")
         m.set("serve_pool_slo_attainment",
               (tot - viol) / tot if tot > 0 else 1.0)
+
+    def _next_boot_cost_s(self) -> float:
+        """Priced cost (seconds of compile) of the NEXT scale-up,
+        exported as serve_pool_boot_cost_s: 0 when a parked warm
+        replica exists or the ProgramRegistry snapshot in
+        --program-cache-dir covers this engine fingerprint (the boot
+        deserializes instead of compiling); otherwise the measured
+        compile seconds of the most recent cold boot — the compile
+        storm made planning-visible instead of an invisible p99
+        cliff."""
+        if any(not r.live for r in self.replicas):
+            return 0.0
+        eng = self.replicas[0].engine
+        reg = getattr(eng, "programs", None)
+        if reg is not None and reg.cache_dir \
+                and os.path.exists(reg._store_path()):
+            return 0.0
+        if self._last_boot and not self._last_boot.get("warm"):
+            cs = float(self._last_boot.get("compile_s", 0.0))
+            if cs > 0:
+                return cs
+        bs = getattr(eng, "boot_stats", None) or {}
+        return float(bs.get("compile_s", 0.0))
 
     def _default_autoscaler(self) -> Autoscaler:
         """The --autoscale autoscaler: SLOs/ceiling from FFConfig,
@@ -925,15 +993,20 @@ class ReplicaPool:
                          direction=decision["direction"])
         if tel.enabled:
             # the scale event is a SPAN: real wall time spent applying
-            # it (a cold replica build shows as a wide span — the
-            # compile-storm cost the AOT-cache ROADMAP item attacks),
-            # virtual decision time in the args
+            # it, virtual decision time in the args. A scale-up's boot
+            # cost is carried by the adjacent `replica_boot` span
+            # (_activate_replica): warm boots — a parked replica or a
+            # --program-cache-dir deserialization — are hairline,
+            # and a cold boot's width IS the measured compile storm
+            # the autoscaler priced into the decision as `boot_s`
             tel.span(_SCALER_TRACK,
                      f"scale_{decision['direction']}", w0,
                      time.perf_counter(),
                      args={"replica": r.idx, "t_virtual": t_now,
                            "reason": decision["reason"],
                            "live": len(self.routable()),
+                           "boot": self._last_boot
+                           if decision["direction"] == "up" else None,
                            "priced_target":
                                decision.get("priced_target")})
 

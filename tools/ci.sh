@@ -215,6 +215,22 @@ echo "--- 1r. host-tier prefix-cache smoke (spill-vs-recompute goodput gate)"
 env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload spill \
     -o /tmp/ci_bench_serve_spill.json || fail=1
 
+echo "--- 1s. warm replica boot smoke (AOT program-cache gate)"
+# the ProgramRegistry AOT compile cache (core/programs.py,
+# --program-cache-dir): a cold engine compiles + snapshots its
+# executables, and a second engine over the same program fingerprint
+# must boot from the deserialized snapshot — fails unless
+# time-to-first-token-ready drops >= 2x, the warm arm's
+# compile_counts() report ZERO compiles (the registry counts exactly,
+# so a hidden compile cannot pass), its greedy tokens equal the
+# in-process cold engine's bit-for-bit, and a corrupted/truncated
+# store falls back to compile-with-warning instead of crashing (the
+# cost_cache.py corrupt-store discipline)
+# (tools/serve_bench.py --workload boot, docs/performance.md
+# "Warm boot")
+env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload boot \
+    -o /tmp/ci_bench_serve_boot.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \

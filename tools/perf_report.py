@@ -183,6 +183,8 @@ def serve_line() -> str:
              "{v:.1f}x threaded wall-clock goodput (wall==virtual)"),
             ("serve_host_tier_goodput_gain",
              "{v:.1f}x host-tier goodput vs eviction"),
+            ("serve_boot_warm_speedup",
+             "{v:.1f}x warm replica boot"),
         )
         for key, fmt in pieces:
             r = recs.get(key)
@@ -192,7 +194,23 @@ def serve_line() -> str:
         if lora is not None:
             tenants = lora.get("extra", {}).get("tenants")
             if tenants:
-                parts[-1] += f" ({int(tenants)} tenants)"
+                idx = [i for i, p in enumerate(parts)
+                       if "batched-LoRA" in p]
+                if idx:
+                    parts[idx[0]] += f" ({int(tenants)} tenants)"
+        # the boot record's cold-vs-warm seconds + programs restored
+        # (the AOT program-cache A/B, serve_bench --workload boot)
+        boot = recs.get("serve_boot_warm_speedup")
+        if boot is not None:
+            e = boot.get("extra", {})
+            idx = [i for i, p in enumerate(parts)
+                   if "warm replica boot" in p]
+            if idx and "cold_ready_s" in e and "warm_ready_s" in e:
+                parts[idx[0]] += (
+                    f" ({e['cold_ready_s']:.2f}s cold -> "
+                    f"{e['warm_ready_s']:.2f}s, "
+                    f"{int(e.get('programs_restored', 0))} programs "
+                    f"restored)")
         # SLO attainment from the EXPORTED pool registry gauge the
         # router workload recorded (serve_pool_slo_attainment — not an
         # ad-hoc stat string), and the worst simulator drift ratio

@@ -118,7 +118,7 @@ def main() -> int:
     ap.add_argument("--workload",
                     choices=("all", "base", "spec", "kv", "shard",
                              "telemetry", "disagg", "router", "lora",
-                             "fabric", "spill"),
+                             "fabric", "spill", "boot"),
                     default="all",
                     help="base = random + shared-prefix (ci.sh 1d), "
                     "spec = repetitive speculative decode (ci.sh 1f), "
@@ -158,7 +158,15 @@ def main() -> int:
                     "rung-3-style no-match, gating >= 1.3x "
                     "goodput-under-SLO over BOTH baselines + token "
                     "identity + zero recompiles + priced "
-                    "spill-vs-recompute decisions (ci.sh 1r)")
+                    "spill-vs-recompute decisions (ci.sh 1r), "
+                    "boot = cold vs warm replica boot A/B through the "
+                    "ProgramRegistry AOT snapshot (--program-cache-dir, "
+                    "core/programs.py): cold engine construction + "
+                    "warmup vs one that deserializes its executables, "
+                    "gating >= 2x time-to-ready reduction, ZERO "
+                    "compiles + token identity on the warm arm, and "
+                    "corrupt-store fallback (compile-with-warning, "
+                    "never a crash) (ci.sh 1s)")
     ap.add_argument("--trace-out", default="",
                     help="write the telemetry workload's Chrome "
                     "trace-event JSON here (Perfetto-loadable; default "
@@ -1994,6 +2002,110 @@ def main() -> int:
                 "adapter_evictions": pool["evictions"],
                 "outputs_identical": True,
                 "compile_counts": eng_a.compile_counts(),
+            },
+        })
+
+    # ---------------- workload: cold vs warm replica boot --------------
+    if args.workload in ("all", "boot"):
+        # A/B the tentpole claim of the program registry
+        # (core/programs.py): an engine whose --program-cache-dir holds
+        # an AOT executable snapshot for its fingerprint must reach
+        # first-token-ready >= 2x faster than a cold one, compile
+        # NOTHING (compile_counts() all zero, the warm-boot contract),
+        # and produce token-identical greedy output. The cold arm runs
+        # FIRST so nothing (the registry's jax persistent-cache arming
+        # included) can warm XLA under it.
+        import glob
+        import shutil
+        import tempfile
+        import warnings as _warnings
+
+        boot_prompts = [list(rng.randint(1, args.vocab, size=12))
+                        for _ in range(4)]
+        boot_new = max(4, min(8, args.max_new))
+
+        def _boot_arm(cache_dir):
+            """(engine, seconds-to-ready, greedy outputs): construction
+            + warmup is the time a scale-up waits before the replica
+            can serve — the number the autoscaler's boot_s prices."""
+            bcfg = dataclasses.replace(cfg,
+                                       program_cache_dir=cache_dir)
+            t0 = time.perf_counter()
+            eng = ServeEngine(ff, config=bcfg)
+            eng.warmup()
+            ready_s = time.perf_counter() - t0
+            out = eng.generate(boot_prompts, boot_new)
+            return eng, ready_s, out
+
+        eng_cold, cold_s, out_cold = _boot_arm(None)
+        assert sum(eng_cold.compile_counts().values()) > 0, (
+            "cold arm compiled nothing — the A/B is vacuous")
+
+        boot_dir = tempfile.mkdtemp(prefix="ffprog_boot_")
+        try:
+            # populate: the first engine over this (fingerprint, dir)
+            # compiles and writes the snapshot back (warmup's
+            # read-through write-back)
+            eng_pop, _, _ = _boot_arm(boot_dir)
+            eng_pop.close()
+            eng_warm, warm_s, out_warm = _boot_arm(boot_dir)
+            warm_counts = eng_warm.compile_counts()
+            assert sum(warm_counts.values()) == 0, (
+                f"warm arm compiled: {warm_counts} (expected zero — "
+                f"every program should deserialize from the snapshot)")
+            assert out_warm == out_cold, (
+                "warm-boot outputs diverged from the in-process cold "
+                "engine (the snapshot must be bit-identical)")
+            restored = int(eng_warm.boot_stats["restored"])
+            assert restored > 0 and eng_warm.boot_stats["warm"], (
+                f"warm arm restored nothing: {eng_warm.boot_stats}")
+            speedup = cold_s / max(warm_s, 1e-9)
+            if speedup < 2.0:
+                msg = (f"warm-boot speedup {speedup:.2f}x < 2x "
+                       f"(cold {cold_s:.2f}s vs warm {warm_s:.2f}s)")
+                assert not args.smoke, msg
+                print(f"WARNING: {msg}", file=sys.stderr)
+
+            # stale-cache rejection: a corrupt/truncated store must
+            # fall back to compile-with-warning, never crash (the
+            # cost_cache.py corrupt-store discipline)
+            (store,) = glob.glob(os.path.join(boot_dir, "*.ffprog"))
+            with open(store, "wb") as f:
+                f.write(b"not a program snapshot")
+            with _warnings.catch_warnings(record=True) as wlog:
+                _warnings.simplefilter("always")
+                eng_bad, _, out_bad = _boot_arm(boot_dir)
+            assert any("program cache" in str(w.message)
+                       for w in wlog), (
+                "corrupt store produced no fallback warning")
+            assert sum(eng_bad.compile_counts().values()) > 0, (
+                "corrupt store arm compiled nothing — fallback "
+                "did not recompile")
+            assert out_bad == out_cold, (
+                "corrupt-store fallback diverged from the cold engine")
+            eng_bad.close()
+            eng_warm.close()
+        finally:
+            shutil.rmtree(boot_dir, ignore_errors=True)
+        eng_cold.close()
+
+        gates.append(f"boot_warm={speedup:.1f}x>=2x "
+                     f"{restored} restored 0 warm compiles exact "
+                     f"corrupt-fallback")
+        records.append({
+            "metric": "serve_boot_warm_speedup",
+            "value": round(speedup, 2),
+            "unit": "x",
+            "extra": {
+                "platform": jax.default_backend(),
+                "cold_ready_s": round(cold_s, 3),
+                "warm_ready_s": round(warm_s, 3),
+                "programs_restored": restored,
+                "cold_compile_s": round(
+                    float(eng_cold.boot_stats["compile_s"]), 3),
+                "warm_compile_counts": warm_counts,
+                "outputs_identical": True,
+                "corrupt_fallback": True,
             },
         })
 
