@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import sys
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +56,14 @@ class FFIterationConfig:
 
     def reset(self) -> None:
         self.seq_length = -1
+
+
+def _int_or_auto(v) -> Union[int, str]:
+    """--serve-replicas value parser: an explicit replica count, or
+    'auto' to resolve the pool shape through the 2-D serve-mesh
+    search (search/serve_place.optimize_serve_mesh)."""
+    s = str(v).strip()
+    return "auto" if s == "auto" else int(s)
 
 
 @dataclasses.dataclass
@@ -485,7 +493,12 @@ class FFConfig:
     # multi-replica serving tier (serve/router.py, docs/serving.md
     # "Multi-replica routing"): N engine replicas behind a request
     # router. serve_replicas sizes the starting pool
-    # (--serve-replicas); router_policy picks how requests land —
+    # (--serve-replicas): an integer, or "auto" to resolve the
+    # (tensor degree, replica count) shape through the 2-D serve-mesh
+    # search (search/serve_place.optimize_serve_mesh, docs/search.md
+    # "2-D serve mesh") — with --serve-mesh N the degree is pinned and
+    # only the replica count is searched; with --serve-mesh auto the
+    # ONE walk prices both. router_policy picks how requests land —
     # "affinity" routes to the replica whose chain-hash prefix
     # registry holds the LONGEST matching prefix of the prompt (a
     # host-side dict probe per page-aligned block; tenant-sticky
@@ -499,7 +512,7 @@ class FFConfig:
     # pool-occupancy gauges vs the SLOs, priced against the placement
     # search's per-degree decode table; --autoscale), scaling between
     # 1 and serve_autoscale_max replicas (0 = 2x serve_replicas).
-    serve_replicas: int = 1
+    serve_replicas: Union[int, str] = 1
     router_policy: str = "affinity"
     slo_ttft_ms: float = 0.0
     slo_tpot_ms: float = 0.0
@@ -688,7 +701,12 @@ class FFConfig:
             raise ValueError(
                 f"serve_disagg_decode_budget must be >= 0 (0 = two "
                 f"pages' worth), got {self.serve_disagg_decode_budget}")
-        if self.serve_replicas < 1:
+        if isinstance(self.serve_replicas, str):
+            if self.serve_replicas.strip() != "auto":
+                raise ValueError(
+                    f"serve_replicas must be an integer >= 1 or "
+                    f"'auto', got {self.serve_replicas!r}")
+        elif self.serve_replicas < 1:
             raise ValueError(
                 f"serve_replicas must be >= 1, got "
                 f"{self.serve_replicas}")
@@ -824,7 +842,7 @@ class FFConfig:
         "--serve-disagg-ratio": ("serve_disagg_ratio", str),
         "--serve-disagg-decode-budget": ("serve_disagg_decode_budget",
                                          int),
-        "--serve-replicas": ("serve_replicas", int),
+        "--serve-replicas": ("serve_replicas", _int_or_auto),
         "--router-policy": ("router_policy", str),
         "--slo-ttft-ms": ("slo_ttft_ms", float),
         "--slo-tpot-ms": ("slo_tpot_ms", float),

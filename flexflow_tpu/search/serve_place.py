@@ -19,6 +19,16 @@ as the tiebreak-weighted second workload. Costs persist in the SAME
 CostCache as op costs, scoped by a machine fingerprint that folds the
 serve signature (cost_cache.machine_fingerprint(serve=...)) — a
 placement or KV-dtype flip is a guaranteed cache miss.
+
+``optimize_serve_mesh`` closes the search at the POOL level — the 2-D
+(tensor x data) space a ``--serve-replicas auto`` ReplicaPool boots
+from: one walk over (tensor degree, replica count, torus-axis
+assignment for each) with t*r <= the device budget, priced by a
+goodput-under-SLO objective that composes the per-replica step price
+with a traffic model (arrival split across replicas, prefix-affinity
+hit discount, HBM feasibility per degree from serve_device_bytes —
+infeasible degrees rejected, not penalized). See docs/search.md
+"2-D serve mesh".
 """
 
 from __future__ import annotations
@@ -26,9 +36,10 @@ from __future__ import annotations
 import dataclasses
 import math
 import random
+import warnings
 from typing import Dict, List, Optional, Tuple
 
-from .cost_model import ServeArch, kv_handoff_bytes
+from .cost_model import ServeArch, kv_handoff_bytes, serve_device_bytes
 from .machine_model import TPUMachineModel
 from .simulator import simulate_serve_step
 
@@ -62,6 +73,16 @@ class ServePlacement:
 
     def speedup_vs_single(self) -> float:
         base = self.decode_by_degree.get(1)
+        if base is None:
+            # a partial-budget search (or a head count not divisible
+            # by 1 — impossible, but a fixed-degree table) can return
+            # a table without the t=1 baseline; the ratio degrades to
+            # 1.0 so report renderers keep working
+            warnings.warn(
+                "serve decode table has no t=1 baseline; reporting "
+                "speedup_vs_single as 1.0x",
+                RuntimeWarning, stacklevel=2)
+            return 1.0
         if not base or not self.decode_step_s:
             return 1.0
         return base / self.decode_step_s
@@ -80,31 +101,41 @@ def axis_assignments(mm: TPUMachineModel, t: int) -> List[Tuple[int, ...]]:
     """Physical layouts the serve axis could take on this machine: the
     flat single ring always, plus every contiguous run of the spec's
     ICI torus dims whose product is exactly t (a k-dim assignment runs
-    ring phases over k link sets concurrently —
-    machine_model._phys)."""
+    ring phases over k link sets concurrently — machine_model._phys).
+    Deduplicated: on a square/cubic torus symmetric runs produce the
+    SAME dims tuple (e.g. (4, 4) yields (4,) twice at t=4) and the
+    cost model prices dims, not positions — duplicates would only
+    burn walk proposals on candidates already visited."""
     out: List[Tuple[int, ...]] = [()]
+    seen = {()}
     dims = tuple(getattr(mm.spec, "ici_torus_dims", ()) or ())
     for i in range(len(dims)):
         prod = 1
         for j in range(i, len(dims)):
             prod *= dims[j]
             if prod == t:
-                out.append(dims[i:j + 1])
+                run = dims[i:j + 1]
+                if run not in seen:
+                    seen.add(run)
+                    out.append(run)
             if prod >= t:
                 break
     return out
 
 
-def _serve_fingerprint(mm: TPUMachineModel, arch: ServeArch) -> str:
+def _serve_signature(arch: ServeArch) -> Tuple:
     # serve_v2: LoRA adapter pricing (adapter_rank/adapter_slots fold
     # in) — rows priced by the pre-adapter formulas can never
     # resurrect into an adapter-aware search, and vice versa
+    return ("serve_v2", arch.kv_dtype, arch.act_dtype,
+            arch.kv_itemsize, arch.act_itemsize,
+            arch.param_itemsize, arch.adapter_rank,
+            arch.adapter_slots)
+
+
+def _serve_fingerprint(mm: TPUMachineModel, arch: ServeArch) -> str:
     from .cost_cache import machine_fingerprint
-    return machine_fingerprint(
-        mm, serve=("serve_v2", arch.kv_dtype, arch.act_dtype,
-                   arch.kv_itemsize, arch.act_itemsize,
-                   arch.param_itemsize, arch.adapter_rank,
-                   arch.adapter_slots))
+    return machine_fingerprint(mm, serve=_serve_signature(arch))
 
 
 def price_placement(arch: ServeArch, t: int, mm: TPUMachineModel,
@@ -247,6 +278,390 @@ def optimize_serve(arch: ServeArch, num_devices: int, *,
 
 
 # ---------------------------------------------------------------------------
+# 2-D (tensor x data) serve mesh placement — docs/search.md "2-D serve mesh"
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class MeshTraffic:
+    """The traffic model the 2-D mesh objective prices a pool against:
+    an aggregate arrival rate split across the replica count, a
+    prefix-affinity hit rate over shared preambles (discounted as
+    replicas multiply — each replica's cache must see a preamble once
+    before it hits), and the SLO targets that turn throughput into
+    goodput. Every field folds into the mesh cost-cache fingerprint
+    (:func:`_mesh_fingerprint`), so an SLO or rate flip is a
+    guaranteed cache miss."""
+    arrival_rps: float = 8.0
+    # fraction of a steady-state prompt's tokens served from the
+    # prefix cache when ONE replica has seen the preamble
+    prefix_hit: float = 0.0
+    # how many requests share each preamble (tenant fan-in): the
+    # hit-rate discount spreads each preamble's one-per-replica cold
+    # prefill over this many requests
+    requests_per_preamble: float = 8.0
+    slo_ttft_s: float = 0.0     # 0 = unbounded
+    slo_tpot_s: float = 0.0
+
+    @classmethod
+    def from_config(cls, config=None, **over) -> "MeshTraffic":
+        """SLO targets from FFConfig's --slo-ttft-ms/--slo-tpot-ms;
+        any field overridable by keyword."""
+        kw = {}
+        if config is not None:
+            tt = float(getattr(config, "slo_ttft_ms", 0.0) or 0.0)
+            tp = float(getattr(config, "slo_tpot_ms", 0.0) or 0.0)
+            if tt:
+                kw["slo_ttft_s"] = tt / 1e3
+            if tp:
+                kw["slo_tpot_s"] = tp / 1e3
+        kw.update(over)
+        return cls(**kw)
+
+    def signature(self) -> Tuple:
+        return ("mesh_v1", float(self.arrival_rps),
+                float(self.prefix_hit),
+                float(self.requests_per_preamble),
+                float(self.slo_ttft_s), float(self.slo_tpot_s))
+
+
+def _mesh_fingerprint(mm: TPUMachineModel, arch: ServeArch,
+                      traffic: MeshTraffic) -> str:
+    """The 1-D serve fingerprint widened with the traffic/SLO tuple:
+    mesh rows can never resurrect across a kv-dtype, adapter-geometry,
+    arrival-rate or SLO-target flip (the acceptance-criteria miss
+    guarantee — step prices don't depend on the SLO, but pricing them
+    under the wider scope trades a few re-simulations for a fingerprint
+    a test can audit field by field)."""
+    from .cost_cache import machine_fingerprint
+    return machine_fingerprint(
+        mm, serve=_serve_signature(arch) + traffic.signature())
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeMeshPlacement:
+    """One 2-D (tensor x data) pool placement the mesh search priced
+    (the winner when returned by optimize_serve_mesh): shard the mixed
+    program ``tensor_parallel`` ways, run ``replicas`` data-parallel
+    copies of it (t*r <= the device budget), each axis riding the
+    recorded torus dims (() = flat ring). ``table`` is the full priced
+    (t, r) grid — what the autoscaler's target pricing and the
+    chosen-vs-rejected explain render read — and ``infeasible`` the
+    degrees whose per-device residency (serve_device_bytes: weight
+    shard + KV pool + adapter pool) overflows HBM: rejected before
+    pricing, never penalty-priced."""
+    tensor_parallel: int
+    replicas: int
+    tensor_axis_dims: Tuple[int, ...]
+    data_axis_dims: Tuple[int, ...]
+    decode_step_s: float
+    prefill_step_s: float
+    mixed_step_s: float
+    goodput_per_s: float
+    cost: float
+    num_devices: int = 0
+    # (t, r) -> cell metrics dict (goodput_per_s, capacity_rps,
+    # tokens_per_s, tpot_s, ttft_s, decode/prefill/mixed_step_s,
+    # slo_ok, device_bytes) for every FEASIBLE cell
+    table: Dict[Tuple[int, int], dict] = dataclasses.field(
+        default_factory=dict)
+    # HBM-rejected degrees: {"tensor", "device_bytes", "hbm_capacity",
+    # "reason"} — one entry per rejected t (every r shares the verdict)
+    infeasible: Tuple[dict, ...] = ()
+    # per-degree decode step at the flat ring (feasible degrees only):
+    # the 1-D table shape the autoscaler's fallback pricing reads
+    decode_by_degree: Dict[int, float] = dataclasses.field(
+        default_factory=dict)
+    traffic: Optional[dict] = None
+    fingerprint: str = ""
+    trace: Optional[dict] = None
+
+    def cell(self, t: int, r: int) -> Optional[dict]:
+        return self.table.get((int(t), int(r)))
+
+    def _best_goodput(self, pred) -> float:
+        vals = [c["goodput_per_s"] for k, c in self.table.items()
+                if pred(k)]
+        return max(vals) if vals else 0.0
+
+    def goodput_gain_vs_tensor_only(self) -> float:
+        """Chosen cell's goodput over the best r=1 (pure tensor)
+        column — one of the two degenerate baselines the bench gates."""
+        base = self._best_goodput(lambda k: k[1] == 1)
+        return self.goodput_per_s / max(base, 1e-12)
+
+    def goodput_gain_vs_replicas_only(self) -> float:
+        """Chosen cell's goodput over the best t=1 (pure replicas)
+        row; infinite when t=1 never fit HBM (the rejection IS the
+        win)."""
+        base = self._best_goodput(lambda k: k[0] == 1)
+        return self.goodput_per_s / max(base, 1e-12)
+
+
+def price_mesh_step(arch: ServeArch, t: int, mm: TPUMachineModel,
+                    axis_dims: Tuple[int, ...] = (), cache=None,
+                    fingerprint: str = ""
+                    ) -> Tuple[float, float, float]:
+    """(decode_step_s, prefill_step_s, mixed_step_s) of one tensor
+    degree, through the persistent cost cache when given — the mesh
+    search's step-price row (the 1-D row plus the mixed-width step the
+    pool's TPOT actually runs at), stored under the WIDENED mesh
+    fingerprint + the full arch signature."""
+    key = None
+    if cache is not None:
+        key = cache.entry_key("serve_mesh_step", (t, tuple(axis_dims)),
+                              extra=arch.signature())
+        row = cache.get(fingerprint, key)
+        if row is not None:
+            return row.fwd, row.bwd, row.fwd_comm
+    dec = simulate_serve_step(arch, t, mm, axis_dims=axis_dims)
+    pre = simulate_serve_step(arch, t, mm, axis_dims=axis_dims,
+                              lanes=arch.prefill_lanes)
+    mixed = simulate_serve_step(
+        arch, t, mm, axis_dims=axis_dims,
+        lanes=arch.decode_lanes + arch.prefill_lanes)
+    if cache is not None:
+        from .cost_model import OpCost
+        cache.put(fingerprint, key,
+                  OpCost(fwd=dec, bwd=pre, fwd_comm=mixed,
+                         bwd_comm=0.0, sync=0.0, mem=0.0))
+    return dec, pre, mixed
+
+
+def mesh_cell_metrics(arch: ServeArch, t: int, r: int, dec: float,
+                      pre: float, mixed: float,
+                      traffic: MeshTraffic) -> dict:
+    """The pool-level objective of one feasible (t, r) cell: compose
+    the per-replica step prices with the traffic model into
+    goodput-under-SLO.
+
+    Steady state: each request decodes ``decode_tokens`` tokens on a
+    lane of the mixed-width step (TPOT = the mixed step — decode lanes
+    pay for the prefill budget riding along) and prefills the NON-hit
+    fraction of its context in budget-sized chunks. The prefix-hit
+    discount shrinks with r (each replica's cache must ingest a
+    preamble once, amortized over the requests sharing it), which is
+    exactly the force pulling AGAINST replicas that the 2-D search
+    trades off. Capacity is r requests in flight per per-request
+    seconds; TTFT is the prefill time inflated by 1/(1-rho) queueing
+    as utilization approaches saturation; goodput is arrival capped by
+    capacity, zeroed when either SLO target (when set) is violated."""
+    dtok = max(1, int(getattr(arch, "decode_tokens", 64)))
+    h = float(traffic.prefix_hit) * max(
+        0.0, 1.0 - (r - 1.0) / max(1.0, traffic.requests_per_preamble))
+    h = min(1.0, max(0.0, h))
+    fresh_tokens = arch.context * (1.0 - h)
+    chunks = max(1, math.ceil(fresh_tokens / max(1, arch.prefill_lanes)))
+    per_request_s = (mixed * dtok / max(1, arch.decode_lanes)
+                     + pre * chunks)
+    capacity_rps = r / max(1e-12, per_request_s)
+    rho = min(0.999, traffic.arrival_rps / max(1e-12, capacity_rps))
+    tpot_s = mixed
+    ttft_s = pre * chunks / (1.0 - rho)
+    slo_ok = not ((traffic.slo_tpot_s and tpot_s > traffic.slo_tpot_s)
+                  or (traffic.slo_ttft_s
+                      and ttft_s > traffic.slo_ttft_s))
+    goodput = min(traffic.arrival_rps, capacity_rps) if slo_ok else 0.0
+    return {
+        "tensor": t, "replicas": r,
+        "goodput_per_s": goodput,
+        "capacity_rps": capacity_rps,
+        # pool decode-token throughput ceiling — what the autoscaler's
+        # demand gauge (decode tokens/s) compares against
+        "tokens_per_s": r * arch.decode_lanes / max(1e-12, mixed),
+        "tpot_s": tpot_s, "ttft_s": ttft_s,
+        "prefix_hit_effective": h,
+        "prefill_chunks": chunks,
+        "decode_step_s": dec, "prefill_step_s": pre,
+        "mixed_step_s": mixed,
+        "slo_ok": bool(slo_ok),
+    }
+
+
+def optimize_serve_mesh(arch: ServeArch, num_devices: int, *,
+                        mm: Optional[TPUMachineModel] = None,
+                        config=None,
+                        traffic: Optional[MeshTraffic] = None,
+                        budget: int = 96, alpha: float = 0.05,
+                        seed: Optional[int] = None,
+                        fixed_tensor: Optional[int] = None,
+                        fixed_replicas: Optional[int] = None
+                        ) -> ServeMeshPlacement:
+    """The paper's ONE-search discipline applied to the serving pool:
+    a single Metropolis walk over 2-D (tensor degree x replica count)
+    placements with a torus-axis assignment for each axis, t*r bounded
+    by the device budget, priced by the pool-level goodput-under-SLO
+    objective (:func:`mesh_cell_metrics`). Degrees whose per-device
+    residency overflows HBM are REJECTED up front (never proposed,
+    never penalty-priced) — the feasibility frontier is part of the
+    answer, recorded in ``infeasible``.
+
+    Every feasible (t, r) is priced once at the flat ring first so the
+    returned table is complete (the exhaustive half, affordable
+    because the grid is divisors x counts); the walk then explores
+    axis assignments under the same accept rule as ``optimize_serve``.
+    ``fixed_tensor``/``fixed_replicas`` pin one dimension (an explicit
+    --serve-mesh N beside --serve-replicas auto, or vice versa).
+    Step prices persist in the shared CostCache under the widened
+    :func:`_mesh_fingerprint`."""
+    if mm is None:
+        from .machine_model import default_machine_model
+        mm = default_machine_model(
+            machine_file=getattr(config, "machine_model_file", None)
+            if config is not None else None)
+    if traffic is None:
+        traffic = MeshTraffic.from_config(config)
+    if seed is None:
+        seed = int(getattr(config, "seed", 0) or 0) \
+            if config is not None else 0
+    n = max(1, int(num_devices))
+    cache = None
+    fingerprint = ""
+    if config is None or getattr(config, "search_cost_cache", True):
+        from .cost_cache import CostCache
+        cache = CostCache.open(
+            (getattr(config, "cost_cache_file", None) or None)
+            if config is not None else None)
+        fingerprint = _mesh_fingerprint(mm, arch, traffic)
+
+    degrees = candidate_degrees(arch, n)
+    if fixed_tensor is not None:
+        t0 = int(fixed_tensor)
+        if t0 not in degrees:
+            raise ValueError(
+                f"fixed tensor degree {t0} is not a feasible degree "
+                f"for {arch.num_heads} heads on {n} devices")
+        degrees = [t0]
+    hbm = float(getattr(mm.spec, "hbm_capacity", float("inf")))
+    infeasible: List[dict] = []
+    feasible: List[int] = []
+    for t in degrees:
+        b = serve_device_bytes(arch, t)
+        if b > hbm:
+            infeasible.append({
+                "tensor": t, "device_bytes": b, "hbm_capacity": hbm,
+                "reason": f"per-device residency "
+                          f"{b / 2**20:.1f} MiB > HBM "
+                          f"{hbm / 2**20:.1f} MiB"})
+        else:
+            feasible.append(t)
+    if not feasible:
+        raise ValueError(
+            f"no tensor degree fits HBM on this machine "
+            f"({[d['reason'] for d in infeasible]})")
+
+    def replica_counts(t: int) -> List[int]:
+        top = n // t
+        if fixed_replicas is not None:
+            rr = int(fixed_replicas)
+            return [rr] if 1 <= rr <= top else []
+        return list(range(1, top + 1))
+
+    step_cache: Dict[Tuple[int, Tuple[int, ...]], Tuple[float, float,
+                                                        float]] = {}
+
+    def steps_of(t: int, dims: Tuple[int, ...]):
+        k = (t, tuple(dims))
+        if k not in step_cache:
+            step_cache[k] = price_mesh_step(
+                arch, t, mm, dims, cache=cache, fingerprint=fingerprint)
+        return step_cache[k]
+
+    def cost_of(cand) -> Tuple[float, dict]:
+        t, r, tdims, _ddims = cand
+        dec, pre, mixed = steps_of(t, tdims)
+        cell = mesh_cell_metrics(arch, t, r, dec, pre, mixed, traffic)
+        # goodput carries the objective; TPOT then TTFT break ties
+        # between cells that both sustain the arrival rate (prefer the
+        # lower-latency shape), and a vanishing device-count term makes
+        # equal-everything ties deterministic
+        cost = (-cell["goodput_per_s"] + cell["tpot_s"]
+                + 1e-3 * cell["ttft_s"] + 1e-9 * t * r)
+        return cost, cell
+
+    # exhaustive flat-ring pricing of the full feasible grid: the
+    # returned table must be complete even where the walk never lands
+    table: Dict[Tuple[int, int], dict] = {}
+    decode_by_degree: Dict[int, float] = {}
+    best = None
+    best_cost = float("inf")
+    best_cell: Optional[dict] = None
+    for t in feasible:
+        for r in replica_counts(t):
+            c, cell = cost_of((t, r, (), ()))
+            table[(t, r)] = cell
+            decode_by_degree[t] = cell["decode_step_s"]
+            if c < best_cost:
+                best, best_cost, best_cell = (t, r, (), ()), c, cell
+    if best is None:
+        raise ValueError(
+            f"no (t, r) cell fits {n} devices with "
+            f"fixed_tensor={fixed_tensor} "
+            f"fixed_replicas={fixed_replicas}")
+
+    space: List[Tuple[int, int, Tuple[int, ...], Tuple[int, ...]]] = [
+        (t, r, tdims, ddims)
+        for t in feasible for r in replica_counts(t)
+        for tdims in axis_assignments(mm, t)
+        for ddims in axis_assignments(mm, r)]
+    rng = random.Random(seed)
+    walk_budget = max(len(space), int(budget))
+    trace = None
+    if config is None or getattr(config, "search_trace", True):
+        from .trace import SearchTrace
+        trace = SearchTrace(budget=walk_budget)
+        trace.record_best(-1, 0, best_cost)
+    cur, cur_cost = best, best_cost
+    for it in range(walk_budget):
+        nxt = space[rng.randrange(len(space))]
+        if nxt == cur:
+            continue
+        nxt_cost, nxt_cell = cost_of(nxt)
+        cell_key = (nxt[0], nxt[1])
+        if nxt_cell["goodput_per_s"] >= table[cell_key][
+                "goodput_per_s"] and nxt[2] != ():
+            # a torus-assigned step that beats the flat ring upgrades
+            # the table's cell (the table records each cell's BEST)
+            if nxt_cost < cost_of((nxt[0], nxt[1], (), ()))[0]:
+                table[cell_key] = nxt_cell
+        delta = nxt_cost - cur_cost
+        temp = alpha * max(1e-12, abs(cur_cost))
+        accepted = delta <= 0 or rng.random() < math.exp(
+            -delta / max(1e-12, temp))
+        if accepted:
+            cur, cur_cost = nxt, nxt_cost
+            if cur_cost < best_cost:
+                best, best_cost, best_cell = cur, cur_cost, nxt_cell
+                if trace is not None:
+                    trace.record_best(it, 0, best_cost)
+        if trace is not None:  # observation only, after the decision —
+            # traced and untraced walks consume the RNG identically
+            trace.record(it, 0, "serve_mesh",
+                         f"t={nxt[0]} r={nxt[1]} "
+                         f"tdims={tuple(nxt[2])} "
+                         f"ddims={tuple(nxt[3])}", delta,
+                         accepted, temp, "serve")
+    if cache is not None:
+        cache.flush()
+    t, r, tdims, ddims = best
+    return ServeMeshPlacement(
+        tensor_parallel=t, replicas=r,
+        tensor_axis_dims=tuple(tdims), data_axis_dims=tuple(ddims),
+        decode_step_s=best_cell["decode_step_s"],
+        prefill_step_s=best_cell["prefill_step_s"],
+        mixed_step_s=best_cell["mixed_step_s"],
+        goodput_per_s=best_cell["goodput_per_s"],
+        cost=best_cost, num_devices=n,
+        table=dict(sorted(table.items())),
+        infeasible=tuple(infeasible),
+        decode_by_degree=dict(sorted(decode_by_degree.items())),
+        traffic=dict(zip(("version", "arrival_rps", "prefix_hit",
+                          "requests_per_preamble", "slo_ttft_s",
+                          "slo_tpot_s"), traffic.signature())),
+        fingerprint=fingerprint,
+        trace=trace.summary() if trace is not None else None)
+
+
+# ---------------------------------------------------------------------------
 # Disaggregated prefill/decode placement (serve/disagg.py's search half)
 # ---------------------------------------------------------------------------
 
@@ -285,8 +700,16 @@ class DisaggPlacement:
 
     def tpot_reduction_vs_unified(self) -> float:
         """Simulated TPOT win of the split: the unified engine's
-        mixed-width step over the decode engine's decode-only step."""
-        if not self.decode_step_s or not self.unified_tpot_s:
+        mixed-width step over the decode engine's decode-only step.
+        Degrades to 1.0 with a warning when the unified baseline was
+        never priced (a partial-budget search)."""
+        if not self.unified_tpot_s:
+            warnings.warn(
+                "disagg placement has no unified-baseline TPOT; "
+                "reporting tpot_reduction_vs_unified as 1.0x",
+                RuntimeWarning, stacklevel=2)
+            return 1.0
+        if not self.decode_step_s:
             return 1.0
         return self.unified_tpot_s / self.decode_step_s
 

@@ -144,6 +144,63 @@ def _dense(p, x, activation=None, psum_axis=None):
     return y
 
 
+def probe_serve_arch(model, config=None, context=None):
+    """The ServeArch a ServeEngine over ``model`` + ``config`` would
+    price, WITHOUT building the engine — what ReplicaPool's 2-D mesh
+    resolution (``--serve-replicas auto``) feeds
+    search/serve_place.optimize_serve_mesh before any replica exists
+    (the searched degree decides how the first engine is built, so
+    the arch must be priceable engine-free). Same model introspection
+    as ServeEngine._read_arch / serve_arch: decode lanes = the slot
+    reserve, prefill lanes = the budget, steady-state context = 3/4
+    of the learned positions, adapter-pool geometry from the
+    --adapter-* knobs via AdapterConfig.from_ff."""
+    from ..search.cost_model import ServeArch
+    from .kv_cache import QUANTIZED_KV_DTYPES
+    cfg = config if config is not None else model.config
+    if model.state is None:
+        from ..config import CompMode
+        model.compile(comp_mode=CompMode.INFERENCE)
+    ops = {op.name: op for op in model.ops}
+    for required in ("tok_embed", "pos_embed", "lm_head"):
+        if required not in ops:
+            raise ValueError(
+                f"serve placement needs a build_transformer_lm-shaped "
+                f"model (missing op {required!r})")
+    num_layers = 0
+    while f"layer{num_layers}_attn" in ops:
+        num_layers += 1
+    if num_layers == 0:
+        raise ValueError("model has no layer{i}_attn blocks")
+    attn0 = ops["layer0_attn"]
+    act_dtype = jnp.dtype(ops["tok_embed"].out_dtype)
+    ff_dim = int(model.state.params["layer0_ff1"]["kernel"].shape[1])
+    max_seq = int(ops["pos_embed"].num_entries)
+    kv_name = str(getattr(cfg, "kv_dtype", "float32"))
+    acfg = None
+    if int(getattr(cfg, "adapter_rank", 0) or 0) > 0:
+        from .adapters import AdapterConfig
+        acfg = AdapterConfig.from_ff(
+            cfg, num_layers=num_layers, hidden=attn0.embed_dim,
+            num_heads=attn0.num_heads, head_dim=attn0.head_dim,
+            ff_dim=ff_dim, act_itemsize=int(act_dtype.itemsize))
+    return ServeArch(
+        num_layers=num_layers, hidden=attn0.embed_dim,
+        num_heads=attn0.num_heads, head_dim=attn0.head_dim,
+        ff_dim=ff_dim, vocab=int(ops["tok_embed"].num_entries),
+        decode_lanes=int(getattr(cfg, "serve_max_seqs", 8)),
+        prefill_lanes=int(getattr(cfg, "serve_prefill_budget", 512)),
+        context=int(context if context is not None
+                    else max(1, max_seq * 3 // 4)),
+        kv_dtype=kv_name,
+        kv_itemsize=float(kv_storage_dtype(kv_name).itemsize),
+        kv_scales=kv_name in QUANTIZED_KV_DTYPES,
+        act_itemsize=float(act_dtype.itemsize),
+        act_dtype=str(act_dtype.name),
+        adapter_rank=acfg.rank if acfg is not None else 0,
+        adapter_slots=acfg.num_slots if acfg is not None else 0)
+
+
 class ServeEngine:
     """Continuous-batching generation over a build_transformer_lm model.
 
@@ -2117,10 +2174,26 @@ class ServeEngine:
                 from ..search.simulator import (serve_step_breakdown,
                                                 simulate_serve_step)
                 arch = self.serve_arch(context=max(1, ctx_bucket))
+                # price on the SAME machine model the placement search
+                # was calibrated against: --machine-model-file, when
+                # set, overrides the default spec (HBM capacity
+                # included — a pool whose degree overflows it pays the
+                # memory penalty in its virtual step price, exactly
+                # what the 2-D mesh search predicted when it rejected
+                # that degree)
+                mm = None
+                mf = getattr(self.config, "machine_model_file", None)
+                if mf:
+                    from ..search.machine_model import \
+                        default_machine_model
+                    if getattr(self, "_drift_mm", None) is None:
+                        self._drift_mm = default_machine_model(
+                            machine_file=mf)
+                    mm = self._drift_mm
                 self._drift_cache[ctx_bucket] = (
-                    float(simulate_serve_step(arch, self.tp,
+                    float(simulate_serve_step(arch, self.tp, mm,
                                               lanes=self.mixed_width)),
-                    serve_step_breakdown(arch, self.tp,
+                    serve_step_breakdown(arch, self.tp, mm,
                                          lanes=self.mixed_width))
             except Exception:
                 self._drift_cache[ctx_bucket] = None

@@ -165,7 +165,9 @@ class Autoscaler:
                  down_patience: int = 4, cooldown_s: float = 0.0,
                  decode_table: Optional[Dict[int, float]] = None,
                  tensor_parallel: int = 1,
-                 decode_lanes: Optional[int] = None):
+                 decode_lanes: Optional[int] = None,
+                 mesh_table: Optional[Dict[Tuple[int, int],
+                                           dict]] = None):
         if min_replicas < 1 or max_replicas < min_replicas:
             raise ValueError(
                 f"need 1 <= min_replicas <= max_replicas, got "
@@ -192,6 +194,13 @@ class Autoscaler:
                 or min(decode_table.values())
             if step_s and decode_lanes:
                 self.capacity_tps = float(decode_lanes) / float(step_s)
+        # the 2-D mesh search's (t, r) price table
+        # (ServeMeshPlacement.table): when present, target pricing
+        # reads the searched pool-capacity column at THIS degree
+        # instead of extrapolating the 1-D decode table — scale
+        # decisions and placement agree on one price
+        self.tensor_parallel = int(tensor_parallel)
+        self.mesh_table = dict(mesh_table) if mesh_table else None
         self.events: List[dict] = []
         self._hot = 0
         self._cold = 0
@@ -202,7 +211,9 @@ class Autoscaler:
                     **kw) -> "Autoscaler":
         """Build from FFConfig's --slo-ttft-ms/--slo-tpot-ms/
         --autoscale-max knobs (max 0 = 2x serve_replicas)."""
-        n = int(getattr(config, "serve_replicas", 1))
+        sr = getattr(config, "serve_replicas", 1)
+        n = 1 if isinstance(sr, str) else int(sr)   # "auto": the pool
+        #   passes the searched count through max_replicas explicitly
         mx = int(getattr(config, "serve_autoscale_max", 0)) or 2 * n
         kw.setdefault("slo_ttft_s",
                       float(getattr(config, "slo_ttft_ms", 0.0)) / 1e3)
@@ -212,9 +223,29 @@ class Autoscaler:
         return cls(registry, **kw)
 
     def target_replicas(self, demand_tps: float) -> Optional[int]:
-        """Priced target count: windowed demand / per-replica
-        capacity (None when the decode table was not supplied)."""
-        if not self.capacity_tps or demand_tps <= 0:
+        """Priced target count. With a 2-D mesh table: the smallest
+        replica count whose searched (t, r) cell sustains the windowed
+        token demand at this pool's tensor degree (extrapolated from
+        the per-replica capacity past the priced grid). Otherwise the
+        1-D path: windowed demand / decode-table capacity. None when
+        no table was supplied."""
+        if demand_tps <= 0:
+            return None
+        if self.mesh_table:
+            rows = sorted(
+                (int(r), cell) for (t, r), cell in
+                self.mesh_table.items()
+                if int(t) == self.tensor_parallel
+                and float(cell.get("tokens_per_s", 0.0)) > 0)
+            if rows:
+                for r, cell in rows:
+                    if float(cell["tokens_per_s"]) >= demand_tps:
+                        return max(self.min_replicas, r)
+                r1, c1 = rows[0]
+                per = float(c1["tokens_per_s"]) / max(1, r1)
+                return max(self.min_replicas,
+                           math.ceil(demand_tps / per))
+        if not self.capacity_tps:
             return None
         return max(self.min_replicas,
                    math.ceil(demand_tps / self.capacity_tps))
@@ -303,6 +334,33 @@ class ReplicaPool:
         self.model = model
         cfg = config if config is not None else model.config
         self.config = cfg
+        engine_kwargs = dict(engine_kwargs or {})
+        # 2-D auto-placement (--serve-replicas auto, docs/search.md
+        # "2-D serve mesh"): ONE search prices tensor degree x replica
+        # count x torus-axis assignment over the device budget and the
+        # pool boots the searched (t, r) shape — an explicit
+        # --serve-mesh N pins the degree and only the count is
+        # searched; --serve-mesh auto lets the walk price both. The
+        # placement is stashed on self.mesh_placement (the autoscaler's
+        # target pricing and router_report read it).
+        self.mesh_placement = None
+        sr = getattr(cfg, "serve_replicas", 1)
+        if num_replicas is None and isinstance(sr, str) \
+                and sr.strip() == "auto":
+            import jax
+            from ..search.serve_place import optimize_serve_mesh
+            from .engine import probe_serve_arch
+            sm = str(getattr(cfg, "serve_mesh", "") or "").strip()
+            fixed_t = int(sm) if sm and sm != "auto" else None
+            if "tensor_parallel" in engine_kwargs:
+                fixed_t = int(engine_kwargs["tensor_parallel"])
+            place = optimize_serve_mesh(
+                probe_serve_arch(model, cfg), len(jax.devices()),
+                config=cfg, fixed_tensor=fixed_t)
+            self.mesh_placement = place
+            num_replicas = place.replicas
+            engine_kwargs.setdefault("tensor_parallel",
+                                     place.tensor_parallel)
         if num_replicas is None:
             num_replicas = int(getattr(cfg, "serve_replicas", 1))
         if num_replicas < 1:
@@ -784,6 +842,32 @@ class ReplicaPool:
                       host["recompute_chosen"])
         return host
 
+    def _mesh_block(self) -> Optional[dict]:
+        """The 2-D placement block of last_stats (--serve-replicas
+        auto): the chosen (t, r) cell with its priced goodput, every
+        rejected neighbor cell with ITS price, and the HBM-infeasible
+        degrees — the chosen-vs-rejected discipline router_report and
+        tools/explain.py render from. None on explicitly-sized
+        pools."""
+        p = self.mesh_placement
+        if p is None:
+            return None
+        cells = {}
+        for (t, r), cell in p.table.items():
+            cells[f"{t}x{r}"] = {
+                k: cell[k] for k in ("goodput_per_s", "tokens_per_s",
+                                     "tpot_s", "ttft_s")}
+        return {
+            "tensor_parallel": p.tensor_parallel,
+            "replicas": p.replicas,
+            "tensor_axis_dims": list(p.tensor_axis_dims),
+            "data_axis_dims": list(p.data_axis_dims),
+            "goodput_per_s": p.goodput_per_s,
+            "num_devices": p.num_devices,
+            "table": cells,
+            "infeasible": [dict(d) for d in p.infeasible],
+        }
+
     # ---------------- the serving loop ---------------------------------
     def _finalize(self, tracked: dict, t_end: float,
                   slo_ttft_s: Optional[float],
@@ -939,18 +1023,34 @@ class ReplicaPool:
         price = self.price_probe(64)
         eng = self.replicas[0].engine
         table = None
-        try:
-            from ..search.serve_place import optimize_serve
-            table = optimize_serve(eng.serve_arch(), max(1, eng.tp),
-                                   config=self.config).decode_by_degree
-        except Exception:
-            pass  # unpriceable arch: pure SLO/occupancy triggers
+        mesh_table = None
+        kw = {}
+        if self.mesh_placement is not None:
+            # the 2-D search already priced the full (t, r) grid —
+            # target pricing reads THAT table, so scale decisions and
+            # the booted placement agree on one price; the ceiling
+            # covers the searched count (2x, the from_config default
+            # shape)
+            mesh_table = self.mesh_placement.table
+            table = self.mesh_placement.decode_by_degree
+            kw["max_replicas"] = max(
+                2 * self.mesh_placement.replicas,
+                int(getattr(self.config, "serve_autoscale_max", 0)))
+        else:
+            try:
+                from ..search.serve_place import optimize_serve
+                table = optimize_serve(
+                    eng.serve_arch(), max(1, eng.tp),
+                    config=self.config).decode_by_degree
+            except Exception:
+                pass  # unpriceable arch: pure SLO/occupancy triggers
         return Autoscaler.from_config(
             self.config, self.metrics, interval_s=20.0 * price,
             cooldown_s=40.0 * price, decode_table=table,
+            mesh_table=mesh_table,
             tensor_parallel=max(1, eng.tp),
             decode_lanes=int(getattr(self.config, "serve_max_seqs",
-                                     8)))
+                                     8)), **kw)
 
     def _maybe_park(self, r: Replica) -> None:
         """A draining replica parks (warm, routable again on the next
@@ -1285,6 +1385,7 @@ class ReplicaPool:
             "routing": {k: self.stats[k] - stats0[k]
                         for k in self.stats},
             "host_tier": self._host_tier_block(),
+            "mesh_placement": self._mesh_block(),
             "scale_events": list(self.scale_events[events0:]),
             "per_replica": [
                 {"replica": r.idx, "live": r.live,
@@ -1592,6 +1693,7 @@ class ReplicaPool:
             "routing": {k: self.stats[k] - stats0[k]
                         for k in self.stats},
             "host_tier": self._host_tier_block(),
+            "mesh_placement": self._mesh_block(),
             "scale_events": list(self.scale_events[events0:]),
             "per_replica": [
                 {"replica": r.idx, "live": r.live,

@@ -356,6 +356,32 @@ def router_report(stats: dict, metrics=None) -> str:
         f"tpot<={slo_p*1e3 if slo_p else 0:.3f}ms; "
         f"{stats.get('completed', 0)} completed, "
         f"{stats.get('cancelled', 0)} cancelled)")
+    # the 2-D serve-mesh placement (--serve-replicas auto,
+    # search/serve_place.optimize_serve_mesh): the chosen (t, r) cell,
+    # its priced goodput, the best rejected neighbor cells WITH their
+    # prices, and the HBM-rejected degrees — the chosen-vs-rejected
+    # explain discipline applied to the pool shape
+    mp = stats.get("mesh_placement")
+    if mp:
+        lines.append(
+            f"2-D placement: t={mp['tensor_parallel']} x "
+            f"r={mp['replicas']} over {mp['num_devices']} devices "
+            f"(tensor dims {tuple(mp['tensor_axis_dims'])}, data dims "
+            f"{tuple(mp['data_axis_dims'])}), priced goodput "
+            f"{mp['goodput_per_s']:.1f} req/s")
+        chosen = f"{mp['tensor_parallel']}x{mp['replicas']}"
+        rej = sorted(
+            ((k, c) for k, c in (mp.get("table") or {}).items()
+             if k != chosen),
+            key=lambda kc: -kc[1].get("goodput_per_s", 0.0))
+        if rej:
+            lines.append("  rejected cells: " + ", ".join(
+                f"(t x r)={k} {c['goodput_per_s']:.1f} req/s, "
+                f"tpot {c['tpot_s']*1e3:.3f} ms"
+                for k, c in rej[:6]))
+        for d in mp.get("infeasible") or []:
+            lines.append(f"  infeasible: t={d['tensor']} "
+                         f"({d['reason']})")
     r = stats.get("routing") or {}
     lines.append(
         f"routing: {r.get('affinity_hits', 0)} affinity hits / "

@@ -1,0 +1,424 @@
+"""2-D (tensor x data) serve-mesh search (PR 20): ONE Metropolis walk
+prices tensor degree x replica count x torus-axis assignment into
+goodput-under-SLO, HBM-infeasible degrees rejected up front, rows
+persisted in the shared CostCache under the widened mesh fingerprint,
+and the searched (t, r) shape wired end to end — the pool boots it and
+the autoscaler's target pricing reads the searched table.
+
+Layers:
+  * search — determinism at one seed, feasibility rejection (a pool
+    that fits sharded but not unsharded), degenerate-baseline gains,
+    axis-assignment dedupe on square/cubic toruses.
+  * cache — disk round-trip of step rows + a guaranteed fingerprint
+    miss per folded field (kv dtype, adapter rank, SLO targets,
+    arrival rate).
+  * serving tier — --serve-replicas auto boots the searched shape
+    with token identity vs a reference engine; the autoscaler's
+    priced target reads the 2-D table (a rigged table flips the
+    decision); router_report renders chosen-vs-rejected cells.
+"""
+
+import dataclasses
+import warnings
+
+import pytest
+
+from flexflow_tpu.config import FFConfig
+from flexflow_tpu.search.cost_model import ServeArch, serve_device_bytes
+from flexflow_tpu.search.machine_model import (MachineSpec,
+                                               TPUMachineModel)
+from flexflow_tpu.search.serve_place import (DisaggPlacement,
+                                             MeshTraffic,
+                                             ServeMeshPlacement,
+                                             ServePlacement,
+                                             _mesh_fingerprint,
+                                             axis_assignments,
+                                             mesh_cell_metrics,
+                                             optimize_serve_mesh)
+
+
+# --------------------------------------------------------------- helpers
+def _arch(**over):
+    kw = dict(num_layers=2, hidden=64, num_heads=4, head_dim=16,
+              ff_dim=256, vocab=89, decode_lanes=4, prefill_lanes=32,
+              context=96, decode_tokens=8)
+    kw.update(over)
+    return ServeArch(**kw)
+
+
+def _traffic(**over):
+    kw = dict(arrival_rps=64.0, prefix_hit=0.5,
+              requests_per_preamble=8.0, slo_ttft_s=1.0,
+              slo_tpot_s=0.1)
+    kw.update(over)
+    return MeshTraffic(**kw)
+
+
+def _mm(**spec_over):
+    return TPUMachineModel(MachineSpec(**spec_over))
+
+
+# =======================================================================
+# axis assignments (satellite: square/cubic torus dedupe)
+# =======================================================================
+def test_axis_assignments_dedupe_cubic_torus():
+    mm = _mm(ici_torus_dims=(2, 2, 2))
+    # three symmetric (2,) runs and two (2, 2) runs collapse to one
+    assert axis_assignments(mm, 2) == [(), (2,)]
+    assert axis_assignments(mm, 4) == [(), (2, 2)]
+    assert axis_assignments(mm, 8) == [(), (2, 2, 2)]
+
+
+def test_axis_assignments_dedupe_square_torus():
+    mm = _mm(ici_torus_dims=(4, 4))
+    assert axis_assignments(mm, 4) == [(), (4,)]
+    assert axis_assignments(mm, 16) == [(), (4, 4)]
+    # asymmetric runs are NOT merged
+    mm2 = _mm(ici_torus_dims=(2, 4))
+    assert axis_assignments(mm2, 2) == [(), (2,)]
+    assert axis_assignments(mm2, 4) == [(), (4,)]
+    assert axis_assignments(mm2, 8) == [(), (2, 4)]
+
+
+# =======================================================================
+# report-ratio degradation (satellite: warn, never KeyError)
+# =======================================================================
+def test_speedup_vs_single_degrades_with_warning():
+    p = ServePlacement(tensor_parallel=2, axis_dims=(),
+                       decode_step_s=1e-3, prefill_step_s=2e-3,
+                       cost=1.5e-3, decode_by_degree={2: 1e-3})
+    with pytest.warns(RuntimeWarning, match="t=1 baseline"):
+        assert p.speedup_vs_single() == 1.0
+    full = dataclasses.replace(p, decode_by_degree={1: 2e-3, 2: 1e-3})
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert full.speedup_vs_single() == pytest.approx(2.0)
+
+
+def test_tpot_reduction_degrades_with_warning():
+    d = DisaggPlacement(prefill_engines=1, prefill_tensor=1,
+                        decode_engines=1, decode_tensor=1,
+                        decode_step_s=1e-3, prefill_step_s=2e-3,
+                        transfer_s=1e-4, bottleneck_s=2e-3,
+                        cost=3e-3, unified_tpot_s=0.0)
+    with pytest.warns(RuntimeWarning, match="unified"):
+        assert d.tpot_reduction_vs_unified() == 1.0
+    ok = dataclasses.replace(d, unified_tpot_s=2e-3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert ok.tpot_reduction_vs_unified() == pytest.approx(2.0)
+
+
+# =======================================================================
+# the 2-D search
+# =======================================================================
+def test_mesh_search_deterministic_at_one_seed():
+    arch = _arch()
+    a = optimize_serve_mesh(arch, 4, mm=_mm(), traffic=_traffic(),
+                            seed=3)
+    b = optimize_serve_mesh(arch, 4, mm=_mm(), traffic=_traffic(),
+                            seed=3)
+    assert (a.tensor_parallel, a.replicas, a.tensor_axis_dims,
+            a.data_axis_dims) == \
+        (b.tensor_parallel, b.replicas, b.tensor_axis_dims,
+         b.data_axis_dims)
+    assert a.table == b.table
+    assert a.cost == b.cost and a.goodput_per_s == b.goodput_per_s
+
+
+def test_mesh_table_complete_and_budgeted():
+    arch = _arch()
+    p = optimize_serve_mesh(arch, 4, mm=_mm(), traffic=_traffic())
+    # divisor degrees {1, 2, 4} x replica counts with t*r <= 4
+    assert set(p.table) == {(1, 1), (1, 2), (1, 3), (1, 4),
+                            (2, 1), (2, 2), (4, 1)}
+    assert p.tensor_parallel * p.replicas <= 4
+    assert set(p.decode_by_degree) == {1, 2, 4}
+    for (t, r), cell in p.table.items():
+        assert cell["tensor"] == t and cell["replicas"] == r
+        assert cell["tokens_per_s"] > 0
+    chosen = p.cell(p.tensor_parallel, p.replicas)
+    assert chosen is not None
+    assert p.goodput_per_s == chosen["goodput_per_s"]
+
+
+def test_mesh_objective_prefers_replicas_under_load():
+    """When one replica cannot sustain the arrival rate and every
+    degree fits HBM, the searched cell multiplies replicas instead of
+    burning the whole budget on tensor sharding."""
+    arch = _arch()
+    mm = _mm()
+    step = mesh_cell_metrics(
+        arch, 1, 1, 1e-3, 1e-3, 1e-3, _traffic())  # shape probe only
+    assert step["capacity_rps"] > 0
+    # arrival far above any single replica's capacity, SLOs loose
+    # enough that every cell passes: goodput == min(arrival, capacity)
+    # and capacity grows with r
+    t1 = optimize_serve_mesh(
+        arch, 4, mm=mm,
+        traffic=_traffic(arrival_rps=1e9, prefix_hit=0.0,
+                         slo_ttft_s=0.0, slo_tpot_s=0.0))
+    assert t1.replicas > 1
+    assert t1.goodput_gain_vs_tensor_only() > 1.0
+
+
+def test_mesh_feasibility_rejection_adapter_pool():
+    """The acceptance geometry: an adapter pool that fits at t=4 but
+    not at t=1 — the unsharded degree is REJECTED (recorded with its
+    residency), never priced into the table, and the winner shards."""
+    arch = _arch(adapter_rank=8, adapter_slots=4)
+    b1 = serve_device_bytes(arch, 1)
+    b4 = serve_device_bytes(arch, 4)
+    assert b4 < b1
+    mm = _mm(hbm_capacity=(b4 + b1) / 2.0)
+    p = optimize_serve_mesh(arch, 4, mm=mm, traffic=_traffic())
+    assert [d["tensor"] for d in p.infeasible] == [1]
+    assert "HBM" in p.infeasible[0]["reason"]
+    assert p.infeasible[0]["device_bytes"] == pytest.approx(b1)
+    assert all(t != 1 for (t, _r) in p.table)
+    assert p.tensor_parallel > 1
+    # the rejection IS the replicas-only baseline's loss
+    assert p.goodput_gain_vs_replicas_only() > 1e6
+
+
+def test_mesh_search_nothing_fits_raises():
+    arch = _arch()
+    mm = _mm(hbm_capacity=1.0)   # one byte: nothing fits
+    with pytest.raises(ValueError, match="no tensor degree fits"):
+        optimize_serve_mesh(arch, 4, mm=mm, traffic=_traffic())
+
+
+def test_mesh_fixed_dimensions():
+    arch = _arch()
+    p = optimize_serve_mesh(arch, 4, mm=_mm(), traffic=_traffic(),
+                            fixed_tensor=2)
+    assert p.tensor_parallel == 2
+    assert set(p.table) == {(2, 1), (2, 2)}
+    q = optimize_serve_mesh(arch, 4, mm=_mm(), traffic=_traffic(),
+                            fixed_replicas=2)
+    assert q.replicas == 2
+    assert set(q.table) == {(1, 2), (2, 2)}
+    with pytest.raises(ValueError, match="not a feasible degree"):
+        optimize_serve_mesh(arch, 4, mm=_mm(), fixed_tensor=3)
+
+
+# =======================================================================
+# cost-cache round-trip + fingerprint misses
+# =======================================================================
+def test_mesh_cache_roundtrip_on_disk(tmp_path, monkeypatch):
+    from flexflow_tpu.search import serve_place
+    from flexflow_tpu.search.cost_cache import CostCache
+
+    path = str(tmp_path / "mesh_cache.json")
+    cfg = FFConfig(batch_size=1, cost_cache_file=path,
+                   search_trace=False)
+    arch = _arch()
+    traffic = _traffic()
+    mm = _mm()
+    p1 = optimize_serve_mesh(arch, 4, mm=mm, config=cfg,
+                             traffic=traffic)
+
+    # the rows survive on DISK: a fresh store (not the process-shared
+    # instance) must return the winner's step row under the mesh
+    # fingerprint + full arch signature
+    fresh = CostCache(path)
+    key = fresh.entry_key(
+        "serve_mesh_step",
+        (p1.tensor_parallel, tuple(p1.tensor_axis_dims)),
+        extra=arch.signature())
+    row = fresh.get(p1.fingerprint, key)
+    assert row is not None
+    assert row.fwd == pytest.approx(p1.decode_step_s)
+    assert row.bwd == pytest.approx(p1.prefill_step_s)
+    assert row.fwd_comm == pytest.approx(p1.mixed_step_s)
+
+    # a second identical search never re-simulates — every step price
+    # is a cache hit
+    def _boom(*a, **kw):
+        raise AssertionError("cache miss: simulate_serve_step called")
+    monkeypatch.setattr(serve_place, "simulate_serve_step", _boom)
+    p2 = optimize_serve_mesh(arch, 4, mm=mm, config=cfg,
+                             traffic=traffic)
+    assert p2.table == p1.table
+    assert (p2.tensor_parallel, p2.replicas) == \
+        (p1.tensor_parallel, p1.replicas)
+
+
+def test_mesh_fingerprint_misses_per_folded_field():
+    """Every folded field flips the fingerprint: kv dtype, adapter
+    rank, and EACH traffic/SLO knob — rows can never resurrect across
+    a flip (the guaranteed-miss acceptance criterion)."""
+    mm = _mm()
+    base_arch = _arch()
+    base_tr = _traffic()
+    fps = {
+        "base": _mesh_fingerprint(mm, base_arch, base_tr),
+        "kv_dtype": _mesh_fingerprint(
+            mm, _arch(kv_dtype="int8", kv_itemsize=1.0,
+                      kv_scales=True), base_tr),
+        "adapter_rank": _mesh_fingerprint(
+            mm, _arch(adapter_rank=8, adapter_slots=4), base_tr),
+        "slo_ttft": _mesh_fingerprint(
+            mm, base_arch, _traffic(slo_ttft_s=2.0)),
+        "slo_tpot": _mesh_fingerprint(
+            mm, base_arch, _traffic(slo_tpot_s=0.2)),
+        "arrival": _mesh_fingerprint(
+            mm, base_arch, _traffic(arrival_rps=128.0)),
+        "prefix_hit": _mesh_fingerprint(
+            mm, base_arch, _traffic(prefix_hit=0.25)),
+    }
+    vals = list(fps.values())
+    assert len(set(vals)) == len(vals), fps
+    # and searches report the fingerprint they cached under
+    p = optimize_serve_mesh(base_arch, 2, mm=mm, traffic=base_tr)
+    assert p.fingerprint in ("", fps["base"])
+
+
+def test_mesh_traffic_from_config():
+    cfg = FFConfig(batch_size=1, slo_ttft_ms=50.0, slo_tpot_ms=5.0)
+    tr = MeshTraffic.from_config(cfg, arrival_rps=10.0)
+    assert tr.slo_ttft_s == pytest.approx(0.05)
+    assert tr.slo_tpot_s == pytest.approx(0.005)
+    assert tr.arrival_rps == 10.0
+
+
+# =======================================================================
+# serving-tier wiring
+# =======================================================================
+def _lm(**cfg_kw):
+    from flexflow_tpu.models.transformer import build_transformer_lm
+    cfg = FFConfig(batch_size=1, kv_page_size=4, kv_num_pages=49,
+                   serve_max_seqs=4, serve_prefill_budget=8,
+                   serve_spec_decode=False, **cfg_kw)
+    return build_transformer_lm(cfg, vocab_size=61, max_seq_len=96,
+                                hidden=32, num_heads=4, num_layers=2,
+                                ff_dim=72)
+
+
+def test_serve_replicas_auto_config_and_cli():
+    cfg = FFConfig(batch_size=1, serve_replicas="auto")
+    assert cfg.serve_replicas == "auto"
+    cfg2 = FFConfig(batch_size=1,
+                    argv=["--serve-replicas", "auto"])
+    assert cfg2.serve_replicas == "auto"
+    cfg2.parse_args(["--serve-replicas", "2"])
+    assert cfg2.serve_replicas == 2
+    with pytest.raises(ValueError, match="serve_replicas"):
+        FFConfig(batch_size=1, serve_replicas="many")
+    with pytest.raises(ValueError, match="serve_replicas"):
+        FFConfig(batch_size=1, serve_replicas=0)
+
+
+def test_pool_boots_searched_placement_token_identity():
+    """--serve-replicas auto: the pool resolves (t, r) through the
+    2-D search, boots exactly that shape, and every completed request
+    is token-identical to a single reference engine."""
+    from flexflow_tpu.serve import ReplicaPool, ServeEngine
+    from flexflow_tpu.serve.traffic import TrafficSpec, make_traffic
+
+    ff = _lm(serve_replicas="auto")
+    pool = ReplicaPool(ff)
+    p = pool.mesh_placement
+    assert isinstance(p, ServeMeshPlacement)
+    assert len(pool.replicas) == p.replicas
+    assert all(r.engine.tp == p.tensor_parallel
+               for r in pool.replicas)
+    traffic = make_traffic(TrafficSpec(
+        requests=8, seed=4, rate_rps=2000.0, tenants=2,
+        prefix_tokens=16, max_prompt=48, max_new_cap=6,
+        sample_frac=0.25, top_k=4, vocab=61))
+    res = pool.run(traffic, slo_ttft_s=1.0, slo_tpot_s=1.0,
+                   sample_seed=0)
+    pool.assert_zero_recompiles()
+    pool.check_drained()
+    assert res["mesh_placement"]["replicas"] == p.replicas
+    eng = ServeEngine(ff)
+    eng.warmup()
+    ref = eng.generate([t.prompt for t in traffic],
+                       [t.max_new for t in traffic],
+                       temperature=[t.temperature for t in traffic],
+                       top_k=[t.top_k for t in traffic],
+                       sample_seed=0,
+                       stream_ids=[t.stream_id for t in traffic])
+    for rec, r in zip(res["requests"], ref):
+        if rec["outcome"] == "completed":
+            assert rec["tokens"] == r
+        else:
+            assert rec["tokens"] == r[:len(rec["tokens"])]
+    # the default autoscaler prices targets off the searched table
+    scaler = pool._default_autoscaler()
+    assert scaler.mesh_table == p.table
+    pool.close()
+
+
+def test_pool_explicit_replicas_unchanged():
+    from flexflow_tpu.serve import ReplicaPool
+    ff = _lm(serve_replicas=2)
+    pool = ReplicaPool(ff)
+    assert pool.mesh_placement is None
+    assert len(pool.replicas) == 2
+    assert pool.last_stats is None
+    pool.close()
+
+
+def test_autoscaler_target_reads_mesh_table_rigged():
+    """The regression the acceptance criteria name: two autoscalers
+    see IDENTICAL gauges; only the (t, r) table differs, and the
+    rigged table flips the scale-up decision — proof the priced
+    target reads the searched 2-D table, not the 1-D decode table."""
+    from flexflow_tpu.serve import Autoscaler
+    from flexflow_tpu.utils.telemetry import MetricsRegistry
+
+    # 1-D table says one replica carries 1000 tok/s (no scale-up at
+    # demand 500); the rigged mesh table prices a replica at only
+    # 100 tok/s (target 5 > 1 live -> scale up)
+    decode_table = {1: 0.004}        # 4 lanes / 4ms = 1000 tok/s
+    weak_cells = {(1, r): {"tokens_per_s": 100.0 * r}
+                  for r in range(1, 9)}
+    strong_cells = {(1, r): {"tokens_per_s": 1000.0 * r}
+                    for r in range(1, 9)}
+
+    def run(mesh_table):
+        m = MetricsRegistry()
+        m.set("serve_pool_replicas_live", 1.0)
+        m.set("serve_pool_decode_tokens_per_s_window", 500.0)
+        m.set("serve_pool_occupancy_mean", 0.5)
+        m.set("serve_pool_queue_depth", 0.0)
+        a = Autoscaler(m, min_replicas=1, max_replicas=8,
+                       interval_s=1.0, up_patience=1,
+                       decode_table=decode_table, tensor_parallel=1,
+                       decode_lanes=4, mesh_table=mesh_table)
+        assert a.target_replicas(500.0) == (5 if mesh_table
+                                            is weak_cells else 1)
+        return a.evaluate(t_now=10.0)
+
+    assert run(None) is None                      # 1-D: no pressure
+    assert run(strong_cells) is None              # 2-D, same price
+    decision = run(weak_cells)                    # rigged: flips
+    assert decision is not None and decision["direction"] == "up"
+    assert "priced target" in decision["reason"]
+
+
+def test_router_report_renders_mesh_placement():
+    from flexflow_tpu.utils.profiling import router_report
+    stats = {
+        "policy": "affinity", "requests": [], "makespan_s": 1.0,
+        "goodput_per_s": 5.0,
+        "mesh_placement": {
+            "tensor_parallel": 2, "replicas": 2,
+            "tensor_axis_dims": [2], "data_axis_dims": [],
+            "goodput_per_s": 40.0, "num_devices": 4,
+            "table": {
+                "2x2": {"goodput_per_s": 40.0, "tokens_per_s": 900.0,
+                        "tpot_s": 0.002, "ttft_s": 0.01},
+                "4x1": {"goodput_per_s": 20.0, "tokens_per_s": 700.0,
+                        "tpot_s": 0.001, "ttft_s": 0.02}},
+            "infeasible": [{"tensor": 1,
+                            "reason": "per-device residency 10.0 MiB "
+                                      "> HBM 5.0 MiB"}],
+        }}
+    text = router_report(stats)
+    assert "2-D placement: t=2 x r=2" in text
+    assert "priced goodput 40.0 req/s" in text
+    assert "(t x r)=4x1 20.0 req/s" in text      # rejected WITH price
+    assert "infeasible: t=1" in text

@@ -231,6 +231,22 @@ echo "--- 1s. warm replica boot smoke (AOT program-cache gate)"
 env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload boot \
     -o /tmp/ci_bench_serve_boot.json || fail=1
 
+echo "--- 1t. 2-D serve-mesh placement smoke (search-vs-degenerate gate)"
+# the 2-D placement search (search/serve_place.optimize_serve_mesh,
+# docs/search.md "2-D serve mesh"): ONE walk prices tensor degree x
+# replica count x HBM residency into goodput-under-SLO, and a pool
+# booted from the searched (t, r) must beat BOTH degenerate
+# allocations of the same 4-device budget — best tp-only (r=1,
+# arrivals queue past the TTFT SLO) and best replicas-only (t=1, the
+# model over-fills one device's HBM so every step pays the reference
+# 1ms/MB penalty and blows TPOT; the search rejects t=1 up front,
+# never pricing it) — by >= 1.3x, with shared-prefix tenants + the
+# armed LoRA adapter pool, token identity vs one reference engine,
+# and zero recompiles after warmup
+# (tools/serve_bench.py --workload mesh2d)
+env JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke --workload mesh2d \
+    -o /tmp/ci_bench_serve_mesh2d.json || fail=1
+
 if [ "$FULL" = "--full" ]; then
   echo "--- 1b. slow remainder (-m slow)"
   python -m pytest tests/ -q -m slow --continue-on-collection-errors 2>&1 \

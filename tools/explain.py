@@ -292,13 +292,52 @@ def main() -> int:
             print(f"walk: {place.trace['proposals']} proposals at "
                   f"{place.trace['acceptance_rate']:.1%} acceptance, "
                   f"{place.trace['improvements']} improvements")
+        # the 2-D (tensor x data) pool placement over the same budget
+        # (search/serve_place.optimize_serve_mesh, docs/search.md
+        # "2-D serve mesh"): chosen cell, priced goodput, and every
+        # rejected neighbor cell WITH its price — the same
+        # chosen-vs-rejected discipline as the training explain
+        from flexflow_tpu.search.serve_place import (MeshTraffic,
+                                                     optimize_serve_mesh)
+        # a 16-chip budget: the demo model over-fills one device's
+        # HBM up through t=4, so the low degrees render as REJECTED
+        # (with their residency) and only the sharded cells are priced
+        mesh = optimize_serve_mesh(
+            arch, 16, seed=args.seed,
+            traffic=MeshTraffic(arrival_rps=0.2, prefix_hit=0.5,
+                                slo_tpot_s=0.6, slo_ttft_s=120.0))
+        print(f"2-D pool placement: t={mesh.tensor_parallel} x "
+              f"r={mesh.replicas} over {mesh.num_devices} devices, "
+              f"priced goodput {mesh.goodput_per_s:.1f} req/s "
+              f"(tpot {mesh.mixed_step_s*1e3:.3f} ms)")
+        chosen = (mesh.tensor_parallel, mesh.replicas)
+        rejected = sorted(
+            (k for k in mesh.table if k != chosen),
+            key=lambda k: -mesh.table[k]["goodput_per_s"])
+        if rejected:
+            print("  rejected cells: " + ", ".join(
+                f"t{t}xr{r} @ "
+                f"{mesh.table[(t, r)]['goodput_per_s']:.1f}/s"
+                for t, r in rejected))
+        for d in mesh.infeasible:
+            print(f"  infeasible: t={d['tensor']} ({d['reason']})")
         out = {"placement": {
             "tensor_parallel": place.tensor_parallel,
             "axis_dims": list(place.axis_dims),
             "decode_step_s": place.decode_step_s,
             "prefill_step_s": place.prefill_step_s,
             "decode_by_degree": place.decode_by_degree,
-            "breakdown_s": bd, "trace": place.trace}}
+            "breakdown_s": bd, "trace": place.trace},
+            "mesh_placement": {
+                "tensor_parallel": mesh.tensor_parallel,
+                "replicas": mesh.replicas,
+                "tensor_axis_dims": list(mesh.tensor_axis_dims),
+                "data_axis_dims": list(mesh.data_axis_dims),
+                "goodput_per_s": mesh.goodput_per_s,
+                "table": {f"{t}x{r}": c
+                          for (t, r), c in mesh.table.items()},
+                "infeasible": list(mesh.infeasible),
+                "traffic": mesh.traffic, "trace": mesh.trace}}
         if args.trace:
             out["schedule_trace"] = export_serve_schedule(
                 arch, place.tensor_parallel, args.trace,

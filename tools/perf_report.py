@@ -185,6 +185,8 @@ def serve_line() -> str:
              "{v:.1f}x host-tier goodput vs eviction"),
             ("serve_boot_warm_speedup",
              "{v:.1f}x warm replica boot"),
+            ("serve_mesh2d_goodput_gain",
+             "{v:.1f}x 2-D mesh goodput vs best 1-D"),
         )
         for key, fmt in pieces:
             r = recs.get(key)
@@ -211,6 +213,18 @@ def serve_line() -> str:
                     f"{e['warm_ready_s']:.2f}s, "
                     f"{int(e.get('programs_restored', 0))} programs "
                     f"restored)")
+        # the 2-D mesh record's searched shape (serve_bench
+        # --workload mesh2d): which (t, r) the walk picked
+        mesh = recs.get("serve_mesh2d_goodput_gain")
+        if mesh is not None:
+            e = mesh.get("extra", {})
+            idx = [i for i, p in enumerate(parts)
+                   if "2-D mesh goodput" in p]
+            if idx and "searched_tensor" in e:
+                parts[idx[0]] += (
+                    f" (t={int(e['searched_tensor'])} x "
+                    f"r={int(e['searched_replicas'])} over "
+                    f"{int(e.get('devices', 0))} devices)")
         # SLO attainment from the EXPORTED pool registry gauge the
         # router workload recorded (serve_pool_slo_attainment — not an
         # ad-hoc stat string), and the worst simulator drift ratio
